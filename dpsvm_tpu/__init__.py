@@ -1,0 +1,40 @@
+"""dpsvm_tpu — a TPU-native distributed SVM training framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of DPSVM (a CUDA +
+OpenMPI distributed trainer for binary C-SVC via the modified-SMO algorithm;
+reference: svmTrainMain.cpp / svmTrain.cu / seq.cpp in aung2phyowai/dpsvm).
+
+Key differences from the reference (by design, TPU-first):
+
+* The entire SMO iteration — working-set selection, kernel-row evaluation,
+  alpha update and gradient (f) update — is a single ``jax.jit``-compiled
+  ``lax.while_loop`` body on device; there is no per-iteration host
+  round-trip (the reference syncs to the host every iteration).
+* Distribution uses a ``jax.sharding.Mesh`` + ``shard_map`` over a ``data``
+  axis with XLA collectives over ICI; the reference's per-iteration
+  ``MPI_Allgather`` of working-set candidates becomes an ``all_gather`` of
+  (value, index) pairs inside the compiled step.
+* The training matrix X is fully row-sharded across devices (the reference
+  replicates X on every GPU); working-set rows are recovered with a masked
+  ``psum`` — memory scales with device count.
+* The kernel-row LRU cache (reference: cache.cu) is a static-shape HBM
+  array with functional (pure) bookkeeping, so it lives inside the jitted
+  loop.
+"""
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.train import train
+from dpsvm_tpu.predict import decision_function, predict, accuracy
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SVMConfig",
+    "SVMModel",
+    "train",
+    "decision_function",
+    "predict",
+    "accuracy",
+    "__version__",
+]

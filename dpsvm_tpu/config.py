@@ -1,0 +1,78 @@
+"""Typed training configuration.
+
+Replaces the reference's global mutable ``state_model state`` singleton
+(svmTrainMain.hpp:4-19, svmTrainMain.cpp:60-136) with an immutable dataclass.
+Flag names and defaults match the reference CLI (svmTrainMain.cpp:22-71)
+except for documented bug fixes:
+
+* default gamma is ``1.0 / num_features`` computed in float (the reference
+  computes ``1 / num_attributes`` in integer arithmetic, giving gamma == 0
+  for d > 1 — bug B1, svmTrainMain.cpp:133).
+* eta (second-derivative of the 2-var subproblem) is clamped to ``tau``
+  before division (the reference divides unguarded — bug B2,
+  svmTrainMain.cpp:290).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+KERNELS = ("rbf", "linear", "poly", "sigmoid")
+
+
+@dataclasses.dataclass(frozen=True)
+class SVMConfig:
+    """Hyper-parameters and runtime knobs for SMO training.
+
+    Attributes mirroring reference CLI flags (svmTrainMain.cpp:46-58):
+      c          -- -c/--cost       (default 1)
+      gamma      -- -g/--gamma      (default None -> 1/num_features)
+      epsilon    -- -e/--epsilon    (default 0.001)
+      max_iter   -- -n/--max-iter   (default 150_000)
+      cache_lines-- -s/--cache-size (default 256 lines here; the reference
+                    default of 10 (svmTrainMain.cpp:71) is far too small for
+                    the MXU-backed row evaluator, where a miss costs a full
+                    pass over X in HBM)
+    """
+
+    c: float = 1.0
+    gamma: Optional[float] = None
+    epsilon: float = 1e-3
+    max_iter: int = 150_000
+    cache_lines: int = 256
+
+    # Kernel family. The reference hardcodes RBF (svmTrain.cu:696-714);
+    # linear/poly/sigmoid are capability extensions sharing the same
+    # dot-product row machinery.
+    kernel: str = "rbf"
+    degree: int = 3
+    coef0: float = 0.0
+
+    # Numerics / runtime knobs (no reference equivalent).
+    tau: float = 1e-12  # eta clamp (LibSVM-style guard, fixes bug B2)
+    dtype: str = "float32"  # storage dtype for X ("float32" | "bfloat16")
+    chunk_iters: int = 2048  # SMO iterations per on-device while_loop dispatch
+    checkpoint_every: int = 0  # iterations between solver checkpoints; 0 = off
+    verbose: bool = False
+
+    def resolve_gamma(self, num_features: int) -> float:
+        """Default gamma = 1/d computed in float (fixes reference bug B1)."""
+        if self.gamma is not None:
+            return float(self.gamma)
+        return 1.0 / float(num_features)
+
+    def __post_init__(self):
+        if self.kernel not in KERNELS:
+            raise ValueError(f"unknown kernel {self.kernel!r}; expected one of {KERNELS}")
+        if self.c <= 0:
+            raise ValueError("c must be > 0")
+        if self.epsilon <= 0:
+            raise ValueError("epsilon must be > 0")
+        if self.cache_lines < 0:
+            raise ValueError("cache_lines must be >= 0")
+        if self.dtype not in ("float32", "bfloat16"):
+            raise ValueError("dtype must be 'float32' or 'bfloat16'")
+
+    def replace(self, **kw) -> "SVMConfig":
+        return dataclasses.replace(self, **kw)

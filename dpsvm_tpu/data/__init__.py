@@ -1,0 +1,4 @@
+from dpsvm_tpu.data.loader import load_csv, save_csv
+from dpsvm_tpu.data.synth import make_blobs_binary, make_mnist_like
+
+__all__ = ["load_csv", "save_csv", "make_blobs_binary", "make_mnist_like"]

@@ -1,0 +1,61 @@
+"""CSV data IO in the reference's format: ``label,f1,f2,...,fd`` per line.
+
+Reference loader: parse.cpp:10-43 (C++ getline/stoi/stof into a flat
+row-major float vector). Here the hot path is a native C++ parser
+(native/fastcsv.cpp) loaded through ctypes, with a NumPy fallback; both
+honour the same format and the reference's convention that the CLI-declared
+(n, d) bound how much is read. Unlike the reference we can also infer the
+shape from the file (SURVEY.md section 5.6 lists shape inference as an
+intended improvement).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dpsvm_tpu.utils import native
+
+
+def load_csv(
+    path: str,
+    num_rows: int | None = None,
+    num_features: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Load ``label,f1,...,fd`` CSV -> (x (n,d) float32, y (n,) int32).
+
+    num_rows / num_features, when given, must match or bound the file
+    contents (the reference requires both and reads exactly num_rows lines,
+    parse.cpp:25); when omitted they are inferred.
+    """
+    parser = native.get_fastcsv()
+    if parser is not None:
+        x, y = parser.parse(path, num_rows)
+    else:
+        x, y = _load_csv_numpy(path, num_rows)
+    if num_features is not None:
+        if x.shape[1] < num_features:
+            raise ValueError(
+                f"{path}: file has {x.shape[1]} features, expected {num_features}")
+        x = x[:, :num_features]
+    if num_rows is not None and x.shape[0] < num_rows:
+        raise ValueError(f"{path}: file has {x.shape[0]} rows, expected {num_rows}")
+    return np.ascontiguousarray(x, np.float32), y.astype(np.int32)
+
+
+def _load_csv_numpy(path: str, num_rows: int | None):
+    data = np.loadtxt(path, delimiter=",", dtype=np.float32,
+                      max_rows=num_rows, ndmin=2)
+    if data.size == 0:
+        raise ValueError(f"{path}: empty data file")
+    y = data[:, 0].astype(np.int32)
+    x = data[:, 1:]
+    return x, y
+
+
+def save_csv(path: str, x: np.ndarray, y: np.ndarray) -> None:
+    """Write the same ``label,f1,...,fd`` format (for tests / converters)."""
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y)
+    with open(path, "w") as fh:
+        for i in range(x.shape[0]):
+            fh.write(f"{int(y[i])}," + ",".join(repr(float(v)) for v in x[i]) + "\n")

@@ -1,0 +1,3 @@
+from dpsvm_tpu.models.svm_model import SVMModel
+
+__all__ = ["SVMModel"]

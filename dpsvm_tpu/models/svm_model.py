@@ -1,0 +1,145 @@
+"""Trained-model container and serialization.
+
+The reference's model artifact (written by write_out_model,
+svmTrainMain.cpp:386-416) is a text file:
+
+    line 1:  gamma
+    line 2:  b                      (distributed writer only)
+    line 3+: alpha_i,y_i,x_i1,...,x_id   for every alpha_i != 0
+
+with three format skews between its writers/readers (SURVEY.md bug B6:
+seq.cpp:302 omits b; seq_test.cpp:267 assumes a 1-line header;
+seq_test.cpp:197 ignores b at predict time). This module defines ONE
+canonical behavior:
+
+* ``save``/``load`` with a ``.txt`` path speak the distributed writer's
+  2-line-header text format (gamma, b, then SV rows) and tolerate the seq
+  writer's 1-line header on load, so models written by the reference can be
+  consumed here.
+* ``save``/``load`` with ``.npz`` use a richer binary format that also
+  round-trips kernel family/degree/coef0 (the text format can only express
+  RBF).
+* The decision function is the standard modified-SMO convention
+  f(q) = sum_j alpha_j y_j K(x_j, q) - b with b = (b_lo + b_hi)/2 —
+  matching the reference trainer's own accuracy check (svmTrain.cu:652),
+  resolving bug B5 in favor of the standard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from dpsvm_tpu.ops.kernels import KernelParams
+
+
+@dataclasses.dataclass
+class SVMModel:
+    sv_x: np.ndarray  # (n_sv, d) support vectors
+    sv_alpha: np.ndarray  # (n_sv,) alpha_i > 0
+    sv_y: np.ndarray  # (n_sv,) labels in {-1, +1}
+    b: float
+    kernel: KernelParams
+
+    @property
+    def n_sv(self) -> int:
+        return int(self.sv_x.shape[0])
+
+    @property
+    def num_features(self) -> int:
+        return int(self.sv_x.shape[1])
+
+    @property
+    def dual_coef(self) -> np.ndarray:
+        """alpha_j * y_j, the weights of the decision sum."""
+        return (self.sv_alpha * self.sv_y).astype(np.float32)
+
+    @classmethod
+    def from_dense(cls, x, y, alpha, b, kernel: KernelParams) -> "SVMModel":
+        """Extract support vectors (alpha > 0) from full training arrays.
+
+        Equivalent of aggregate_sv (svmTrain.cu:595-627: thrust::remove_if
+        on alpha <= 0 + host-side row gather).
+        """
+        alpha = np.asarray(alpha, np.float32)
+        mask = alpha > 0
+        return cls(
+            sv_x=np.ascontiguousarray(np.asarray(x)[mask], np.float32),
+            sv_alpha=alpha[mask],
+            sv_y=np.asarray(y, np.int32)[mask],
+            b=float(b),
+            kernel=kernel,
+        )
+
+    # ------------------------------------------------------------------ io
+    def save(self, path: str) -> None:
+        if path.endswith(".npz"):
+            np.savez_compressed(
+                path,
+                format_version=1,
+                sv_x=self.sv_x,
+                sv_alpha=self.sv_alpha,
+                sv_y=self.sv_y,
+                b=np.float32(self.b),
+                kernel_kind=self.kernel.kind,
+                gamma=np.float32(self.kernel.gamma),
+                degree=np.int32(self.kernel.degree),
+                coef0=np.float32(self.kernel.coef0),
+            )
+            return
+        if self.kernel.kind != "rbf":
+            raise ValueError(
+                "the text model format only expresses RBF (reference format, "
+                "svmTrainMain.cpp:386-416); save non-RBF models to .npz")
+        with open(path, "w") as fh:
+            fh.write(f"{self.kernel.gamma}\n")
+            fh.write(f"{self.b}\n")
+            for i in range(self.n_sv):
+                row = ",".join(repr(float(v)) for v in self.sv_x[i])
+                fh.write(f"{float(self.sv_alpha[i])!r},{int(self.sv_y[i])},{row}\n")
+
+    @classmethod
+    def load(cls, path: str) -> "SVMModel":
+        if path.endswith(".npz"):
+            z = np.load(path, allow_pickle=False)
+            return cls(
+                sv_x=z["sv_x"].astype(np.float32),
+                sv_alpha=z["sv_alpha"].astype(np.float32),
+                sv_y=z["sv_y"].astype(np.int32),
+                b=float(z["b"]),
+                kernel=KernelParams(
+                    kind=str(z["kernel_kind"]),
+                    gamma=float(z["gamma"]),
+                    degree=int(z["degree"]),
+                    coef0=float(z["coef0"]),
+                ),
+            )
+        return cls._load_text(path)
+
+    @classmethod
+    def _load_text(cls, path: str) -> "SVMModel":
+        with open(path) as fh:
+            lines = [ln.strip() for ln in fh if ln.strip()]
+        if len(lines) < 2:
+            raise ValueError(f"{path}: not a model file")
+        gamma = float(lines[0])
+        # 2-line header (distributed writer) vs 1-line header (seq writer):
+        # an SV row has >= 3 comma-separated fields, a b line exactly one.
+        if "," in lines[1]:
+            b, first_sv = 0.0, 1
+        else:
+            b, first_sv = float(lines[1]), 2
+        alphas, ys, xs = [], [], []
+        for ln in lines[first_sv:]:
+            parts = ln.split(",")
+            alphas.append(float(parts[0]))
+            ys.append(int(float(parts[1])))
+            xs.append([float(v) for v in parts[2:]])
+        return cls(
+            sv_x=np.asarray(xs, np.float32),
+            sv_alpha=np.asarray(alphas, np.float32),
+            sv_y=np.asarray(ys, np.int32),
+            b=b,
+            kernel=KernelParams(kind="rbf", gamma=gamma),
+        )

@@ -1,0 +1,21 @@
+from dpsvm_tpu.ops.kernels import (
+    KernelParams,
+    row_dots,
+    kernel_from_dots,
+    kernel_rows,
+    kernel_matrix,
+    squared_norms,
+)
+from dpsvm_tpu.ops.select import select_working_set, up_mask, low_mask
+
+__all__ = [
+    "KernelParams",
+    "row_dots",
+    "kernel_from_dots",
+    "kernel_rows",
+    "kernel_matrix",
+    "squared_norms",
+    "select_working_set",
+    "up_mask",
+    "low_mask",
+]

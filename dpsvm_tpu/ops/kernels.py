@@ -1,0 +1,124 @@
+"""Kernel (Gram) evaluation primitives, MXU-first.
+
+The reference evaluates kernel rows two ways:
+  * device: cuBLAS sgemv X . x_i producing a dot-product row, then rebuilds
+    the RBF value per element as exp(-gamma (|x_i|^2 + |x_j|^2 - 2 dot))
+    inside the f-update functor (svmTrain.cu:222,247 and :128-135);
+  * host: CBLAS saxpy + snrm2 per pair (svmTrain.cu:696-714, seq.cpp:398-415).
+
+Here every kernel family is derived from dot products (plus cached squared
+norms for RBF), so the dot-product row is the one cached/communicated
+quantity — exactly the property the reference's cache exploits (cache.cu
+stores dot rows, not exp'd rows). Dots are computed on the MXU via jnp.dot
+with float32 accumulation; storage dtype of X may be bfloat16.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelParams:
+    """Static kernel parameters (hashable -> usable as a jit static arg)."""
+
+    kind: str = "rbf"  # rbf | linear | poly | sigmoid
+    gamma: float = 1.0
+    degree: int = 3
+    coef0: float = 0.0
+
+
+def squared_norms(x: jax.Array) -> jax.Array:
+    """Per-row |x_i|^2, shape (n,).
+
+    The reference computes these once at setup with n sequential
+    thrust::inner_product launches (svmTrain.cu:361-364); here it is one
+    fused reduction.
+    """
+    xf = x.astype(jnp.float32)
+    return jnp.einsum("nd,nd->n", xf, xf)
+
+
+def row_dots(x: jax.Array, q: jax.Array) -> jax.Array:
+    """Dot-product rows X . q^T on the MXU.
+
+    x: (n, d) data matrix (any float dtype); q: (k, d) or (d,) query rows.
+    Returns float32 (k, n) or (n,). Equivalent of the reference's
+    cublasSgemv row evaluation (svmTrain.cu:222,247) but batched so hi/lo
+    rows share one pass over X.
+    """
+    squeeze = q.ndim == 1
+    q2 = jnp.atleast_2d(q).astype(x.dtype)
+    out = jnp.dot(q2, x.T, preferred_element_type=jnp.float32)
+    return out[0] if squeeze else out
+
+
+def kernel_from_dots(
+    dots: jax.Array,
+    x_sq: jax.Array,
+    q_sq: jax.Array,
+    params: KernelParams,
+) -> jax.Array:
+    """Turn dot-product rows into kernel rows.
+
+    dots: (..., n) dot rows; x_sq: (n,) squared norms of the data rows;
+    q_sq: (...,) squared norms of the query rows (ignored except for rbf).
+    RBF matches the reference's update_functor algebra
+    exp(-gamma (x_sq + q_sq - 2 dot)) (svmTrain.cu:128-135).
+    """
+    dots = dots.astype(jnp.float32)
+    if params.kind == "linear":
+        return dots
+    if params.kind == "rbf":
+        q_sq = jnp.asarray(q_sq, jnp.float32)
+        sq_dist = x_sq + q_sq[..., None] if dots.ndim > 1 else x_sq + q_sq
+        sq_dist = jnp.maximum(sq_dist - 2.0 * dots, 0.0)
+        return jnp.exp(-params.gamma * sq_dist)
+    if params.kind == "poly":
+        return (params.gamma * dots + params.coef0) ** params.degree
+    if params.kind == "sigmoid":
+        return jnp.tanh(params.gamma * dots + params.coef0)
+    raise ValueError(f"unknown kernel kind {params.kind!r}")
+
+
+def kernel_rows(
+    x: jax.Array,
+    x_sq: jax.Array,
+    q: jax.Array,
+    q_sq: jax.Array,
+    params: KernelParams,
+) -> jax.Array:
+    """Full kernel rows K(q_k, x_i): (k, n) or (n,)."""
+    return kernel_from_dots(row_dots(x, q), x_sq, q_sq, params)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def kernel_matrix(
+    a: jax.Array,
+    b: jax.Array,
+    params: KernelParams,
+) -> jax.Array:
+    """Dense Gram matrix K(a_i, b_j) of shape (n_a, n_b).
+
+    Used by the predictor and the test oracles; the training path never
+    materialises the full Gram matrix (it is O(n^2) — the reason the
+    reference exists at all; see SURVEY.md section 5.7).
+    """
+    a_sq = squared_norms(a)
+    b_sq = squared_norms(b)
+    dots = jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32).T,
+                   preferred_element_type=jnp.float32)
+    if params.kind == "linear":
+        return dots
+    if params.kind == "rbf":
+        sq = jnp.maximum(a_sq[:, None] + b_sq[None, :] - 2.0 * dots, 0.0)
+        return jnp.exp(-params.gamma * sq)
+    if params.kind == "poly":
+        return (params.gamma * dots + params.coef0) ** params.degree
+    if params.kind == "sigmoid":
+        return jnp.tanh(params.gamma * dots + params.coef0)
+    raise ValueError(f"unknown kernel kind {params.kind!r}")

@@ -1,0 +1,71 @@
+"""Working-set selection for modified SMO (Keerthi et al. "modification 2").
+
+The reference implements this as a fused Thrust classify functor +
+min/max pair reduction (arbitrary_functor svmTrain.cu:41-95, my_maxmin
+:400-467) on GPU, and as explicit I_0..I_4 index-vector scans on CPU
+(seq.cpp:469-553). On TPU the same computation collapses to masked
+argmin/argmax, which XLA lowers to fused single-pass reductions on the VPU.
+
+Set definitions (seq.cpp:469-493):
+  I_up  = I_0 u I_1 u I_2 = {0<a<C} u {a=0, y=+1} u {a=C, y=-1}
+        = {y=+1, a<C} u {y=-1, a>0}
+  I_low = I_0 u I_3 u I_4 = {0<a<C} u {a=C, y=+1} u {a=0, y=-1}
+        = {y=+1, a>0} u {y=-1, a<C}
+
+b_hi = min f over I_up, b_lo = max f over I_low; converged when
+b_lo <= b_hi + 2 eps (svmTrainMain.cpp:310).
+
+Tie-breaking: jnp.argmin/argmax return the first (lowest-index) extremum, a
+deterministic rule independent of device count (the reference tie-breaks by
+reduction order, which differs between its CPU and GPU paths — SURVEY.md
+section 7.3 item 4).
+
+Indices are int32 throughout — the reference smuggles them through float
+buffers, losing exactness above 2^24 rows (bug B4, svmTrain.cu:478-479).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel magnitude for masked-out entries; the reference uses +-1e9
+# (svmTrain.cu:67,84). Use inf: masked entries can then never win.
+_INF = jnp.inf
+
+
+def up_mask(alpha: jax.Array, y: jax.Array, c: float) -> jax.Array:
+    """Membership in I_up."""
+    return jnp.where(y > 0, alpha < c, alpha > 0)
+
+
+def low_mask(alpha: jax.Array, y: jax.Array, c: float) -> jax.Array:
+    """Membership in I_low."""
+    return jnp.where(y > 0, alpha > 0, alpha < c)
+
+
+def select_working_set(
+    f: jax.Array,
+    alpha: jax.Array,
+    y: jax.Array,
+    c: float,
+    valid: jax.Array | None = None,
+):
+    """Pick the most-violating pair.
+
+    Returns (i_up, b_hi, i_low, b_lo): int32 indices and float32 extrema.
+    `valid` masks out padding rows (needed when n is padded up to a multiple
+    of the device count / lane width; the reference never pads — bug B3 is
+    its unguarded uneven shard math).
+    """
+    f = f.astype(jnp.float32)
+    up = up_mask(alpha, y, c)
+    low = low_mask(alpha, y, c)
+    if valid is not None:
+        up = up & valid
+        low = low & valid
+    f_up = jnp.where(up, f, _INF)
+    f_low = jnp.where(low, f, -_INF)
+    i_up = jnp.argmin(f_up).astype(jnp.int32)
+    i_low = jnp.argmax(f_low).astype(jnp.int32)
+    return i_up, f_up[i_up], i_low, f_low[i_low]

@@ -1,0 +1,5 @@
+from dpsvm_tpu.solver.result import SolveResult
+from dpsvm_tpu.solver.reference import smo_reference
+from dpsvm_tpu.solver.smo import solve as solve_single_chip
+
+__all__ = ["SolveResult", "smo_reference", "solve_single_chip"]

@@ -1,0 +1,108 @@
+"""Functional LRU cache of kernel dot-product rows, resident in HBM.
+
+TPU-native re-design of the reference's myCache (cache.hpp:23-43,
+cache.cu:49-105): there, preallocated device vectors hold dot-product rows
+and a host-side std::map + std::list implement LRU; here the whole cache is
+three static-shape arrays living inside the jitted while_loop carry:
+
+    data  (L, n) float32 -- the cached dot rows (like the reference, the
+                            cache stores DOT rows, not exp'd kernel rows;
+                            the kernel transform is recomputed per use,
+                            cache.cu line semantics / svmTrain.cu:128-131)
+    keys  (L,)  int32    -- training-row index held by each line (-1 empty)
+    ticks (L,)  int32    -- last-use stamp; eviction = argmin(ticks)
+
+This fixes reference bug B7 (O(cache) list::remove per hit) trivially: hit
+refresh is one scatter. Both working-set rows are looked up at once so a
+double miss costs ONE (2,d)x(d,n) MXU pass over X instead of two.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dpsvm_tpu.ops.kernels import row_dots
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class CacheState(NamedTuple):
+    data: jax.Array  # (L, n) float32
+    keys: jax.Array  # (L,) int32
+    ticks: jax.Array  # (L,) int32
+
+
+def init_cache(lines: int, n: int) -> CacheState:
+    # Negative, ordered ticks make empty lines fill in slot order before any
+    # real eviction happens (real stamps are >= 1).
+    return CacheState(
+        data=jnp.zeros((lines, n), jnp.float32),
+        keys=jnp.full((lines,), -1, jnp.int32),
+        ticks=(jnp.arange(lines, dtype=jnp.int32) - lines),
+    )
+
+
+def lookup_pair(
+    cache: CacheState,
+    x: jax.Array,
+    i_hi: jax.Array,
+    i_lo: jax.Array,
+    q_hi: jax.Array,
+    q_lo: jax.Array,
+    it: jax.Array,
+):
+    """Fetch dot rows for both working-set indices, updating the cache.
+
+    Returns (row_hi, row_lo, new_cache, n_hits) with rows float32 (n,).
+    Equivalent role: SvmTrain::lookup_cache + get_new_cache_line
+    (svmTrain.cu:142-156, cache.cu:62-105), fused for the pair.
+    """
+    lines = cache.keys.shape[0]
+    hit_hi_vec = cache.keys == i_hi
+    hit_lo_vec = cache.keys == i_lo
+    hit_hi = jnp.any(hit_hi_vec)
+    hit_lo = jnp.any(hit_lo_vec)
+
+    slot_hi = jnp.where(hit_hi, jnp.argmax(hit_hi_vec), jnp.argmin(cache.ticks))
+    slot_hi = slot_hi.astype(jnp.int32)
+    # Keep the second lookup off the first one's slot so a double miss fills
+    # two distinct lines.
+    ticks_masked = jnp.where(
+        jnp.arange(lines, dtype=jnp.int32) == slot_hi, _I32_MAX, cache.ticks)
+    slot_lo = jnp.where(hit_lo, jnp.argmax(hit_lo_vec), jnp.argmin(ticks_masked))
+    slot_lo = slot_lo.astype(jnp.int32)
+
+    def both_miss(_):
+        d2 = row_dots(x, jnp.stack([q_hi, q_lo]))
+        return d2[0], d2[1]
+
+    def hi_hit_only(_):
+        return _read(cache.data, slot_hi), row_dots(x, q_lo)
+
+    def lo_hit_only(_):
+        return row_dots(x, q_hi), _read(cache.data, slot_lo)
+
+    def both_hit(_):
+        return _read(cache.data, slot_hi), _read(cache.data, slot_lo)
+
+    # case = 2*hit_hi + hit_lo: 0 = both miss, 1 = only lo hit,
+    # 2 = only hi hit, 3 = both hit.
+    case = hit_hi.astype(jnp.int32) * 2 + hit_lo.astype(jnp.int32)
+    row_hi, row_lo = lax.switch(case, [both_miss, lo_hit_only, hi_hit_only, both_hit], None)
+
+    stamp = 2 * it.astype(jnp.int32)
+    new_cache = CacheState(
+        data=cache.data.at[slot_hi].set(row_hi).at[slot_lo].set(row_lo),
+        keys=cache.keys.at[slot_hi].set(i_hi).at[slot_lo].set(i_lo),
+        ticks=cache.ticks.at[slot_hi].set(stamp + 1).at[slot_lo].set(stamp + 2),
+    )
+    n_hits = hit_hi.astype(jnp.int32) + hit_lo.astype(jnp.int32)
+    return row_hi, row_lo, new_cache, n_hits
+
+
+def _read(data: jax.Array, slot: jax.Array) -> jax.Array:
+    return lax.dynamic_index_in_dim(data, slot, axis=0, keepdims=False)

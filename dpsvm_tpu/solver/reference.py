@@ -1,0 +1,141 @@
+"""Pure-NumPy sequential modified-SMO — the correctness oracle.
+
+Plays the role seq.cpp plays in the reference: a transparent, host-only
+implementation of the exact algorithm (Keerthi et al. "modification 2",
+global most-violating pair), used by the tests as ground truth for the
+jitted engines. Algebra matches seq.cpp:195-260 step for step; the known
+reference bugs are fixed (eta clamp — B2; float index transport — B4 is
+moot here).
+
+Also provides ``duality_gap`` — the reference ships an unused
+``get_duality_gap`` (seq.cpp:352-376); here it is revived as a test
+invariant (SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.solver.result import SolveResult
+
+
+def _kernel_row_np(x: np.ndarray, x_sq: np.ndarray, i: int, p: KernelParams) -> np.ndarray:
+    dots = x @ x[i]
+    if p.kind == "linear":
+        return dots.astype(np.float32)
+    if p.kind == "rbf":
+        sq = np.maximum(x_sq + x_sq[i] - 2.0 * dots, 0.0)
+        return np.exp(-p.gamma * sq).astype(np.float32)
+    if p.kind == "poly":
+        return ((p.gamma * dots + p.coef0) ** p.degree).astype(np.float32)
+    if p.kind == "sigmoid":
+        return np.tanh(p.gamma * dots + p.coef0).astype(np.float32)
+    raise ValueError(p.kind)
+
+
+def smo_reference(
+    x: np.ndarray,
+    y: np.ndarray,
+    config: SVMConfig,
+    full_gram_limit: int = 6000,
+) -> SolveResult:
+    """Train binary C-SVC by sequential modified SMO (NumPy, CPU).
+
+    For n <= full_gram_limit the Gram matrix is precomputed (fast oracle
+    path for tests); above that, kernel rows are evaluated on demand like
+    seq.cpp's update_f (seq.cpp:378-386).
+    """
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int32)
+    n = x.shape[0]
+    gamma = config.resolve_gamma(x.shape[1])
+    p = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    c = np.float32(config.c)
+    eps = np.float32(config.epsilon)
+
+    x_sq = np.einsum("nd,nd->n", x, x).astype(np.float32)
+    gram = None
+    if n <= full_gram_limit:
+        dots = (x @ x.T).astype(np.float32)
+        if p.kind == "linear":
+            gram = dots
+        elif p.kind == "rbf":
+            sq = np.maximum(x_sq[:, None] + x_sq[None, :] - 2.0 * dots, 0.0)
+            gram = np.exp(-p.gamma * sq).astype(np.float32)
+        elif p.kind == "poly":
+            gram = ((p.gamma * dots + p.coef0) ** p.degree).astype(np.float32)
+        elif p.kind == "sigmoid":
+            gram = np.tanh(p.gamma * dots + p.coef0).astype(np.float32)
+
+    def row(i: int) -> np.ndarray:
+        if gram is not None:
+            return gram[i]
+        return _kernel_row_np(x, x_sq, i, p)
+
+    alpha = np.zeros(n, np.float32)
+    f = (-y).astype(np.float32)  # f_i = -y_i at alpha = 0 (seq.cpp:463-467)
+
+    yp = y > 0
+    t0 = time.perf_counter()
+    it = 0
+    b_hi = np.float32(0.0)
+    b_lo = np.float32(0.0)
+    while it < config.max_iter:
+        up = np.where(yp, alpha < c, alpha > 0)
+        low = np.where(yp, alpha > 0, alpha < c)
+        f_up = np.where(up, f, np.inf)
+        f_low = np.where(low, f, -np.inf)
+        i_hi = int(np.argmin(f_up))
+        i_lo = int(np.argmax(f_low))
+        b_hi = f[i_hi]
+        b_lo = f[i_lo]
+
+        k_hi = row(i_hi)
+        k_lo = row(i_lo)
+        eta = k_hi[i_hi] + k_lo[i_lo] - 2.0 * k_hi[i_lo]
+        eta = max(float(eta), config.tau)  # B2 fix (LibSVM-style clamp)
+
+        y_hi = np.float32(y[i_hi])
+        y_lo = np.float32(y[i_lo])
+        a_hi_old = alpha[i_hi]
+        a_lo_old = alpha[i_lo]
+        # Pair update (seq.cpp:237-250).
+        a_lo_new = np.float32(np.clip(a_lo_old + y_lo * (b_hi - b_lo) / eta, 0.0, c))
+        a_hi_new = np.float32(np.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c))
+        alpha[i_lo] = a_lo_new
+        alpha[i_hi] = a_hi_new
+
+        f += (a_hi_new - a_hi_old) * y_hi * k_hi + (a_lo_new - a_lo_old) * y_lo * k_lo
+        it += 1
+        # do-while: test AFTER the update, like seq.cpp:260.
+        if not (b_lo > b_hi + 2.0 * eps):
+            break
+
+    converged = not (b_lo > b_hi + 2.0 * eps)
+    return SolveResult(
+        alpha=alpha,
+        b=float((b_lo + b_hi) / 2.0),
+        b_hi=float(b_hi),
+        b_lo=float(b_lo),
+        iterations=it,
+        converged=converged,
+        train_seconds=time.perf_counter() - t0,
+        stats={"f": f},
+    )
+
+
+def duality_gap(alpha, y, f, c, b) -> float:
+    """Duality gap invariant (revived from dead code at seq.cpp:352-376).
+
+    gap = sum_i alpha_i y_i f_i + sum_i C * max(0, y_i (b - f_i y_i) ...)
+    following the reference's formulation; approaches ~0 at convergence.
+    """
+    alpha = np.asarray(alpha, np.float64)
+    y = np.asarray(y, np.float64)
+    f = np.asarray(f, np.float64)
+    slack = np.where(y > 0, np.maximum(0.0, b - f), np.maximum(0.0, f - b))
+    return float(np.sum(alpha * y * f) + c * np.sum(slack))

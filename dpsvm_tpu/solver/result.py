@@ -1,0 +1,23 @@
+"""Common result record returned by every solver backend."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SolveResult:
+    alpha: np.ndarray  # (n,) final dual variables
+    b: float  # intercept = (b_lo + b_hi) / 2 (svmTrainMain.cpp:329)
+    b_hi: float
+    b_lo: float
+    iterations: int
+    converged: bool
+    train_seconds: float = 0.0
+    stats: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def n_sv(self) -> int:
+        return int(np.count_nonzero(np.asarray(self.alpha) > 0))

@@ -1,0 +1,179 @@
+"""Single-chip jitted SMO engine.
+
+TPU-native re-design of class SvmTrain (svmTrain.h:48-140, svmTrain.cu):
+the reference runs each SMO iteration as a host-driven sequence of GPU
+launches (classify for_each, min/max reduce, cublas sgemv, f-update
+for_each) with a device->host sync every iteration (svmTrain.cu:469-499,
+svmTrainMain.cpp:235-310). Here the ENTIRE iteration — selection, kernel
+rows (with HBM cache), alpha-pair algebra and f update — is one
+``lax.while_loop`` body compiled once by XLA; the host only observes state
+between chunks of ``config.chunk_iters`` iterations (for convergence
+reporting, metrics and checkpointing; SURVEY.md section 7.3 item 6).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, row_dots, squared_norms
+from dpsvm_tpu.ops.select import select_working_set
+from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_pair
+from dpsvm_tpu.solver.result import SolveResult
+
+
+class SMOState(NamedTuple):
+    """while_loop carry. Mirrors SvmTrain's device-resident solver state
+    (g_alpha/g_f, svmTrain.cu:349,380) plus convergence scalars and the
+    kernel-row cache."""
+
+    alpha: jax.Array  # (n,) float32
+    f: jax.Array  # (n,) float32, f_i = sum_j a_j y_j K_ij - y_i
+    b_hi: jax.Array  # float32
+    b_lo: jax.Array  # float32
+    it: jax.Array  # int32
+    cache: CacheState
+    hits: jax.Array  # int32 cache-hit count (observability, SURVEY 5.5)
+
+
+def init_state(n: int, y: jax.Array, cache_lines: int) -> SMOState:
+    return SMOState(
+        alpha=jnp.zeros((n,), jnp.float32),
+        f=(-y).astype(jnp.float32),  # f = -y at alpha = 0 (svmTrain.cu:380)
+        b_hi=jnp.float32(-jnp.inf),
+        b_lo=jnp.float32(jnp.inf),  # do-while: first chunk always enters
+        it=jnp.int32(0),
+        cache=init_cache(cache_lines, n),
+        hits=jnp.int32(0),
+    )
+
+
+def _smo_iteration(x, y, x_sq, valid, state: SMOState, kp: KernelParams,
+                   c: float, tau: float, use_cache: bool) -> SMOState:
+    """One modified-SMO iteration (the body of the compiled loop)."""
+    i_hi, b_hi, i_lo, b_lo = select_working_set(state.f, state.alpha, y, c, valid)
+
+    q_hi = lax.dynamic_index_in_dim(x, i_hi, 0, keepdims=False)
+    q_lo = lax.dynamic_index_in_dim(x, i_lo, 0, keepdims=False)
+    if use_cache:
+        d_hi, d_lo, cache, n_hits = lookup_pair(
+            state.cache, x, i_hi, i_lo, q_hi, q_lo, state.it)
+    else:
+        d2 = row_dots(x, jnp.stack([q_hi, q_lo]))
+        d_hi, d_lo, cache, n_hits = d2[0], d2[1], state.cache, jnp.int32(0)
+
+    k_hi = kernel_from_dots(d_hi, x_sq, x_sq[i_hi], kp)
+    k_lo = kernel_from_dots(d_lo, x_sq, x_sq[i_lo], kp)
+
+    # eta = K(hi,hi) + K(lo,lo) - 2 K(hi,lo), clamped (fixes bug B2; the
+    # reference divides unguarded at svmTrainMain.cpp:290).
+    eta = jnp.maximum(k_hi[i_hi] + k_lo[i_lo] - 2.0 * k_hi[i_lo], tau)
+
+    y_hi = y[i_hi].astype(jnp.float32)
+    y_lo = y[i_lo].astype(jnp.float32)
+    a_hi_old = state.alpha[i_hi]
+    a_lo_old = state.alpha[i_lo]
+    # Pair update + clip (svmTrainMain.cpp:285-299).
+    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi - b_lo) / eta, 0.0, c)
+    a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
+    alpha = state.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
+
+    # Rank-2 gradient update (update_functor, svmTrain.cu:98-137).
+    f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
+                + (a_lo_new - a_lo_old) * y_lo * k_lo
+
+    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
+
+
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "chunk", "use_cache"))
+def _run_chunk(x, y, x_sq, valid, state: SMOState, max_iter,
+               kp: KernelParams, c: float, eps: float, tau: float,
+               chunk: int, use_cache: bool) -> SMOState:
+    """Run up to `chunk` SMO iterations fully on device."""
+    end = jnp.minimum(state.it + chunk, max_iter)
+
+    def cond(st: SMOState):
+        return (st.it < end) & (st.b_lo > st.b_hi + 2.0 * eps)
+
+    def body(st: SMOState):
+        return _smo_iteration(x, y, x_sq, valid, st, kp, c, tau, use_cache)
+
+    return lax.while_loop(cond, body, state)
+
+
+def solve(
+    x,
+    y,
+    config: SVMConfig,
+    callback=None,
+    device: Optional[jax.Device] = None,
+) -> SolveResult:
+    """Train binary C-SVC on one chip. Returns SolveResult.
+
+    `callback(iter, b_hi, b_lo, state)`, when given, fires once per chunk —
+    the structured-progress hook the reference lacks (its per-iteration
+    print is commented out, svmTrainMain.cpp:237-239).
+    """
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    y_np = np.asarray(y, np.int32)
+    n, d = x.shape
+    gamma = config.resolve_gamma(d)
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+    if device is None:
+        device = jax.devices()[0]
+    x_dev = jax.device_put(jnp.asarray(x, dtype), device)
+    y_dev = jax.device_put(jnp.asarray(y_np, jnp.float32), device)
+    x_sq = jax.jit(squared_norms)(x_dev)
+
+    cache_lines = min(config.cache_lines, n)
+    use_cache = cache_lines > 0
+    state = init_state(n, y_dev, cache_lines if use_cache else 1)
+    state = jax.device_put(state, device)
+    max_iter = jnp.int32(config.max_iter)
+
+    t0 = time.perf_counter()
+    while True:
+        state = _run_chunk(x_dev, y_dev, x_sq, None, state, max_iter,
+                           kp, float(config.c), float(config.epsilon),
+                           float(config.tau), int(config.chunk_iters), use_cache)
+        it = int(state.it)
+        b_hi = float(state.b_hi)
+        b_lo = float(state.b_lo)
+        converged = not (b_lo > b_hi + 2.0 * config.epsilon)
+        if callback is not None:
+            callback(it, b_hi, b_lo, state)
+        if config.verbose:
+            gap = b_lo - b_hi
+            print(f"[smo] iter={it} b_lo-b_hi={gap:.6f} "
+                  f"hits={int(state.hits)}")
+        if converged or it >= config.max_iter:
+            break
+    train_seconds = time.perf_counter() - t0
+
+    alpha = np.asarray(state.alpha)
+    total_lookups = 2 * it if use_cache else 0
+    return SolveResult(
+        alpha=alpha,
+        b=float((b_lo + b_hi) / 2.0),  # svmTrainMain.cpp:329
+        b_hi=b_hi,
+        b_lo=b_lo,
+        iterations=it,
+        converged=converged,
+        train_seconds=train_seconds,
+        stats={
+            "cache_hits": int(state.hits),
+            "cache_lookups": total_lookups,
+            "cache_hit_rate": (int(state.hits) / total_lookups) if total_lookups else 0.0,
+            "f": np.asarray(state.f),
+        },
+    )

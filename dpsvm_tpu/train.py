@@ -1,0 +1,67 @@
+"""High-level training API: data in, SVMModel out.
+
+The svmTrainMain.cpp main() equivalent, minus the launcher: picks the
+single-chip or distributed (mesh) backend, runs the solver, extracts
+support vectors, and optionally reports training accuracy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.solver.result import SolveResult
+
+
+def train(
+    x,
+    y,
+    config: SVMConfig = SVMConfig(),
+    backend: str = "auto",
+    num_devices: Optional[int] = None,
+    callback=None,
+) -> tuple[SVMModel, SolveResult]:
+    """Train binary C-SVC with modified SMO.
+
+    backend: "auto" | "single" | "mesh" | "reference".
+      auto picks "mesh" when >1 device is visible, else "single".
+    Labels must be in {-1, +1} (reference convention, parse.cpp label stoi).
+    """
+    import jax
+
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int32)
+    labels = set(np.unique(y).tolist())
+    if labels != {-1, 1}:
+        raise ValueError(
+            f"labels must contain both classes -1 and +1, got {sorted(labels)}")
+
+    if backend == "auto":
+        try:
+            from dpsvm_tpu.parallel import dist_smo  # noqa: F401
+            mesh_available = True
+        except ImportError:
+            mesh_available = False
+        multi = (num_devices or len(jax.devices())) > 1
+        backend = "mesh" if (multi and mesh_available) else "single"
+
+    if backend == "single":
+        from dpsvm_tpu.solver.smo import solve
+        result = solve(x, y, config, callback=callback)
+    elif backend == "mesh":
+        from dpsvm_tpu.parallel.dist_smo import solve_mesh
+        result = solve_mesh(x, y, config, num_devices=num_devices, callback=callback)
+    elif backend == "reference":
+        from dpsvm_tpu.solver.reference import smo_reference
+        result = smo_reference(x, y, config)
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+
+    gamma = config.resolve_gamma(x.shape[1])
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    model = SVMModel.from_dense(x, y, result.alpha, result.b, kp)
+    return model, result

@@ -1,0 +1,99 @@
+"""ctypes bridge to the native runtime components under native/.
+
+Compiles native/fastcsv.cpp on first use with g++ into
+native/_build/fastcsv.so and binds it via ctypes (no pybind11 in this
+environment). Every native component is optional: if the toolchain or the
+shared object is unavailable, callers fall back to pure NumPy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
+
+_lock = threading.Lock()
+_fastcsv_cache: list = []  # [] = untried, [None] = failed, [obj] = loaded
+
+
+class FastCsv:
+    """Typed wrapper over the fastcsv C ABI."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.fastcsv_shape.restype = ctypes.c_int
+        lib.fastcsv_shape.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.fastcsv_parse.restype = ctypes.c_long
+        lib.fastcsv_parse.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+
+    def shape(self, path: str) -> tuple[int, int]:
+        rows = ctypes.c_long()
+        fields = ctypes.c_long()
+        rc = self._lib.fastcsv_shape(path.encode(), ctypes.byref(rows), ctypes.byref(fields))
+        if rc != 0:
+            raise IOError(f"fastcsv_shape({path}) failed with code {rc}")
+        return rows.value, fields.value
+
+    def parse(self, path: str, num_rows: int | None = None):
+        rows, fields = self.shape(path)
+        if num_rows is not None:
+            rows = min(rows, num_rows)
+        d = fields - 1
+        x = np.empty((rows, d), np.float32)
+        y = np.empty((rows,), np.int32)
+        got = self._lib.fastcsv_parse(
+            path.encode(), rows, fields,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        )
+        if got < 0:
+            raise IOError(f"fastcsv_parse({path}) failed with code {got}")
+        return x[:got], y[:got]
+
+
+def _build_fastcsv() -> str | None:
+    src = os.path.join(_NATIVE_DIR, "fastcsv.cpp")
+    if not os.path.exists(src):
+        return None
+    out = os.path.join(_BUILD_DIR, "fastcsv.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return None
+    return out
+
+
+def get_fastcsv() -> FastCsv | None:
+    """Return the native parser, building it if needed; None if unavailable."""
+    with _lock:
+        if not _fastcsv_cache:
+            so = _build_fastcsv()
+            if so is None:
+                _fastcsv_cache.append(None)
+            else:
+                try:
+                    _fastcsv_cache.append(FastCsv(ctypes.CDLL(so)))
+                except OSError:
+                    _fastcsv_cache.append(None)
+        return _fastcsv_cache[0]

@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+Runs every test on CPU with 8 virtual XLA devices — the TPU-world
+equivalent of "multi-node testing without a cluster" that the reference
+lacks entirely (SURVEY.md section 4: its multi-rank behavior was only ever
+exercised on a real 11-host cluster).
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+# NOTE: in this image a sitecustomize hook imports jax at interpreter
+# startup with JAX_PLATFORMS=axon (the tunneled TPU), so setting the env
+# var here is too late — override through the live config instead. The
+# XLA_FLAGS env is still honored because no backend has been initialized
+# yet when conftest runs.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def blobs_small():
+    """Non-separable 2-class blobs: small enough for exact oracles."""
+    from dpsvm_tpu.data.synth import make_blobs_binary
+    return make_blobs_binary(n=300, d=10, seed=3, sep=1.2)
+
+
+@pytest.fixture(scope="session")
+def blobs_medium():
+    from dpsvm_tpu.data.synth import make_blobs_binary
+    return make_blobs_binary(n=1200, d=24, seed=11, sep=1.0)

@@ -1,0 +1,92 @@
+"""Unit tests for the functional LRU kernel-row cache (solver/cache.py),
+exercising every hit/miss combination directly — the reference's cache
+(cache.cu) has no tests at all."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpsvm_tpu.solver.cache import init_cache, lookup_pair
+
+
+@pytest.fixture(scope="module")
+def x():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.normal(size=(20, 4)).astype(np.float32))
+
+
+def _lookup(cache, x, i_hi, i_lo, it):
+    fn = jax.jit(lambda c, ih, il, t: lookup_pair(
+        c, x, ih, il, x[ih], x[il], t))
+    return fn(cache, jnp.int32(i_hi), jnp.int32(i_lo), jnp.int32(it))
+
+
+def _expect_row(x, i):
+    return np.asarray(x) @ np.asarray(x)[i]
+
+
+def test_rows_correct_for_all_hit_miss_combos(x):
+    cache = init_cache(4, 20)
+    # 1) both miss
+    r_hi, r_lo, cache, hits = _lookup(cache, x, 3, 7, 0)
+    np.testing.assert_allclose(r_hi, _expect_row(x, 3), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r_lo, _expect_row(x, 7), rtol=1e-5, atol=1e-6)
+    assert int(hits) == 0
+    # 2) hi hit, lo miss
+    r_hi, r_lo, cache, hits = _lookup(cache, x, 3, 9, 1)
+    np.testing.assert_allclose(r_hi, _expect_row(x, 3), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r_lo, _expect_row(x, 9), rtol=1e-5, atol=1e-6)
+    assert int(hits) == 1
+    # 3) hi miss, lo hit
+    r_hi, r_lo, cache, hits = _lookup(cache, x, 11, 7, 2)
+    np.testing.assert_allclose(r_hi, _expect_row(x, 11), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r_lo, _expect_row(x, 7), rtol=1e-5, atol=1e-6)
+    assert int(hits) == 1
+    # 4) both hit
+    r_hi, r_lo, cache, hits = _lookup(cache, x, 9, 11, 3)
+    np.testing.assert_allclose(r_hi, _expect_row(x, 9), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r_lo, _expect_row(x, 11), rtol=1e-5, atol=1e-6)
+    assert int(hits) == 2
+
+
+def test_lru_evicts_least_recently_used(x):
+    cache = init_cache(4, 20)
+    # Fill all 4 lines: keys {0,1} then {2,3}.
+    *_, cache, _ = _lookup(cache, x, 0, 1, 0)
+    *_, cache, _ = _lookup(cache, x, 2, 3, 1)
+    # Touch 0 and 1 (refresh), then insert {4,5}: evicts 2 and 3.
+    *_, cache, _ = _lookup(cache, x, 0, 1, 2)
+    *_, cache, _ = _lookup(cache, x, 4, 5, 3)
+    keys = set(np.asarray(cache.keys).tolist())
+    assert keys == {0, 1, 4, 5}
+    # 0/1 must now be hits.
+    *_, cache, hits = _lookup(cache, x, 0, 1, 4)
+    assert int(hits) == 2
+
+
+def test_double_miss_fills_two_distinct_lines(x):
+    cache = init_cache(4, 20)
+    *_, cache, _ = _lookup(cache, x, 6, 8, 0)
+    keys = np.asarray(cache.keys)
+    assert (keys == 6).sum() == 1
+    assert (keys == 8).sum() == 1
+
+
+def test_same_index_pair_is_consistent(x):
+    # Degenerate i_hi == i_lo (possible at convergence boundary) must not
+    # corrupt the cache or return mismatched rows.
+    cache = init_cache(4, 20)
+    r_hi, r_lo, cache, _ = _lookup(cache, x, 5, 5, 0)
+    np.testing.assert_allclose(r_hi, r_lo, rtol=1e-6)
+    np.testing.assert_allclose(r_hi, _expect_row(x, 5), rtol=1e-5, atol=1e-6)
+    r_hi2, _, cache, hits = _lookup(cache, x, 5, 5, 1)
+    np.testing.assert_allclose(r_hi2, _expect_row(x, 5), rtol=1e-5, atol=1e-6)
+
+
+def test_cached_row_contents_survive_eviction_pressure(x):
+    cache = init_cache(2, 20)
+    for it, (a, b) in enumerate([(0, 1), (2, 3), (4, 5), (0, 2)]):
+        r_hi, r_lo, cache, _ = _lookup(cache, x, a, b, it)
+        np.testing.assert_allclose(r_hi, _expect_row(x, a), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(r_lo, _expect_row(x, b), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,135 @@
+"""CSV loader (native + NumPy fallback) and model serialization tests."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.data.loader import load_csv, save_csv, _load_csv_numpy
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.utils import native
+
+
+@pytest.fixture()
+def csv_file(tmp_path):
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(37, 5)).astype(np.float32)
+    y = np.where(rng.random(37) < 0.5, 1, -1).astype(np.int32)
+    path = str(tmp_path / "data.csv")
+    save_csv(path, x, y)
+    return path, x, y
+
+
+def test_load_csv_roundtrip(csv_file):
+    path, x, y = csv_file
+    x2, y2 = load_csv(path)
+    np.testing.assert_allclose(x2, x, rtol=1e-6)
+    np.testing.assert_array_equal(y2, y)
+
+
+def test_load_csv_with_declared_shape(csv_file):
+    path, x, y = csv_file
+    x2, y2 = load_csv(path, num_rows=20, num_features=5)
+    assert x2.shape == (20, 5)
+    np.testing.assert_allclose(x2, x[:20], rtol=1e-6)
+
+
+def test_load_csv_shape_mismatch_raises(csv_file):
+    path, *_ = csv_file
+    with pytest.raises(ValueError):
+        load_csv(path, num_rows=1000)
+    with pytest.raises(ValueError):
+        load_csv(path, num_features=64)
+
+
+def test_native_parser_matches_numpy(csv_file):
+    path, x, y = csv_file
+    parser = native.get_fastcsv()
+    if parser is None:
+        pytest.skip("native toolchain unavailable")
+    xn, yn = parser.parse(path)
+    xp, yp = _load_csv_numpy(path, None)
+    np.testing.assert_allclose(xn, xp, rtol=1e-6)
+    np.testing.assert_array_equal(yn, yp)
+    assert parser.shape(path) == (37, 6)
+
+
+def test_native_parser_rejects_ragged_rows(tmp_path):
+    # A short row must be an error, not a silent misalignment that eats
+    # the next line's label (strtof skips newlines).
+    parser = native.get_fastcsv()
+    if parser is None:
+        pytest.skip("native toolchain unavailable")
+    path = str(tmp_path / "ragged.csv")
+    with open(path, "w") as fh:
+        fh.write("1,1.0,2.0,3.0\n")
+        fh.write("-1,4.0\n")  # ragged: 2 of 3 features
+        fh.write("1,5.0,6.0,7.0\n")
+    with pytest.raises(IOError):
+        parser.parse(path)
+
+
+def test_non_rbf_text_save_refused(tmp_path):
+    m = _model()
+    m = SVMModel(m.sv_x, m.sv_alpha, m.sv_y, m.b, KernelParams("linear"))
+    with pytest.raises(ValueError):
+        m.save(str(tmp_path / "m.txt"))
+    m.save(str(tmp_path / "m.npz"))  # npz path accepts any kernel
+
+
+def _model():
+    rng = np.random.default_rng(4)
+    return SVMModel(
+        sv_x=rng.normal(size=(11, 4)).astype(np.float32),
+        sv_alpha=rng.random(11).astype(np.float32) + 0.01,
+        sv_y=np.where(rng.random(11) < 0.5, 1, -1).astype(np.int32),
+        b=0.731,
+        kernel=KernelParams("rbf", gamma=0.25),
+    )
+
+
+def test_model_text_roundtrip(tmp_path):
+    m = _model()
+    path = str(tmp_path / "model.txt")
+    m.save(path)
+    m2 = SVMModel.load(path)
+    np.testing.assert_allclose(m2.sv_x, m.sv_x, rtol=1e-6)
+    np.testing.assert_allclose(m2.sv_alpha, m.sv_alpha, rtol=1e-6)
+    np.testing.assert_array_equal(m2.sv_y, m.sv_y)
+    assert m2.b == pytest.approx(m.b, rel=1e-6)
+    assert m2.kernel.gamma == pytest.approx(0.25, rel=1e-6)
+
+
+def test_model_npz_roundtrip(tmp_path):
+    m = _model()
+    m = SVMModel(m.sv_x, m.sv_alpha, m.sv_y, m.b,
+                 KernelParams("poly", gamma=0.5, degree=4, coef0=1.5))
+    path = str(tmp_path / "model.npz")
+    m.save(path)
+    m2 = SVMModel.load(path)
+    np.testing.assert_allclose(m2.sv_x, m.sv_x)
+    assert m2.kernel == m.kernel
+    assert m2.b == pytest.approx(m.b, rel=1e-6)
+
+
+def test_model_loads_seq_style_single_header(tmp_path):
+    # seq.cpp:295-321 writes gamma but NO b line (reference bug B6); the
+    # loader must accept that legacy layout with b = 0.
+    path = str(tmp_path / "legacy.txt")
+    with open(path, "w") as fh:
+        fh.write("0.5\n")
+        fh.write("0.25,1,1.0,2.0\n")
+        fh.write("0.75,-1,3.0,4.0\n")
+    m = SVMModel.load(path)
+    assert m.b == 0.0
+    assert m.n_sv == 2
+    assert m.kernel.gamma == 0.5
+    np.testing.assert_allclose(m.sv_x, [[1, 2], [3, 4]])
+
+
+def test_from_dense_filters_zero_alpha():
+    x = np.eye(4, dtype=np.float32)
+    y = np.array([1, -1, 1, -1], np.int32)
+    alpha = np.array([0.0, 0.5, 0.0, 1.0], np.float32)
+    m = SVMModel.from_dense(x, y, alpha, 0.1, KernelParams("rbf", 1.0))
+    assert m.n_sv == 2
+    np.testing.assert_array_equal(m.sv_y, [-1, -1])

@@ -1,0 +1,84 @@
+"""Kernel math unit tests (vs sklearn.metrics.pairwise + hand values)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.ops.kernels import (
+    KernelParams,
+    kernel_from_dots,
+    kernel_matrix,
+    kernel_rows,
+    row_dots,
+    squared_norms,
+)
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(40, 7)).astype(np.float32)
+    b = rng.normal(size=(23, 7)).astype(np.float32)
+    return a, b
+
+
+def _sk(kind, a, b, gamma, degree, coef0):
+    from sklearn.metrics import pairwise
+    if kind == "rbf":
+        return pairwise.rbf_kernel(a, b, gamma=gamma)
+    if kind == "linear":
+        return pairwise.linear_kernel(a, b)
+    if kind == "poly":
+        return pairwise.polynomial_kernel(a, b, degree=degree, gamma=gamma, coef0=coef0)
+    if kind == "sigmoid":
+        return pairwise.sigmoid_kernel(a, b, gamma=gamma, coef0=coef0)
+    raise ValueError(kind)
+
+
+@pytest.mark.parametrize("kind", ["rbf", "linear", "poly", "sigmoid"])
+def test_kernel_matrix_matches_sklearn(xy, kind):
+    a, b = xy
+    p = KernelParams(kind=kind, gamma=0.3, degree=3, coef0=0.5)
+    got = np.asarray(kernel_matrix(a, b, p))
+    want = _sk(kind, a, b, 0.3, 3, 0.5)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_squared_norms(xy):
+    a, _ = xy
+    np.testing.assert_allclose(
+        np.asarray(squared_norms(a)), (a * a).sum(1), rtol=1e-5)
+
+
+def test_row_dots_matches_matmul(xy):
+    a, _ = xy
+    q = a[[3, 17]]
+    np.testing.assert_allclose(np.asarray(row_dots(a, q)), q @ a.T, rtol=1e-5)
+    # single row
+    np.testing.assert_allclose(np.asarray(row_dots(a, a[5])), a[5] @ a.T, rtol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["rbf", "linear", "poly", "sigmoid"])
+def test_kernel_rows_consistent_with_matrix(xy, kind):
+    a, _ = xy
+    p = KernelParams(kind=kind, gamma=0.7, degree=2, coef0=1.0)
+    x_sq = np.asarray(squared_norms(a))
+    q = a[[0, 9]]
+    rows = np.asarray(kernel_rows(a, x_sq, q, x_sq[[0, 9]], p))
+    full = np.asarray(kernel_matrix(q, a, p))
+    np.testing.assert_allclose(rows, full, rtol=2e-5, atol=2e-5)
+
+
+def test_rbf_diagonal_is_one(xy):
+    a, _ = xy
+    p = KernelParams(kind="rbf", gamma=0.5)
+    k = np.asarray(kernel_matrix(a, a, p))
+    np.testing.assert_allclose(np.diag(k), 1.0, atol=1e-6)
+
+
+def test_kernel_from_dots_rbf_hand_value():
+    # Two 1-d points u=0, v=2, gamma=0.25 -> exp(-0.25*4) = exp(-1).
+    x = np.array([[0.0], [2.0]], np.float32)
+    x_sq = (x * x).sum(1)
+    dots = x @ x[1]
+    k = np.asarray(kernel_from_dots(dots, x_sq, x_sq[1], KernelParams("rbf", 0.25)))
+    np.testing.assert_allclose(k, [np.exp(-1.0), 1.0], rtol=1e-6)

@@ -1,0 +1,73 @@
+"""Working-set selection unit tests vs brute-force I-set construction."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dpsvm_tpu.ops.select import low_mask, select_working_set, up_mask
+
+
+def _brute_sets(alpha, y, c):
+    """Literal transcription of the Keerthi I-set definitions
+    (seq.cpp:469-493) for cross-checking the mask algebra."""
+    n = len(alpha)
+    i_up, i_low = [], []
+    for i in range(n):
+        a, yi = alpha[i], y[i]
+        in_i0 = 0 < a < c
+        if in_i0 or (a == 0 and yi == 1) or (a == c and yi == -1):
+            i_up.append(i)
+        if in_i0 or (a == c and yi == 1) or (a == 0 and yi == -1):
+            i_low.append(i)
+    return i_up, i_low
+
+
+def test_masks_match_brute_force():
+    rng = np.random.default_rng(5)
+    c = 2.0
+    n = 200
+    y = np.where(rng.random(n) < 0.5, 1, -1).astype(np.int32)
+    # Mix of interior, 0, and C alphas.
+    alpha = rng.choice([0.0, c, 0.7, 1.3], size=n).astype(np.float32)
+    up_b, low_b = _brute_sets(alpha, y, c)
+    up = np.asarray(up_mask(jnp.asarray(alpha), jnp.asarray(y), c))
+    low = np.asarray(low_mask(jnp.asarray(alpha), jnp.asarray(y), c))
+    assert sorted(np.nonzero(up)[0].tolist()) == up_b
+    assert sorted(np.nonzero(low)[0].tolist()) == low_b
+
+
+def test_select_picks_extrema():
+    rng = np.random.default_rng(9)
+    n = 500
+    c = 1.0
+    y = np.where(rng.random(n) < 0.5, 1, -1).astype(np.int32)
+    alpha = rng.choice([0.0, c, 0.4], size=n).astype(np.float32)
+    f = rng.normal(size=n).astype(np.float32)
+    i_up, b_hi, i_low, b_lo = select_working_set(
+        jnp.asarray(f), jnp.asarray(alpha), jnp.asarray(y), c)
+    up_b, low_b = _brute_sets(alpha, y, c)
+    assert int(i_up) == min(up_b, key=lambda i: (f[i], i))
+    assert int(i_low) == min(low_b, key=lambda i: (-f[i], i))
+    assert float(b_hi) == f[int(i_up)]
+    assert float(b_lo) == f[int(i_low)]
+
+
+def test_select_respects_valid_mask():
+    # Padding rows carry extreme f values but must never be chosen.
+    f = np.array([0.5, -9.0, 0.1, 9.0], np.float32)
+    alpha = np.zeros(4, np.float32)
+    y = np.array([1, 1, -1, -1], np.int32)
+    valid = jnp.asarray([True, False, True, False])
+    i_up, b_hi, i_low, b_lo = select_working_set(
+        jnp.asarray(f), jnp.asarray(alpha), jnp.asarray(y), 1.0, valid)
+    assert int(i_up) == 0 and float(b_hi) == np.float32(0.5)
+    assert int(i_low) == 2 and float(b_lo) == np.float32(0.1)
+
+
+def test_select_first_index_tie_break():
+    f = np.array([1.0, -2.0, -2.0, 3.0, 3.0], np.float32)
+    alpha = np.array([0.5] * 5, np.float32)
+    y = np.ones(5, np.int32)
+    i_up, _, i_low, _ = select_working_set(
+        jnp.asarray(f), jnp.asarray(alpha), jnp.asarray(y), 1.0)
+    assert int(i_up) == 1
+    assert int(i_low) == 3

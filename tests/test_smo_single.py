@@ -1,0 +1,86 @@
+"""Single-chip jitted SMO engine: parity vs the NumPy oracle and LibSVM."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.predict import accuracy, decision_function
+from dpsvm_tpu.solver.reference import smo_reference
+from dpsvm_tpu.solver.smo import solve
+
+
+CFG = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000,
+                cache_lines=32, chunk_iters=256)
+
+
+def test_jit_engine_matches_numpy_oracle(blobs_small):
+    x, y = blobs_small
+    res_jit = solve(x, y, CFG)
+    res_np = smo_reference(x, y, CFG)
+    assert res_jit.converged and res_np.converged
+    # Identical algorithm, same deterministic tie-breaks -> near-identical
+    # trajectories; alphas may differ slightly via fp reassociation.
+    assert abs(res_jit.iterations - res_np.iterations) <= max(
+        5, 0.05 * res_np.iterations)
+    assert abs(res_jit.b - res_np.b) < 5e-3
+    assert abs(res_jit.n_sv - res_np.n_sv) <= max(3, 0.03 * res_np.n_sv)
+    np.testing.assert_allclose(res_jit.alpha, res_np.alpha, atol=2e-2)
+
+
+def test_jit_engine_matches_libsvm(blobs_small):
+    from sklearn.svm import SVC
+    x, y = blobs_small
+    res = solve(x, y, CFG)
+    sk = SVC(C=CFG.c, kernel="rbf", gamma=CFG.gamma, tol=CFG.epsilon).fit(x, y)
+    assert abs(res.n_sv - len(sk.support_)) <= max(3, int(0.03 * len(sk.support_)))
+    model = SVMModel.from_dense(x, y, res.alpha, res.b, KernelParams("rbf", CFG.gamma))
+    np.testing.assert_allclose(
+        decision_function(model, x), sk.decision_function(x), atol=5e-2)
+    assert accuracy(model, x, y) == pytest.approx(sk.score(x, y), abs=0.01)
+
+
+def test_cache_does_not_change_result(blobs_small):
+    x, y = blobs_small
+    res_cached = solve(x, y, CFG.replace(cache_lines=64))
+    res_nocache = solve(x, y, CFG.replace(cache_lines=0))
+    assert res_cached.iterations == res_nocache.iterations
+    np.testing.assert_allclose(res_cached.alpha, res_nocache.alpha, atol=1e-6)
+    assert res_cached.b == pytest.approx(res_nocache.b, abs=1e-6)
+    # And the cache actually gets hits (SMO revisits its active set).
+    assert res_cached.stats["cache_hit_rate"] > 0.3
+
+
+def test_chunk_size_invariance(blobs_small):
+    # Convergence must not depend on the host observation cadence.
+    x, y = blobs_small
+    r1 = solve(x, y, CFG.replace(chunk_iters=64))
+    r2 = solve(x, y, CFG.replace(chunk_iters=4096))
+    assert r1.iterations == r2.iterations
+    np.testing.assert_allclose(r1.alpha, r2.alpha, atol=1e-6)
+
+
+def test_max_iter_cap(blobs_small):
+    x, y = blobs_small
+    res = solve(x, y, CFG.replace(max_iter=7, chunk_iters=3))
+    assert res.iterations == 7
+    assert not res.converged
+
+
+def test_callback_fires(blobs_small):
+    x, y = blobs_small
+    seen = []
+    solve(x, y, CFG.replace(chunk_iters=50),
+          callback=lambda it, bh, bl, st: seen.append(it))
+    assert seen and seen[-1] >= seen[0]
+
+
+def test_linear_kernel_engine(blobs_small):
+    x, y = blobs_small
+    cfg = CFG.replace(kernel="linear", gamma=None, max_iter=200_000,
+                      c=0.1)
+    res = solve(x, y, cfg)
+    res_np = smo_reference(x, y, cfg)
+    assert res.converged
+    assert abs(res.b - res_np.b) < 5e-2
