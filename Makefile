@@ -1,0 +1,50 @@
+# Convenience run targets mirroring the reference's pinned experiment
+# configs (reference Makefile:73-92), adapted to the mesh launcher: the
+# reference's `mpirun -np 10 --hostfile hf ./svmTrain ...` becomes a single
+# process driving the whole device mesh. Dataset CSVs are expected under
+# data/ (not shipped; see dpsvm_tpu/data/converters.py to produce them).
+
+PY ?= python
+DATA ?= data
+
+.PHONY: test bench smoke native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+smoke:
+	$(PY) -m dpsvm_tpu.cli smoke
+
+native: native/_build/fastcsv.so
+
+native/_build/fastcsv.so: native/fastcsv.cpp
+	mkdir -p native/_build
+	g++ -O3 -shared -fPIC -std=c++17 $< -o $@
+
+# MNIST even-odd (ref Makefile:74: 10 ranks, c=10, g=0.125, e=0.01)
+run_mnist:
+	$(PY) -m dpsvm_tpu.cli train -f $(DATA)/mnist_train.csv -m $(DATA)/mnist.model \
+	  -a 784 -x 60000 -c 10 -g 0.125 -e 0.01 -n 100000 --backend mesh
+
+# Covtype binary (ref Makefile:77: c=2048, g=0.03125, e=0.001)
+run_cover:
+	$(PY) -m dpsvm_tpu.cli train -f $(DATA)/covtype_train.csv -m $(DATA)/covtype.model \
+	  -a 54 -x 500000 -c 2048 -g 0.03125 -e 0.001 -n 3000000 --backend mesh
+
+# Adult a9a (ref Makefile:86: 1 rank, c=100, g=0.5, e=0.001)
+run_adult:
+	$(PY) -m dpsvm_tpu.cli train -f $(DATA)/adult_train.csv -m $(DATA)/adult.model \
+	  -a 123 -x 32561 -c 100 -g 0.5 -e 0.001 -n 150000 --backend single
+
+run_test_mnist:
+	$(PY) -m dpsvm_tpu.cli test -f $(DATA)/mnist_test.csv -m $(DATA)/mnist.model -a 784 -x 10000
+
+run_test_adult:
+	$(PY) -m dpsvm_tpu.cli test -f $(DATA)/adult_test.csv -m $(DATA)/adult.model -a 123 -x 16281
+
+# Offline stand-in when no datasets are available (synthetic MNIST-shaped).
+run_synth:
+	$(PY) bench.py

@@ -26,6 +26,7 @@ from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.models.svm_model import SVMModel
 from dpsvm_tpu.train import train
 from dpsvm_tpu.predict import decision_function, predict, accuracy
+from dpsvm_tpu import data
 
 __version__ = "0.1.0"
 
@@ -36,5 +37,6 @@ __all__ = [
     "decision_function",
     "predict",
     "accuracy",
+    "data",
     "__version__",
 ]

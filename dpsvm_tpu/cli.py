@@ -1,0 +1,204 @@
+"""Command-line entry points: ``svmtrain`` and ``svmtest``.
+
+Flag-compatible with the reference CLI so its run recipes port directly:
+
+* train flags mirror svmTrainMain.cpp:46-58 (-a/--num-att, -x/--num-ex,
+  -c/--cost, -g/--gamma, -e/--epsilon, -n/--max-iter, -f/--file-path,
+  -m/--model, -s/--cache-size), with the reference's required-shape flags
+  made OPTIONAL (shapes are inferred from the file — the improvement
+  SURVEY.md section 5.6 calls for). Defaults match (eps 0.001, C 1,
+  max-iter 150000) except gamma, where the reference's default is the
+  integer-division bug B1 (always 0); ours is 1/num_features.
+* test flags mirror seq_test.cpp:54-62 (-a, -x, -g, -f, -m).
+* ``mpirun -np N ./svmTrain`` becomes ``svmtrain --num-devices N`` (or no
+  flag: every visible device) — one process drives the whole mesh.
+
+Usage:
+    python -m dpsvm_tpu.cli train -f train.csv -m model.txt -c 10 -g 0.125
+    python -m dpsvm_tpu.cli test  -f test.csv  -m model.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def _build_train_parser(sub) -> argparse.ArgumentParser:
+    p = sub.add_parser("train", help="train a binary C-SVC with modified SMO")
+    p.add_argument("-f", "--file-path", required=True, help="training CSV (label,f1,...,fd)")
+    p.add_argument("-m", "--model", required=True, help="output model path (.txt or .npz)")
+    p.add_argument("-a", "--num-att", type=int, default=None,
+                   help="number of features (inferred from file if omitted)")
+    p.add_argument("-x", "--num-ex", type=int, default=None,
+                   help="number of training examples (inferred if omitted)")
+    p.add_argument("-c", "--cost", type=float, default=1.0, help="C parameter (default 1)")
+    p.add_argument("-g", "--gamma", type=float, default=None,
+                   help="RBF gamma (default 1/num_features)")
+    p.add_argument("-e", "--epsilon", type=float, default=1e-3,
+                   help="convergence tolerance (default 0.001)")
+    p.add_argument("-n", "--max-iter", type=int, default=150_000)
+    p.add_argument("-s", "--cache-size", type=int, default=256,
+                   help="kernel-row cache lines per device (default 256)")
+    p.add_argument("--kernel", choices=["rbf", "linear", "poly", "sigmoid"],
+                   default="rbf")
+    p.add_argument("--degree", type=int, default=3)
+    p.add_argument("--coef0", type=float, default=0.0)
+    p.add_argument("--backend", choices=["auto", "single", "mesh", "reference"],
+                   default="auto")
+    p.add_argument("--num-devices", type=int, default=None,
+                   help="devices in the data mesh (default: all visible)")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32",
+                   help="X storage dtype (bfloat16 halves kernel-row bandwidth)")
+    p.add_argument("--chunk-iters", type=int, default=2048)
+    p.add_argument("--checkpoint", default=None, help="solver checkpoint path")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="iterations between checkpoints (0 = off)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from --checkpoint if it exists")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="write per-chunk metrics records to this JSONL file")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace into this directory")
+    p.add_argument("-q", "--quiet", action="store_true")
+    return p
+
+
+def _build_test_parser(sub) -> argparse.ArgumentParser:
+    p = sub.add_parser("test", help="evaluate a trained model on a CSV")
+    p.add_argument("-f", "--file-path", required=True, help="test CSV")
+    p.add_argument("-m", "--model", required=True, help="model path (.txt or .npz)")
+    p.add_argument("-a", "--num-att", type=int, default=None)
+    p.add_argument("-x", "--num-ex", type=int, default=None)
+    p.add_argument("-g", "--gamma", type=float, default=None,
+                   help="override the model file's gamma")
+    return p
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dpsvm-tpu", description="TPU-native distributed SVM trainer")
+    sub = parser.add_subparsers(dest="command", required=True)
+    _build_train_parser(sub)
+    _build_test_parser(sub)
+    p = sub.add_parser("smoke", help="device/mesh environment smoke test")
+    p.add_argument("--num-devices", type=int, default=None)
+    args = parser.parse_args(argv)
+    if args.command == "train":
+        return _cmd_train(args)
+    if args.command == "smoke":
+        return _cmd_smoke(args)
+    return _cmd_test(args)
+
+
+def _cmd_smoke(args) -> int:
+    """Environment bring-up check — the role of the reference's
+    mpi_sample.cpp / testblas.c (per-host MPI spawn + known 3x3 matvec):
+    enumerate devices, run a known matvec on each, and verify a mesh psum.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh
+
+    devices = jax.devices()
+    print(f"platform={devices[0].platform} devices={len(devices)}")
+    a = jnp.asarray(np.arange(9, dtype=np.float32).reshape(3, 3))
+    v = jnp.asarray(np.array([1.0, 2.0, 3.0], np.float32))
+    want = np.array([8.0, 26.0, 44.0], np.float32)
+    ok = True
+    for d in devices:
+        got = np.asarray(jax.device_put(a, d) @ jax.device_put(v, d))
+        good = np.allclose(got, want)
+        ok &= good
+        print(f"  {d}: matvec {'OK' if good else 'FAIL ' + str(got)}")
+    n = args.num_devices or len(devices)
+    mesh = make_data_mesh(n)
+    psum = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(x, DATA_AXIS), mesh=mesh,
+        in_specs=P(DATA_AXIS), out_specs=P()))
+    got = np.asarray(psum(jnp.ones((n,), jnp.float32)))
+    good = np.allclose(got, n)
+    ok &= good
+    print(f"  mesh({n}) psum {'OK' if good else 'FAIL ' + str(got)}")
+    return 0 if ok else 1
+
+
+def _cmd_train(args) -> int:
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.train import train
+    from dpsvm_tpu.utils.metrics import MetricsLogger, profile_trace
+
+    t0 = time.perf_counter()
+    x, y = load_csv(args.file_path, args.num_ex, args.num_att)
+    if not args.quiet:
+        print(f"loaded {x.shape[0]} examples x {x.shape[1]} features "
+              f"in {time.perf_counter() - t0:.2f}s")
+
+    config = SVMConfig(
+        c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
+        max_iter=args.max_iter, cache_lines=args.cache_size,
+        kernel=args.kernel, degree=args.degree, coef0=args.coef0,
+        dtype=args.dtype, chunk_iters=args.chunk_iters,
+        checkpoint_every=args.checkpoint_every, verbose=not args.quiet)
+
+    logger = MetricsLogger(
+        sink=None if args.quiet else sys.stderr,
+        jsonl_path=args.metrics_jsonl)
+    with profile_trace(args.profile_dir):
+        model, result = train(
+            x, y, config, backend=args.backend, num_devices=args.num_devices,
+            callback=logger, checkpoint_path=args.checkpoint, resume=args.resume)
+    logger.close()
+
+    if result.converged:
+        print(f"converged at iteration {result.iterations}")
+    else:
+        print(f"stopped at max-iter {result.iterations} without converging")
+    print(f"training took {result.train_seconds:.2f}s")
+    print(f"b: {result.b:.6f}")
+    print(f"support vectors: {result.n_sv}")
+    if result.stats.get("cache_lookups"):
+        print(f"cache hit rate: {result.stats['cache_hit_rate']:.3f}")
+
+    from dpsvm_tpu.predict import accuracy
+    print(f"train accuracy: {accuracy(model, x, y):.4f}")
+    model.save(args.model)
+    print(f"model saved to {args.model}")
+    return 0
+
+
+def _cmd_test(args) -> int:
+    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.models.svm_model import SVMModel
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.predict import accuracy
+
+    model = SVMModel.load(args.model)
+    if args.gamma is not None:
+        model.kernel = KernelParams(
+            model.kernel.kind, args.gamma, model.kernel.degree, model.kernel.coef0)
+    x, y = load_csv(args.file_path, args.num_ex, args.num_att)
+    acc = accuracy(model, x, y)
+    print(f"loaded model: {model.n_sv} SVs, gamma={model.kernel.gamma}, "
+          f"b={model.b:.6f}")
+    print(f"test accuracy: {acc:.4f} ({x.shape[0]} examples)")
+    return 0
+
+
+def train_main() -> int:
+    """`svmtrain` console entry — the reference's ./svmTrain binary role."""
+    return main(["train"] + sys.argv[1:])
+
+
+def test_main() -> int:
+    """`svmtest` console entry — the reference's svmTest/seq_test role."""
+    return main(["test"] + sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

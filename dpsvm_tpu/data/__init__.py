@@ -1,4 +1,19 @@
 from dpsvm_tpu.data.loader import load_csv, save_csv
 from dpsvm_tpu.data.synth import make_blobs_binary, make_mnist_like
+from dpsvm_tpu.data.converters import (
+    libsvm_to_csv,
+    mnist_to_odd_even,
+    mnist_to_odd_even_csv,
+    parse_libsvm,
+)
 
-__all__ = ["load_csv", "save_csv", "make_blobs_binary", "make_mnist_like"]
+__all__ = [
+    "load_csv",
+    "save_csv",
+    "make_blobs_binary",
+    "make_mnist_like",
+    "libsvm_to_csv",
+    "mnist_to_odd_even",
+    "mnist_to_odd_even_csv",
+    "parse_libsvm",
+]

@@ -1,0 +1,67 @@
+"""Dataset converters — modern equivalents of the reference's Python 2
+prep scripts (component C12).
+
+* ``libsvm_to_csv``: sparse LIBSVM format -> dense ``label,f1,...,fd`` CSV
+  (the role of scripts/convert_adult.py: Adult a9a with +/- labels and
+  123 binary features).
+* ``mnist_to_odd_even_csv``: MNIST-style (label, pixels) rows -> +-1
+  even/odd labels with pixels scaled to [0, 1] (the role of
+  scripts/convert_mnist_to_odd_even.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def parse_libsvm(path: str, num_features: int | None = None):
+    """Parse sparse LIBSVM lines ``label idx:val idx:val ...`` (1-based
+    indices) into dense arrays (x float32 (n,d), y int32 +-1)."""
+    rows: list[dict[int, float]] = []
+    labels: list[int] = []
+    max_idx = 0
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if not parts:
+                continue
+            lab = parts[0]
+            labels.append(1 if lab.lstrip("+").startswith(("1",)) and not lab.startswith("-") else -1)
+            feats = {}
+            for tok in parts[1:]:
+                idx_s, val_s = tok.split(":")
+                idx = int(idx_s)
+                feats[idx] = float(val_s)
+                max_idx = max(max_idx, idx)
+            rows.append(feats)
+    d = num_features or max_idx
+    x = np.zeros((len(rows), d), np.float32)
+    for i, feats in enumerate(rows):
+        for idx, val in feats.items():
+            if idx <= d:
+                x[i, idx - 1] = val
+    return x, np.asarray(labels, np.int32)
+
+
+def libsvm_to_csv(src: str, dst: str, num_features: int | None = None) -> tuple[int, int]:
+    """LIBSVM sparse file -> dense reference-format CSV. Returns (n, d)."""
+    from dpsvm_tpu.data.loader import save_csv
+    x, y = parse_libsvm(src, num_features)
+    save_csv(dst, x, y)
+    return x.shape
+
+
+def mnist_to_odd_even(x: np.ndarray, digits: np.ndarray, scale: float = 255.0):
+    """Digit labels -> +1 (even) / -1 (odd); pixels scaled by 1/scale —
+    the relabelling convert_mnist_to_odd_even.py applies."""
+    y = np.where(np.asarray(digits) % 2 == 0, 1, -1).astype(np.int32)
+    return (np.asarray(x, np.float32) / scale), y
+
+
+def mnist_to_odd_even_csv(src: str, dst: str) -> tuple[int, int]:
+    """CSV of ``digit,p1,...,p784`` -> reference-format even/odd CSV."""
+    from dpsvm_tpu.data.loader import load_csv, save_csv
+    x, digits = load_csv(src)
+    x, y = mnist_to_odd_even(x * 1.0, digits, scale=255.0)
+    save_csv(dst, x, y)
+    return x.shape

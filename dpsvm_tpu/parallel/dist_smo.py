@@ -1,0 +1,311 @@
+"""Distributed SMO over a device mesh (shard_map + XLA collectives).
+
+TPU-native re-design of the reference's MPI layer (svmTrainMain.cpp):
+
+* The reference row-partitions only the *compute* — every GPU holds a full
+  replicated copy of X and alpha (svmTrain.cu:344,349) while f/y are shard
+  local. Here EVERYTHING row-indexed is sharded over the ``data`` mesh
+  axis — X, y, f, alpha, the cache lines — so memory scales with device
+  count (SURVEY.md section 7.3 item 5); working-set rows are recovered
+  with a masked ``psum`` instead of replication.
+* The reference's per-iteration ``MPI_Allgather`` of 4 floats per rank,
+  with working-set indices cast through float (bug B4,
+  svmTrain.cu:478-479), becomes an ``all_gather`` of (float32 value,
+  int32 index) candidate pairs inside the compiled loop — exact at any n.
+* The redundant replicated global scan after the allgather
+  (svmTrainMain.cpp:255-277) maps to the same min/max over the gathered
+  (P,) vectors — O(P) work fused into the step, no host involvement.
+* MPI barriers and rank bookkeeping disappear: the SPMD program is one
+  XLA module; collectives ride ICI (and DCN between slices on multi-host).
+* Shards are equal by construction — rows are padded to a multiple of the
+  shard count and masked out of selection (fixes bug B3, the reference's
+  possibly-non-positive last shard).
+
+The per-iteration algebra is identical to the single-chip engine
+(solver/smo.py); convergence trajectories match the single-chip run
+iteration for iteration because tie-breaking is by global row index in
+both.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots
+from dpsvm_tpu.ops.select import up_mask, low_mask
+from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_pair
+from dpsvm_tpu.solver.result import SolveResult
+from dpsvm_tpu.solver.smo import SMOState
+from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh, pad_rows
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _global_ids(n_loc: int) -> jax.Array:
+    """Global row ids of this shard (contiguous row partitioning, like the
+    reference's shard displacements, svmTrainMain.cpp:378-384)."""
+    dev = lax.axis_index(DATA_AXIS)
+    return dev.astype(jnp.int32) * n_loc + jnp.arange(n_loc, dtype=jnp.int32)
+
+
+def _select_global(f, alpha, y, c, valid):
+    """Distributed most-violating-pair selection.
+
+    Local masked extrema -> all_gather of (value, index) candidates ->
+    replicated global reduction with lowest-global-index tie-break. The
+    semantic equivalent of reference step1 + Allgather + replicated scan
+    (svmTrain.cu:469-481, svmTrainMain.cpp:244-277) fused into the
+    compiled step.
+    """
+    n_loc = f.shape[0]
+    gids = _global_ids(n_loc)
+    up = up_mask(alpha, y, c) & valid
+    low = low_mask(alpha, y, c) & valid
+    f_up = jnp.where(up, f, jnp.inf)
+    f_low = jnp.where(low, f, -jnp.inf)
+    l_hi = jnp.argmin(f_up).astype(jnp.int32)
+    l_lo = jnp.argmax(f_low).astype(jnp.int32)
+
+    cand_vals = jnp.stack([f_up[l_hi], f_low[l_lo]])  # (2,) float32
+    cand_idx = jnp.stack([gids[l_hi], gids[l_lo]])  # (2,) int32
+    g_vals = lax.all_gather(cand_vals, DATA_AXIS)  # (P, 2)
+    g_idx = lax.all_gather(cand_idx, DATA_AXIS)  # (P, 2)
+
+    b_hi = jnp.min(g_vals[:, 0])
+    i_hi = jnp.min(jnp.where(g_vals[:, 0] == b_hi, g_idx[:, 0], _I32_MAX))
+    b_lo = jnp.max(g_vals[:, 1])
+    i_lo = jnp.min(jnp.where(g_vals[:, 1] == b_lo, g_idx[:, 1], _I32_MAX))
+    return i_hi, b_hi, i_lo, b_lo
+
+
+def _gather_row(x_loc, owner_mask):
+    """Fetch one global row from the sharded X by masked psum — the
+    replicated-X read `g_x[i]` of the reference (svmTrain.cu:222) without
+    replicating X."""
+    contrib = jnp.sum(jnp.where(owner_mask[:, None], x_loc.astype(jnp.float32), 0.0),
+                      axis=0)
+    return lax.psum(contrib, DATA_AXIS)
+
+
+def _gather_scalar(v_loc, owner_mask):
+    return lax.psum(jnp.sum(jnp.where(owner_mask, v_loc, 0.0)), DATA_AXIS)
+
+
+def _pair_kernel(q_a, q_b, kp: KernelParams):
+    """K(q_a, q_b) for two replicated rows (the reference's host CBLAS
+    rbf_kernel eta evaluations, svmTrain.cu:696-714 — here on device)."""
+    dot = jnp.sum(q_a * q_b)
+    if kp.kind == "linear":
+        return dot
+    if kp.kind == "rbf":
+        sq = jnp.maximum(jnp.sum(q_a * q_a) + jnp.sum(q_b * q_b) - 2.0 * dot, 0.0)
+        return jnp.exp(-kp.gamma * sq)
+    if kp.kind == "poly":
+        return (kp.gamma * dot + kp.coef0) ** kp.degree
+    if kp.kind == "sigmoid":
+        return jnp.tanh(kp.gamma * dot + kp.coef0)
+    raise ValueError(kp.kind)
+
+
+def _iteration(x_loc, y_loc, x_sq_loc, valid_loc, state: SMOState,
+               kp: KernelParams, c: float, tau: float, use_cache: bool) -> SMOState:
+    """One distributed SMO iteration; runs identically on every device."""
+    n_loc = x_loc.shape[0]
+    i_hi, b_hi, i_lo, b_lo = _select_global(
+        state.f, state.alpha, y_loc, c, valid_loc)
+
+    gids = _global_ids(n_loc)
+    own_hi = gids == i_hi
+    own_lo = gids == i_lo
+    q_hi = _gather_row(x_loc, own_hi)
+    q_lo = _gather_row(x_loc, own_lo)
+    q_hi_sq = jnp.sum(q_hi * q_hi)
+    q_lo_sq = jnp.sum(q_lo * q_lo)
+
+    if use_cache:
+        d_hi, d_lo, cache, n_hits = lookup_pair(
+            state.cache, x_loc, i_hi, i_lo,
+            q_hi.astype(x_loc.dtype), q_lo.astype(x_loc.dtype), state.it)
+    else:
+        from dpsvm_tpu.ops.kernels import row_dots
+        d2 = row_dots(x_loc, jnp.stack([q_hi, q_lo]).astype(x_loc.dtype))
+        d_hi, d_lo, cache, n_hits = d2[0], d2[1], state.cache, jnp.int32(0)
+
+    k_hi = kernel_from_dots(d_hi, x_sq_loc, q_hi_sq, kp)
+    k_lo = kernel_from_dots(d_lo, x_sq_loc, q_lo_sq, kp)
+
+    eta = jnp.maximum(
+        _pair_kernel(q_hi, q_hi, kp) + _pair_kernel(q_lo, q_lo, kp)
+        - 2.0 * _pair_kernel(q_hi, q_lo, kp),
+        tau)
+
+    y_hi = _gather_scalar(y_loc, own_hi)
+    y_lo = _gather_scalar(y_loc, own_lo)
+    a_hi_old = _gather_scalar(state.alpha, own_hi)
+    a_lo_old = _gather_scalar(state.alpha, own_lo)
+
+    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi - b_lo) / eta, 0.0, c)
+    a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
+    # lo writes first, hi wins on i_hi == i_lo (matches seq.cpp:248-251).
+    alpha = jnp.where(own_lo, a_lo_new, state.alpha)
+    alpha = jnp.where(own_hi, a_hi_new, alpha)
+
+    f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
+                + (a_lo_new - a_lo_old) * y_lo * k_lo
+
+    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
+
+
+def _make_chunk_runner(mesh: Mesh, kp: KernelParams, c: float, eps: float,
+                       tau: float, chunk: int, use_cache: bool):
+    """Build the jitted shard_mapped chunk executor."""
+
+    def chunk_body(x_loc, y_loc, x_sq_loc, valid_loc, state, max_iter):
+        end = jnp.minimum(state.it + chunk, max_iter)
+
+        def cond(st):
+            return (st.it < end) & (st.b_lo > st.b_hi + 2.0 * eps)
+
+        def body(st):
+            return _iteration(x_loc, y_loc, x_sq_loc, valid_loc, st,
+                              kp, c, tau, use_cache)
+
+        return lax.while_loop(cond, body, state)
+
+    shard = P(DATA_AXIS)
+    rep = P()
+    state_specs = SMOState(
+        alpha=shard, f=shard, b_hi=rep, b_lo=rep, it=rep,
+        cache=CacheState(data=P(None, DATA_AXIS), keys=rep, ticks=rep),
+        hits=rep,
+    )
+    mapped = jax.shard_map(
+        chunk_body,
+        mesh=mesh,
+        in_specs=(shard, shard, shard, shard, state_specs, rep),
+        out_specs=state_specs,
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def solve_mesh(
+    x,
+    y,
+    config: SVMConfig,
+    num_devices: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    callback=None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
+) -> SolveResult:
+    """Train binary C-SVC sharded over the mesh's `data` axis."""
+    x = np.asarray(x, np.float32)
+    y_np = np.asarray(y, np.int32)
+    n, d = x.shape
+    gamma = config.resolve_gamma(d)
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+
+    if mesh is None:
+        mesh = make_data_mesh(num_devices)
+    n_dev = mesh.devices.size
+
+    n_pad = pad_rows(n, n_dev)
+    x_p = np.zeros((n_pad, d), np.float32)
+    x_p[:n] = x
+    y_p = np.ones((n_pad,), np.float32)
+    y_p[:n] = y_np
+    valid = np.zeros((n_pad,), bool)
+    valid[:n] = True
+
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    x_dev = jax.device_put(jnp.asarray(x_p, dtype), shard)
+    y_dev = jax.device_put(jnp.asarray(y_p), shard)
+    x_sq = jax.device_put(
+        jnp.asarray(np.einsum("nd,nd->n", x_p, x_p, dtype=np.float32)), shard)
+    valid_dev = jax.device_put(jnp.asarray(valid), shard)
+
+    cache_lines = min(config.cache_lines, n_pad // n_dev)
+    use_cache = cache_lines > 0
+    state = SMOState(
+        alpha=jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard),
+        f=jax.device_put(jnp.asarray(-y_p, jnp.float32), shard),
+        b_hi=jax.device_put(jnp.float32(-jnp.inf), rep),
+        b_lo=jax.device_put(jnp.float32(jnp.inf), rep),
+        it=jax.device_put(jnp.int32(0), rep),
+        cache=jax.tree.map(
+            lambda a, s: jax.device_put(a, s),
+            init_cache(max(cache_lines, 1), n_pad),
+            CacheState(data=NamedSharding(mesh, P(None, DATA_AXIS)), keys=rep, ticks=rep)),
+        hits=jax.device_put(jnp.int32(0), rep),
+    )
+    from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer, resume_solver_state
+
+    if resume:
+        restored = resume_solver_state(checkpoint_path, config, n)
+        if restored is not None:
+            a0, f0, it0, bh0, bl0 = restored
+            a_p = np.zeros((n_pad,), np.float32)
+            a_p[:n] = a0
+            f_p = np.asarray(-y_p, np.float32)
+            f_p[:n] = f0
+            state = state._replace(
+                alpha=jax.device_put(jnp.asarray(a_p), shard),
+                f=jax.device_put(jnp.asarray(f_p), shard),
+                b_hi=jax.device_put(jnp.float32(bh0), rep),
+                b_lo=jax.device_put(jnp.float32(bl0), rep),
+                it=jax.device_put(jnp.int32(it0), rep))
+    run_chunk = _make_chunk_runner(mesh, kp, float(config.c), float(config.epsilon),
+                                   float(config.tau), int(config.chunk_iters),
+                                   use_cache)
+    max_iter = jnp.int32(config.max_iter)
+    start_iter = int(state.it)
+    ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
+
+    t0 = time.perf_counter()
+    while True:
+        state = run_chunk(x_dev, y_dev, x_sq, valid_dev, state, max_iter)
+        it = int(state.it)
+        b_hi = float(state.b_hi)
+        b_lo = float(state.b_lo)
+        converged = not (b_lo > b_hi + 2.0 * config.epsilon)
+        if callback is not None:
+            callback(it, b_hi, b_lo, state)
+        ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
+                        np.asarray(state.f)[:n], b_hi, b_lo)
+        if config.verbose:
+            print(f"[smo-mesh p={n_dev}] iter={it} gap={b_lo - b_hi:.6f}")
+        if converged or it >= config.max_iter:
+            break
+    train_seconds = time.perf_counter() - t0
+
+    alpha = np.asarray(state.alpha)[:n]
+    lookups = 2 * (it - start_iter) if use_cache else 0
+    return SolveResult(
+        alpha=alpha,
+        b=float((b_lo + b_hi) / 2.0),
+        b_hi=b_hi,
+        b_lo=b_lo,
+        iterations=it,
+        converged=converged,
+        train_seconds=train_seconds,
+        stats={
+            "num_devices": n_dev,
+            "rows_padded": n_pad - n,
+            "cache_hits": int(state.hits),
+            "cache_lookups": lookups,
+            "cache_hit_rate": (int(state.hits) / lookups) if lookups else 0.0,
+            "f": np.asarray(state.f)[:n],
+        },
+    )

@@ -1,0 +1,62 @@
+"""Device mesh construction and multi-host initialization.
+
+Replaces the reference's launcher layer (mpirun + hostfile, Makefile:74 and
+hf:1-11, plus the MPI Init/Get_rank/Barrier boilerplate in
+svmTrainMain.cpp:144-198): on TPU the SPMD program is compiled once over a
+``jax.sharding.Mesh`` and XLA inserts the collectives; there is no explicit
+rank bookkeeping or barrier code anywhere in the solver.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+DATA_AXIS = "data"
+
+
+def make_data_mesh(
+    num_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D mesh over the `data` axis — the row-shard axis of SURVEY.md
+    section 2.3 (one shard per reference MPI rank)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} visible")
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up: the `mpirun --hostfile` equivalent.
+
+    On a real pod slice each host runs the same program and calls this once
+    before building the mesh; jax.distributed wires the DCN coordination
+    that OpenMPI's ssh launcher provided for the reference.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def pad_rows(n: int, num_shards: int, multiple: int = 8) -> int:
+    """Padded row count: divisible by num_shards and a lane-friendly
+    multiple. Replaces the reference's uneven ceil-sharding
+    (initialize_shard_sizes, svmTrainMain.cpp:367-376), whose last shard
+    can go non-positive (bug B3) — padded rows are masked out of selection
+    instead."""
+    per = -(-n // num_shards)
+    per = -(-per // multiple) * multiple
+    return per * num_shards

@@ -113,12 +113,19 @@ def solve(
     config: SVMConfig,
     callback=None,
     device: Optional[jax.Device] = None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> SolveResult:
     """Train binary C-SVC on one chip. Returns SolveResult.
 
     `callback(iter, b_hi, b_lo, state)`, when given, fires once per chunk —
     the structured-progress hook the reference lacks (its per-iteration
     print is commented out, svmTrainMain.cpp:237-239).
+
+    With `checkpoint_path` and config.checkpoint_every > 0, solver state
+    (alpha, f, iteration) is persisted periodically; `resume=True` restarts
+    from the file if present (a capability gap in the reference — SURVEY.md
+    section 5.3: an MPI rank death loses the whole run).
     """
     import numpy as np
 
@@ -135,11 +142,23 @@ def solve(
     y_dev = jax.device_put(jnp.asarray(y_np, jnp.float32), device)
     x_sq = jax.jit(squared_norms)(x_dev)
 
+    from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer, resume_solver_state
+
     cache_lines = min(config.cache_lines, n)
     use_cache = cache_lines > 0
     state = init_state(n, y_dev, cache_lines if use_cache else 1)
+    if resume:
+        restored = resume_solver_state(checkpoint_path, config, n)
+        if restored is not None:
+            a0, f0, it0, bh0, bl0 = restored
+            state = state._replace(
+                alpha=jnp.asarray(a0), f=jnp.asarray(f0),
+                b_hi=jnp.float32(bh0), b_lo=jnp.float32(bl0),
+                it=jnp.int32(it0))
     state = jax.device_put(state, device)
     max_iter = jnp.int32(config.max_iter)
+    start_iter = int(state.it)
+    ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
 
     t0 = time.perf_counter()
     while True:
@@ -152,6 +171,7 @@ def solve(
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
+        ckpt.maybe_save(it, state.alpha, state.f, b_hi, b_lo)
         if config.verbose:
             gap = b_lo - b_hi
             print(f"[smo] iter={it} b_lo-b_hi={gap:.6f} "
@@ -161,7 +181,8 @@ def solve(
     train_seconds = time.perf_counter() - t0
 
     alpha = np.asarray(state.alpha)
-    total_lookups = 2 * it if use_cache else 0
+    # Hit-rate denominator covers only THIS run's lookups (post-resume).
+    total_lookups = 2 * (it - start_iter) if use_cache else 0
     return SolveResult(
         alpha=alpha,
         b=float((b_lo + b_hi) / 2.0),  # svmTrainMain.cpp:329

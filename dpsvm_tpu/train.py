@@ -24,6 +24,8 @@ def train(
     backend: str = "auto",
     num_devices: Optional[int] = None,
     callback=None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> tuple[SVMModel, SolveResult]:
     """Train binary C-SVC with modified SMO.
 
@@ -51,10 +53,13 @@ def train(
 
     if backend == "single":
         from dpsvm_tpu.solver.smo import solve
-        result = solve(x, y, config, callback=callback)
+        result = solve(x, y, config, callback=callback,
+                       checkpoint_path=checkpoint_path, resume=resume)
     elif backend == "mesh":
         from dpsvm_tpu.parallel.dist_smo import solve_mesh
-        result = solve_mesh(x, y, config, num_devices=num_devices, callback=callback)
+        result = solve_mesh(x, y, config, num_devices=num_devices,
+                            callback=callback, checkpoint_path=checkpoint_path,
+                            resume=resume)
     elif backend == "reference":
         from dpsvm_tpu.solver.reference import smo_reference
         result = smo_reference(x, y, config)

@@ -1,0 +1,100 @@
+"""Solver-state checkpoint / resume.
+
+The reference has NO training-state checkpointing (SURVEY.md section 5.3:
+an MPI rank death kills the job and all progress); only the final model is
+persisted. Full solver state here is just {alpha, f, iteration, b_hi, b_lo}
+plus config, so periodic checkpoints are nearly free. Stored as .npz.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+
+FORMAT_VERSION = 1
+
+
+def save_checkpoint(path: str, alpha, f, iteration: int, b_hi: float,
+                    b_lo: float, config: SVMConfig) -> None:
+    """Atomic write (tmp + rename) so a preemption mid-save never leaves a
+    truncated checkpoint."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez_compressed(
+                fh,
+                format_version=FORMAT_VERSION,
+                alpha=np.asarray(alpha, np.float32),
+                f=np.asarray(f, np.float32),
+                iteration=np.int64(iteration),
+                b_hi=np.float32(b_hi),
+                b_lo=np.float32(b_lo),
+                config_json=json.dumps(dataclasses.asdict(config)),
+            )
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def resume_solver_state(path: Optional[str], config: SVMConfig, n: int):
+    """Load + validate a solver checkpoint for resuming.
+
+    Returns (alpha, f, iteration, b_hi, b_lo) or None when `path` is unset
+    or missing. Raises ValueError when the checkpoint belongs to a
+    different dataset size or incompatible hyper-parameters — resuming
+    across those would silently corrupt the solution (the restored
+    gradient f is only valid for the kernel/C it was computed under).
+    """
+    if not path or not os.path.exists(path):
+        return None
+    alpha, f, it, b_hi, b_lo, saved = load_checkpoint(path)
+    if alpha.shape[0] != n:
+        raise ValueError(
+            f"checkpoint {path} holds state for n={alpha.shape[0]} rows, "
+            f"but the current dataset has n={n}")
+    for field in ("c", "gamma", "kernel", "degree", "coef0", "epsilon"):
+        if getattr(saved, field) != getattr(config, field):
+            raise ValueError(
+                f"checkpoint {path} was written with {field}="
+                f"{getattr(saved, field)!r}, current run uses "
+                f"{getattr(config, field)!r}; refusing to resume")
+    return alpha, f, it, b_hi, b_lo
+
+
+class PeriodicCheckpointer:
+    """Chunk-cadence checkpoint trigger shared by all solver backends."""
+
+    def __init__(self, path: Optional[str], config: SVMConfig, start_iter: int = 0):
+        self.path = path
+        self.config = config
+        self.every = config.checkpoint_every
+        self.last = start_iter
+
+    def maybe_save(self, iteration: int, alpha, f, b_hi: float, b_lo: float) -> bool:
+        if not (self.path and self.every > 0 and iteration - self.last >= self.every):
+            return False
+        save_checkpoint(self.path, np.asarray(alpha), np.asarray(f),
+                        iteration, b_hi, b_lo, self.config)
+        self.last = iteration
+        return True
+
+
+def load_checkpoint(path: str):
+    """Returns (alpha, f, iteration, b_hi, b_lo, config)."""
+    z = np.load(path, allow_pickle=False)
+    if int(z["format_version"]) != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {int(z['format_version'])}")
+    config = SVMConfig(**json.loads(str(z["config_json"])))
+    return (z["alpha"].astype(np.float32), z["f"].astype(np.float32),
+            int(z["iteration"]), float(z["b_hi"]), float(z["b_lo"]), config)

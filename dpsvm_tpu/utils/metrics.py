@@ -1,0 +1,98 @@
+"""Structured training metrics & profiling hooks.
+
+The reference's observability is printf-only, with the per-iteration
+progress print commented out (svmTrainMain.cpp:237-239) and a `logs` dir
+that is declared but never written (Makefile:12,68). This module provides
+the structured equivalent SURVEY.md section 5.5 calls for: periodic
+{iteration, b-gap, SV estimate, cache hit rate, iters/sec} records, an
+optional JSONL sink, and jax.profiler trace capture (section 5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import IO, Optional
+
+import numpy as np
+
+
+class MetricsLogger:
+    """Chunk-cadence metrics recorder; usable as the solver `callback`."""
+
+    def __init__(self, sink: Optional[IO] = None, jsonl_path: Optional[str] = None,
+                 print_every: int = 0):
+        self.records: list[dict] = []
+        self._sink = sink
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._t0 = time.perf_counter()
+        self._last_iter = 0
+        self._last_t = self._t0
+        self._print_every = print_every
+
+    def __call__(self, iteration: int, b_hi: float, b_lo: float, state) -> None:
+        now = time.perf_counter()
+        d_it = iteration - self._last_iter
+        d_t = max(now - self._last_t, 1e-9)
+        alpha = state.alpha
+        hits = int(state.hits)
+        rec = {
+            "iteration": iteration,
+            "b_hi": b_hi,
+            "b_lo": b_lo,
+            "gap": b_lo - b_hi,
+            "sv_estimate": int(np.asarray(alpha > 0).sum()),
+            "cache_hits": hits,
+            "cache_hit_rate": hits / max(2 * iteration, 1),
+            "iters_per_sec": d_it / d_t,
+            "elapsed_sec": now - self._t0,
+        }
+        self.records.append(rec)
+        self._last_iter, self._last_t = iteration, now
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        if self._sink is not None:
+            self._sink.write(
+                f"iter={iteration} gap={rec['gap']:.6f} "
+                f"sv~{rec['sv_estimate']} {rec['iters_per_sec']:.0f} it/s\n")
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]):
+    """jax.profiler trace around a training run (SURVEY.md 5.1's TPU
+    equivalent of the reference's commented-out CycleTimer probes)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Simple named wall-clock phases with block_until_ready discipline —
+    the CycleTimer (CycleTimer.h) role, minus the rdtsc fragility."""
+
+    def __init__(self):
+        self.phases: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, *arrays):
+        import jax
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            for a in arrays:
+                jax.block_until_ready(a)
+            self.phases[name] = self.phases.get(name, 0.0) + time.perf_counter() - t0

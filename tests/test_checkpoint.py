@@ -1,0 +1,82 @@
+"""Checkpoint/resume tests — the failure-recovery capability the
+reference lacks entirely (SURVEY.md section 5.3)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.solver.smo import solve
+from dpsvm_tpu.parallel.dist_smo import solve_mesh
+from dpsvm_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+CFG = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000,
+                cache_lines=16, chunk_iters=64, checkpoint_every=64)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    alpha = np.arange(5, dtype=np.float32)
+    f = -alpha
+    save_checkpoint(p, alpha, f, 123, -0.5, 0.7, CFG)
+    a2, f2, it, bh, bl, cfg = load_checkpoint(p)
+    np.testing.assert_array_equal(a2, alpha)
+    np.testing.assert_array_equal(f2, f)
+    assert it == 123 and bh == pytest.approx(-0.5) and bl == pytest.approx(0.7)
+    assert cfg.c == CFG.c and cfg.chunk_iters == CFG.chunk_iters
+
+
+def test_interrupted_run_resumes_to_same_answer(blobs_small, tmp_path):
+    x, y = blobs_small
+    p = str(tmp_path / "solver.npz")
+    full = solve(x, y, CFG)
+    # "Preempt" after 128 iterations...
+    part = solve(x, y, CFG.replace(max_iter=128), checkpoint_path=p)
+    assert part.iterations == 128 and not part.converged
+    save_checkpoint(p, part.alpha, part.stats["f"], part.iterations,
+                    part.b_hi, part.b_lo, CFG)
+    # ...and resume to convergence: same final answer as the uninterrupted run.
+    res = solve(x, y, CFG, checkpoint_path=p, resume=True)
+    assert res.converged
+    assert res.iterations == full.iterations
+    np.testing.assert_allclose(res.alpha, full.alpha, atol=1e-4)
+    assert res.b == pytest.approx(full.b, abs=1e-4)
+
+
+def test_mesh_resumes_from_single_chip_checkpoint(blobs_small, tmp_path):
+    # Solver state is backend-portable: a single-chip checkpoint restores
+    # onto an 8-device mesh (alpha/f are global row vectors either way).
+    x, y = blobs_small
+    p = str(tmp_path / "solver.npz")
+    part = solve(x, y, CFG.replace(max_iter=128))
+    save_checkpoint(p, part.alpha, part.stats["f"], part.iterations,
+                    part.b_hi, part.b_lo, CFG)
+    full = solve(x, y, CFG)
+    res = solve_mesh(x, y, CFG, num_devices=8, checkpoint_path=p, resume=True)
+    assert res.converged
+    assert res.iterations == full.iterations
+    np.testing.assert_allclose(res.alpha, full.alpha, atol=1e-4)
+
+
+def test_resume_refuses_mismatched_config(blobs_small, tmp_path):
+    # Resuming under different hyper-parameters would silently corrupt the
+    # solution (f was computed under the old kernel) — must refuse loudly.
+    x, y = blobs_small
+    p = str(tmp_path / "ck.npz")
+    part = solve(x, y, CFG.replace(max_iter=64), checkpoint_path=p)
+    save_checkpoint(p, part.alpha, part.stats["f"], part.iterations,
+                    part.b_hi, part.b_lo, CFG)
+    with pytest.raises(ValueError, match="gamma"):
+        solve(x, y, CFG.replace(gamma=0.5), checkpoint_path=p, resume=True)
+    with pytest.raises(ValueError, match="n="):
+        solve(x[:100], y[:100], CFG, checkpoint_path=p, resume=True)
+
+
+def test_periodic_checkpoint_written_during_solve(blobs_small, tmp_path):
+    import os
+    x, y = blobs_small
+    p = str(tmp_path / "auto.npz")
+    solve(x, y, CFG.replace(max_iter=200), checkpoint_path=p)
+    assert os.path.exists(p)
+    a, f, it, *_ = load_checkpoint(p)
+    assert 0 < it <= 200
+    assert a.shape == (x.shape[0],)

@@ -1,0 +1,87 @@
+"""CLI end-to-end tests: the reference's train->model->test flow
+(Makefile run targets) through `python -m dpsvm_tpu.cli`."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.cli import main
+from dpsvm_tpu.data.loader import save_csv
+from dpsvm_tpu.data.synth import make_blobs_binary
+
+
+@pytest.fixture(scope="module")
+def csvs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    x, y = make_blobs_binary(n=500, d=12, seed=21, sep=2.5)
+    train_p = str(d / "train.csv")
+    test_p = str(d / "test.csv")
+    save_csv(train_p, x[:400], y[:400])
+    save_csv(test_p, x[400:], y[400:])
+    return train_p, test_p, str(d)
+
+
+def test_train_then_test_roundtrip(csvs, capsys):
+    train_p, test_p, d = csvs
+    model_p = d + "/model.txt"
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g", "0.1",
+               "-e", "0.001", "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "converged at iteration" in out
+    assert "model saved" in out
+
+    rc = main(["test", "-f", test_p, "-m", model_p])
+    assert rc == 0
+    out = capsys.readouterr().out
+    acc = float(out.split("test accuracy: ")[1].split()[0])
+    assert acc > 0.85
+
+
+def test_train_with_declared_shapes_and_npz(csvs, capsys):
+    train_p, test_p, d = csvs
+    model_p = d + "/model.npz"
+    rc = main(["train", "-f", train_p, "-m", model_p, "-a", "12", "-x", "400",
+               "-c", "5", "-g", "0.1", "--backend", "mesh",
+               "--num-devices", "4", "-q"])
+    assert rc == 0
+    rc = main(["test", "-f", test_p, "-m", model_p])
+    assert rc == 0
+    acc = float(capsys.readouterr().out.split("test accuracy: ")[1].split()[0])
+    assert acc > 0.85
+
+
+def test_checkpoint_resume_cli(csvs, capsys):
+    train_p, _, d = csvs
+    model_p = d + "/model_ck.txt"
+    ck = d + "/solver.ckpt.npz"
+    # Run a few iterations only, checkpointing.
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g", "0.1",
+               "-n", "40", "--chunk-iters", "20", "--checkpoint", ck,
+               "--checkpoint-every", "20", "--backend", "single", "-q"])
+    assert rc == 0
+    import os
+    assert os.path.exists(ck)
+    # Resume to convergence.
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g", "0.1",
+               "--checkpoint", ck, "--resume", "--backend", "single", "-q"])
+    assert rc == 0
+    assert "converged" in capsys.readouterr().out
+
+
+def test_smoke_command(capsys):
+    rc = main(["smoke", "--num-devices", "4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "matvec OK" in out and "psum OK" in out
+
+
+def test_metrics_jsonl(csvs):
+    import json
+    train_p, _, d = csvs
+    mpath = d + "/metrics.jsonl"
+    main(["train", "-f", train_p, "-m", d + "/m.txt", "-c", "5", "-g", "0.1",
+          "--chunk-iters", "100", "--metrics-jsonl", mpath,
+          "--backend", "single", "-q"])
+    recs = [json.loads(ln) for ln in open(mpath)]
+    assert recs
+    assert {"iteration", "gap", "sv_estimate", "iters_per_sec"} <= recs[0].keys()

@@ -1,0 +1,43 @@
+"""Data-prep converter tests (reference scripts C12 equivalents)."""
+
+import numpy as np
+
+from dpsvm_tpu.data.converters import (
+    libsvm_to_csv,
+    mnist_to_odd_even,
+    parse_libsvm,
+)
+from dpsvm_tpu.data.loader import load_csv
+
+
+def test_parse_libsvm_dense_expansion(tmp_path):
+    src = tmp_path / "a.libsvm"
+    src.write_text(
+        "+1 3:1 11:1 14:1\n"
+        "-1 1:0.5 4:2\n"
+        "+1 2:1\n")
+    x, y = parse_libsvm(str(src), num_features=14)
+    assert x.shape == (3, 14)
+    np.testing.assert_array_equal(y, [1, -1, 1])
+    assert x[0, 2] == 1 and x[0, 10] == 1 and x[0, 13] == 1
+    assert x[1, 0] == 0.5 and x[1, 3] == 2
+    assert x[2].sum() == 1 and x[2, 1] == 1
+
+
+def test_libsvm_to_csv_roundtrip(tmp_path):
+    src = tmp_path / "a.libsvm"
+    src.write_text("+1 1:1 3:1\n-1 2:1\n")
+    dst = str(tmp_path / "a.csv")
+    n, d = libsvm_to_csv(str(src), dst, num_features=3)
+    assert (n, d) == (2, 3)
+    x, y = load_csv(dst)
+    np.testing.assert_array_equal(y, [1, -1])
+    np.testing.assert_allclose(x, [[1, 0, 1], [0, 1, 0]])
+
+
+def test_mnist_odd_even_relabel():
+    digits = np.array([0, 1, 2, 3, 7, 8])
+    x = np.full((6, 4), 127.5)
+    xs, y = mnist_to_odd_even(x, digits)
+    np.testing.assert_array_equal(y, [1, -1, 1, -1, -1, 1])
+    np.testing.assert_allclose(xs, 0.5)
