@@ -45,6 +45,12 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                    help="kernel-row cache lines per device (default 256)")
     p.add_argument("--kernel", choices=["rbf", "linear", "poly", "sigmoid"],
                    default="rbf")
+    p.add_argument("--selection", choices=["mvp", "second_order"], default="mvp",
+                   help="working-set rule: mvp = reference-parity maximal "
+                        "violating pair; second_order = LibSVM-style WSS2")
+    p.add_argument("--engine", choices=["xla", "pallas"], default="xla",
+                   help="single-chip compute engine (pallas = fused "
+                        "update+select TPU kernel)")
     p.add_argument("--degree", type=int, default=3)
     p.add_argument("--coef0", type=float, default=0.0)
     p.add_argument("--backend", choices=["auto", "single", "mesh", "reference"],
@@ -143,6 +149,7 @@ def _cmd_train(args) -> int:
         c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
         max_iter=args.max_iter, cache_lines=args.cache_size,
         kernel=args.kernel, degree=args.degree, coef0=args.coef0,
+        selection=args.selection, engine=args.engine,
         dtype=args.dtype, chunk_iters=args.chunk_iters,
         checkpoint_every=args.checkpoint_every, verbose=not args.quiet)
 

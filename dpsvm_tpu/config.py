@@ -49,6 +49,24 @@ class SVMConfig:
     degree: int = 3
     coef0: float = 0.0
 
+    # Working-set selection rule (no reference equivalent for the second):
+    #   "mvp"          -- maximal-violating pair, exactly the reference
+    #                     algorithm (global argmin/argmax of f);
+    #   "second_order" -- LibSVM/ThunderSVM-style WSS2: i as in mvp, j by
+    #                     maximal second-order gain (f_i - f_j)^2 / eta_ij
+    #                     using row i's kernel values. Converges to the
+    #                     same solution in typically far fewer iterations.
+    selection: str = "mvp"
+
+    # Compute engine for the single-chip solver:
+    #   "xla"    -- pure XLA ops (reference-parity iteration structure);
+    #   "pallas" -- fused Pallas TPU kernel doing the rank-2 f update and
+    #               the next selection in one HBM pass, with the loop
+    #               software-pipelined around it. Same optimum; iteration
+    #               count may differ by one (the fused path skips the
+    #               reference's final degenerate update).
+    engine: str = "xla"
+
     # Numerics / runtime knobs (no reference equivalent).
     tau: float = 1e-12  # eta clamp (LibSVM-style guard, fixes bug B2)
     dtype: str = "float32"  # storage dtype for X ("float32" | "bfloat16")
@@ -73,6 +91,12 @@ class SVMConfig:
             raise ValueError("cache_lines must be >= 0")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError("dtype must be 'float32' or 'bfloat16'")
+        if self.selection not in ("mvp", "second_order"):
+            raise ValueError("selection must be 'mvp' or 'second_order'")
+        if self.engine not in ("xla", "pallas"):
+            raise ValueError("engine must be 'xla' or 'pallas'")
+        if self.engine == "pallas" and self.selection != "mvp":
+            raise ValueError("engine='pallas' currently supports selection='mvp' only")
 
     def replace(self, **kw) -> "SVMConfig":
         return dataclasses.replace(self, **kw)

@@ -21,12 +21,20 @@ def parse_libsvm(path: str, num_features: int | None = None):
     labels: list[int] = []
     max_idx = 0
     with open(path) as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, 1):
             parts = line.split()
             if not parts:
                 continue
-            lab = parts[0]
-            labels.append(1 if lab.lstrip("+").startswith(("1",)) and not lab.startswith("-") else -1)
+            lab_val = float(parts[0])
+            if lab_val == 1:
+                labels.append(1)
+            elif lab_val == -1:
+                labels.append(-1)
+            else:
+                raise ValueError(
+                    f"{path}:{lineno}: label {parts[0]!r} is not +-1; this "
+                    "converter handles binary LIBSVM files only (relabel "
+                    "multiclass/0-1 data first)")
             feats = {}
             for tok in parts[1:]:
                 idx_s, val_s = tok.split(":")

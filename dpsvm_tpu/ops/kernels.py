@@ -85,6 +85,18 @@ def kernel_from_dots(
     raise ValueError(f"unknown kernel kind {params.kind!r}")
 
 
+def kernel_diag(x_sq: jax.Array, params: KernelParams) -> jax.Array:
+    """Diagonal K(x_i, x_i) for all rows, from the cached squared norms:
+    dot(x_i, x_i) == |x_i|^2, so this is kernel_from_dots applied
+    elementwise (for rbf the distance term cancels to 0 -> 1). Needed by
+    second-order working-set selection for the curvature eta_ij."""
+    x_sq = x_sq.astype(jnp.float32)
+    if params.kind == "rbf":
+        # Shortcut the exp(0): exact ones, no transcendental.
+        return jnp.ones_like(x_sq)
+    return kernel_from_dots(x_sq, x_sq, x_sq, params)
+
+
 def kernel_rows(
     x: jax.Array,
     x_sq: jax.Array,

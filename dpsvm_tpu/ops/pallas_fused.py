@@ -1,0 +1,141 @@
+"""Fused Pallas TPU kernel: rank-2 gradient update + next working-set
+selection in ONE pass over HBM.
+
+Motivation (SURVEY.md section 7.1 step 7): per SMO iteration the XLA
+engine streams f several times — the f-update reads (f, d_hi, d_lo, x_sq)
+and writes f, then the next iteration's selection re-reads (f, alpha, y).
+At n ~ 60k each stream is only ~240 KB, so per-kernel launch/fusion
+boundaries dominate; fusing update+selection halves the passes over f and
+cuts the per-iteration kernel count. This is the TPU counterpart of the
+reference fusing classify+reduce into one Thrust pass (svmTrain.cu:469-476)
+— except here the *update* is fused in too, which the reference could not
+do because its update and selection straddle an MPI round trip.
+
+The kernel computes, per grid block of 128-lane rows:
+
+    k_hi = kernel(d_hi, x_sq, qsq_hi)        # rebuild kernel row values
+    k_lo = kernel(d_lo, x_sq, qsq_lo)        #   (svmTrain.cu:128-135 algebra)
+    f'   = f + coef_hi * k_hi + coef_lo * k_lo
+    partial min/argmin of f' over I_up, max/argmax over I_low
+
+and a tiny jnp epilogue reduces the per-block partials. Selection masks
+use the ALREADY-UPDATED alpha (the caller scatters the pair first), so the
+result equals running selection at the top of the next iteration — the
+solver loop is software-pipelined around it (see solver/smo.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots
+
+LANES = 128
+_BIG = float("inf")  # plain float: a jnp scalar here would be a captured constant
+
+
+def _fused_kernel(scalars_ref, f_ref, alpha_ref, y_ref, valid_ref,
+                  d_hi_ref, d_lo_ref, x_sq_ref,
+                  f_out_ref, bhi_ref, ihi_ref, blo_ref, ilo_ref,
+                  *, kp: KernelParams, c: float, rows_per_block: int):
+    """One grid step: update a (rows, 128) block of f and emit selection
+    partials for it."""
+    coef_hi = scalars_ref[0]
+    coef_lo = scalars_ref[1]
+    qsq_hi = scalars_ref[2]
+    qsq_lo = scalars_ref[3]
+
+    x_sq = x_sq_ref[:]
+    k_hi = kernel_from_dots(d_hi_ref[:], x_sq, qsq_hi, kp)
+    k_lo = kernel_from_dots(d_lo_ref[:], x_sq, qsq_lo, kp)
+    f_new = f_ref[:] + coef_hi * k_hi + coef_lo * k_lo
+    f_out_ref[:] = f_new
+
+    alpha = alpha_ref[:]
+    y = y_ref[:]
+    valid = valid_ref[:] != 0
+    pos = y > 0
+    up = jnp.where(pos, alpha < c, alpha > 0) & valid
+    low = jnp.where(pos, alpha > 0, alpha < c) & valid
+
+    rows = rows_per_block
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    base = pl.program_id(0) * (rows * LANES)
+    flat_ids = base + row_ids * LANES + col_ids
+
+    f_up = jnp.where(up, f_new, _BIG)
+    f_low = jnp.where(low, f_new, -_BIG)
+    # Lowest-global-index tie-break, matching jnp.argmin/argmax first-hit
+    # semantics (SURVEY.md 7.3 item 4): among equal extrema prefer the
+    # smallest flat id.
+    bhi = jnp.min(f_up)
+    ihi = jnp.min(jnp.where(f_up == bhi, flat_ids, jnp.int32(2**31 - 1)))
+    blo = jnp.max(f_low)
+    ilo = jnp.min(jnp.where(f_low == blo, flat_ids, jnp.int32(2**31 - 1)))
+
+    bhi_ref[0] = bhi
+    ihi_ref[0] = ihi
+    blo_ref[0] = blo
+    ilo_ref[0] = ilo
+
+
+@functools.partial(jax.jit, static_argnames=("kp", "c", "block_rows", "interpret"))
+def fused_update_select(
+    f2d: jax.Array,  # (R, 128) float32 — f, lane-tiled
+    alpha2d: jax.Array,  # (R, 128) float32
+    y2d: jax.Array,  # (R, 128) float32 (+-1)
+    valid2d: jax.Array,  # (R, 128) int8 (1 = real row)
+    d_hi2d: jax.Array,  # (R, 128) float32 dot row for the hi index
+    d_lo2d: jax.Array,  # (R, 128) float32 dot row for the lo index
+    x_sq2d: jax.Array,  # (R, 128) float32
+    scalars: jax.Array,  # (4,) float32: coef_hi, coef_lo, qsq_hi, qsq_lo
+    kp: KernelParams,
+    c: float,
+    block_rows: int = 64,
+    interpret: bool = False,
+):
+    """Returns (f_new2d, b_hi, i_hi, b_lo, i_lo) with flat int32 indices.
+
+    Arrays are shaped (R, 128) where R = n_padded / 128; padding rows must
+    have valid == 0.
+    """
+    rows = f2d.shape[0]
+    assert rows % block_rows == 0, (rows, block_rows)
+    nblocks = rows // block_rows
+
+    block = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    part = pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM)
+    kern = functools.partial(_fused_kernel, kp=kp, c=c,
+                             rows_per_block=block_rows)
+
+    f_new, bhi_p, ihi_p, blo_p, ilo_p = pl.pallas_call(
+        kern,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # scalars, whole array
+            block, block, block, block, block, block, block,
+        ],
+        out_specs=[block, part, part, part, part],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks,), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks,), jnp.int32),
+            jax.ShapeDtypeStruct((nblocks,), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(scalars, f2d, alpha2d, y2d, valid2d, d_hi2d, d_lo2d, x_sq2d)
+
+    # Epilogue: reduce the per-block partials (nblocks is tiny).
+    b_hi = jnp.min(bhi_p)
+    i_hi = jnp.min(jnp.where(bhi_p == b_hi, ihi_p, jnp.int32(2**31 - 1)))
+    b_lo = jnp.max(blo_p)
+    i_lo = jnp.min(jnp.where(blo_p == b_lo, ilo_p, jnp.int32(2**31 - 1)))
+    return f_new, b_hi, i_hi, b_lo, i_lo

@@ -40,9 +40,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SVMConfig
-from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_diag, kernel_from_dots
 from dpsvm_tpu.ops.select import up_mask, low_mask
-from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_pair
+from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
 from dpsvm_tpu.solver.smo import SMOState
 from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh, pad_rows
@@ -102,21 +102,108 @@ def _gather_scalar(v_loc, owner_mask):
 
 def _pair_kernel(q_a, q_b, kp: KernelParams):
     """K(q_a, q_b) for two replicated rows (the reference's host CBLAS
-    rbf_kernel eta evaluations, svmTrain.cu:696-714 — here on device)."""
-    dot = jnp.sum(q_a * q_b)
-    if kp.kind == "linear":
-        return dot
-    if kp.kind == "rbf":
-        sq = jnp.maximum(jnp.sum(q_a * q_a) + jnp.sum(q_b * q_b) - 2.0 * dot, 0.0)
-        return jnp.exp(-kp.gamma * sq)
-    if kp.kind == "poly":
-        return (kp.gamma * dot + kp.coef0) ** kp.degree
-    if kp.kind == "sigmoid":
-        return jnp.tanh(kp.gamma * dot + kp.coef0)
-    raise ValueError(kp.kind)
+    rbf_kernel eta evaluations, svmTrain.cu:696-714 — here on device, via
+    the shared dot-product kernel reconstruction)."""
+    return kernel_from_dots(
+        jnp.sum(q_a * q_b), jnp.sum(q_a * q_a), jnp.sum(q_b * q_b), kp)
 
 
-def _iteration(x_loc, y_loc, x_sq_loc, valid_loc, state: SMOState,
+def _pair_update_local(state, y_loc, own_hi, own_lo, b_hi_pair, b_lo_pair,
+                       k_hi, k_lo, eta, c, gate=None):
+    """Shared distributed tail: replicated alpha-pair algebra + local
+    scatter + local rank-2 f update. `gate=False` forces an exact no-op
+    (see solver/smo.py _apply_pair_update)."""
+    ok = jnp.isfinite(b_hi_pair) & jnp.isfinite(b_lo_pair)
+    if gate is not None:
+        ok = ok & gate
+    y_hi = _gather_scalar(y_loc, own_hi)
+    y_lo = _gather_scalar(y_loc, own_lo)
+    a_hi_old = _gather_scalar(state.alpha, own_hi)
+    a_lo_old = _gather_scalar(state.alpha, own_lo)
+    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi_pair - b_lo_pair) / eta, 0.0, c)
+    a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
+    a_lo_new = jnp.where(ok, a_lo_new, a_lo_old)
+    a_hi_new = jnp.where(ok, a_hi_new, a_hi_old)
+    # lo writes first, hi wins on i_hi == i_lo (matches seq.cpp:248-251).
+    alpha = jnp.where(own_lo, a_lo_new, state.alpha)
+    alpha = jnp.where(own_hi, a_hi_new, alpha)
+    f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
+                + (a_lo_new - a_lo_old) * y_lo * k_lo
+    return alpha, f
+
+
+def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
+                    state: SMOState, kp: KernelParams, c: float, tau: float,
+                    use_cache: bool) -> SMOState:
+    """Distributed second-order (WSS2) iteration: i by max violation
+    (first all_gather round), j by max second-order gain over the sharded
+    candidates (second all_gather round). See solver/smo.py
+    _smo_iteration_wss2 for the single-chip derivation."""
+    n_loc = x_loc.shape[0]
+    gids = _global_ids(n_loc)
+    up = up_mask(state.alpha, y_loc, c) & valid_loc
+    low = low_mask(state.alpha, y_loc, c) & valid_loc
+    f_up = jnp.where(up, state.f, jnp.inf)
+    f_low = jnp.where(low, state.f, -jnp.inf)
+    l_hi = jnp.argmin(f_up).astype(jnp.int32)
+
+    # Round 1: global i (min f over I_up) + global b_lo (convergence gap).
+    cand_vals = jnp.stack([f_up[l_hi], jnp.max(f_low)])
+    cand_idx = jnp.stack([gids[l_hi], jnp.int32(0)])
+    g_vals = lax.all_gather(cand_vals, DATA_AXIS)
+    g_idx = lax.all_gather(cand_idx, DATA_AXIS)
+    b_hi = jnp.min(g_vals[:, 0])
+    i_hi = jnp.min(jnp.where(g_vals[:, 0] == b_hi, g_idx[:, 0], _I32_MAX))
+    b_lo = jnp.max(g_vals[:, 1])
+
+    own_hi = gids == i_hi
+    q_hi = _gather_row(x_loc, own_hi)
+    q_hi_sq = jnp.sum(q_hi * q_hi)
+    stamp = 2 * state.it.astype(jnp.int32)
+    if use_cache:
+        d_hi, cache, hit_hi = lookup_one(
+            state.cache, x_loc, i_hi, q_hi.astype(x_loc.dtype), stamp + 1)
+    else:
+        from dpsvm_tpu.ops.kernels import row_dots
+        d_hi, cache, hit_hi = (row_dots(x_loc, q_hi.astype(x_loc.dtype)),
+                               state.cache, jnp.bool_(False))
+    k_hi = kernel_from_dots(d_hi, x_sq_loc, q_hi_sq, kp)
+
+    # Round 2: global j by second-order gain over local I_low candidates.
+    k_hh = _pair_kernel(q_hi, q_hi, kp)
+    diff = state.f - b_hi
+    eta_j = jnp.maximum(k_hh + k_diag_loc - 2.0 * k_hi, tau)
+    gain = jnp.where(low & (diff > 0), diff * diff / eta_j, -jnp.inf)
+    l_lo = jnp.argmax(gain).astype(jnp.int32)
+    g_gain = lax.all_gather(gain[l_lo], DATA_AXIS)
+    g_jidx = lax.all_gather(gids[l_lo], DATA_AXIS)
+    best = jnp.max(g_gain)
+    any_elig = best > -jnp.inf
+    i_lo = jnp.where(any_elig,
+                     jnp.min(jnp.where(g_gain == best, g_jidx, _I32_MAX)),
+                     i_hi).astype(jnp.int32)
+    own_lo = gids == i_lo
+    b_lo_pair = _gather_scalar(state.f, own_lo)
+
+    q_lo = _gather_row(x_loc, own_lo)
+    q_lo_sq = jnp.sum(q_lo * q_lo)
+    if use_cache:
+        d_lo, cache, hit_lo = lookup_one(
+            cache, x_loc, i_lo, q_lo.astype(x_loc.dtype), stamp + 2)
+    else:
+        from dpsvm_tpu.ops.kernels import row_dots
+        d_lo, hit_lo = row_dots(x_loc, q_lo.astype(x_loc.dtype)), jnp.bool_(False)
+    k_lo = kernel_from_dots(d_lo, x_sq_loc, q_lo_sq, kp)
+
+    eta = jnp.maximum(k_hh + _pair_kernel(q_lo, q_lo, kp)
+                      - 2.0 * _pair_kernel(q_hi, q_lo, kp), tau)
+    n_hits = hit_hi.astype(jnp.int32) + hit_lo.astype(jnp.int32)
+    alpha, f = _pair_update_local(state, y_loc, own_hi, own_lo, b_hi,
+                                  b_lo_pair, k_hi, k_lo, eta, c, gate=any_elig)
+    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
+
+
+def _iteration(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state: SMOState,
                kp: KernelParams, c: float, tau: float, use_cache: bool) -> SMOState:
     """One distributed SMO iteration; runs identically on every device."""
     n_loc = x_loc.shape[0]
@@ -148,36 +235,29 @@ def _iteration(x_loc, y_loc, x_sq_loc, valid_loc, state: SMOState,
         - 2.0 * _pair_kernel(q_hi, q_lo, kp),
         tau)
 
-    y_hi = _gather_scalar(y_loc, own_hi)
-    y_lo = _gather_scalar(y_loc, own_lo)
-    a_hi_old = _gather_scalar(state.alpha, own_hi)
-    a_lo_old = _gather_scalar(state.alpha, own_lo)
-
-    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi - b_lo) / eta, 0.0, c)
-    a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
-    # lo writes first, hi wins on i_hi == i_lo (matches seq.cpp:248-251).
-    alpha = jnp.where(own_lo, a_lo_new, state.alpha)
-    alpha = jnp.where(own_hi, a_hi_new, alpha)
-
-    f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
-                + (a_lo_new - a_lo_old) * y_lo * k_lo
-
+    alpha, f = _pair_update_local(state, y_loc, own_hi, own_lo, b_hi, b_lo,
+                                  k_hi, k_lo, eta, c)
     return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
 
 
-def _make_chunk_runner(mesh: Mesh, kp: KernelParams, c: float, eps: float,
-                       tau: float, chunk: int, use_cache: bool):
-    """Build the jitted shard_mapped chunk executor."""
+_ITERATION_FNS = {"mvp": _iteration, "second_order": _iteration_wss2}
 
-    def chunk_body(x_loc, y_loc, x_sq_loc, valid_loc, state, max_iter):
+
+def _make_chunk_runner(mesh: Mesh, kp: KernelParams, c: float, eps: float,
+                       tau: float, chunk: int, use_cache: bool,
+                       selection: str = "mvp"):
+    """Build the jitted shard_mapped chunk executor."""
+    step = _ITERATION_FNS[selection]
+
+    def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state, max_iter):
         end = jnp.minimum(state.it + chunk, max_iter)
 
         def cond(st):
             return (st.it < end) & (st.b_lo > st.b_hi + 2.0 * eps)
 
         def body(st):
-            return _iteration(x_loc, y_loc, x_sq_loc, valid_loc, st,
-                              kp, c, tau, use_cache)
+            return step(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, st,
+                        kp, c, tau, use_cache)
 
         return lax.while_loop(cond, body, state)
 
@@ -191,7 +271,7 @@ def _make_chunk_runner(mesh: Mesh, kp: KernelParams, c: float, eps: float,
     mapped = jax.shard_map(
         chunk_body,
         mesh=mesh,
-        in_specs=(shard, shard, shard, shard, state_specs, rep),
+        in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
         out_specs=state_specs,
         check_vma=False,
     )
@@ -232,8 +312,9 @@ def solve_mesh(
     rep = NamedSharding(mesh, P())
     x_dev = jax.device_put(jnp.asarray(x_p, dtype), shard)
     y_dev = jax.device_put(jnp.asarray(y_p), shard)
-    x_sq = jax.device_put(
-        jnp.asarray(np.einsum("nd,nd->n", x_p, x_p, dtype=np.float32)), shard)
+    x_sq_np = np.einsum("nd,nd->n", x_p, x_p, dtype=np.float32)
+    x_sq = jax.device_put(jnp.asarray(x_sq_np), shard)
+    k_diag = jax.device_put(kernel_diag(jnp.asarray(x_sq_np), kp), shard)
     valid_dev = jax.device_put(jnp.asarray(valid), shard)
 
     cache_lines = min(config.cache_lines, n_pad // n_dev)
@@ -268,14 +349,16 @@ def solve_mesh(
                 it=jax.device_put(jnp.int32(it0), rep))
     run_chunk = _make_chunk_runner(mesh, kp, float(config.c), float(config.epsilon),
                                    float(config.tau), int(config.chunk_iters),
-                                   use_cache)
+                                   use_cache, config.selection)
     max_iter = jnp.int32(config.max_iter)
     start_iter = int(state.it)
     ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
+    if callback is not None and hasattr(callback, "on_start"):
+        callback.on_start(start_iter)
 
     t0 = time.perf_counter()
     while True:
-        state = run_chunk(x_dev, y_dev, x_sq, valid_dev, state, max_iter)
+        state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter)
         it = int(state.it)
         b_hi = float(state.b_hi)
         b_lo = float(state.b_lo)

@@ -104,5 +104,31 @@ def lookup_pair(
     return row_hi, row_lo, new_cache, n_hits
 
 
+def lookup_one(
+    cache: CacheState,
+    x: jax.Array,
+    i: jax.Array,
+    q: jax.Array,
+    stamp: jax.Array,
+):
+    """Fetch the dot row for a single index (used by second-order selection,
+    which must see row i before choosing j). Returns (row, new_cache, hit)."""
+    hit_vec = cache.keys == i
+    hit = jnp.any(hit_vec)
+    slot = jnp.where(hit, jnp.argmax(hit_vec), jnp.argmin(cache.ticks))
+    slot = slot.astype(jnp.int32)
+    row = lax.cond(
+        hit,
+        lambda _: _read(cache.data, slot),
+        lambda _: row_dots(x, q),
+        None)
+    new_cache = CacheState(
+        data=cache.data.at[slot].set(row),
+        keys=cache.keys.at[slot].set(i),
+        ticks=cache.ticks.at[slot].set(stamp),
+    )
+    return row, new_cache, hit
+
+
 def _read(data: jax.Array, slot: jax.Array) -> jax.Array:
     return lax.dynamic_index_in_dim(data, slot, axis=0, keepdims=False)

@@ -22,9 +22,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from dpsvm_tpu.config import SVMConfig
-from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, row_dots, squared_norms
-from dpsvm_tpu.ops.select import select_working_set
-from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_pair
+from dpsvm_tpu.ops.kernels import (
+    KernelParams,
+    kernel_diag,
+    kernel_from_dots,
+    row_dots,
+    squared_norms,
+)
+from dpsvm_tpu.ops.select import low_mask, select_working_set, up_mask
+from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
 
 
@@ -54,9 +60,36 @@ def init_state(n: int, y: jax.Array, cache_lines: int) -> SMOState:
     )
 
 
-def _smo_iteration(x, y, x_sq, valid, state: SMOState, kp: KernelParams,
+def _apply_pair_update(state: SMOState, y, i_hi, i_lo, b_hi_pair, b_lo_pair,
+                       k_hi, k_lo, eta, c, gate=None) -> tuple:
+    """Shared tail of an SMO iteration: alpha-pair algebra + rank-2 f
+    update (svmTrainMain.cpp:285-299 + update_functor svmTrain.cu:98-137).
+
+    `gate` (bool scalar) forces an exact no-op when False — used when a
+    selection round found no admissible pair (empty I_up/I_low after alpha
+    hit the bounds), where the +-inf sentinels would otherwise clip alpha
+    to a bound and desynchronize f from alpha.
+    """
+    ok = jnp.isfinite(b_hi_pair) & jnp.isfinite(b_lo_pair)
+    if gate is not None:
+        ok = ok & gate
+    y_hi = y[i_hi].astype(jnp.float32)
+    y_lo = y[i_lo].astype(jnp.float32)
+    a_hi_old = state.alpha[i_hi]
+    a_lo_old = state.alpha[i_lo]
+    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi_pair - b_lo_pair) / eta, 0.0, c)
+    a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
+    a_lo_new = jnp.where(ok, a_lo_new, a_lo_old)
+    a_hi_new = jnp.where(ok, a_hi_new, a_hi_old)
+    alpha = state.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
+    f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
+                + (a_lo_new - a_lo_old) * y_lo * k_lo
+    return alpha, f
+
+
+def _smo_iteration(x, y, x_sq, k_diag, valid, state: SMOState, kp: KernelParams,
                    c: float, tau: float, use_cache: bool) -> SMOState:
-    """One modified-SMO iteration (the body of the compiled loop)."""
+    """One reference-parity (maximal-violating-pair) SMO iteration."""
     i_hi, b_hi, i_lo, b_lo = select_working_set(state.f, state.alpha, y, c, valid)
 
     q_hi = lax.dynamic_index_in_dim(x, i_hi, 0, keepdims=False)
@@ -75,34 +108,163 @@ def _smo_iteration(x, y, x_sq, valid, state: SMOState, kp: KernelParams,
     # reference divides unguarded at svmTrainMain.cpp:290).
     eta = jnp.maximum(k_hi[i_hi] + k_lo[i_lo] - 2.0 * k_hi[i_lo], tau)
 
-    y_hi = y[i_hi].astype(jnp.float32)
-    y_lo = y[i_lo].astype(jnp.float32)
-    a_hi_old = state.alpha[i_hi]
-    a_lo_old = state.alpha[i_lo]
-    # Pair update + clip (svmTrainMain.cpp:285-299).
-    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi - b_lo) / eta, 0.0, c)
-    a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
-    alpha = state.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
-
-    # Rank-2 gradient update (update_functor, svmTrain.cu:98-137).
-    f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
-                + (a_lo_new - a_lo_old) * y_lo * k_lo
-
+    alpha, f = _apply_pair_update(state, y, i_hi, i_lo, b_hi, b_lo,
+                                  k_hi, k_lo, eta, c)
     return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
 
 
-@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "chunk", "use_cache"))
-def _run_chunk(x, y, x_sq, valid, state: SMOState, max_iter,
+def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
+                        kp: KernelParams, c: float, tau: float,
+                        use_cache: bool) -> SMOState:
+    """One second-order (WSS2) iteration: i by max violation, j by max
+    second-order gain (f_j - f_i)^2 / eta_ij over eligible I_low.
+
+    No reference equivalent — this is the LibSVM working-set rule, offered
+    because the row of kernel values needed for the gain is exactly the
+    row the f update fetches anyway, so the extra selection is one more
+    O(n) pass for typically several-fold fewer iterations.
+    """
+    up = up_mask(state.alpha, y, c)
+    low = low_mask(state.alpha, y, c)
+    if valid is not None:
+        up = up & valid
+        low = low & valid
+    f_up = jnp.where(up, state.f, jnp.inf)
+    f_low = jnp.where(low, state.f, -jnp.inf)
+    i_hi = jnp.argmin(f_up).astype(jnp.int32)
+    b_hi = f_up[i_hi]
+    b_lo = jnp.max(f_low)  # convergence gap still uses the max violator
+
+    q_hi = lax.dynamic_index_in_dim(x, i_hi, 0, keepdims=False)
+    stamp = 2 * state.it.astype(jnp.int32)
+    if use_cache:
+        d_hi, cache, hit_hi = lookup_one(state.cache, x, i_hi, q_hi, stamp + 1)
+    else:
+        d_hi, cache, hit_hi = row_dots(x, q_hi), state.cache, jnp.bool_(False)
+    k_hi = kernel_from_dots(d_hi, x_sq, x_sq[i_hi], kp)
+
+    diff = state.f - b_hi  # f_j - f_i
+    eta_j = jnp.maximum(k_diag[i_hi] + k_diag - 2.0 * k_hi, tau)
+    gain = jnp.where(low & (diff > 0), diff * diff / eta_j, -jnp.inf)
+    any_elig = jnp.any(gain > -jnp.inf)
+    # No eligible j <=> b_lo <= b_hi <=> converged; make the update a no-op
+    # by degenerating to i_lo = i_hi (deltas become exactly 0).
+    i_lo = jnp.where(any_elig, jnp.argmax(gain), i_hi).astype(jnp.int32)
+    b_lo_pair = state.f[i_lo]
+
+    q_lo = lax.dynamic_index_in_dim(x, i_lo, 0, keepdims=False)
+    if use_cache:
+        d_lo, cache, hit_lo = lookup_one(cache, x, i_lo, q_lo, stamp + 2)
+    else:
+        d_lo, hit_lo = row_dots(x, q_lo), jnp.bool_(False)
+    k_lo = kernel_from_dots(d_lo, x_sq, x_sq[i_lo], kp)
+
+    eta = jnp.maximum(k_diag[i_hi] + k_diag[i_lo] - 2.0 * k_hi[i_lo], tau)
+    n_hits = hit_hi.astype(jnp.int32) + hit_lo.astype(jnp.int32)
+    alpha, f = _apply_pair_update(state, y, i_hi, i_lo, b_hi, b_lo_pair,
+                                  k_hi, k_lo, eta, c, gate=any_elig)
+    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
+
+
+_ITERATION_FNS = {"mvp": _smo_iteration, "second_order": _smo_iteration_wss2}
+
+
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "chunk",
+                                   "use_cache", "block_rows", "interpret"))
+def _run_chunk_pallas(x, y, x_sq, valid, state: SMOState, max_iter,
+                      kp: KernelParams, c: float, eps: float, tau: float,
+                      chunk: int, use_cache: bool, block_rows: int,
+                      interpret: bool) -> SMOState:
+    """Software-pipelined chunk executor built on the fused Pallas kernel
+    (ops/pallas_fused.py): each loop body applies iteration t's rank-2
+    update AND computes iteration t+1's selection in one pass over f.
+
+    Requires n padded to a multiple of block_rows*128 with `valid`
+    marking real rows. Semantics note: unlike the reference's do-while
+    (svmTrainMain.cpp:235-310), the loop stops as soon as a post-update
+    selection shows convergence, skipping the reference's final
+    degenerate update — iteration counts can differ by one.
+    """
+    from dpsvm_tpu.ops.pallas_fused import LANES, fused_update_select
+
+    n_pad = y.shape[0]
+    rows = n_pad // LANES
+    shp = (rows, LANES)
+    y2d = y.reshape(shp)
+    valid2d = valid.astype(jnp.int8).reshape(shp)
+    x_sq2d = x_sq.reshape(shp)
+
+    # Seed selection for the pipelined carry (top-of-iteration values).
+    i_hi0, b_hi0, i_lo0, b_lo0 = select_working_set(
+        state.f, state.alpha, y, c, valid)
+    end = jnp.minimum(state.it + chunk, max_iter)
+
+    def cond(carry):
+        st, i_hi, i_lo = carry
+        return (st.it < end) & (st.b_lo > st.b_hi + 2.0 * eps)
+
+    def body(carry):
+        st, i_hi, i_lo = carry
+        q_hi = lax.dynamic_index_in_dim(x, i_hi, 0, keepdims=False)
+        q_lo = lax.dynamic_index_in_dim(x, i_lo, 0, keepdims=False)
+        if use_cache:
+            d_hi, d_lo, cache, n_hits = lookup_pair(
+                st.cache, x, i_hi, i_lo, q_hi, q_lo, st.it)
+        else:
+            d2 = row_dots(x, jnp.stack([q_hi, q_lo]))
+            d_hi, d_lo, cache, n_hits = d2[0], d2[1], st.cache, jnp.int32(0)
+
+        qsq_hi = x_sq[i_hi]
+        qsq_lo = x_sq[i_lo]
+        k_hh = kernel_from_dots(d_hi[i_hi], qsq_hi, qsq_hi, kp)
+        k_ll = kernel_from_dots(d_lo[i_lo], qsq_lo, qsq_lo, kp)
+        k_hl = kernel_from_dots(d_hi[i_lo], qsq_lo, qsq_hi, kp)
+        eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
+
+        ok = jnp.isfinite(st.b_hi) & jnp.isfinite(st.b_lo)
+        y_hi = y[i_hi]
+        y_lo = y[i_lo]
+        a_hi_old = st.alpha[i_hi]
+        a_lo_old = st.alpha[i_lo]
+        a_lo_new = jnp.clip(a_lo_old + y_lo * (st.b_hi - st.b_lo) / eta, 0.0, c)
+        a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
+        a_lo_new = jnp.where(ok, a_lo_new, a_lo_old)
+        a_hi_new = jnp.where(ok, a_hi_new, a_hi_old)
+        alpha = st.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
+
+        scalars = jnp.stack([
+            (a_hi_new - a_hi_old) * y_hi,
+            (a_lo_new - a_lo_old) * y_lo,
+            qsq_hi, qsq_lo,
+        ])
+        f2d, b_hi, i_hi_n, b_lo, i_lo_n = fused_update_select(
+            st.f.reshape(shp), alpha.reshape(shp), y2d, valid2d,
+            d_hi.reshape(shp), d_lo.reshape(shp), x_sq2d, scalars,
+            kp, c, block_rows=block_rows, interpret=interpret)
+
+        new_st = SMOState(alpha, f2d.reshape(n_pad), b_hi, b_lo,
+                          st.it + 1, cache, st.hits + n_hits)
+        return new_st, i_hi_n, i_lo_n
+
+    st0 = state._replace(b_hi=b_hi0, b_lo=b_lo0)
+    final, _, _ = lax.while_loop(cond, body, (st0, i_hi0, i_lo0))
+    return final
+
+
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "chunk",
+                                   "use_cache", "selection"))
+def _run_chunk(x, y, x_sq, k_diag, valid, state: SMOState, max_iter,
                kp: KernelParams, c: float, eps: float, tau: float,
-               chunk: int, use_cache: bool) -> SMOState:
+               chunk: int, use_cache: bool, selection: str = "mvp") -> SMOState:
     """Run up to `chunk` SMO iterations fully on device."""
     end = jnp.minimum(state.it + chunk, max_iter)
+    step = _ITERATION_FNS[selection]
 
     def cond(st: SMOState):
         return (st.it < end) & (st.b_lo > st.b_hi + 2.0 * eps)
 
     def body(st: SMOState):
-        return _smo_iteration(x, y, x_sq, valid, st, kp, c, tau, use_cache)
+        return step(x, y, x_sq, k_diag, valid, st, kp, c, tau, use_cache)
 
     return lax.while_loop(cond, body, state)
 
@@ -136,42 +298,75 @@ def solve(
     kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
     dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
+    use_pallas = config.engine == "pallas"
+    block_rows = 64
+    if use_pallas:
+        # Pad rows to a whole number of (block_rows, 128) kernel blocks;
+        # padding is masked out of selection via `valid`.
+        blk = block_rows * 128
+        n_pad = -(-n // blk) * blk
+    else:
+        n_pad = n
+    x_p = np.zeros((n_pad, d), np.float32)
+    x_p[:n] = x
+    y_p = np.ones((n_pad,), np.float32)
+    y_p[:n] = y_np
+    valid_np = np.zeros((n_pad,), bool)
+    valid_np[:n] = True
+
     if device is None:
         device = jax.devices()[0]
-    x_dev = jax.device_put(jnp.asarray(x, dtype), device)
-    y_dev = jax.device_put(jnp.asarray(y_np, jnp.float32), device)
+    x_dev = jax.device_put(jnp.asarray(x_p, dtype), device)
+    y_dev = jax.device_put(jnp.asarray(y_p, jnp.float32), device)
+    valid_dev = jax.device_put(jnp.asarray(valid_np), device) if use_pallas else None
     x_sq = jax.jit(squared_norms)(x_dev)
+    k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq, params=kp)
 
     from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer, resume_solver_state
 
-    cache_lines = min(config.cache_lines, n)
+    cache_lines = min(config.cache_lines, n_pad)
     use_cache = cache_lines > 0
-    state = init_state(n, y_dev, cache_lines if use_cache else 1)
+    state = init_state(n_pad, y_dev, cache_lines if use_cache else 1)
     if resume:
         restored = resume_solver_state(checkpoint_path, config, n)
         if restored is not None:
             a0, f0, it0, bh0, bl0 = restored
+            a_pad = np.zeros((n_pad,), np.float32)
+            a_pad[:n] = a0
+            f_pad = np.asarray(-y_p, np.float32)
+            f_pad[:n] = f0
             state = state._replace(
-                alpha=jnp.asarray(a0), f=jnp.asarray(f0),
+                alpha=jnp.asarray(a_pad), f=jnp.asarray(f_pad),
                 b_hi=jnp.float32(bh0), b_lo=jnp.float32(bl0),
                 it=jnp.int32(it0))
     state = jax.device_put(state, device)
     max_iter = jnp.int32(config.max_iter)
     start_iter = int(state.it)
     ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
+    interpret = jax.devices()[0].platform != "tpu"
+    if callback is not None and hasattr(callback, "on_start"):
+        callback.on_start(start_iter)
 
     t0 = time.perf_counter()
     while True:
-        state = _run_chunk(x_dev, y_dev, x_sq, None, state, max_iter,
-                           kp, float(config.c), float(config.epsilon),
-                           float(config.tau), int(config.chunk_iters), use_cache)
+        if use_pallas:
+            state = _run_chunk_pallas(
+                x_dev, y_dev, x_sq, valid_dev, state, max_iter,
+                kp, float(config.c), float(config.epsilon), float(config.tau),
+                int(config.chunk_iters), use_cache, block_rows, interpret)
+        else:
+            state = _run_chunk(x_dev, y_dev, x_sq, k_diag, None, state, max_iter,
+                               kp, float(config.c), float(config.epsilon),
+                               float(config.tau), int(config.chunk_iters), use_cache,
+                               config.selection)
         it = int(state.it)
         b_hi = float(state.b_hi)
         b_lo = float(state.b_lo)
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
-        ckpt.maybe_save(it, state.alpha, state.f, b_hi, b_lo)
+        ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
+                        np.asarray(state.f)[:n], b_hi, b_lo)
         if config.verbose:
             gap = b_lo - b_hi
             print(f"[smo] iter={it} b_lo-b_hi={gap:.6f} "
@@ -180,7 +375,7 @@ def solve(
             break
     train_seconds = time.perf_counter() - t0
 
-    alpha = np.asarray(state.alpha)
+    alpha = np.asarray(state.alpha)[:n]
     # Hit-rate denominator covers only THIS run's lookups (post-resume).
     total_lookups = 2 * (it - start_iter) if use_cache else 0
     return SolveResult(
@@ -195,6 +390,6 @@ def solve(
             "cache_hits": int(state.hits),
             "cache_lookups": total_lookups,
             "cache_hit_rate": (int(state.hits) / total_lookups) if total_lookups else 0.0,
-            "f": np.asarray(state.f),
+            "f": np.asarray(state.f)[:n],
         },
     )

@@ -49,7 +49,15 @@ def train(
         except ImportError:
             mesh_available = False
         multi = (num_devices or len(jax.devices())) > 1
-        backend = "mesh" if (multi and mesh_available) else "single"
+        # The fused-pallas engine only exists in the single-chip solver.
+        backend = ("mesh" if (multi and mesh_available and config.engine != "pallas")
+                   else "single")
+
+    if backend == "mesh" and config.engine == "pallas":
+        raise ValueError(
+            "engine='pallas' is implemented for the single-chip backend only; "
+            "use backend='single' (the mesh backend would silently run the "
+            "XLA iteration path)")
 
     if backend == "single":
         from dpsvm_tpu.solver.smo import solve

@@ -27,16 +27,27 @@ class MetricsLogger:
         self._sink = sink
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self._t0 = time.perf_counter()
-        self._last_iter = 0
+        self._start_iter: Optional[int] = None  # set by on_start (resume-aware)
+        self._last_iter: Optional[int] = None
         self._last_t = self._t0
         self._print_every = print_every
 
+    def on_start(self, start_iter: int) -> None:
+        """Called by the solver before the loop with the (possibly resumed)
+        starting iteration, so rates don't count pre-resume history."""
+        self._start_iter = start_iter
+        self._last_iter = start_iter
+        self._last_t = time.perf_counter()
+
     def __call__(self, iteration: int, b_hi: float, b_lo: float, state) -> None:
         now = time.perf_counter()
+        if self._last_iter is None:  # solver didn't announce a start
+            self._start_iter = self._last_iter = 0
         d_it = iteration - self._last_iter
         d_t = max(now - self._last_t, 1e-9)
         alpha = state.alpha
-        hits = int(state.hits)
+        hits = int(state.hits)  # counts this run only (not checkpointed)
+        this_run_iters = iteration - (self._start_iter or 0)
         rec = {
             "iteration": iteration,
             "b_hi": b_hi,
@@ -44,7 +55,7 @@ class MetricsLogger:
             "gap": b_lo - b_hi,
             "sv_estimate": int(np.asarray(alpha > 0).sum()),
             "cache_hits": hits,
-            "cache_hit_rate": hits / max(2 * iteration, 1),
+            "cache_hit_rate": hits / max(2 * this_run_iters, 1),
             "iters_per_sec": d_it / d_t,
             "elapsed_sec": now - self._t0,
         }

@@ -1,0 +1,84 @@
+"""Fused Pallas update+select kernel vs a plain-jnp reference
+(interpret mode on CPU; the same kernel compiles natively on TPU)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots
+from dpsvm_tpu.ops.pallas_fused import LANES, fused_update_select
+from dpsvm_tpu.ops.select import select_working_set
+
+
+def _reference(f, alpha, y, valid, d_hi, d_lo, x_sq, scalars, kp, c):
+    k_hi = np.asarray(kernel_from_dots(jnp.asarray(d_hi), jnp.asarray(x_sq),
+                                       jnp.float32(scalars[2]), kp))
+    k_lo = np.asarray(kernel_from_dots(jnp.asarray(d_lo), jnp.asarray(x_sq),
+                                       jnp.float32(scalars[3]), kp))
+    f_new = f + scalars[0] * k_hi + scalars[1] * k_lo
+    i_hi, b_hi, i_lo, b_lo = select_working_set(
+        jnp.asarray(f_new), jnp.asarray(alpha), jnp.asarray(y), c,
+        jnp.asarray(valid))
+    return f_new, float(b_hi), int(i_hi), float(b_lo), int(i_lo)
+
+
+@pytest.mark.parametrize("kind", ["rbf", "linear", "poly"])
+@pytest.mark.parametrize("n_valid", [700, 1024])
+def test_fused_matches_reference(kind, n_valid):
+    rng = np.random.default_rng(3)
+    rows = 8 * 2  # 2 blocks of 8 rows -> n_pad = 2048
+    n_pad = rows * LANES
+    block_rows = 8
+    c = 1.5
+    kp = KernelParams(kind=kind, gamma=0.3, degree=2, coef0=0.5)
+
+    f = rng.normal(size=n_pad).astype(np.float32)
+    alpha = rng.choice([0.0, c, 0.6], size=n_pad).astype(np.float32)
+    y = rng.choice([-1.0, 1.0], size=n_pad).astype(np.float32)
+    valid = np.zeros(n_pad, np.int8)
+    valid[:n_valid] = 1
+    d_hi = rng.normal(size=n_pad).astype(np.float32)
+    d_lo = rng.normal(size=n_pad).astype(np.float32)
+    x_sq = np.abs(rng.normal(size=n_pad)).astype(np.float32)
+    scalars = np.array([0.37, -0.21, 1.3, 0.8], np.float32)
+
+    shp = (rows, LANES)
+    got_f, b_hi, i_hi, b_lo, i_lo = fused_update_select(
+        jnp.asarray(f.reshape(shp)), jnp.asarray(alpha.reshape(shp)),
+        jnp.asarray(y.reshape(shp)), jnp.asarray(valid.reshape(shp)),
+        jnp.asarray(d_hi.reshape(shp)), jnp.asarray(d_lo.reshape(shp)),
+        jnp.asarray(x_sq.reshape(shp)), jnp.asarray(scalars),
+        kp, c, block_rows=block_rows, interpret=True)
+
+    want_f, wb_hi, wi_hi, wb_lo, wi_lo = _reference(
+        f, alpha, y, valid.astype(bool), d_hi, d_lo, x_sq, scalars, kp, c)
+
+    np.testing.assert_allclose(np.asarray(got_f).ravel(), want_f,
+                               rtol=1e-5, atol=1e-5)
+    assert int(i_hi) == wi_hi
+    assert int(i_lo) == wi_lo
+    assert float(b_hi) == pytest.approx(wb_hi, rel=1e-5)
+    assert float(b_lo) == pytest.approx(wb_lo, rel=1e-5)
+
+
+def test_fused_tie_break_lowest_index():
+    # Equal extrema in different blocks: the lower flat index must win,
+    # matching jnp.argmin/argmax first-occurrence semantics.
+    rows, block_rows = 16, 8
+    n_pad = rows * LANES
+    f = np.zeros(n_pad, np.float32)
+    alpha = np.full(n_pad, 0.5, np.float32)
+    y = np.ones(n_pad, np.float32)
+    valid = np.ones(n_pad, np.int8)
+    zeros = np.zeros(n_pad, np.float32)
+    scalars = np.zeros(4, np.float32)
+    shp = (rows, LANES)
+    kp = KernelParams("linear")
+    _, b_hi, i_hi, b_lo, i_lo = fused_update_select(
+        jnp.asarray(f.reshape(shp)), jnp.asarray(alpha.reshape(shp)),
+        jnp.asarray(y.reshape(shp)), jnp.asarray(valid.reshape(shp)),
+        jnp.asarray(zeros.reshape(shp)), jnp.asarray(zeros.reshape(shp)),
+        jnp.asarray(zeros.reshape(shp)), jnp.asarray(scalars),
+        kp, 1.0, block_rows=block_rows, interpret=True)
+    assert int(i_hi) == 0
+    assert int(i_lo) == 0
