@@ -25,7 +25,12 @@ def parse_libsvm(path: str, num_features: int | None = None):
             parts = line.split()
             if not parts:
                 continue
-            lab_val = float(parts[0])
+            try:
+                lab_val = float(parts[0])
+            except ValueError:
+                raise ValueError(
+                    f"{path}:{lineno}: label token {parts[0]!r} is not "
+                    "numeric (comment/header lines are not supported)") from None
             if lab_val == 1:
                 labels.append(1)
             elif lab_val == -1:

@@ -1,3 +1,15 @@
 from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.models.multiclass import (
+    MulticlassSVM,
+    accuracy_multiclass,
+    predict_multiclass,
+    train_multiclass,
+)
 
-__all__ = ["SVMModel"]
+__all__ = [
+    "SVMModel",
+    "MulticlassSVM",
+    "train_multiclass",
+    "predict_multiclass",
+    "accuracy_multiclass",
+]

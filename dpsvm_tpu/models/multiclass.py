@@ -1,0 +1,145 @@
+"""Multiclass SVM via one-vs-rest / one-vs-one reductions.
+
+Capability extension: the reference trains binary C-SVC only (labels are
++-1 straight from the CSV, parse.cpp:31); multiclass problems had to be
+pre-reduced by hand (scripts/convert_mnist_to_odd_even.py collapses the 10
+MNIST digits into even/odd for exactly this reason). Here the reduction is
+part of the framework: K binary solvers (OvR) or K(K-1)/2 (OvO), each an
+independent run of the same single-chip/mesh SMO engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.predict import decision_function
+
+
+@dataclasses.dataclass
+class MulticlassSVM:
+    classes: np.ndarray  # (k,) sorted original labels
+    models: list[SVMModel]  # OvR: k models; OvO: k(k-1)/2 in (i<j) order
+    strategy: str  # "ovr" | "ovo"
+
+    def save(self, path: str) -> None:
+        if not path.endswith(".npz"):
+            raise ValueError("multiclass models are saved as .npz")
+        payload = {
+            "format_version": 1,
+            "strategy": self.strategy,
+            "classes": self.classes,
+            "n_models": len(self.models),
+        }
+        for i, m in enumerate(self.models):
+            payload[f"m{i}_sv_x"] = m.sv_x
+            payload[f"m{i}_sv_alpha"] = m.sv_alpha
+            payload[f"m{i}_sv_y"] = m.sv_y
+            payload[f"m{i}_b"] = np.float32(m.b)
+            payload[f"m{i}_kernel_kind"] = m.kernel.kind
+            payload[f"m{i}_gamma"] = np.float32(m.kernel.gamma)
+            payload[f"m{i}_degree"] = np.int32(m.kernel.degree)
+            payload[f"m{i}_coef0"] = np.float32(m.kernel.coef0)
+        np.savez_compressed(path, **payload)
+
+    @classmethod
+    def load(cls, path: str) -> "MulticlassSVM":
+        from dpsvm_tpu.ops.kernels import KernelParams
+        z = np.load(path, allow_pickle=False)
+        models = []
+        for i in range(int(z["n_models"])):
+            models.append(SVMModel(
+                sv_x=z[f"m{i}_sv_x"].astype(np.float32),
+                sv_alpha=z[f"m{i}_sv_alpha"].astype(np.float32),
+                sv_y=z[f"m{i}_sv_y"].astype(np.int32),
+                b=float(z[f"m{i}_b"]),
+                kernel=KernelParams(
+                    kind=str(z[f"m{i}_kernel_kind"]),
+                    gamma=float(z[f"m{i}_gamma"]),
+                    degree=int(z[f"m{i}_degree"]),
+                    coef0=float(z[f"m{i}_coef0"]),
+                ),
+            ))
+        return cls(classes=z["classes"], models=models, strategy=str(z["strategy"]))
+
+
+def train_multiclass(
+    x,
+    y,
+    config: SVMConfig = SVMConfig(),
+    strategy: str = "ovr",
+    backend: str = "auto",
+    num_devices: Optional[int] = None,
+    verbose: bool = False,
+) -> tuple[MulticlassSVM, list]:
+    """Train a multiclass SVM; y may hold arbitrary integer labels."""
+    from dpsvm_tpu.train import train
+
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y)
+    classes = np.unique(y)
+    if classes.shape[0] < 2:
+        raise ValueError("need at least 2 classes")
+    if classes.shape[0] == 2:
+        strategy = "ovr"  # degenerate: a single binary model either way
+
+    models: list[SVMModel] = []
+    results = []
+    if strategy == "ovr":
+        for k, cls_label in enumerate(classes):
+            yk = np.where(y == cls_label, 1, -1).astype(np.int32)
+            model, res = train(x, yk, config, backend=backend,
+                               num_devices=num_devices)
+            if verbose:
+                print(f"[ovr {k + 1}/{len(classes)}] class={cls_label} "
+                      f"iters={res.iterations} n_sv={res.n_sv}")
+            models.append(model)
+            results.append(res)
+    elif strategy == "ovo":
+        for a in range(len(classes)):
+            for b in range(a + 1, len(classes)):
+                mask = (y == classes[a]) | (y == classes[b])
+                xa = x[mask]
+                ya = np.where(y[mask] == classes[a], 1, -1).astype(np.int32)
+                model, res = train(xa, ya, config, backend=backend,
+                                   num_devices=num_devices)
+                if verbose:
+                    print(f"[ovo {classes[a]} vs {classes[b]}] "
+                          f"iters={res.iterations} n_sv={res.n_sv}")
+                models.append(model)
+                results.append(res)
+    else:
+        raise ValueError(f"unknown strategy {strategy!r}; use 'ovr' or 'ovo'")
+    return MulticlassSVM(classes=classes, models=models, strategy=strategy), results
+
+
+def predict_multiclass(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
+    """Predicted class labels for a batch of query points."""
+    q = np.asarray(q, np.float32)
+    k = len(m.classes)
+    if m.strategy == "ovr":
+        scores = np.stack([decision_function(mm, q, block) for mm in m.models])
+        return m.classes[np.argmax(scores, axis=0)]
+    # OvO majority vote; ties broken by summed decision margins.
+    votes = np.zeros((q.shape[0], k), np.int32)
+    margin = np.zeros((q.shape[0], k), np.float64)
+    idx = 0
+    for a in range(k):
+        for b in range(a + 1, k):
+            d = decision_function(m.models[idx], q, block)
+            win_a = d >= 0
+            votes[:, a] += win_a
+            votes[:, b] += ~win_a
+            margin[:, a] += d
+            margin[:, b] -= d
+            idx += 1
+    best = votes + 1e-9 * np.tanh(margin)  # margins only break ties
+    return m.classes[np.argmax(best, axis=1)]
+
+
+def accuracy_multiclass(m: MulticlassSVM, q, y, block: int = 8192) -> float:
+    return float(np.mean(predict_multiclass(m, q, block) == np.asarray(y)))

@@ -92,6 +92,12 @@ class SVMModel:
             raise ValueError(
                 "the text model format only expresses RBF (reference format, "
                 "svmTrainMain.cpp:386-416); save non-RBF models to .npz")
+        from dpsvm_tpu.utils import native
+        writer = native.get_fastcsv()
+        if writer is not None:
+            writer.write_model(path, float(self.kernel.gamma), float(self.b),
+                               self.sv_alpha, self.sv_y, self.sv_x)
+            return
         with open(path, "w") as fh:
             fh.write(f"{self.kernel.gamma}\n")
             fh.write(f"{self.b}\n")

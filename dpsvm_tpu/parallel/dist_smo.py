@@ -100,30 +100,19 @@ def _gather_scalar(v_loc, owner_mask):
     return lax.psum(jnp.sum(jnp.where(owner_mask, v_loc, 0.0)), DATA_AXIS)
 
 
-def _pair_kernel(q_a, q_b, kp: KernelParams):
-    """K(q_a, q_b) for two replicated rows (the reference's host CBLAS
-    rbf_kernel eta evaluations, svmTrain.cu:696-714 — here on device, via
-    the shared dot-product kernel reconstruction)."""
-    return kernel_from_dots(
-        jnp.sum(q_a * q_b), jnp.sum(q_a * q_a), jnp.sum(q_b * q_b), kp)
-
-
 def _pair_update_local(state, y_loc, own_hi, own_lo, b_hi_pair, b_lo_pair,
                        k_hi, k_lo, eta, c, gate=None):
     """Shared distributed tail: replicated alpha-pair algebra + local
     scatter + local rank-2 f update. `gate=False` forces an exact no-op
     (see solver/smo.py _apply_pair_update)."""
-    ok = jnp.isfinite(b_hi_pair) & jnp.isfinite(b_lo_pair)
-    if gate is not None:
-        ok = ok & gate
+    from dpsvm_tpu.solver.smo import pair_alpha_update
+
     y_hi = _gather_scalar(y_loc, own_hi)
     y_lo = _gather_scalar(y_loc, own_lo)
     a_hi_old = _gather_scalar(state.alpha, own_hi)
     a_lo_old = _gather_scalar(state.alpha, own_lo)
-    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi_pair - b_lo_pair) / eta, 0.0, c)
-    a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
-    a_lo_new = jnp.where(ok, a_lo_new, a_lo_old)
-    a_hi_new = jnp.where(ok, a_hi_new, a_hi_old)
+    a_hi_new, a_lo_new = pair_alpha_update(
+        a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair, eta, c, gate)
     # lo writes first, hi wins on i_hi == i_lo (matches seq.cpp:248-251).
     alpha = jnp.where(own_lo, a_lo_new, state.alpha)
     alpha = jnp.where(own_hi, a_hi_new, alpha)
@@ -170,7 +159,10 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
     k_hi = kernel_from_dots(d_hi, x_sq_loc, q_hi_sq, kp)
 
     # Round 2: global j by second-order gain over local I_low candidates.
-    k_hh = _pair_kernel(q_hi, q_hi, kp)
+    # K(hi,hi) is gathered from the precomputed diagonal (not recomputed
+    # from q_hi) so the reduction is bit-identical to the single-chip
+    # path's k_diag[i_hi] and trajectories stay aligned across backends.
+    k_hh = _gather_scalar(k_diag_loc, own_hi)
     diff = state.f - b_hi
     eta_j = jnp.maximum(k_hh + k_diag_loc - 2.0 * k_hi, tau)
     gain = jnp.where(low & (diff > 0), diff * diff / eta_j, -jnp.inf)
@@ -195,8 +187,12 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
         d_lo, hit_lo = row_dots(x_loc, q_lo.astype(x_loc.dtype)), jnp.bool_(False)
     k_lo = kernel_from_dots(d_lo, x_sq_loc, q_lo_sq, kp)
 
-    eta = jnp.maximum(k_hh + _pair_kernel(q_lo, q_lo, kp)
-                      - 2.0 * _pair_kernel(q_hi, q_lo, kp), tau)
+    # Same bit-identical sourcing for the final eta: diagonal entries from
+    # k_diag, cross term from the fetched hi row (matches single-chip
+    # k_hi[i_lo]).
+    k_ll = _gather_scalar(k_diag_loc, own_lo)
+    k_hl = _gather_scalar(k_hi, own_lo)
+    eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
     n_hits = hit_hi.astype(jnp.int32) + hit_lo.astype(jnp.int32)
     alpha, f = _pair_update_local(state, y_loc, own_hi, own_lo, b_hi,
                                   b_lo_pair, k_hi, k_lo, eta, c, gate=any_elig)
@@ -230,10 +226,13 @@ def _iteration(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state: SMOState,
     k_hi = kernel_from_dots(d_hi, x_sq_loc, q_hi_sq, kp)
     k_lo = kernel_from_dots(d_lo, x_sq_loc, q_lo_sq, kp)
 
-    eta = jnp.maximum(
-        _pair_kernel(q_hi, q_hi, kp) + _pair_kernel(q_lo, q_lo, kp)
-        - 2.0 * _pair_kernel(q_hi, q_lo, kp),
-        tau)
+    # eta sourced from the fetched kernel rows (gathered at the owning
+    # shard), bit-identical to the single-chip k_hi[i_hi]/k_lo[i_lo]/
+    # k_hi[i_lo] reads so mesh and single-chip trajectories stay aligned.
+    k_hh = _gather_scalar(k_hi, own_hi)
+    k_ll = _gather_scalar(k_lo, own_lo)
+    k_hl = _gather_scalar(k_hi, own_lo)
+    eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
 
     alpha, f = _pair_update_local(state, y_loc, own_hi, own_lo, b_hi, b_lo,
                                   k_hi, k_lo, eta, c)
@@ -289,6 +288,10 @@ def solve_mesh(
     resume: bool = False,
 ) -> SolveResult:
     """Train binary C-SVC sharded over the mesh's `data` axis."""
+    if config.engine == "pallas":
+        raise ValueError(
+            "engine='pallas' is implemented for the single-chip solver only; "
+            "the mesh backend would silently run the XLA iteration path")
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)
     n, d = x.shape

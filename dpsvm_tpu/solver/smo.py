@@ -60,27 +60,38 @@ def init_state(n: int, y: jax.Array, cache_lines: int) -> SMOState:
     )
 
 
-def _apply_pair_update(state: SMOState, y, i_hi, i_lo, b_hi_pair, b_lo_pair,
-                       k_hi, k_lo, eta, c, gate=None) -> tuple:
-    """Shared tail of an SMO iteration: alpha-pair algebra + rank-2 f
-    update (svmTrainMain.cpp:285-299 + update_functor svmTrain.cu:98-137).
+def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
+                      eta, c, gate=None):
+    """THE alpha-pair algebra (svmTrainMain.cpp:285-299), shared verbatim
+    by the XLA, Pallas and distributed engines. Returns
+    (a_hi_new, a_lo_new).
 
     `gate` (bool scalar) forces an exact no-op when False — used when a
     selection round found no admissible pair (empty I_up/I_low after alpha
     hit the bounds), where the +-inf sentinels would otherwise clip alpha
-    to a bound and desynchronize f from alpha.
+    to a bound and desynchronize f from alpha. Non-finite pair values are
+    always gated out.
     """
     ok = jnp.isfinite(b_hi_pair) & jnp.isfinite(b_lo_pair)
     if gate is not None:
         ok = ok & gate
-    y_hi = y[i_hi].astype(jnp.float32)
-    y_lo = y[i_lo].astype(jnp.float32)
-    a_hi_old = state.alpha[i_hi]
-    a_lo_old = state.alpha[i_lo]
     a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi_pair - b_lo_pair) / eta, 0.0, c)
     a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
     a_lo_new = jnp.where(ok, a_lo_new, a_lo_old)
     a_hi_new = jnp.where(ok, a_hi_new, a_hi_old)
+    return a_hi_new, a_lo_new
+
+
+def _apply_pair_update(state: SMOState, y, i_hi, i_lo, b_hi_pair, b_lo_pair,
+                       k_hi, k_lo, eta, c, gate=None) -> tuple:
+    """Shared tail of an SMO iteration: alpha-pair algebra + rank-2 f
+    update (update_functor svmTrain.cu:98-137)."""
+    y_hi = y[i_hi].astype(jnp.float32)
+    y_lo = y[i_lo].astype(jnp.float32)
+    a_hi_old = state.alpha[i_hi]
+    a_lo_old = state.alpha[i_lo]
+    a_hi_new, a_lo_new = pair_alpha_update(
+        a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair, eta, c, gate)
     alpha = state.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
     f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
                 + (a_lo_new - a_lo_old) * y_lo * k_lo
@@ -221,15 +232,12 @@ def _run_chunk_pallas(x, y, x_sq, valid, state: SMOState, max_iter,
         k_hl = kernel_from_dots(d_hi[i_lo], qsq_lo, qsq_hi, kp)
         eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
 
-        ok = jnp.isfinite(st.b_hi) & jnp.isfinite(st.b_lo)
         y_hi = y[i_hi]
         y_lo = y[i_lo]
         a_hi_old = st.alpha[i_hi]
         a_lo_old = st.alpha[i_lo]
-        a_lo_new = jnp.clip(a_lo_old + y_lo * (st.b_hi - st.b_lo) / eta, 0.0, c)
-        a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
-        a_lo_new = jnp.where(ok, a_lo_new, a_lo_old)
-        a_hi_new = jnp.where(ok, a_hi_new, a_hi_old)
+        a_hi_new, a_lo_new = pair_alpha_update(
+            a_hi_old, a_lo_old, y_hi, y_lo, st.b_hi, st.b_lo, eta, c)
         alpha = st.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
 
         scalars = jnp.stack([
@@ -307,10 +315,14 @@ def solve(
         n_pad = -(-n // blk) * blk
     else:
         n_pad = n
-    x_p = np.zeros((n_pad, d), np.float32)
-    x_p[:n] = x
-    y_p = np.ones((n_pad,), np.float32)
-    y_p[:n] = y_np
+    if n_pad == n:
+        x_p = x
+        y_p = y_np.astype(np.float32)
+    else:
+        x_p = np.zeros((n_pad, d), np.float32)
+        x_p[:n] = x
+        y_p = np.ones((n_pad,), np.float32)
+        y_p[:n] = y_np
     valid_np = np.zeros((n_pad,), bool)
     valid_np[:n] = True
 

@@ -53,11 +53,12 @@ def train(
         backend = ("mesh" if (multi and mesh_available and config.engine != "pallas")
                    else "single")
 
-    if backend == "mesh" and config.engine == "pallas":
+    if backend == "reference" and (config.engine != "xla"
+                                   or config.selection != "mvp"):
         raise ValueError(
-            "engine='pallas' is implemented for the single-chip backend only; "
-            "use backend='single' (the mesh backend would silently run the "
-            "XLA iteration path)")
+            "backend='reference' is the fixed NumPy oracle (MVP selection, "
+            "host math); it cannot honor engine/selection overrides — drop "
+            "them or pick another backend")
 
     if backend == "single":
         from dpsvm_tpu.solver.smo import solve

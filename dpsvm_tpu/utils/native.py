@@ -42,6 +42,17 @@ class FastCsv:
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int),
         ]
+        lib.fastmodel_write.restype = ctypes.c_long
+        lib.fastmodel_write.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_float,
+            ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.c_long,
+        ]
 
     def shape(self, path: str) -> tuple[int, int]:
         rows = ctypes.c_long()
@@ -50,6 +61,21 @@ class FastCsv:
         if rc != 0:
             raise IOError(f"fastcsv_shape({path}) failed with code {rc}")
         return rows.value, fields.value
+
+    def write_model(self, path: str, gamma: float, b: float,
+                    alpha: np.ndarray, y: np.ndarray, x: np.ndarray) -> None:
+        alpha = np.ascontiguousarray(alpha, np.float32)
+        y = np.ascontiguousarray(y, np.int32)
+        x = np.ascontiguousarray(x, np.float32)
+        n_sv, d = x.shape
+        rc = self._lib.fastmodel_write(
+            path.encode(), ctypes.c_float(gamma), ctypes.c_float(b),
+            alpha.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n_sv, d)
+        if rc < 0:
+            raise IOError(f"fastmodel_write({path}) failed with code {rc}")
 
     def parse(self, path: str, num_rows: int | None = None):
         rows, fields = self.shape(path)
@@ -94,6 +120,9 @@ def get_fastcsv() -> FastCsv | None:
             else:
                 try:
                     _fastcsv_cache.append(FastCsv(ctypes.CDLL(so)))
-                except OSError:
+                except (OSError, AttributeError):
+                    # AttributeError: stale .so missing newer symbols —
+                    # every native component must degrade to the
+                    # NumPy/Python fallback, never crash the caller.
                     _fastcsv_cache.append(None)
         return _fastcsv_cache[0]

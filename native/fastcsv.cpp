@@ -106,4 +106,29 @@ long fastcsv_parse(const char* path, long n_rows, long n_fields,
     return row;
 }
 
+// Write the text model format (gamma line, b line, then one
+// "alpha,y,x1,...,xd" row per support vector — the layout of the
+// reference's distributed writer, svmTrainMain.cpp:386-416). The Python
+// fallback calls repr() per float (~15M calls for an MNIST-scale model);
+// this writes with %.9g, which round-trips float32 exactly.
+long fastmodel_write(const char* path, float gamma, float b,
+                     const float* alpha, const int* y, const float* x,
+                     long n_sv, long d) {
+    FILE* fp = std::fopen(path, "wb");
+    if (!fp) return -1;
+    std::vector<char> iobuf(1 << 20);
+    std::setvbuf(fp, iobuf.data(), _IOFBF, iobuf.size());
+    std::fprintf(fp, "%.9g\n%.9g\n", (double)gamma, (double)b);
+    for (long i = 0; i < n_sv; ++i) {
+        std::fprintf(fp, "%.9g,%d", (double)alpha[i], y[i]);
+        const float* row = x + i * d;
+        for (long j = 0; j < d; ++j) {
+            std::fprintf(fp, ",%.9g", (double)row[j]);
+        }
+        std::fputc('\n', fp);
+    }
+    if (std::fclose(fp) != 0) return -2;
+    return n_sv;
+}
+
 }  // extern "C"

@@ -1,0 +1,72 @@
+"""Multiclass (OvR / OvO) reduction tests vs sklearn's multiclass SVC."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.multiclass import (
+    MulticlassSVM,
+    accuracy_multiclass,
+    predict_multiclass,
+    train_multiclass,
+)
+
+CFG = SVMConfig(c=5.0, gamma=0.2, epsilon=1e-3, max_iter=100_000,
+                cache_lines=32, chunk_iters=256)
+
+
+@pytest.fixture(scope="module")
+def three_class():
+    rng = np.random.default_rng(17)
+    n_per = 150
+    centers = np.array([[2.0, 0, 0, 0], [0, 2.0, 0, 0], [0, 0, 2.0, 0]],
+                       np.float32)
+    xs, ys = [], []
+    for k in range(3):
+        xs.append(rng.normal(size=(n_per, 4)).astype(np.float32) * 0.8 + centers[k])
+        ys.append(np.full(n_per, k + 3))  # labels 3,4,5: not 0-based on purpose
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+@pytest.mark.parametrize("strategy", ["ovr", "ovo"])
+def test_multiclass_matches_sklearn_accuracy(three_class, strategy):
+    from sklearn.svm import SVC
+    x, y = three_class
+    xtr, ytr, xte, yte = x[:360], y[:360], x[360:], y[360:]
+    m, results = train_multiclass(xtr, ytr, CFG, strategy=strategy)
+    assert all(r.converged for r in results)
+    acc = accuracy_multiclass(m, xte, yte)
+    sk = SVC(C=CFG.c, gamma=CFG.gamma, tol=CFG.epsilon).fit(xtr, ytr)
+    assert acc >= sk.score(xte, yte) - 0.03
+    # predictions carry the original (non-contiguous) labels
+    assert set(np.unique(predict_multiclass(m, xte))) <= {3, 4, 5}
+
+
+def test_multiclass_model_count(three_class):
+    x, y = three_class
+    m_ovr, _ = train_multiclass(x[:300], y[:300], CFG, strategy="ovr")
+    assert len(m_ovr.models) == 3
+    m_ovo, _ = train_multiclass(x[:300], y[:300], CFG, strategy="ovo")
+    assert len(m_ovo.models) == 3
+
+
+def test_multiclass_save_load_roundtrip(three_class, tmp_path):
+    x, y = three_class
+    m, _ = train_multiclass(x[:300], y[:300], CFG, strategy="ovr")
+    p = str(tmp_path / "mc.npz")
+    m.save(p)
+    m2 = MulticlassSVM.load(p)
+    np.testing.assert_array_equal(m2.classes, m.classes)
+    assert m2.strategy == "ovr"
+    np.testing.assert_array_equal(
+        predict_multiclass(m2, x[300:]), predict_multiclass(m, x[300:]))
+
+
+def test_multiclass_rejects_single_class():
+    x = np.zeros((10, 3), np.float32)
+    y = np.ones(10, np.int32)
+    with pytest.raises(ValueError):
+        train_multiclass(x, y, CFG)
