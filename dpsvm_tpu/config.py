@@ -69,6 +69,10 @@ class SVMConfig:
 
     # Numerics / runtime knobs (no reference equivalent).
     tau: float = 1e-12  # eta clamp (LibSVM-style guard, fixes bug B2)
+    # Debug mode (SURVEY.md 5.2: the reference has no sanitizers at all):
+    # verify f/alpha stay finite at every chunk boundary and fail loudly
+    # with solver context instead of silently diverging.
+    check_numerics: bool = False
     dtype: str = "float32"  # storage dtype for X ("float32" | "bfloat16")
     chunk_iters: int = 2048  # SMO iterations per on-device while_loop dispatch
     checkpoint_every: int = 0  # iterations between solver checkpoints; 0 = off

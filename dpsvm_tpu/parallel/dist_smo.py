@@ -44,7 +44,7 @@ from dpsvm_tpu.ops.kernels import KernelParams, kernel_diag, kernel_from_dots
 from dpsvm_tpu.ops.select import up_mask, low_mask
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
-from dpsvm_tpu.solver.smo import SMOState
+from dpsvm_tpu.solver.smo import SMOState, assert_finite_state
 from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh, pad_rows
 
 _I32_MAX = jnp.iinfo(jnp.int32).max
@@ -368,6 +368,8 @@ def solve_mesh(
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
+        if config.check_numerics:
+            assert_finite_state(state, it, f"mesh p={n_dev}")
         ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
                         np.asarray(state.f)[:n], b_hi, b_lo)
         if config.verbose:

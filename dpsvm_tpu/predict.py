@@ -10,6 +10,7 @@ models/svm_model.py for how this resolves the reference's bug B5).
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -17,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dpsvm_tpu.models.svm_model import SVMModel
-from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_matrix
 
 
 @partial(jax.jit, static_argnames=("kp",))
@@ -51,3 +52,63 @@ def accuracy(model: SVMModel, q, y, block: int = 8192) -> float:
     (seq_test.cpp:187-210)."""
     pred = predict(model, q, block)
     return float(np.mean(pred == np.asarray(y)))
+
+
+@functools.lru_cache(maxsize=16)
+def _mesh_decision_executor(n_dev: int, kp: KernelParams):
+    """Build (once per mesh-width/kernel) the jitted shard_mapped partial
+    decision sum. jit caches by function identity, so the closure must not
+    be rebuilt per call."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dpsvm_tpu.ops.kernels import kernel_rows, squared_norms
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh
+
+    mesh = make_data_mesh(n_dev)
+
+    def shard_fn(qb, sv_loc, coef_loc, sv_sq_loc):
+        k = kernel_rows(sv_loc, sv_sq_loc, qb, squared_norms(qb), kp)
+        return lax.psum(k @ coef_loc, DATA_AXIS)
+
+    mapped = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P()))
+    return mesh, mapped
+
+
+def decision_function_mesh(model: SVMModel, q, num_devices=None,
+                           block: int = 8192) -> np.ndarray:
+    """Mesh-parallel decision function: support vectors are row-sharded
+    over the `data` axis (like training's X sharding) and per-device
+    partial decision sums are combined with a psum — so inference memory
+    also scales with device count. Query batches are replicated.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS, pad_rows
+
+    if num_devices is None:
+        num_devices = len(jax.devices())
+    mesh, mapped = _mesh_decision_executor(num_devices, model.kernel)
+    q = np.asarray(q, np.float32)
+
+    n_sv = model.n_sv
+    n_pad = pad_rows(n_sv, num_devices)
+    sv = np.zeros((n_pad, model.num_features), np.float32)
+    sv[:n_sv] = model.sv_x
+    coef = np.zeros((n_pad,), np.float32)
+    coef[:n_sv] = model.dual_coef  # padded rows have zero weight -> inert
+
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    sv_dev = jax.device_put(jnp.asarray(sv), shard)
+    coef_dev = jax.device_put(jnp.asarray(coef), shard)
+    sv_sq = jax.device_put(jnp.asarray((sv * sv).sum(1, dtype=np.float32)), shard)
+
+    out = []
+    for s in range(0, q.shape[0], block):
+        qb = jax.device_put(jnp.asarray(q[s:s + block]), rep)
+        out.append(np.asarray(mapped(qb, sv_dev, coef_dev, sv_sq)) - model.b)
+    return np.concatenate(out) if out else np.zeros((0,), np.float32)

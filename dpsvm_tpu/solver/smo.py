@@ -277,6 +277,20 @@ def _run_chunk(x, y, x_sq, k_diag, valid, state: SMOState, max_iter,
     return lax.while_loop(cond, body, state)
 
 
+def assert_finite_state(state: SMOState, it: int, backend: str) -> None:
+    """Chunk-boundary sanitizer (config.check_numerics): the functional
+    solver cannot race, but bad inputs (inf features, absurd gamma/C) can
+    still produce NaN/inf f — fail with context instead of looping to
+    max_iter."""
+    bad_f = int(jnp.sum(~jnp.isfinite(state.f)))
+    bad_a = int(jnp.sum(~jnp.isfinite(state.alpha)))
+    if bad_f or bad_a:
+        raise FloatingPointError(
+            f"[{backend}] non-finite solver state at iteration {it}: "
+            f"{bad_f} bad f entries, {bad_a} bad alpha entries — check "
+            "input features for inf/NaN and gamma/C scaling")
+
+
 def solve(
     x,
     y,
@@ -377,6 +391,8 @@ def solve(
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
+        if config.check_numerics:
+            assert_finite_state(state, it, "single-chip")
         ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
                         np.asarray(state.f)[:n], b_hi, b_lo)
         if config.verbose:

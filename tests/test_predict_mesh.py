@@ -1,0 +1,40 @@
+"""Mesh-parallel inference vs single-device decision function."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.predict import decision_function, decision_function_mesh
+from dpsvm_tpu.solver.smo import solve
+
+
+@pytest.fixture(scope="module")
+def trained(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, cache_lines=16)
+    res = solve(x, y, cfg)
+    return SVMModel.from_dense(x, y, res.alpha, res.b, KernelParams("rbf", 0.1)), x
+
+
+@pytest.mark.parametrize("n_dev", [1, 4, 8])
+def test_mesh_decision_matches_single(trained, n_dev):
+    model, x = trained
+    single = decision_function(model, x)
+    mesh = decision_function_mesh(model, x, num_devices=n_dev)
+    np.testing.assert_allclose(mesh, single, rtol=1e-4, atol=1e-4)
+
+
+def test_mesh_decision_blocked(trained):
+    model, x = trained
+    got = decision_function_mesh(model, x, num_devices=4, block=64)
+    np.testing.assert_allclose(got, decision_function(model, x),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_mesh_decision_empty(trained):
+    model, _ = trained
+    out = decision_function_mesh(model, np.zeros((0, model.num_features)),
+                                 num_devices=2)
+    assert out.shape == (0,)
