@@ -18,11 +18,10 @@ bench:
 smoke:
 	$(PY) -m dpsvm_tpu.cli smoke
 
-native: native/_build/fastcsv.so
-
-native/_build/fastcsv.so: native/fastcsv.cpp
-	mkdir -p native/_build
-	g++ -O3 -shared -fPIC -std=c++17 $< -o $@
+# Delegates to the Python builder so the compile command lives in exactly
+# one place (dpsvm_tpu/utils/native.py, which also fingerprints the flags).
+native:
+	$(PY) -c "from dpsvm_tpu.utils.native import build_all; print('\n'.join(build_all()) or 'native build unavailable')"
 
 # MNIST even-odd (ref Makefile:74: 10 ranks, c=10, g=0.125, e=0.01)
 run_mnist:

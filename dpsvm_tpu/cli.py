@@ -53,7 +53,8 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "update+select TPU kernel)")
     p.add_argument("--degree", type=int, default=3)
     p.add_argument("--coef0", type=float, default=0.0)
-    p.add_argument("--backend", choices=["auto", "single", "mesh", "reference"],
+    p.add_argument("--backend",
+                   choices=["auto", "single", "mesh", "reference", "native"],
                    default="auto")
     p.add_argument("--num-devices", type=int, default=None,
                    help="devices in the data mesh (default: all visible)")

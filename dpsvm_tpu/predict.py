@@ -94,18 +94,26 @@ def decision_function_mesh(model: SVMModel, q, num_devices=None,
     mesh, mapped = _mesh_decision_executor(num_devices, model.kernel)
     q = np.asarray(q, np.float32)
 
-    n_sv = model.n_sv
-    n_pad = pad_rows(n_sv, num_devices)
-    sv = np.zeros((n_pad, model.num_features), np.float32)
-    sv[:n_sv] = model.sv_x
-    coef = np.zeros((n_pad,), np.float32)
-    coef[:n_sv] = model.dual_coef  # padded rows have zero weight -> inert
+    # The padded + sharded SV arrays are cached on the model instance so a
+    # serving loop pays the host copies and H2D transfer once, not per call.
+    prepared = getattr(model, "_mesh_prepared", None)
+    if prepared is not None and prepared[0] == num_devices:
+        sv_dev, coef_dev, sv_sq = prepared[1]
+    else:
+        n_sv = model.n_sv
+        n_pad = pad_rows(n_sv, num_devices)
+        sv = np.zeros((n_pad, model.num_features), np.float32)
+        sv[:n_sv] = model.sv_x
+        coef = np.zeros((n_pad,), np.float32)
+        coef[:n_sv] = model.dual_coef  # padded rows have zero weight -> inert
 
-    shard = NamedSharding(mesh, P(DATA_AXIS))
+        shard = NamedSharding(mesh, P(DATA_AXIS))
+        sv_dev = jax.device_put(jnp.asarray(sv), shard)
+        coef_dev = jax.device_put(jnp.asarray(coef), shard)
+        sv_sq = jax.device_put(jnp.asarray((sv * sv).sum(1, dtype=np.float32)), shard)
+        model._mesh_prepared = (num_devices, (sv_dev, coef_dev, sv_sq))
+
     rep = NamedSharding(mesh, P())
-    sv_dev = jax.device_put(jnp.asarray(sv), shard)
-    coef_dev = jax.device_put(jnp.asarray(coef), shard)
-    sv_sq = jax.device_put(jnp.asarray((sv * sv).sum(1, dtype=np.float32)), shard)
 
     out = []
     for s in range(0, q.shape[0], block):

@@ -128,6 +128,34 @@ def smo_reference(
     )
 
 
+def smo_native(x: np.ndarray, y: np.ndarray, config: SVMConfig) -> SolveResult:
+    """Train with the native C++ sequential engine (native/seqsmo.cpp) —
+    the compiled counterpart of ``smo_reference`` (the reference's seq.cpp
+    role as an actual native binary). Raises RuntimeError if the native
+    toolchain is unavailable; callers wanting a guaranteed path should use
+    ``smo_reference``."""
+    from dpsvm_tpu.utils.native import get_seqsmo
+
+    eng = get_seqsmo()
+    if eng is None:
+        raise RuntimeError(
+            "native seqsmo engine unavailable (g++ missing or build failed); "
+            "use backend='reference' for the NumPy oracle")
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int32)
+    gamma = config.resolve_gamma(x.shape[1])
+    t0 = time.perf_counter()
+    alpha, f, b, b_hi, b_lo, it, converged = eng.train(
+        x, y, c=config.c, gamma=gamma, epsilon=config.epsilon,
+        tau=max(config.tau, 1e-20), max_iter=config.max_iter,
+        kernel=config.kernel, degree=config.degree, coef0=config.coef0)
+    return SolveResult(
+        alpha=alpha, b=b, b_hi=b_hi, b_lo=b_lo, iterations=it,
+        converged=converged, train_seconds=time.perf_counter() - t0,
+        stats={"f": f, "engine": "native-seqsmo"},
+    )
+
+
 def duality_gap(alpha, y, f, c, b) -> float:
     """Duality gap invariant (revived from dead code at seq.cpp:352-376).
 

@@ -29,8 +29,10 @@ def train(
 ) -> tuple[SVMModel, SolveResult]:
     """Train binary C-SVC with modified SMO.
 
-    backend: "auto" | "single" | "mesh" | "reference".
+    backend: "auto" | "single" | "mesh" | "reference" | "native".
       auto picks "mesh" when >1 device is visible, else "single".
+      "reference" is the NumPy oracle; "native" the C++ sequential engine
+      (native/seqsmo.cpp) — both host-only, MVP selection.
     Labels must be in {-1, +1} (reference convention, parse.cpp label stoi).
     """
     import jax
@@ -53,12 +55,16 @@ def train(
         backend = ("mesh" if (multi and mesh_available and config.engine != "pallas")
                    else "single")
 
-    if backend == "reference" and (config.engine != "xla"
-                                   or config.selection != "mvp"):
-        raise ValueError(
-            "backend='reference' is the fixed NumPy oracle (MVP selection, "
-            "host math); it cannot honor engine/selection overrides — drop "
-            "them or pick another backend")
+    if backend in ("reference", "native"):
+        if config.engine != "xla" or config.selection != "mvp":
+            raise ValueError(
+                f"backend={backend!r} is a fixed host engine (MVP selection); "
+                "it cannot honor engine/selection overrides — drop them or "
+                "pick another backend")
+        if checkpoint_path or resume:
+            raise ValueError(
+                f"backend={backend!r} does not support checkpoint/resume; "
+                "use the 'single' or 'mesh' backend for long runs")
 
     if backend == "single":
         from dpsvm_tpu.solver.smo import solve
@@ -69,9 +75,20 @@ def train(
         result = solve_mesh(x, y, config, num_devices=num_devices,
                             callback=callback, checkpoint_path=checkpoint_path,
                             resume=resume)
-    elif backend == "reference":
-        from dpsvm_tpu.solver.reference import smo_reference
-        result = smo_reference(x, y, config)
+    elif backend in ("reference", "native"):
+        from dpsvm_tpu.solver.reference import smo_native, smo_reference
+        fn = smo_reference if backend == "reference" else smo_native
+        result = fn(x, y, config)
+        if callback is not None:
+            # Host engines run to completion in one shot; report one final
+            # record so metrics sinks aren't silently empty. The namespace
+            # mirrors the SMOState fields a chunk callback can rely on.
+            from types import SimpleNamespace
+            callback(result.iterations, result.b_hi, result.b_lo,
+                     SimpleNamespace(
+                         alpha=result.alpha, f=result.stats["f"],
+                         b_hi=result.b_hi, b_lo=result.b_lo,
+                         it=result.iterations, hits=0))
     else:
         raise ValueError(f"unknown backend {backend!r}")
 

@@ -21,6 +21,7 @@ _BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
 
 _lock = threading.Lock()
 _fastcsv_cache: list = []  # [] = untried, [None] = failed, [obj] = loaded
+_seqsmo_cache: list = []
 
 
 class FastCsv:
@@ -94,20 +95,168 @@ class FastCsv:
         return x[:got], y[:got]
 
 
-def _build_fastcsv() -> str | None:
-    src = os.path.join(_NATIVE_DIR, "fastcsv.cpp")
+# Portable baseline flags on purpose: -march=native would pin the cached
+# .so to the build host's ISA and a mismatch dies with an uncatchable
+# SIGILL, violating the degrade-to-fallback contract above.
+_CXX_FLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17"]
+
+
+def _build_so(stem: str) -> str | None:
+    """Compile native/<stem>.cpp into native/_build/<stem>.so.
+
+    Rebuilds when the source is newer OR the recorded compile flags differ
+    (a sidecar <stem>.so.flags file fingerprints the command, so flag
+    changes propagate without touching the source). Returns None on
+    failure with the diagnostic recorded in _build_errors (runtime callers
+    degrade to the NumPy path; `build_all` surfaces it)."""
+    src = os.path.join(_NATIVE_DIR, f"{stem}.cpp")
     if not os.path.exists(src):
+        _build_errors[stem] = f"source not found: {src}"
         return None
-    out = os.path.join(_BUILD_DIR, "fastcsv.so")
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    out = os.path.join(_BUILD_DIR, f"{stem}.so")
+    tag = out + ".flags"
+    flags = " ".join(_CXX_FLAGS)
+    fresh = os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src)
+    if fresh:
+        try:
+            with open(tag) as fh:
+                fresh = fh.read().strip() == flags
+        except OSError:
+            fresh = False
+    if fresh:
         return out
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
+    cmd = ["g++", *_CXX_FLAGS, src, "-o", out]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        proc = subprocess.run(cmd, capture_output=True, timeout=120, text=True)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        _build_errors[stem] = f"{' '.join(cmd)}: {e}"
         return None
+    if proc.returncode != 0:
+        _build_errors[stem] = (
+            f"{' '.join(cmd)} exited {proc.returncode}:\n{proc.stderr}")
+        return None
+    with open(tag, "w") as fh:
+        fh.write(flags)
+    _build_errors.pop(stem, None)
     return out
+
+
+_build_errors: dict[str, str] = {}
+
+
+def build_all() -> list[str]:
+    """Build every native component; raises on any failure with the full
+    compiler diagnostic (the `make native` entry point — unlike the lazy
+    runtime path, a build target must not silently succeed)."""
+    built = []
+    for stem in ("fastcsv", "seqsmo"):
+        so = _build_so(stem)
+        if so:
+            built.append(so)
+    if _build_errors:
+        detail = "\n".join(f"[{k}] {v}" for k, v in _build_errors.items())
+        raise RuntimeError(f"native build failed:\n{detail}")
+    return built
+
+
+def _build_fastcsv() -> str | None:
+    return _build_so("fastcsv")
+
+
+_KERNEL_KINDS = {"linear": 0, "rbf": 1, "poly": 2, "sigmoid": 3}
+
+
+class SeqSMO:
+    """Typed wrapper over the seqsmo C ABI (native sequential trainer +
+    predictor — the seq.cpp / seq_test.cpp runtime roles)."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.seqsmo_train.restype = ctypes.c_long
+        lib.seqsmo_train.argtypes = [
+            f32p, ctypes.POINTER(ctypes.c_int), ctypes.c_long, ctypes.c_long,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_float,
+            f32p, f32p, f32p,
+        ]
+        lib.seqsmo_decision.restype = ctypes.c_long
+        lib.seqsmo_decision.argtypes = [
+            f32p, f32p, ctypes.c_long, ctypes.c_long,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_float,
+            ctypes.c_float, f32p, ctypes.c_long, f32p,
+        ]
+
+    def train(self, x: np.ndarray, y: np.ndarray, *, c: float, gamma: float,
+              epsilon: float, tau: float, max_iter: int, kernel: str = "rbf",
+              degree: int = 3, coef0: float = 0.0):
+        """Returns (alpha, f, b, b_hi, b_lo, iterations, converged)."""
+        x = np.ascontiguousarray(x, np.float32)
+        y = np.ascontiguousarray(y, np.int32)
+        if x.ndim != 2:
+            raise ValueError(f"x must be 2-D (n, d), got shape {x.shape}")
+        n, d = x.shape
+        if y.shape != (n,):
+            raise ValueError(f"y must have shape ({n},), got {y.shape}")
+        alpha = np.empty((n,), np.float32)
+        f = np.empty((n,), np.float32)
+        scalars = np.empty((4,), np.float32)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        it = self._lib.seqsmo_train(
+            x.ctypes.data_as(f32p),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            n, d, ctypes.c_float(c), ctypes.c_float(gamma),
+            ctypes.c_float(epsilon), ctypes.c_float(tau), max_iter,
+            _KERNEL_KINDS[kernel], degree, ctypes.c_float(coef0),
+            alpha.ctypes.data_as(f32p), f.ctypes.data_as(f32p),
+            scalars.ctypes.data_as(f32p))
+        if it < 0:
+            raise ValueError(f"seqsmo_train failed with code {it}")
+        return (alpha, f, float(scalars[0]), float(scalars[1]),
+                float(scalars[2]), int(it), bool(scalars[3] > 0))
+
+    def decision(self, sv_x: np.ndarray, coef: np.ndarray, b: float,
+                 q: np.ndarray, *, gamma: float, kernel: str = "rbf",
+                 degree: int = 3, coef0: float = 0.0) -> np.ndarray:
+        sv_x = np.ascontiguousarray(sv_x, np.float32)
+        coef = np.ascontiguousarray(coef, np.float32)
+        q = np.ascontiguousarray(q, np.float32)
+        if sv_x.ndim != 2 or q.ndim != 2:
+            raise ValueError(
+                f"sv_x and q must be 2-D, got {sv_x.shape} and {q.shape}")
+        n_sv, d = sv_x.shape
+        if q.shape[1] != d:
+            raise ValueError(
+                f"q feature dim {q.shape[1]} != support-vector dim {d}")
+        if coef.shape != (n_sv,):
+            raise ValueError(f"coef must have shape ({n_sv},), got {coef.shape}")
+        m = q.shape[0]
+        out = np.empty((m,), np.float32)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        rc = self._lib.seqsmo_decision(
+            sv_x.ctypes.data_as(f32p), coef.ctypes.data_as(f32p), n_sv, d,
+            ctypes.c_float(gamma), _KERNEL_KINDS[kernel], degree,
+            ctypes.c_float(coef0), ctypes.c_float(b),
+            q.ctypes.data_as(f32p), m, out.ctypes.data_as(f32p))
+        if rc < 0:
+            raise ValueError(f"seqsmo_decision failed with code {rc}")
+        return out
+
+
+def get_seqsmo() -> SeqSMO | None:
+    """Return the native sequential SMO engine; None if unavailable."""
+    with _lock:
+        if not _seqsmo_cache:
+            so = _build_so("seqsmo")
+            if so is None:
+                _seqsmo_cache.append(None)
+            else:
+                try:
+                    _seqsmo_cache.append(SeqSMO(ctypes.CDLL(so)))
+                except (OSError, AttributeError):
+                    _seqsmo_cache.append(None)
+        return _seqsmo_cache[0]
 
 
 def get_fastcsv() -> FastCsv | None:

@@ -1,0 +1,82 @@
+"""Native C++ sequential SMO engine (native/seqsmo.cpp) vs the NumPy
+oracle — both play the reference's seq.cpp / seq_test.cpp roles, so they
+must agree on the whole solver trajectory, not just the optimum."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.predict import accuracy, decision_function
+from dpsvm_tpu.solver.reference import smo_native, smo_reference
+from dpsvm_tpu.utils.native import get_seqsmo
+
+pytestmark = pytest.mark.skipif(
+    get_seqsmo() is None, reason="native toolchain unavailable")
+
+
+def test_native_matches_oracle_trajectory(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000)
+    ref = smo_reference(x, y, cfg)
+    nat = smo_native(x, y, cfg)
+    assert nat.converged and ref.converged
+    # Same algorithm, same fp32 math -> near-identical trajectories. Exact
+    # iteration equality is not guaranteed (x86 FMA contraction can flip
+    # ties) but they must land within a hair of each other.
+    assert abs(nat.iterations - ref.iterations) <= max(3, ref.iterations // 50)
+    assert nat.b == pytest.approx(ref.b, abs=5e-3)
+    assert abs(nat.n_sv - ref.n_sv) <= max(2, ref.n_sv // 25)
+    np.testing.assert_allclose(nat.alpha, ref.alpha, atol=5e-2)
+
+
+def test_native_decision_matches_python_predict(blobs_small):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000)
+    nat = smo_native(x, y, cfg)
+    kp = KernelParams("rbf", 0.1)
+    model = SVMModel.from_dense(x, y, nat.alpha, nat.b, kp)
+    want = decision_function(model, x[:64])
+    eng = get_seqsmo()
+    got = eng.decision(model.sv_x, model.dual_coef, model.b, x[:64],
+                       gamma=kp.gamma, kernel=kp.kind)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-4)
+
+
+@pytest.mark.parametrize("kernel", ["linear", "poly", "sigmoid"])
+def test_native_other_kernels(blobs_small, kernel):
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.05, kernel=kernel, degree=2, coef0=1.0,
+                    epsilon=1e-3, max_iter=200_000)
+    ref = smo_reference(x, y, cfg)
+    nat = smo_native(x, y, cfg)
+    assert nat.converged
+    gamma = cfg.resolve_gamma(x.shape[1])
+    model = SVMModel.from_dense(
+        x, y, nat.alpha, nat.b, KernelParams(kernel, gamma, 2, 1.0))
+    ref_model = SVMModel.from_dense(
+        x, y, ref.alpha, ref.b, KernelParams(kernel, gamma, 2, 1.0))
+    assert accuracy(model, x, y) == pytest.approx(
+        accuracy(ref_model, x, y), abs=0.02)
+
+
+def test_train_backend_native(blobs_small):
+    from dpsvm_tpu.train import train
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000)
+    model, res = train(x, y, cfg, backend="native")
+    assert res.converged
+    assert res.stats["engine"] == "native-seqsmo"
+    ref = smo_reference(x, y, cfg)
+    ref_model = SVMModel.from_dense(x, y, ref.alpha, ref.b,
+                                    KernelParams("rbf", 0.1))
+    assert accuracy(model, x, y) == pytest.approx(
+        accuracy(ref_model, x, y), abs=0.01)
+
+
+def test_train_backend_native_rejects_overrides(blobs_small):
+    from dpsvm_tpu.train import train
+    x, y = blobs_small
+    with pytest.raises(ValueError, match="fixed host engine"):
+        train(x, y, SVMConfig(selection="second_order"), backend="native")
