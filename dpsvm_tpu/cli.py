@@ -58,6 +58,16 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                    default="auto")
     p.add_argument("--num-devices", type=int, default=None,
                    help="devices in the data mesh (default: all visible)")
+    # Multi-host bring-up (the reference's `mpirun --hostfile hf` role,
+    # Makefile:74): every host runs the same command with its own
+    # --process-id; jax.distributed wires the DCN coordination.
+    p.add_argument("--coordinator-address", default=None,
+                   help="host:port of process 0 for multi-host pods "
+                        "(enables jax.distributed.initialize)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total processes in the multi-host job")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's index in the multi-host job")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32",
                    help="X storage dtype (bfloat16 halves kernel-row bandwidth)")
     p.add_argument("--chunk-iters", type=int, default=2048)
@@ -139,6 +149,11 @@ def _cmd_train(args) -> int:
     from dpsvm_tpu.data.loader import load_csv
     from dpsvm_tpu.train import train
     from dpsvm_tpu.utils.metrics import MetricsLogger, profile_trace
+
+    if args.coordinator_address or args.num_processes or args.process_id is not None:
+        from dpsvm_tpu.parallel.mesh import initialize_multihost
+        initialize_multihost(args.coordinator_address, args.num_processes,
+                             args.process_id)
 
     t0 = time.perf_counter()
     x, y = load_csv(args.file_path, args.num_ex, args.num_att)

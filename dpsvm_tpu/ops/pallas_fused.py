@@ -58,10 +58,17 @@ def _fused_kernel(scalars_ref, f_ref, alpha_ref, y_ref, valid_ref,
 
     alpha = alpha_ref[:]
     y = y_ref[:]
-    valid = valid_ref[:] != 0
+    # valid rides as float32: Mosaic can't truncate i8 vectors to i1, and
+    # sub-32-bit VMEM tiles have their own layout constraints.
+    valid = valid_ref[:] > 0.0
+    # Pure i1 logic (no jnp.where over booleans: Mosaic materializes the
+    # select at i8 and cannot truncate i8 vectors back to i1).
     pos = y > 0
-    up = jnp.where(pos, alpha < c, alpha > 0) & valid
-    low = jnp.where(pos, alpha > 0, alpha < c) & valid
+    neg = ~pos
+    lt_c = alpha < c
+    gt_0 = alpha > 0
+    up = ((pos & lt_c) | (neg & gt_0)) & valid
+    low = ((pos & gt_0) | (neg & lt_c)) & valid
 
     rows = rows_per_block
     row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
@@ -79,10 +86,13 @@ def _fused_kernel(scalars_ref, f_ref, alpha_ref, y_ref, valid_ref,
     blo = jnp.max(f_low)
     ilo = jnp.min(jnp.where(f_low == blo, flat_ids, jnp.int32(2**31 - 1)))
 
-    bhi_ref[0] = bhi
-    ihi_ref[0] = ihi
-    blo_ref[0] = blo
-    ilo_ref[0] = ilo
+    # Partial outputs live whole-array in SMEM (Mosaic rejects rank-1
+    # blocks of size 1); each grid step writes its own slot.
+    blk = pl.program_id(0)
+    bhi_ref[blk] = bhi
+    ihi_ref[blk] = ihi
+    blo_ref[blk] = blo
+    ilo_ref[blk] = ilo
 
 
 @functools.partial(jax.jit, static_argnames=("kp", "c", "block_rows", "interpret"))
@@ -90,7 +100,7 @@ def fused_update_select(
     f2d: jax.Array,  # (R, 128) float32 — f, lane-tiled
     alpha2d: jax.Array,  # (R, 128) float32
     y2d: jax.Array,  # (R, 128) float32 (+-1)
-    valid2d: jax.Array,  # (R, 128) int8 (1 = real row)
+    valid2d: jax.Array,  # (R, 128) float32 (1.0 = real row)
     d_hi2d: jax.Array,  # (R, 128) float32 dot row for the hi index
     d_lo2d: jax.Array,  # (R, 128) float32 dot row for the lo index
     x_sq2d: jax.Array,  # (R, 128) float32
@@ -111,7 +121,7 @@ def fused_update_select(
 
     block = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
-    part = pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM)
+    part = pl.BlockSpec(memory_space=pltpu.SMEM)  # whole (nblocks,) array
     kern = functools.partial(_fused_kernel, kp=kp, c=c,
                              rows_per_block=block_rows)
 

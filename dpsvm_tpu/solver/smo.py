@@ -202,7 +202,7 @@ def _run_chunk_pallas(x, y, x_sq, valid, state: SMOState, max_iter,
     rows = n_pad // LANES
     shp = (rows, LANES)
     y2d = y.reshape(shp)
-    valid2d = valid.astype(jnp.int8).reshape(shp)
+    valid2d = valid.astype(jnp.float32).reshape(shp)
     x_sq2d = x_sq.reshape(shp)
 
     # Seed selection for the pipelined carry (top-of-iteration values).

@@ -85,3 +85,33 @@ def test_metrics_jsonl(csvs):
     recs = [json.loads(ln) for ln in open(mpath)]
     assert recs
     assert {"iteration", "gap", "sv_estimate", "iters_per_sec"} <= recs[0].keys()
+
+
+def test_multihost_flags_invoke_initialize(csvs, monkeypatch):
+    """--coordinator-address etc. must call initialize_multihost before
+    training (the mpirun --hostfile equivalent, SURVEY.md 5.8)."""
+    train_p, _, d = csvs
+    calls = []
+    import dpsvm_tpu.parallel.mesh as mesh_mod
+    monkeypatch.setattr(
+        mesh_mod, "initialize_multihost",
+        lambda addr, nproc, pid: calls.append((addr, nproc, pid)))
+    rc = main(["train", "-f", train_p, "-m", d + "/mh.txt", "-c", "5",
+               "-g", "0.1", "--backend", "single", "-q",
+               "--coordinator-address", "localhost:1234",
+               "--num-processes", "1", "--process-id", "0"])
+    assert rc == 0
+    assert calls == [("localhost:1234", 1, 0)]
+
+
+@pytest.mark.skipif(
+    __import__("dpsvm_tpu.utils.native", fromlist=["get_seqsmo"]).get_seqsmo() is None,
+    reason="native toolchain unavailable")
+def test_native_backend_cli(csvs, capsys):
+    train_p, test_p, d = csvs
+    rc = main(["train", "-f", train_p, "-m", d + "/nat.txt", "-c", "5",
+               "-g", "0.1", "--backend", "native", "-q"])
+    assert rc == 0
+    rc = main(["test", "-f", test_p, "-m", d + "/nat.txt"])
+    assert rc == 0
+    assert "test accuracy" in capsys.readouterr().out

@@ -35,7 +35,7 @@ def test_fused_matches_reference(kind, n_valid):
     f = rng.normal(size=n_pad).astype(np.float32)
     alpha = rng.choice([0.0, c, 0.6], size=n_pad).astype(np.float32)
     y = rng.choice([-1.0, 1.0], size=n_pad).astype(np.float32)
-    valid = np.zeros(n_pad, np.int8)
+    valid = np.zeros(n_pad, np.float32)
     valid[:n_valid] = 1
     d_hi = rng.normal(size=n_pad).astype(np.float32)
     d_lo = rng.normal(size=n_pad).astype(np.float32)
@@ -69,7 +69,7 @@ def test_fused_tie_break_lowest_index():
     f = np.zeros(n_pad, np.float32)
     alpha = np.full(n_pad, 0.5, np.float32)
     y = np.ones(n_pad, np.float32)
-    valid = np.ones(n_pad, np.int8)
+    valid = np.ones(n_pad, np.float32)
     zeros = np.zeros(n_pad, np.float32)
     scalars = np.zeros(4, np.float32)
     shp = (rows, LANES)
