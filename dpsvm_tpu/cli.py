@@ -41,8 +41,10 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("-e", "--epsilon", type=float, default=1e-3,
                    help="convergence tolerance (default 0.001)")
     p.add_argument("-n", "--max-iter", type=int, default=150_000)
-    p.add_argument("-s", "--cache-size", type=int, default=256,
-                   help="kernel-row cache lines per device (default 256)")
+    p.add_argument("-s", "--cache-size", type=int, default=0,
+                   help="kernel-row cache lines per device (default 0 = off; "
+                        "on the MXU a fresh kernel-row matvec is cheaper than "
+                        "the cache bookkeeping — see SVMConfig.cache_lines)")
     p.add_argument("--kernel", choices=["rbf", "linear", "poly", "sigmoid"],
                    default="rbf")
     p.add_argument("--selection", choices=["mvp", "second_order"], default="mvp",

