@@ -24,6 +24,8 @@ Key differences from the reference (by design, TPU-first):
 
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.models.svr import SVRModel, train_svr
+from dpsvm_tpu.models.oneclass import OneClassModel, train_oneclass
 from dpsvm_tpu.train import train
 from dpsvm_tpu.predict import decision_function, predict, accuracy
 from dpsvm_tpu import data
@@ -33,6 +35,10 @@ __version__ = "0.1.0"
 __all__ = [
     "SVMConfig",
     "SVMModel",
+    "SVRModel",
+    "train_svr",
+    "OneClassModel",
+    "train_oneclass",
     "train",
     "decision_function",
     "predict",

@@ -5,6 +5,8 @@ from dpsvm_tpu.models.multiclass import (
     predict_multiclass,
     train_multiclass,
 )
+from dpsvm_tpu.models.svr import SVRModel, train_svr
+from dpsvm_tpu.models.oneclass import OneClassModel, train_oneclass
 
 __all__ = [
     "SVMModel",
@@ -12,4 +14,8 @@ __all__ = [
     "train_multiclass",
     "predict_multiclass",
     "accuracy_multiclass",
+    "SVRModel",
+    "train_svr",
+    "OneClassModel",
+    "train_oneclass",
 ]

@@ -82,10 +82,7 @@ class SVMModel:
                 sv_alpha=self.sv_alpha,
                 sv_y=self.sv_y,
                 b=np.float32(self.b),
-                kernel_kind=self.kernel.kind,
-                gamma=np.float32(self.kernel.gamma),
-                degree=np.int32(self.kernel.degree),
-                coef0=np.float32(self.kernel.coef0),
+                **self.kernel.npz_fields(),
             )
             return
         if self.kernel.kind != "rbf":
@@ -114,12 +111,7 @@ class SVMModel:
                 sv_alpha=z["sv_alpha"].astype(np.float32),
                 sv_y=z["sv_y"].astype(np.int32),
                 b=float(z["b"]),
-                kernel=KernelParams(
-                    kind=str(z["kernel_kind"]),
-                    gamma=float(z["gamma"]),
-                    degree=int(z["degree"]),
-                    coef0=float(z["coef0"]),
-                ),
+                kernel=KernelParams.from_npz(z),
             )
         return cls._load_text(path)
 

@@ -1,0 +1,137 @@
+"""Epsilon-SVR (support-vector regression) on the same SMO engine.
+
+No reference equivalent (the reference trains binary C-SVC only) — this is
+a capability extension using the standard LibSVM reduction: the SVR dual
+
+    min 1/2 (a - a*)^T K (a - a*) + eps sum(a + a*) - z^T (a - a*)
+    s.t. sum(a - a*) = 0,  0 <= a_i, a*_i <= C
+
+is the generic SMO problem over 2n variables with the feature rows
+duplicated, pseudo-labels y = [+1]*n ++ [-1]*n (which makes
+Q_ij = y_i y_j K_ij the required [[K, -K], [-K, K]] block structure), and
+linear term p = [eps - z; eps + z]. The engine's optimality indicator
+f = y * (Q alpha + p) therefore starts at f_init = [eps - z; -eps - z]
+instead of -y, which is exactly the hook solver.smo.solve exposes; every
+other part of the pipeline — working-set selection, the alpha-pair update,
+kernel-row evaluation, mesh sharding — is reused unchanged.
+
+The duplicated feature matrix costs 2x memory and 2x kernel-row time
+versus an index-mapped formulation (a (2n)-problem kernel row is the
+n-problem row tiled twice); acceptable because SVR problems are typically
+much smaller than the classification workloads the engine is sized for.
+
+Prediction: z_hat(q) = sum_i coef_i K(x_i, q) - b with
+coef_i = a_i - a*_i, sharing the classifier's decision convention
+(models/svm_model.py), so all of predict.py works on the flattened model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.solver.result import SolveResult
+
+
+@dataclasses.dataclass
+class SVRModel:
+    """Trained regressor: z_hat(q) = sum_i coef_i K(x_i, q) - b."""
+
+    sv_x: np.ndarray  # (n_sv, d)
+    coef: np.ndarray  # (n_sv,) signed dual coefficients a_i - a*_i, != 0
+    b: float
+    kernel: KernelParams
+
+    @property
+    def n_sv(self) -> int:
+        return int(self.sv_x.shape[0])
+
+    def as_classifier_model(self) -> SVMModel:
+        """View as an SVMModel (sv_alpha = |coef|, sv_y = sign(coef)) so the
+        batched/mesh decision machinery in predict.py applies verbatim."""
+        sign = np.where(self.coef >= 0, 1, -1).astype(np.int32)
+        return SVMModel(sv_x=self.sv_x, sv_alpha=np.abs(self.coef),
+                        sv_y=sign, b=self.b, kernel=self.kernel)
+
+    def predict(self, q, block: int = 8192) -> np.ndarray:
+        """Regression estimates for query rows."""
+        from dpsvm_tpu.predict import decision_function
+        return decision_function(self.as_classifier_model(), q, block)
+
+    def save(self, path: str) -> None:
+        if not path.endswith(".npz"):
+            raise ValueError("SVR models use the .npz format (the reference "
+                             "text format encodes a classifier)")
+        np.savez_compressed(
+            path, format_version=1, model_type="svr",
+            sv_x=self.sv_x, coef=self.coef, b=np.float32(self.b),
+            **self.kernel.npz_fields())
+
+    @classmethod
+    def load(cls, path: str) -> "SVRModel":
+        z = np.load(path, allow_pickle=False)
+        if str(z.get("model_type", "")) != "svr":
+            raise ValueError(f"{path}: not an SVR model")
+        return cls(
+            sv_x=z["sv_x"].astype(np.float32),
+            coef=z["coef"].astype(np.float32),
+            b=float(z["b"]),
+            kernel=KernelParams.from_npz(z))
+
+
+def train_svr(
+    x,
+    z,
+    config: SVMConfig = SVMConfig(),
+    svr_epsilon: float = 0.1,
+    backend: str = "auto",
+    num_devices: Optional[int] = None,
+    callback=None,
+) -> tuple[SVRModel, SolveResult]:
+    """Train epsilon-SVR: fit z ~ f(x) within an `svr_epsilon` tube.
+
+    `config.epsilon` remains the SMO convergence tolerance; the tube width
+    is this function's `svr_epsilon` (LibSVM's -p vs -e distinction).
+    """
+    import jax
+
+    x = np.asarray(x, np.float32)
+    z = np.asarray(z, np.float32)
+    n, d = x.shape
+    if z.shape != (n,):
+        raise ValueError(f"targets must be shape ({n},), got {z.shape}")
+    if svr_epsilon < 0:
+        raise ValueError("svr_epsilon must be >= 0")
+
+    x2 = np.vstack([x, x])
+    y2 = np.concatenate([np.ones(n, np.int32), -np.ones(n, np.int32)])
+    f_init = np.concatenate([svr_epsilon - z, -svr_epsilon - z]).astype(np.float32)
+
+    if backend == "auto":
+        backend = "mesh" if (num_devices or len(jax.devices())) > 1 else "single"
+    if backend == "single":
+        from dpsvm_tpu.solver.smo import solve
+        result = solve(x2, y2, config, callback=callback, f_init=f_init)
+    elif backend == "mesh":
+        from dpsvm_tpu.parallel.dist_smo import solve_mesh
+        result = solve_mesh(x2, y2, config, num_devices=num_devices,
+                            callback=callback, f_init=f_init)
+    else:
+        raise ValueError(f"unknown backend {backend!r} (svr supports "
+                         "'auto' | 'single' | 'mesh')")
+
+    coef = result.alpha[:n] - result.alpha[n:]
+    mask = coef != 0
+    gamma = config.resolve_gamma(d)
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    model = SVRModel(
+        sv_x=np.ascontiguousarray(x[mask], np.float32),
+        coef=coef[mask].astype(np.float32),
+        b=float(result.b),
+        kernel=kp)
+    return model, result

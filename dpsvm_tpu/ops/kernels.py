@@ -31,6 +31,24 @@ class KernelParams:
     degree: int = 3
     coef0: float = 0.0
 
+    def npz_fields(self) -> dict:
+        """The .npz serialization of the kernel, shared by every model
+        class (SVMModel / SVRModel / OneClassModel) so the format lives in
+        exactly one place."""
+        import numpy as np
+
+        return {
+            "kernel_kind": self.kind,
+            "gamma": np.float32(self.gamma),
+            "degree": np.int32(self.degree),
+            "coef0": np.float32(self.coef0),
+        }
+
+    @classmethod
+    def from_npz(cls, z) -> "KernelParams":
+        return cls(kind=str(z["kernel_kind"]), gamma=float(z["gamma"]),
+                   degree=int(z["degree"]), coef0=float(z["coef0"]))
+
 
 def squared_norms(x: jax.Array) -> jax.Array:
     """Per-row |x_i|^2, shape (n,).

@@ -147,7 +147,7 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
 
     own_hi = gids == i_hi
     q_hi = _gather_row(x_loc, own_hi)
-    q_hi_sq = jnp.sum(q_hi * q_hi)
+    q_hi_sq = _gather_scalar(x_sq_loc, own_hi)  # see _iteration: bit-parity
     stamp = 2 * state.it.astype(jnp.int32)
     if use_cache:
         d_hi, cache, hit_hi = lookup_one(
@@ -178,7 +178,7 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
     b_lo_pair = _gather_scalar(state.f, own_lo)
 
     q_lo = _gather_row(x_loc, own_lo)
-    q_lo_sq = jnp.sum(q_lo * q_lo)
+    q_lo_sq = _gather_scalar(x_sq_loc, own_lo)  # see _iteration: bit-parity
     if use_cache:
         d_lo, cache, hit_lo = lookup_one(
             cache, x_loc, i_lo, q_lo.astype(x_loc.dtype), stamp + 2)
@@ -211,8 +211,12 @@ def _iteration(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state: SMOState,
     own_lo = gids == i_lo
     q_hi = _gather_row(x_loc, own_hi)
     q_lo = _gather_row(x_loc, own_lo)
-    q_hi_sq = jnp.sum(q_hi * q_hi)
-    q_lo_sq = jnp.sum(q_lo * q_lo)
+    # Squared norms come from the precomputed x_sq (via one-hot psum), NOT
+    # recomputed from the fetched row: a re-reduction can differ in the
+    # last ulp from the setup-time value, which is enough to desync mesh
+    # and single-chip trajectories (single-chip reads x_sq[i], smo.py).
+    q_hi_sq = _gather_scalar(x_sq_loc, own_hi)
+    q_lo_sq = _gather_scalar(x_sq_loc, own_lo)
 
     if use_cache:
         d_hi, d_lo, cache, n_hits = lookup_pair(
@@ -286,8 +290,14 @@ def solve_mesh(
     callback=None,
     checkpoint_path: Optional[str] = None,
     resume: bool = False,
+    alpha_init=None,
+    f_init=None,
 ) -> SolveResult:
-    """Train binary C-SVC sharded over the mesh's `data` axis."""
+    """Train binary C-SVC sharded over the mesh's `data` axis.
+
+    `alpha_init` / `f_init` override the standard start point exactly as in
+    solver.smo.solve — the hook the SVR / one-class reductions use.
+    """
     if config.engine == "pallas":
         raise ValueError(
             "engine='pallas' is implemented for the single-chip solver only; "
@@ -315,9 +325,13 @@ def solve_mesh(
     rep = NamedSharding(mesh, P())
     x_dev = jax.device_put(jnp.asarray(x_p, dtype), shard)
     y_dev = jax.device_put(jnp.asarray(y_p), shard)
-    x_sq_np = np.einsum("nd,nd->n", x_p, x_p, dtype=np.float32)
-    x_sq = jax.device_put(jnp.asarray(x_sq_np), shard)
-    k_diag = jax.device_put(kernel_diag(jnp.asarray(x_sq_np), kp), shard)
+    # x_sq computed on device from the STORED x (matters for bf16: squares
+    # of the rounded values, exactly like the single-chip path) so mesh and
+    # single-chip kernel values — and hence trajectories — are bit-equal.
+    from dpsvm_tpu.ops.kernels import squared_norms
+    x_sq = jax.jit(squared_norms, out_shardings=shard)(x_dev)
+    k_diag = jax.jit(kernel_diag, static_argnames="params",
+                     out_shardings=shard)(x_sq, params=kp)
     valid_dev = jax.device_put(jnp.asarray(valid), shard)
 
     cache_lines = min(config.cache_lines, n_pad // n_dev)
@@ -334,6 +348,14 @@ def solve_mesh(
             CacheState(data=NamedSharding(mesh, P(None, DATA_AXIS)), keys=rep, ticks=rep)),
         hits=jax.device_put(jnp.int32(0), rep),
     )
+    if alpha_init is not None:
+        a_p = np.zeros((n_pad,), np.float32)
+        a_p[:n] = np.asarray(alpha_init, np.float32)
+        state = state._replace(alpha=jax.device_put(jnp.asarray(a_p), shard))
+    if f_init is not None:
+        f_p = np.asarray(-y_p, np.float32)
+        f_p[:n] = np.asarray(f_init, np.float32)
+        state = state._replace(f=jax.device_put(jnp.asarray(f_p), shard))
     from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer, resume_solver_state
 
     if resume:

@@ -103,9 +103,29 @@ def smo_reference(
         y_lo = np.float32(y[i_lo])
         a_hi_old = alpha[i_hi]
         a_lo_old = alpha[i_lo]
-        # Pair update (seq.cpp:237-250).
-        a_lo_new = np.float32(np.clip(a_lo_old + y_lo * (b_hi - b_lo) / eta, 0.0, c))
-        a_hi_new = np.float32(np.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c))
+        # Pair update with the joint [L, H] clip (the reference's sequential
+        # double clip at seq.cpp:237-250 can violate sum alpha_i y_i — see
+        # solver/smo.py pair_alpha_update).
+        s = y_hi * y_lo
+        w = a_hi_old + s * a_lo_old
+        if s > 0:
+            lo_b, hi_b = max(np.float32(0.0), w - c), min(c, w)
+        else:
+            lo_b, hi_b = max(np.float32(0.0), -w), min(c, c - w)
+        a_lo_new = np.float32(np.clip(a_lo_old + y_lo * (b_hi - b_lo) / eta, lo_b, hi_b))
+        # Bound snap (see solver/smo.py pair_alpha_update: avoids the
+        # c - 1ulp livelock); a_lo snaps BEFORE a_hi is derived from it so
+        # conservation survives the snap.
+        snap = np.float32(1e-6) * c
+        if a_lo_new < snap:
+            a_lo_new = np.float32(0.0)
+        elif a_lo_new > c - snap:
+            a_lo_new = c
+        a_hi_new = np.float32(np.clip(a_hi_old + s * (a_lo_old - a_lo_new), 0.0, c))
+        if a_hi_new < snap:
+            a_hi_new = np.float32(0.0)
+        elif a_hi_new > c - snap:
+            a_hi_new = c
         alpha[i_lo] = a_lo_new
         alpha[i_hi] = a_hi_new
 

@@ -62,9 +62,22 @@ def init_state(n: int, y: jax.Array, cache_lines: int) -> SMOState:
 
 def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
                       eta, c, gate=None):
-    """THE alpha-pair algebra (svmTrainMain.cpp:285-299), shared verbatim
-    by the XLA, Pallas and distributed engines. Returns
-    (a_hi_new, a_lo_new).
+    """THE alpha-pair algebra, shared by the XLA, Pallas and distributed
+    engines. Returns (a_hi_new, a_lo_new).
+
+    Deliberate divergence from the reference (svmTrainMain.cpp:285-299,
+    seq.cpp:237-250): the reference clips a_lo to [0, C] and then clips
+    a_hi to [0, C] *independently*. Whenever that second clip actually
+    triggers, delta(a_hi) != -s * delta(a_lo) and the dual equality
+    constraint sum_i alpha_i y_i = const is silently violated — the drift
+    accumulates and biases b (it is what made the one-class reduction,
+    whose alphas start AT the bound, end up with sum alpha != nu*n).
+    The standard (Platt) form used here clips a_lo to the joint feasible
+    segment [L, H] of the box intersected with the constraint line, after
+    which a_hi stays in box by construction and conservation is exact:
+        s = y_hi*y_lo, w = a_hi_old + s*a_lo_old
+        s=+1: L = max(0, w - C),  H = min(C, w)
+        s=-1: L = max(0, -w),     H = min(C, C - w)
 
     `gate` (bool scalar) forces an exact no-op when False — used when a
     selection round found no admissible pair (empty I_up/I_low after alpha
@@ -75,8 +88,26 @@ def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
     ok = jnp.isfinite(b_hi_pair) & jnp.isfinite(b_lo_pair)
     if gate is not None:
         ok = ok & gate
-    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi_pair - b_lo_pair) / eta, 0.0, c)
-    a_hi_new = jnp.clip(a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new), 0.0, c)
+    s = y_hi * y_lo
+    w = a_hi_old + s * a_lo_old
+    lo_bound = jnp.where(s > 0, jnp.maximum(0.0, w - c), jnp.maximum(0.0, -w))
+    hi_bound = jnp.where(s > 0, jnp.minimum(c, w), jnp.minimum(c, c - w))
+    a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi_pair - b_lo_pair) / eta,
+                        lo_bound, hi_bound)
+    # Snap to the box bounds (LibSVM assigns exact bound constants in its
+    # clip branches): round-off in w can leave an alpha at c - 1ulp, which
+    # the I_up/I_low masks still admit while the joint feasible segment has
+    # ~ulp width — a selectable pair with a zero step, i.e. a livelock.
+    # a_lo is snapped BEFORE a_hi is derived from it so the derivation
+    # keeps delta(a_hi) = -s * delta(a_lo) (conservation); a_hi's own snap
+    # then only absorbs rounding of the derivation itself.
+    snap = 1e-6 * c
+    a_lo_new = jnp.where(a_lo_new < snap, 0.0,
+                         jnp.where(a_lo_new > c - snap, c, a_lo_new))
+    # In box by construction; the final clip only absorbs float round-off.
+    a_hi_new = jnp.clip(a_hi_old + s * (a_lo_old - a_lo_new), 0.0, c)
+    a_hi_new = jnp.where(a_hi_new < snap, 0.0,
+                         jnp.where(a_hi_new > c - snap, c, a_hi_new))
     a_lo_new = jnp.where(ok, a_lo_new, a_lo_old)
     a_hi_new = jnp.where(ok, a_hi_new, a_hi_old)
     return a_hi_new, a_lo_new
@@ -299,6 +330,8 @@ def solve(
     device: Optional[jax.Device] = None,
     checkpoint_path: Optional[str] = None,
     resume: bool = False,
+    alpha_init=None,
+    f_init=None,
 ) -> SolveResult:
     """Train binary C-SVC on one chip. Returns SolveResult.
 
@@ -310,6 +343,14 @@ def solve(
     (alpha, f, iteration) is persisted periodically; `resume=True` restarts
     from the file if present (a capability gap in the reference — SURVEY.md
     section 5.3: an MPI rank death loses the whole run).
+
+    `alpha_init` / `f_init` override the C-SVC start point (alpha = 0,
+    f = -y). They express other SMO-reducible problems through the same
+    engine: the general dual min 1/2 a^T Q a + p^T a with y in {+-1} and
+    Q_ij = y_i y_j K_ij starts from f = y * (Q alpha_init + p) — epsilon-SVR
+    uses the 2n-variable expansion with f_init = [eps - z; -eps - z]
+    (models/svr.py), one-class SVM a nonzero alpha_init (models/oneclass.py).
+    A checkpoint resume, when present, takes precedence over both.
     """
     import numpy as np
 
@@ -353,6 +394,14 @@ def solve(
     cache_lines = min(config.cache_lines, n_pad)
     use_cache = cache_lines > 0
     state = init_state(n_pad, y_dev, cache_lines if use_cache else 1)
+    if alpha_init is not None:
+        a_p = np.zeros((n_pad,), np.float32)
+        a_p[:n] = np.asarray(alpha_init, np.float32)
+        state = state._replace(alpha=jax.device_put(jnp.asarray(a_p), device))
+    if f_init is not None:
+        f_p = np.asarray(-y_p, np.float32)
+        f_p[:n] = np.asarray(f_init, np.float32)
+        state = state._replace(f=jax.device_put(jnp.asarray(f_p), device))
     if resume:
         restored = resume_solver_state(checkpoint_path, config, n)
         if restored is not None:

@@ -108,13 +108,29 @@ long seqsmo_train(const float* x, const int* y, long n, long d,
 
         float y_hi = (float)y[i_hi], y_lo = (float)y[i_lo];
         float a_hi_old = alpha[i_hi], a_lo_old = alpha[i_lo];
-        // Pair update (seq.cpp:237-250).
+        // Pair update with the joint [L, H] clip; the reference's
+        // sequential double clip (seq.cpp:237-250) can violate
+        // sum alpha_i y_i (see solver/smo.py pair_alpha_update).
+        float s = y_hi * y_lo;
+        float w = a_hi_old + s * a_lo_old;
+        float lo_b = s > 0.0f ? (w - c > 0.0f ? w - c : 0.0f)
+                              : (-w > 0.0f ? -w : 0.0f);
+        float hi_b = s > 0.0f ? (w < c ? w : c)
+                              : (c - w < c ? c - w : c);
         float a_lo_new = a_lo_old + y_lo * (b_hi - b_lo) / eta;
-        if (a_lo_new < 0.0f) a_lo_new = 0.0f;
-        if (a_lo_new > c) a_lo_new = c;
-        float a_hi_new = a_hi_old + y_lo * y_hi * (a_lo_old - a_lo_new);
+        if (a_lo_new < lo_b) a_lo_new = lo_b;
+        if (a_lo_new > hi_b) a_lo_new = hi_b;
+        // Bound snap (see solver/smo.py pair_alpha_update: avoids the
+        // c - 1ulp livelock); a_lo snaps BEFORE a_hi is derived from it
+        // so conservation survives the snap.
+        float snap = 1e-6f * c;
+        if (a_lo_new < snap) a_lo_new = 0.0f;
+        else if (a_lo_new > c - snap) a_lo_new = c;
+        float a_hi_new = a_hi_old + s * (a_lo_old - a_lo_new);
         if (a_hi_new < 0.0f) a_hi_new = 0.0f;
         if (a_hi_new > c) a_hi_new = c;
+        if (a_hi_new < snap) a_hi_new = 0.0f;
+        else if (a_hi_new > c - snap) a_hi_new = c;
         alpha[i_lo] = a_lo_new;
         alpha[i_hi] = a_hi_new;
 

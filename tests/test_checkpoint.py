@@ -53,8 +53,13 @@ def test_mesh_resumes_from_single_chip_checkpoint(blobs_small, tmp_path):
     full = solve(x, y, CFG)
     res = solve_mesh(x, y, CFG, num_devices=8, checkpoint_path=p, resume=True)
     assert res.converged
-    assert res.iterations == full.iterations
+    # Cross-BACKEND resume asserts the same solution, with one iteration of
+    # slack: XLA's per-shard f-update lowering can differ from the
+    # full-array one by a final ulp, which near a selection tie lets the
+    # mesh run stop one iteration earlier/later than single-chip.
+    assert abs(res.iterations - full.iterations) <= 1
     np.testing.assert_allclose(res.alpha, full.alpha, atol=1e-4)
+    assert res.b == pytest.approx(full.b, abs=1e-4)
 
 
 def test_resume_refuses_mismatched_config(blobs_small, tmp_path):

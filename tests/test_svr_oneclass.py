@@ -1,0 +1,193 @@
+"""SVR and one-class SVM: parity vs sklearn (LibSVM) and round trips.
+
+These model families have no reference equivalent (the reference trains
+binary C-SVC only); the oracle is LibSVM via sklearn, the same oracle the
+reference cites for its SV-count parity claim (README.md:27).
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.oneclass import OneClassModel, train_oneclass
+from dpsvm_tpu.models.svr import SVRModel, train_svr
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(400, 6)).astype(np.float32)
+    z = (np.sin(x[:, 0]) + 0.5 * x[:, 1] ** 2
+         + 0.1 * rng.normal(size=400)).astype(np.float32)
+    return x, z
+
+
+@pytest.fixture(scope="module")
+def novelty_data():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(500, 8)).astype(np.float32)
+    x[:25] += 6.0  # outlier cluster
+    return x
+
+
+CFG = SVMConfig(c=10.0, gamma=0.5, epsilon=1e-3, chunk_iters=512)
+
+
+def test_svr_matches_libsvm(reg_data):
+    from sklearn.svm import SVR
+
+    x, z = reg_data
+    m, res = train_svr(x, z, CFG, svr_epsilon=0.1, backend="single")
+    assert res.converged
+    sk = SVR(C=10.0, gamma=0.5, epsilon=0.1, tol=1e-3).fit(x, z)
+    assert abs(m.n_sv - len(sk.support_)) <= max(3, 0.03 * len(sk.support_))
+    pred = m.predict(x)
+    np.testing.assert_allclose(pred, sk.predict(x), atol=5e-3)
+    assert abs(m.b - (-sk.intercept_[0])) < 5e-3
+
+
+def test_svr_mesh_matches_single(reg_data):
+    """Mesh and single-chip SVR converge to the same solution. (Unlike
+    C-SVC — test_dist_smo asserts iteration-exact trajectories there — the
+    2n duplicated-point expansion is sensitive to 1-ulp FMA/fusion
+    differences between the full and per-shard f-update lowerings, so the
+    assertion here is solution-level, not trajectory-level.)"""
+    x, z = reg_data
+    m1, r1 = train_svr(x, z, CFG, svr_epsilon=0.1, backend="single")
+    m4, r4 = train_svr(x, z, CFG, svr_epsilon=0.1, backend="mesh")
+    assert abs(r4.iterations - r1.iterations) <= 0.05 * r1.iterations
+    np.testing.assert_allclose(m4.predict(x), m1.predict(x), atol=5e-3)
+    assert abs(m4.b - m1.b) < 5e-3
+    assert abs(m4.n_sv - m1.n_sv) <= max(3, 0.03 * m1.n_sv)
+
+
+def test_svr_tube_property(reg_data):
+    """At convergence, free SVs (0 < |coef| < C) sit ON the eps-tube and
+    non-SVs strictly inside it (KKT conditions of the SVR dual)."""
+    x, z = reg_data
+    eps_tube = 0.2
+    m, res = train_svr(x, z, CFG, svr_epsilon=eps_tube, backend="single")
+    resid_sv = np.abs(m.predict(m.sv_x) - _targets_for(m.sv_x, x, z))
+    free = (np.abs(m.coef) > 1e-4) & (np.abs(m.coef) < CFG.c - 1e-4)
+    tol = 2 * CFG.epsilon + 5e-3
+    # Free SVs: |residual| == eps_tube (they sit on the tube boundary).
+    assert free.any()
+    np.testing.assert_allclose(resid_sv[free], eps_tube, atol=tol)
+    # Non-SVs: strictly inside the tube.
+    resid = np.abs(m.predict(x) - z)
+    sv_rows = {tuple(r) for r in np.round(m.sv_x, 5).tolist()}
+    non_sv = np.array([tuple(r) not in sv_rows
+                       for r in np.round(x, 5).tolist()])
+    assert np.all(resid[non_sv] <= eps_tube + tol)
+
+
+def _targets_for(rows, x, z):
+    """Look up the training target of each (unique) row in `rows`."""
+    index = {tuple(r): t for r, t in zip(np.round(x, 5).tolist(), z)}
+    return np.asarray([index[tuple(r)] for r in np.round(rows, 5).tolist()],
+                      np.float32)
+
+
+def test_svr_save_load_roundtrip(reg_data, tmp_path):
+    x, z = reg_data
+    m, _ = train_svr(x, z, CFG, svr_epsilon=0.1, backend="single")
+    p = str(tmp_path / "svr.npz")
+    m.save(p)
+    m2 = SVRModel.load(p)
+    np.testing.assert_allclose(m2.predict(x[:50]), m.predict(x[:50]), atol=1e-6)
+    with pytest.raises(ValueError):
+        m.save(str(tmp_path / "svr.txt"))
+
+
+def test_svr_input_validation(reg_data):
+    x, z = reg_data
+    with pytest.raises(ValueError):
+        train_svr(x, z[:10], CFG)
+    with pytest.raises(ValueError):
+        train_svr(x, z, CFG, svr_epsilon=-1.0)
+    with pytest.raises(ValueError):
+        train_svr(x, z, CFG, backend="bogus")
+
+
+def test_oneclass_matches_libsvm(novelty_data):
+    from sklearn.svm import OneClassSVM
+
+    x = novelty_data
+    cfg = SVMConfig(gamma=0.1, epsilon=1e-3, chunk_iters=512)
+    m, res = train_oneclass(x, nu=0.1, config=cfg, backend="single")
+    assert res.converged
+    sk = OneClassSVM(nu=0.1, gamma=0.1, tol=1e-3).fit(x)
+    assert abs(m.n_sv - len(sk.support_)) <= max(3, 0.03 * len(sk.support_))
+    df = m.decision_function(x)
+    np.testing.assert_allclose(df, sk.decision_function(x), atol=5e-3)
+    # Predictions agree away from the boundary (within-tolerance flips are
+    # expected exactly at |decision| ~ tol).
+    clear = np.abs(sk.decision_function(x)) > 1e-2
+    assert np.all(m.predict(x)[clear] == sk.predict(x)[clear])
+
+
+def test_oneclass_nu_property(novelty_data):
+    """nu upper-bounds the training outlier fraction and lower-bounds the
+    SV fraction (Scholkopf's nu-property), up to boundary slack."""
+    x = novelty_data
+    n = x.shape[0]
+    cfg = SVMConfig(gamma=0.1, epsilon=1e-3, chunk_iters=512)
+    for nu in (0.05, 0.2):
+        m, res = train_oneclass(x, nu=nu, config=cfg, backend="single")
+        frac_out = float((m.decision_function(x) < -1e-3).mean())
+        assert frac_out <= nu + 5.0 / n
+        assert m.n_sv >= nu * n - 5
+
+
+def test_oneclass_mesh_matches_single(novelty_data):
+    # Solution-level parity (trajectories can shift by one near selection
+    # ties when XLA's per-shard lowering differs by a final ulp — same
+    # slack as the C-SVC mesh tests in test_dist_smo).
+    x = novelty_data
+    cfg = SVMConfig(gamma=0.1, epsilon=1e-3, chunk_iters=512)
+    m1, r1 = train_oneclass(x, nu=0.1, config=cfg, backend="single")
+    m4, r4 = train_oneclass(x, nu=0.1, config=cfg, backend="mesh")
+    assert abs(r4.iterations - r1.iterations) <= 0.02 * r1.iterations + 1
+    np.testing.assert_allclose(r4.alpha, r1.alpha, rtol=0, atol=1e-3)
+    assert m4.rho == pytest.approx(m1.rho, abs=1e-3)
+
+
+def test_oneclass_save_load_roundtrip(novelty_data, tmp_path):
+    x = novelty_data
+    cfg = SVMConfig(gamma=0.1, epsilon=1e-3, chunk_iters=512)
+    m, _ = train_oneclass(x, nu=0.1, config=cfg, backend="single")
+    p = str(tmp_path / "oc.npz")
+    m.save(p)
+    m2 = OneClassModel.load(p)
+    np.testing.assert_allclose(m2.decision_function(x[:50]),
+                               m.decision_function(x[:50]), atol=1e-6)
+
+
+def test_oneclass_input_validation(novelty_data):
+    with pytest.raises(ValueError):
+        train_oneclass(novelty_data, nu=0.0)
+    with pytest.raises(ValueError):
+        train_oneclass(novelty_data, nu=1.5)
+
+
+def test_equality_constraint_conserved(novelty_data):
+    """The dual equality constraint sum_i alpha_i y_i = const must hold
+    exactly(ish) at convergence. The reference's sequential double clip
+    violates it when the second clip triggers (see pair_alpha_update);
+    one-class — whose alphas START at the bound — is the regression test."""
+    x = novelty_data
+    n = x.shape[0]
+    cfg = SVMConfig(gamma=0.1, epsilon=1e-3, chunk_iters=512)
+    for nu in (0.1, 0.15):
+        m, res = train_oneclass(x, nu=nu, config=cfg, backend="single")
+        assert abs(float(res.alpha.sum()) - nu * n) < 1e-2
+
+
+def test_csvc_equality_constraint_conserved():
+    """Same invariant for C-SVC: sum alpha_i y_i stays 0."""
+    from dpsvm_tpu.data.synth import make_blobs_binary
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = make_blobs_binary(n=600, d=10, seed=5, sep=1.0)  # overlapping
+    res = solve(x, y, SVMConfig(c=5.0, gamma=0.3, chunk_iters=512))
+    assert abs(float((res.alpha * y).sum())) < 1e-2
