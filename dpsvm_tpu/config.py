@@ -55,6 +55,12 @@ class SVMConfig:
     degree: int = 3
     coef0: float = 0.0
 
+    # Per-class C multipliers (LibSVM -w1 / -w-1; no reference equivalent):
+    # the box bound of row i is C * weight_{y_i}. Used for imbalanced
+    # classes. Equal weights compile to the identical unweighted program.
+    weight_pos: float = 1.0
+    weight_neg: float = 1.0
+
     # Working-set selection rule (no reference equivalent for the second):
     #   "mvp"          -- maximal-violating pair, exactly the reference
     #                     algorithm (global argmin/argmax of f);
@@ -84,6 +90,10 @@ class SVMConfig:
     checkpoint_every: int = 0  # iterations between solver checkpoints; 0 = off
     verbose: bool = False
 
+    def c_bounds(self) -> tuple:
+        """(c_pos, c_neg): per-class box upper bounds, hashable for jit."""
+        return (self.c * self.weight_pos, self.c * self.weight_neg)
+
     def resolve_gamma(self, num_features: int) -> float:
         """Default gamma = 1/d computed in float (fixes reference bug B1)."""
         if self.gamma is not None:
@@ -99,6 +109,8 @@ class SVMConfig:
             raise ValueError("epsilon must be > 0")
         if self.cache_lines < 0:
             raise ValueError("cache_lines must be >= 0")
+        if self.weight_pos <= 0 or self.weight_neg <= 0:
+            raise ValueError("class weights must be > 0")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError("dtype must be 'float32' or 'bfloat16'")
         if self.selection not in ("mvp", "second_order"):

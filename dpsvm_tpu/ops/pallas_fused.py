@@ -63,12 +63,17 @@ def _fused_kernel(scalars_ref, f_ref, alpha_ref, y_ref, valid_ref,
     valid = valid_ref[:] > 0.0
     # Pure i1 logic (no jnp.where over booleans: Mosaic materializes the
     # select at i8 and cannot truncate i8 vectors back to i1).
+    cp, cn = c if isinstance(c, tuple) else (c, c)
     pos = y > 0
     neg = ~pos
-    lt_c = alpha < c
+    if cp == cn:
+        lt_cp = lt_cn = alpha < cp
+    else:  # class-weighted C: per-class box bound (LibSVM -w)
+        lt_cp = alpha < cp
+        lt_cn = alpha < cn
     gt_0 = alpha > 0
-    up = ((pos & lt_c) | (neg & gt_0)) & valid
-    low = ((pos & gt_0) | (neg & lt_c)) & valid
+    up = ((pos & lt_cp) | (neg & gt_0)) & valid
+    low = ((pos & gt_0) | (neg & lt_cn)) & valid
 
     rows = rows_per_block
     row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)

@@ -34,13 +34,26 @@ import jax.numpy as jnp
 _INF = jnp.inf
 
 
-def up_mask(alpha: jax.Array, y: jax.Array, c: float) -> jax.Array:
+def c_of(y: jax.Array, c_pos: float, c_neg: float):
+    """Per-row upper bound C_i = C * w_{y_i} (LibSVM -w class weights).
+    Statically collapses to the scalar when the weights are equal, so the
+    unweighted hot path compiles with zero extra ops."""
+    if c_pos == c_neg:
+        return c_pos
+    return jnp.where(y > 0, c_pos, c_neg)
+
+
+def up_mask(alpha: jax.Array, y: jax.Array, c_pos: float,
+            c_neg: float | None = None) -> jax.Array:
     """Membership in I_up."""
+    c = c_of(y, c_pos, c_pos if c_neg is None else c_neg)
     return jnp.where(y > 0, alpha < c, alpha > 0)
 
 
-def low_mask(alpha: jax.Array, y: jax.Array, c: float) -> jax.Array:
+def low_mask(alpha: jax.Array, y: jax.Array, c_pos: float,
+             c_neg: float | None = None) -> jax.Array:
     """Membership in I_low."""
+    c = c_of(y, c_pos, c_pos if c_neg is None else c_neg)
     return jnp.where(y > 0, alpha > 0, alpha < c)
 
 
@@ -48,7 +61,7 @@ def select_working_set(
     f: jax.Array,
     alpha: jax.Array,
     y: jax.Array,
-    c: float,
+    c: float | tuple,
     valid: jax.Array | None = None,
 ):
     """Pick the most-violating pair.
@@ -57,10 +70,13 @@ def select_working_set(
     `valid` masks out padding rows (needed when n is padded up to a multiple
     of the device count / lane width; the reference never pads — bug B3 is
     its unguarded uneven shard math).
+
+    `c` may be a scalar or a (c_pos, c_neg) pair for class-weighted C.
     """
+    cp, cn = (c, c) if not isinstance(c, tuple) else c
     f = f.astype(jnp.float32)
-    up = up_mask(alpha, y, c)
-    low = low_mask(alpha, y, c)
+    up = up_mask(alpha, y, cp, cn)
+    low = low_mask(alpha, y, cp, cn)
     if valid is not None:
         up = up & valid
         low = low & valid

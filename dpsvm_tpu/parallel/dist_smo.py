@@ -66,10 +66,11 @@ def _select_global(f, alpha, y, c, valid):
     (svmTrain.cu:469-481, svmTrainMain.cpp:244-277) fused into the
     compiled step.
     """
+    cp, cn = c if isinstance(c, tuple) else (c, c)
     n_loc = f.shape[0]
     gids = _global_ids(n_loc)
-    up = up_mask(alpha, y, c) & valid
-    low = low_mask(alpha, y, c) & valid
+    up = up_mask(alpha, y, cp, cn) & valid
+    low = low_mask(alpha, y, cp, cn) & valid
     f_up = jnp.where(up, f, jnp.inf)
     f_low = jnp.where(low, f, -jnp.inf)
     l_hi = jnp.argmin(f_up).astype(jnp.int32)
@@ -103,16 +104,19 @@ def _gather_scalar(v_loc, owner_mask):
 def _pair_update_local(state, y_loc, own_hi, own_lo, b_hi_pair, b_lo_pair,
                        k_hi, k_lo, eta, c, gate=None):
     """Shared distributed tail: replicated alpha-pair algebra + local
-    scatter + local rank-2 f update. `gate=False` forces an exact no-op
-    (see solver/smo.py _apply_pair_update)."""
+    scatter + local rank-2 f update. `c` is (c_pos, c_neg). `gate=False`
+    forces an exact no-op (see solver/smo.py _apply_pair_update)."""
+    from dpsvm_tpu.ops.select import c_of
     from dpsvm_tpu.solver.smo import pair_alpha_update
 
+    cp, cn = c if isinstance(c, tuple) else (c, c)
     y_hi = _gather_scalar(y_loc, own_hi)
     y_lo = _gather_scalar(y_loc, own_lo)
     a_hi_old = _gather_scalar(state.alpha, own_hi)
     a_lo_old = _gather_scalar(state.alpha, own_lo)
     a_hi_new, a_lo_new = pair_alpha_update(
-        a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair, eta, c, gate)
+        a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair, eta,
+        c_of(y_hi, cp, cn), c_of(y_lo, cp, cn), gate)
     # lo writes first, hi wins on i_hi == i_lo (matches seq.cpp:248-251).
     alpha = jnp.where(own_lo, a_lo_new, state.alpha)
     alpha = jnp.where(own_hi, a_hi_new, alpha)
@@ -130,8 +134,9 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
     _smo_iteration_wss2 for the single-chip derivation."""
     n_loc = x_loc.shape[0]
     gids = _global_ids(n_loc)
-    up = up_mask(state.alpha, y_loc, c) & valid_loc
-    low = low_mask(state.alpha, y_loc, c) & valid_loc
+    cp, cn = c if isinstance(c, tuple) else (c, c)
+    up = up_mask(state.alpha, y_loc, cp, cn) & valid_loc
+    low = low_mask(state.alpha, y_loc, cp, cn) & valid_loc
     f_up = jnp.where(up, state.f, jnp.inf)
     f_low = jnp.where(low, state.f, -jnp.inf)
     l_hi = jnp.argmin(f_up).astype(jnp.int32)
@@ -372,7 +377,7 @@ def solve_mesh(
                 b_hi=jax.device_put(jnp.float32(bh0), rep),
                 b_lo=jax.device_put(jnp.float32(bl0), rep),
                 it=jax.device_put(jnp.int32(it0), rep))
-    run_chunk = _make_chunk_runner(mesh, kp, float(config.c), float(config.epsilon),
+    run_chunk = _make_chunk_runner(mesh, kp, config.c_bounds(), float(config.epsilon),
                                    float(config.tau), int(config.chunk_iters),
                                    use_cache, config.selection)
     max_iter = jnp.int32(config.max_iter)

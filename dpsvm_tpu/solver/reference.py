@@ -54,8 +54,10 @@ def smo_reference(
     n = x.shape[0]
     gamma = config.resolve_gamma(x.shape[1])
     p = KernelParams(config.kernel, gamma, config.degree, config.coef0)
-    c = np.float32(config.c)
     eps = np.float32(config.epsilon)
+    cp = np.float32(config.c * config.weight_pos)
+    cn = np.float32(config.c * config.weight_neg)
+    c_arr = np.where(y > 0, cp, cn).astype(np.float32)
 
     x_sq = np.einsum("nd,nd->n", x, x).astype(np.float32)
     gram = None
@@ -85,8 +87,8 @@ def smo_reference(
     b_hi = np.float32(0.0)
     b_lo = np.float32(0.0)
     while it < config.max_iter:
-        up = np.where(yp, alpha < c, alpha > 0)
-        low = np.where(yp, alpha > 0, alpha < c)
+        up = np.where(yp, alpha < c_arr, alpha > 0)
+        low = np.where(yp, alpha > 0, alpha < c_arr)
         f_up = np.where(up, f, np.inf)
         f_low = np.where(low, f, -np.inf)
         i_hi = int(np.argmin(f_up))
@@ -105,27 +107,31 @@ def smo_reference(
         a_lo_old = alpha[i_lo]
         # Pair update with the joint [L, H] clip (the reference's sequential
         # double clip at seq.cpp:237-250 can violate sum alpha_i y_i — see
-        # solver/smo.py pair_alpha_update).
+        # solver/smo.py pair_alpha_update). c_hi/c_lo are the per-variable
+        # box bounds (class-weighted C).
+        c_hi = c_arr[i_hi]
+        c_lo = c_arr[i_lo]
         s = y_hi * y_lo
         w = a_hi_old + s * a_lo_old
         if s > 0:
-            lo_b, hi_b = max(np.float32(0.0), w - c), min(c, w)
+            lo_b, hi_b = max(np.float32(0.0), w - c_hi), min(c_lo, w)
         else:
-            lo_b, hi_b = max(np.float32(0.0), -w), min(c, c - w)
+            lo_b, hi_b = max(np.float32(0.0), -w), min(c_lo, c_hi - w)
         a_lo_new = np.float32(np.clip(a_lo_old + y_lo * (b_hi - b_lo) / eta, lo_b, hi_b))
         # Bound snap (see solver/smo.py pair_alpha_update: avoids the
         # c - 1ulp livelock); a_lo snaps BEFORE a_hi is derived from it so
         # conservation survives the snap.
-        snap = np.float32(1e-6) * c
-        if a_lo_new < snap:
+        snap_lo = np.float32(1e-6) * c_lo
+        snap_hi = np.float32(1e-6) * c_hi
+        if a_lo_new < snap_lo:
             a_lo_new = np.float32(0.0)
-        elif a_lo_new > c - snap:
-            a_lo_new = c
-        a_hi_new = np.float32(np.clip(a_hi_old + s * (a_lo_old - a_lo_new), 0.0, c))
-        if a_hi_new < snap:
+        elif a_lo_new > c_lo - snap_lo:
+            a_lo_new = c_lo
+        a_hi_new = np.float32(np.clip(a_hi_old + s * (a_lo_old - a_lo_new), 0.0, c_hi))
+        if a_hi_new < snap_hi:
             a_hi_new = np.float32(0.0)
-        elif a_hi_new > c - snap:
-            a_hi_new = c
+        elif a_hi_new > c_hi - snap_hi:
+            a_hi_new = c_hi
         alpha[i_lo] = a_lo_new
         alpha[i_hi] = a_hi_new
 

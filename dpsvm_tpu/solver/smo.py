@@ -61,9 +61,11 @@ def init_state(n: int, y: jax.Array, cache_lines: int) -> SMOState:
 
 
 def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
-                      eta, c, gate=None):
+                      eta, c_hi, c_lo=None, gate=None):
     """THE alpha-pair algebra, shared by the XLA, Pallas and distributed
-    engines. Returns (a_hi_new, a_lo_new).
+    engines. Returns (a_hi_new, a_lo_new). `c_hi`/`c_lo` are the box upper
+    bounds of the two variables (they differ under class-weighted C,
+    LibSVM's -w; pass one value for the unweighted case).
 
     Deliberate divergence from the reference (svmTrainMain.cpp:285-299,
     seq.cpp:237-250): the reference clips a_lo to [0, C] and then clips
@@ -76,8 +78,8 @@ def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
     segment [L, H] of the box intersected with the constraint line, after
     which a_hi stays in box by construction and conservation is exact:
         s = y_hi*y_lo, w = a_hi_old + s*a_lo_old
-        s=+1: L = max(0, w - C),  H = min(C, w)
-        s=-1: L = max(0, -w),     H = min(C, C - w)
+        s=+1: L = max(0, w - C_hi),  H = min(C_lo, w)
+        s=-1: L = max(0, -w),        H = min(C_lo, C_hi - w)
 
     `gate` (bool scalar) forces an exact no-op when False — used when a
     selection round found no admissible pair (empty I_up/I_low after alpha
@@ -85,13 +87,15 @@ def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
     to a bound and desynchronize f from alpha. Non-finite pair values are
     always gated out.
     """
+    if c_lo is None:
+        c_lo = c_hi
     ok = jnp.isfinite(b_hi_pair) & jnp.isfinite(b_lo_pair)
     if gate is not None:
         ok = ok & gate
     s = y_hi * y_lo
     w = a_hi_old + s * a_lo_old
-    lo_bound = jnp.where(s > 0, jnp.maximum(0.0, w - c), jnp.maximum(0.0, -w))
-    hi_bound = jnp.where(s > 0, jnp.minimum(c, w), jnp.minimum(c, c - w))
+    lo_bound = jnp.where(s > 0, jnp.maximum(0.0, w - c_hi), jnp.maximum(0.0, -w))
+    hi_bound = jnp.where(s > 0, jnp.minimum(c_lo, w), jnp.minimum(c_lo, c_hi - w))
     a_lo_new = jnp.clip(a_lo_old + y_lo * (b_hi_pair - b_lo_pair) / eta,
                         lo_bound, hi_bound)
     # Snap to the box bounds (LibSVM assigns exact bound constants in its
@@ -101,13 +105,14 @@ def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
     # a_lo is snapped BEFORE a_hi is derived from it so the derivation
     # keeps delta(a_hi) = -s * delta(a_lo) (conservation); a_hi's own snap
     # then only absorbs rounding of the derivation itself.
-    snap = 1e-6 * c
-    a_lo_new = jnp.where(a_lo_new < snap, 0.0,
-                         jnp.where(a_lo_new > c - snap, c, a_lo_new))
+    snap_lo = 1e-6 * c_lo
+    snap_hi = 1e-6 * c_hi
+    a_lo_new = jnp.where(a_lo_new < snap_lo, 0.0,
+                         jnp.where(a_lo_new > c_lo - snap_lo, c_lo, a_lo_new))
     # In box by construction; the final clip only absorbs float round-off.
-    a_hi_new = jnp.clip(a_hi_old + s * (a_lo_old - a_lo_new), 0.0, c)
-    a_hi_new = jnp.where(a_hi_new < snap, 0.0,
-                         jnp.where(a_hi_new > c - snap, c, a_hi_new))
+    a_hi_new = jnp.clip(a_hi_old + s * (a_lo_old - a_lo_new), 0.0, c_hi)
+    a_hi_new = jnp.where(a_hi_new < snap_hi, 0.0,
+                         jnp.where(a_hi_new > c_hi - snap_hi, c_hi, a_hi_new))
     a_lo_new = jnp.where(ok, a_lo_new, a_lo_old)
     a_hi_new = jnp.where(ok, a_hi_new, a_hi_old)
     return a_hi_new, a_lo_new
@@ -116,13 +121,17 @@ def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
 def _apply_pair_update(state: SMOState, y, i_hi, i_lo, b_hi_pair, b_lo_pair,
                        k_hi, k_lo, eta, c, gate=None) -> tuple:
     """Shared tail of an SMO iteration: alpha-pair algebra + rank-2 f
-    update (update_functor svmTrain.cu:98-137)."""
+    update (update_functor svmTrain.cu:98-137). `c` is (c_pos, c_neg)."""
+    from dpsvm_tpu.ops.select import c_of
+
+    cp, cn = c if isinstance(c, tuple) else (c, c)
     y_hi = y[i_hi].astype(jnp.float32)
     y_lo = y[i_lo].astype(jnp.float32)
     a_hi_old = state.alpha[i_hi]
     a_lo_old = state.alpha[i_lo]
     a_hi_new, a_lo_new = pair_alpha_update(
-        a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair, eta, c, gate)
+        a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair, eta,
+        c_of(y_hi, cp, cn), c_of(y_lo, cp, cn), gate)
     alpha = state.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
     f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
                 + (a_lo_new - a_lo_old) * y_lo * k_lo
@@ -166,8 +175,9 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
     row the f update fetches anyway, so the extra selection is one more
     O(n) pass for typically several-fold fewer iterations.
     """
-    up = up_mask(state.alpha, y, c)
-    low = low_mask(state.alpha, y, c)
+    cp, cn = c if isinstance(c, tuple) else (c, c)
+    up = up_mask(state.alpha, y, cp, cn)
+    low = low_mask(state.alpha, y, cp, cn)
     if valid is not None:
         up = up & valid
         low = low & valid
@@ -263,12 +273,15 @@ def _run_chunk_pallas(x, y, x_sq, valid, state: SMOState, max_iter,
         k_hl = kernel_from_dots(d_hi[i_lo], qsq_lo, qsq_hi, kp)
         eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
 
+        from dpsvm_tpu.ops.select import c_of
+        cp, cn = c if isinstance(c, tuple) else (c, c)
         y_hi = y[i_hi]
         y_lo = y[i_lo]
         a_hi_old = st.alpha[i_hi]
         a_lo_old = st.alpha[i_lo]
         a_hi_new, a_lo_new = pair_alpha_update(
-            a_hi_old, a_lo_old, y_hi, y_lo, st.b_hi, st.b_lo, eta, c)
+            a_hi_old, a_lo_old, y_hi, y_lo, st.b_hi, st.b_lo, eta,
+            c_of(y_hi, cp, cn), c_of(y_lo, cp, cn))
         alpha = st.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
 
         scalars = jnp.stack([
@@ -427,11 +440,11 @@ def solve(
         if use_pallas:
             state = _run_chunk_pallas(
                 x_dev, y_dev, x_sq, valid_dev, state, max_iter,
-                kp, float(config.c), float(config.epsilon), float(config.tau),
+                kp, config.c_bounds(), float(config.epsilon), float(config.tau),
                 int(config.chunk_iters), use_cache, block_rows, interpret)
         else:
             state = _run_chunk(x_dev, y_dev, x_sq, k_diag, None, state, max_iter,
-                               kp, float(config.c), float(config.epsilon),
+                               kp, config.c_bounds(), float(config.epsilon),
                                float(config.tau), int(config.chunk_iters), use_cache,
                                config.selection)
         it = int(state.it)

@@ -62,12 +62,14 @@ extern "C" {
 // algorithm of seq.cpp:195-260).
 //
 //   x      n*d row-major features, y  n labels in {-1,+1}
+//   c_pos/c_neg  per-class box bounds C * w_{+1} / C * w_{-1} (equal for
+//                the unweighted problem)
 //   out_alpha[n], out_f[n] caller-allocated; out_scalars[4] receives
 //   {b, b_hi, b_lo, converged(0/1)}.
 // Returns iterations executed, or negative on error.
 long seqsmo_train(const float* x, const int* y, long n, long d,
-                  float c, float gamma, float eps, float tau, long max_iter,
-                  int kernel_kind, int degree, float coef0,
+                  float c_pos, float c_neg, float gamma, float eps, float tau,
+                  long max_iter, int kernel_kind, int degree, float coef0,
                   float* out_alpha, float* out_f, float* out_scalars) {
     if (n <= 0 || d <= 0 || max_iter < 0) return -1;
     std::vector<float> x_sq((size_t)n);
@@ -89,8 +91,9 @@ long seqsmo_train(const float* x, const int* y, long n, long d,
         float f_hi = 0.0f, f_lo = 0.0f;
         for (long i = 0; i < n; ++i) {
             bool pos = y[i] > 0;
-            bool up = pos ? (alpha[i] < c) : (alpha[i] > 0.0f);
-            bool low = pos ? (alpha[i] > 0.0f) : (alpha[i] < c);
+            float ci = pos ? c_pos : c_neg;
+            bool up = pos ? (alpha[i] < ci) : (alpha[i] > 0.0f);
+            bool low = pos ? (alpha[i] > 0.0f) : (alpha[i] < ci);
             if (up && (i_hi < 0 || f[i] < f_hi)) { f_hi = f[i]; i_hi = i; }
             if (low && (i_lo < 0 || f[i] > f_lo)) { f_lo = f[i]; i_lo = i; }
         }
@@ -107,30 +110,33 @@ long seqsmo_train(const float* x, const int* y, long n, long d,
         if (eta < tau) eta = tau;  // B2 fix (reference divides unguarded)
 
         float y_hi = (float)y[i_hi], y_lo = (float)y[i_lo];
+        float c_hi = y[i_hi] > 0 ? c_pos : c_neg;
+        float c_lo = y[i_lo] > 0 ? c_pos : c_neg;
         float a_hi_old = alpha[i_hi], a_lo_old = alpha[i_lo];
         // Pair update with the joint [L, H] clip; the reference's
         // sequential double clip (seq.cpp:237-250) can violate
         // sum alpha_i y_i (see solver/smo.py pair_alpha_update).
         float s = y_hi * y_lo;
         float w = a_hi_old + s * a_lo_old;
-        float lo_b = s > 0.0f ? (w - c > 0.0f ? w - c : 0.0f)
+        float lo_b = s > 0.0f ? (w - c_hi > 0.0f ? w - c_hi : 0.0f)
                               : (-w > 0.0f ? -w : 0.0f);
-        float hi_b = s > 0.0f ? (w < c ? w : c)
-                              : (c - w < c ? c - w : c);
+        float hi_b = s > 0.0f ? (w < c_lo ? w : c_lo)
+                              : (c_hi - w < c_lo ? c_hi - w : c_lo);
         float a_lo_new = a_lo_old + y_lo * (b_hi - b_lo) / eta;
         if (a_lo_new < lo_b) a_lo_new = lo_b;
         if (a_lo_new > hi_b) a_lo_new = hi_b;
         // Bound snap (see solver/smo.py pair_alpha_update: avoids the
         // c - 1ulp livelock); a_lo snaps BEFORE a_hi is derived from it
         // so conservation survives the snap.
-        float snap = 1e-6f * c;
-        if (a_lo_new < snap) a_lo_new = 0.0f;
-        else if (a_lo_new > c - snap) a_lo_new = c;
+        float snap_lo = 1e-6f * c_lo;
+        float snap_hi = 1e-6f * c_hi;
+        if (a_lo_new < snap_lo) a_lo_new = 0.0f;
+        else if (a_lo_new > c_lo - snap_lo) a_lo_new = c_lo;
         float a_hi_new = a_hi_old + s * (a_lo_old - a_lo_new);
         if (a_hi_new < 0.0f) a_hi_new = 0.0f;
-        if (a_hi_new > c) a_hi_new = c;
-        if (a_hi_new < snap) a_hi_new = 0.0f;
-        else if (a_hi_new > c - snap) a_hi_new = c;
+        if (a_hi_new > c_hi) a_hi_new = c_hi;
+        if (a_hi_new < snap_hi) a_hi_new = 0.0f;
+        else if (a_hi_new > c_hi - snap_hi) a_hi_new = c_hi;
         alpha[i_lo] = a_lo_new;
         alpha[i_hi] = a_hi_new;
 
