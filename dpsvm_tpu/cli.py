@@ -55,6 +55,11 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "update+select TPU kernel)")
     p.add_argument("--degree", type=int, default=3)
     p.add_argument("--coef0", type=float, default=0.0)
+    p.add_argument("-w1", "--weight-pos", type=float, default=1.0,
+                   help="C multiplier for the +1 class (LibSVM -w1)")
+    p.add_argument("-w-1", "--weight-neg", type=float, default=1.0,
+                   dest="weight_neg",
+                   help="C multiplier for the -1 class (LibSVM -w-1)")
     p.add_argument("--backend",
                    choices=["auto", "single", "mesh", "reference", "native"],
                    default="auto")
@@ -167,6 +172,7 @@ def _cmd_train(args) -> int:
         c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
         max_iter=args.max_iter, cache_lines=args.cache_size,
         kernel=args.kernel, degree=args.degree, coef0=args.coef0,
+        weight_pos=args.weight_pos, weight_neg=args.weight_neg,
         selection=args.selection, engine=args.engine,
         dtype=args.dtype, chunk_iters=args.chunk_iters,
         checkpoint_every=args.checkpoint_every, verbose=not args.quiet)

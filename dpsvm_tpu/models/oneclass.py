@@ -134,7 +134,10 @@ def train_oneclass(
     kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
     f_init = _initial_gradient(x, alpha0, kp, config.dtype)
     y = np.ones((n,), np.int32)
-    cfg = config.replace(c=1.0)
+    # The OCSVM box is exactly [0, 1]: neutralize the class weights along
+    # with c, else weight_pos would silently rescale the box below the
+    # alpha_init values and break the sum(alpha) = nu*n constraint.
+    cfg = config.replace(c=1.0, weight_pos=1.0, weight_neg=1.0)
 
     if backend == "auto":
         backend = "mesh" if (num_devices or len(jax.devices())) > 1 else "single"

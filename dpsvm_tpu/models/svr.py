@@ -111,6 +111,10 @@ def train_svr(
     x2 = np.vstack([x, x])
     y2 = np.concatenate([np.ones(n, np.int32), -np.ones(n, np.int32)])
     f_init = np.concatenate([svr_epsilon - z, -svr_epsilon - z]).astype(np.float32)
+    # SVR has a single C: the synthetic +-1 labels of the 2n-variable
+    # expansion are bookkeeping, not classes, so class weights must not
+    # asymmetrically bound the alpha vs alpha* halves.
+    config = config.replace(weight_pos=1.0, weight_neg=1.0)
 
     if backend == "auto":
         backend = "mesh" if (num_devices or len(jax.devices())) > 1 else "single"

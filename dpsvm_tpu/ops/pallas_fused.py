@@ -34,6 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots
+from dpsvm_tpu.ops.select import split_c
 
 LANES = 128
 _BIG = float("inf")  # plain float: a jnp scalar here would be a captured constant
@@ -63,7 +64,7 @@ def _fused_kernel(scalars_ref, f_ref, alpha_ref, y_ref, valid_ref,
     valid = valid_ref[:] > 0.0
     # Pure i1 logic (no jnp.where over booleans: Mosaic materializes the
     # select at i8 and cannot truncate i8 vectors back to i1).
-    cp, cn = c if isinstance(c, tuple) else (c, c)
+    cp, cn = split_c(c)
     pos = y > 0
     neg = ~pos
     if cp == cn:

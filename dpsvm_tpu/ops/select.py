@@ -34,6 +34,11 @@ import jax.numpy as jnp
 _INF = jnp.inf
 
 
+def split_c(c: float | tuple) -> tuple:
+    """Normalize a scalar-or-(c_pos, c_neg) box bound to the pair form."""
+    return c if isinstance(c, tuple) else (c, c)
+
+
 def c_of(y: jax.Array, c_pos: float, c_neg: float):
     """Per-row upper bound C_i = C * w_{y_i} (LibSVM -w class weights).
     Statically collapses to the scalar when the weights are equal, so the
@@ -73,7 +78,7 @@ def select_working_set(
 
     `c` may be a scalar or a (c_pos, c_neg) pair for class-weighted C.
     """
-    cp, cn = (c, c) if not isinstance(c, tuple) else c
+    cp, cn = split_c(c)
     f = f.astype(jnp.float32)
     up = up_mask(alpha, y, cp, cn)
     low = low_mask(alpha, y, cp, cn)

@@ -41,7 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_diag, kernel_from_dots
-from dpsvm_tpu.ops.select import up_mask, low_mask
+from dpsvm_tpu.ops.select import c_of, low_mask, split_c, up_mask
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
 from dpsvm_tpu.solver.smo import SMOState, assert_finite_state
@@ -66,7 +66,7 @@ def _select_global(f, alpha, y, c, valid):
     (svmTrain.cu:469-481, svmTrainMain.cpp:244-277) fused into the
     compiled step.
     """
-    cp, cn = c if isinstance(c, tuple) else (c, c)
+    cp, cn = split_c(c)
     n_loc = f.shape[0]
     gids = _global_ids(n_loc)
     up = up_mask(alpha, y, cp, cn) & valid
@@ -106,10 +106,9 @@ def _pair_update_local(state, y_loc, own_hi, own_lo, b_hi_pair, b_lo_pair,
     """Shared distributed tail: replicated alpha-pair algebra + local
     scatter + local rank-2 f update. `c` is (c_pos, c_neg). `gate=False`
     forces an exact no-op (see solver/smo.py _apply_pair_update)."""
-    from dpsvm_tpu.ops.select import c_of
     from dpsvm_tpu.solver.smo import pair_alpha_update
 
-    cp, cn = c if isinstance(c, tuple) else (c, c)
+    cp, cn = split_c(c)
     y_hi = _gather_scalar(y_loc, own_hi)
     y_lo = _gather_scalar(y_loc, own_lo)
     a_hi_old = _gather_scalar(state.alpha, own_hi)
@@ -134,7 +133,7 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
     _smo_iteration_wss2 for the single-chip derivation."""
     n_loc = x_loc.shape[0]
     gids = _global_ids(n_loc)
-    cp, cn = c if isinstance(c, tuple) else (c, c)
+    cp, cn = split_c(c)
     up = up_mask(state.alpha, y_loc, cp, cn) & valid_loc
     low = low_mask(state.alpha, y_loc, cp, cn) & valid_loc
     f_up = jnp.where(up, state.f, jnp.inf)

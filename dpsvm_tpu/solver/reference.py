@@ -55,8 +55,9 @@ def smo_reference(
     gamma = config.resolve_gamma(x.shape[1])
     p = KernelParams(config.kernel, gamma, config.degree, config.coef0)
     eps = np.float32(config.epsilon)
-    cp = np.float32(config.c * config.weight_pos)
-    cn = np.float32(config.c * config.weight_neg)
+    c_pos, c_neg = config.c_bounds()
+    cp = np.float32(c_pos)
+    cn = np.float32(c_neg)
     c_arr = np.where(y > 0, cp, cn).astype(np.float32)
 
     x_sq = np.einsum("nd,nd->n", x, x).astype(np.float32)
@@ -171,8 +172,9 @@ def smo_native(x: np.ndarray, y: np.ndarray, config: SVMConfig) -> SolveResult:
     y = np.asarray(y, np.int32)
     gamma = config.resolve_gamma(x.shape[1])
     t0 = time.perf_counter()
+    c_pos, c_neg = config.c_bounds()
     alpha, f, b, b_hi, b_lo, it, converged = eng.train(
-        x, y, c=config.c, gamma=gamma, epsilon=config.epsilon,
+        x, y, c=c_pos, c_neg=c_neg, gamma=gamma, epsilon=config.epsilon,
         tau=max(config.tau, 1e-20), max_iter=config.max_iter,
         kernel=config.kernel, degree=config.degree, coef0=config.coef0)
     return SolveResult(

@@ -29,7 +29,8 @@ from dpsvm_tpu.ops.kernels import (
     row_dots,
     squared_norms,
 )
-from dpsvm_tpu.ops.select import low_mask, select_working_set, up_mask
+from dpsvm_tpu.ops.select import (c_of, low_mask, select_working_set,
+                                  split_c, up_mask)
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
 
@@ -122,9 +123,8 @@ def _apply_pair_update(state: SMOState, y, i_hi, i_lo, b_hi_pair, b_lo_pair,
                        k_hi, k_lo, eta, c, gate=None) -> tuple:
     """Shared tail of an SMO iteration: alpha-pair algebra + rank-2 f
     update (update_functor svmTrain.cu:98-137). `c` is (c_pos, c_neg)."""
-    from dpsvm_tpu.ops.select import c_of
 
-    cp, cn = c if isinstance(c, tuple) else (c, c)
+    cp, cn = split_c(c)
     y_hi = y[i_hi].astype(jnp.float32)
     y_lo = y[i_lo].astype(jnp.float32)
     a_hi_old = state.alpha[i_hi]
@@ -175,7 +175,7 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
     row the f update fetches anyway, so the extra selection is one more
     O(n) pass for typically several-fold fewer iterations.
     """
-    cp, cn = c if isinstance(c, tuple) else (c, c)
+    cp, cn = split_c(c)
     up = up_mask(state.alpha, y, cp, cn)
     low = low_mask(state.alpha, y, cp, cn)
     if valid is not None:
@@ -273,8 +273,7 @@ def _run_chunk_pallas(x, y, x_sq, valid, state: SMOState, max_iter,
         k_hl = kernel_from_dots(d_hi[i_lo], qsq_lo, qsq_hi, kp)
         eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
 
-        from dpsvm_tpu.ops.select import c_of
-        cp, cn = c if isinstance(c, tuple) else (c, c)
+        cp, cn = split_c(c)
         y_hi = y[i_hi]
         y_lo = y[i_lo]
         a_hi_old = st.alpha[i_hi]

@@ -178,8 +178,8 @@ class SeqSMO:
         lib.seqsmo_train.argtypes = [
             f32p, ctypes.POINTER(ctypes.c_int), ctypes.c_long, ctypes.c_long,
             ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
-            ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_float,
-            f32p, f32p, f32p,
+            ctypes.c_float, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, f32p, f32p, f32p,
         ]
         lib.seqsmo_decision.restype = ctypes.c_long
         lib.seqsmo_decision.argtypes = [
@@ -190,7 +190,8 @@ class SeqSMO:
 
     def train(self, x: np.ndarray, y: np.ndarray, *, c: float, gamma: float,
               epsilon: float, tau: float, max_iter: int, kernel: str = "rbf",
-              degree: int = 3, coef0: float = 0.0):
+              degree: int = 3, coef0: float = 0.0,
+              c_neg: float | None = None):
         """Returns (alpha, f, b, b_hi, b_lo, iterations, converged)."""
         x = np.ascontiguousarray(x, np.float32)
         y = np.ascontiguousarray(y, np.int32)
@@ -206,7 +207,9 @@ class SeqSMO:
         it = self._lib.seqsmo_train(
             x.ctypes.data_as(f32p),
             y.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
-            n, d, ctypes.c_float(c), ctypes.c_float(gamma),
+            n, d, ctypes.c_float(c),
+            ctypes.c_float(c if c_neg is None else c_neg),
+            ctypes.c_float(gamma),
             ctypes.c_float(epsilon), ctypes.c_float(tau), max_iter,
             _KERNEL_KINDS[kernel], degree, ctypes.c_float(coef0),
             alpha.ctypes.data_as(f32p), f.ctypes.data_as(f32p),

@@ -1,0 +1,100 @@
+"""Class-weighted C (LibSVM -w1/-w-1) across every engine.
+
+The weighted branches (`c_of`, weighted up/low masks, per-variable box
+bounds in the pair clip) statically collapse to the unweighted program at
+equal weights, so the default-weight parity tests exercise none of them.
+This file pins every engine's weighted path against the NumPy oracle and
+LibSVM, plus the weight-neutralization contracts of the SVR/one-class
+frontends (their synthetic +-1 labels are not classes)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.solver.reference import smo_reference
+from dpsvm_tpu.solver.smo import solve
+
+WCFG = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000,
+                 weight_pos=2.0, weight_neg=0.5, chunk_iters=256)
+
+
+def _assert_matches_oracle(res, ref, y, cfg):
+    assert res.converged and ref.converged
+    assert res.b == pytest.approx(ref.b, abs=5e-3)
+    np.testing.assert_allclose(res.alpha, ref.alpha, atol=5e-2)
+    cp, cn = cfg.c_bounds()
+    bound = np.where(np.asarray(y) > 0, cp, cn)
+    assert np.all(np.asarray(res.alpha) <= bound + 1e-5)
+
+
+@pytest.mark.parametrize("cfg", [
+    WCFG,
+    WCFG.replace(selection="second_order"),
+    WCFG.replace(cache_lines=32),
+], ids=["mvp", "wss2", "cached"])
+def test_single_chip_weighted_matches_oracle(blobs_small, cfg):
+    x, y = blobs_small
+    ref = smo_reference(x, y, WCFG)
+    res = solve(x, y, cfg)
+    if cfg.selection == "second_order":
+        # WSS2 picks different pairs; compare optima, not trajectories.
+        assert res.converged and ref.converged
+        assert res.b == pytest.approx(ref.b, abs=2e-2)
+        cp, cn = cfg.c_bounds()
+        bound = np.where(np.asarray(y) > 0, cp, cn)
+        assert np.all(np.asarray(res.alpha) <= bound + 1e-5)
+    else:
+        _assert_matches_oracle(res, ref, y, cfg)
+
+
+def test_pallas_weighted_matches_oracle(blobs_small):
+    x, y = blobs_small
+    ref = smo_reference(x, y, WCFG)
+    res = solve(x, y, WCFG.replace(engine="pallas"))
+    _assert_matches_oracle(res, ref, y, WCFG)
+
+
+def test_mesh_weighted_matches_oracle(blobs_small):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+    x, y = blobs_small
+    ref = smo_reference(x, y, WCFG)
+    res = solve_mesh(x, y, WCFG, num_devices=4)
+    _assert_matches_oracle(res, ref, y, WCFG)
+
+
+def test_weighted_matches_libsvm_class_weight(blobs_small):
+    from sklearn.svm import SVC
+    x, y = blobs_small
+    res = solve(x, y, WCFG)
+    sk = SVC(C=1.0, kernel="rbf", gamma=0.1, tol=1e-3,
+             class_weight={1: 2.0, -1: 0.5}).fit(x, y)
+    assert abs(res.n_sv - len(sk.support_)) <= max(3, int(0.05 * len(sk.support_)))
+
+
+def test_svr_ignores_class_weights(blobs_small):
+    # SVR's 2n expansion labels are bookkeeping; weights must not skew
+    # the alpha vs alpha* boxes.
+    from dpsvm_tpu.models.svr import train_svr
+    x, _ = blobs_small
+    rng = np.random.default_rng(0)
+    z = np.sin(x[:, 0]) + 0.05 * rng.standard_normal(x.shape[0])
+    cfg = SVMConfig(c=1.0, gamma=0.5, epsilon=1e-3, max_iter=100_000)
+    m_plain, r_plain = train_svr(x, z, cfg, backend="single")
+    m_w, r_w = train_svr(x, z, cfg.replace(weight_pos=3.0, weight_neg=0.25),
+                         backend="single")
+    assert r_w.iterations == r_plain.iterations
+    np.testing.assert_allclose(r_w.alpha, r_plain.alpha, atol=1e-6)
+
+
+def test_oneclass_ignores_class_weights(blobs_small):
+    # The OCSVM box is [0, 1] by definition; weight_pos must not rescale
+    # it below the nu-constrained alpha_init.
+    from dpsvm_tpu.models.oneclass import train_oneclass
+    x, _ = blobs_small
+    cfg = SVMConfig(gamma=0.2, epsilon=1e-3, max_iter=100_000)
+    m_plain, r_plain = train_oneclass(x, nu=0.3, config=cfg, backend="single")
+    m_w, r_w = train_oneclass(
+        x, nu=0.3, config=cfg.replace(weight_pos=0.5), backend="single")
+    assert r_w.converged
+    np.testing.assert_allclose(r_w.alpha, r_plain.alpha, atol=1e-6)
+    assert np.asarray(r_w.alpha).max() <= 1.0 + 1e-6

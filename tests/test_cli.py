@@ -38,6 +38,24 @@ def test_train_then_test_roundtrip(csvs, capsys):
     assert acc > 0.85
 
 
+def test_train_class_weight_flags(csvs, capsys):
+    # LibSVM-style -w1/-w-1 must reach the solver (weighted C changes the
+    # iterate count vs the unweighted run on the same data).
+    train_p, _, d = csvs
+    model_p = d + "/wmodel.txt"
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g", "0.1",
+               "-w1", "2.0", "-w-1", "0.5", "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    it_w = int(out.split("converged at iteration ")[1].split()[0])
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g", "0.1",
+               "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    it_plain = int(out.split("converged at iteration ")[1].split()[0])
+    assert it_w != it_plain
+
+
 def test_train_with_declared_shapes_and_npz(csvs, capsys):
     train_p, test_p, d = csvs
     model_p = d + "/model.npz"

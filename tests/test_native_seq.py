@@ -31,6 +31,23 @@ def test_native_matches_oracle_trajectory(blobs_small):
     np.testing.assert_allclose(nat.alpha, ref.alpha, atol=5e-2)
 
 
+def test_native_class_weights_match_oracle(blobs_small):
+    # Regression: the seqsmo ABI takes separate c_pos/c_neg bounds; a
+    # binding that drops one shifts every following argument and the
+    # solver silently diverges.
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, weight_pos=2.0, weight_neg=0.5,
+                    epsilon=1e-3, max_iter=100_000)
+    ref = smo_reference(x, y, cfg)
+    nat = smo_native(x, y, cfg)
+    assert nat.converged and ref.converged
+    assert nat.b == pytest.approx(ref.b, abs=5e-3)
+    np.testing.assert_allclose(nat.alpha, ref.alpha, atol=5e-2)
+    cp, cn = cfg.c_bounds()
+    bound = np.where(y > 0, cp, cn)
+    assert np.all(nat.alpha <= bound + 1e-6)
+
+
 def test_native_decision_matches_python_predict(blobs_small):
     x, y = blobs_small
     cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000)
