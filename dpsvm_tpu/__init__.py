@@ -30,6 +30,15 @@ from dpsvm_tpu.train import train
 from dpsvm_tpu.predict import decision_function, predict, accuracy
 from dpsvm_tpu import data
 
+
+def __getattr__(name):
+    # PEP 562 lazy submodule: the estimator facade imports sklearn, which
+    # solver-only users (CLI, mesh startup) should never pay for.
+    if name == "estimators":
+        import importlib
+        return importlib.import_module("dpsvm_tpu.estimators")
+    raise AttributeError(f"module 'dpsvm_tpu' has no attribute {name!r}")
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -44,5 +53,6 @@ __all__ = [
     "predict",
     "accuracy",
     "data",
+    "estimators",
     "__version__",
 ]

@@ -1,0 +1,311 @@
+"""scikit-learn-compatible estimator facade.
+
+The reference is a CLI tool with no library API at all (svmTrainMain.cpp
+parses flags into a global struct and writes a text model); this module is
+the opposite end of the adoption surface: drop-in ``SVC`` / ``SVR`` /
+``OneClassSVM`` estimators with sklearn ``fit``/``predict``/``score``
+semantics, backed by the TPU solver. Subclassing
+``sklearn.base.BaseEstimator`` makes ``get_params``/``set_params``/
+``clone`` work, so ``GridSearchCV``, ``cross_val_score``, ``Pipeline``
+etc. compose with TPU-trained SVMs unchanged.
+
+sklearn itself is only imported lazily (it is a test/facade dependency,
+not a solver dependency).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # pragma: no cover - exercised implicitly by import
+    from sklearn.base import BaseEstimator, ClassifierMixin, OutlierMixin, RegressorMixin
+except ImportError:  # sklearn genuinely absent: degrade to plain objects
+    class BaseEstimator:  # type: ignore[no-redef]
+        def get_params(self, deep=True):
+            import inspect
+            keys = inspect.signature(type(self).__init__).parameters
+            return {k: getattr(self, k) for k in keys if k != "self"}
+
+        def set_params(self, **params):
+            for k, v in params.items():
+                setattr(self, k, v)
+            return self
+
+    class ClassifierMixin:  # type: ignore[no-redef]
+        pass
+
+    class RegressorMixin:  # type: ignore[no-redef]
+        pass
+
+    class OutlierMixin:  # type: ignore[no-redef]
+        pass
+
+from dpsvm_tpu.config import SVMConfig
+
+
+def _resolve_gamma(gamma, x: np.ndarray) -> float:
+    if gamma == "scale":
+        var = float(x.var())
+        return 1.0 / (x.shape[1] * var) if var > 0 else 1.0 / x.shape[1]
+    if gamma == "auto":
+        return 1.0 / x.shape[1]
+    return float(gamma)
+
+
+def _base_config(est, gamma: float) -> SVMConfig:
+    return SVMConfig(
+        c=est.C if hasattr(est, "C") else 1.0,
+        gamma=gamma,
+        kernel=est.kernel,
+        degree=est.degree,
+        coef0=est.coef0,
+        epsilon=est.tol,
+        max_iter=est.max_iter if est.max_iter > 0 else 150_000,
+        selection=getattr(est, "selection", "mvp"),
+        cache_lines=est.cache_lines,
+        dtype=est.dtype,
+    )
+
+
+class SVC(ClassifierMixin, BaseEstimator):
+    """C-SVC with sklearn semantics on the TPU solver.
+
+    Accepts arbitrary (binary or multiclass) integer/str labels; multiclass
+    is reduced via one-vs-rest or one-vs-one (``strategy``). ``class_weight``
+    ({label: w} or "balanced") is honored for binary problems, mirroring
+    LibSVM ``-w``.
+    """
+
+    def __init__(self, C=1.0, kernel="rbf", degree=3, gamma="scale",
+                 coef0=0.0, tol=1e-3, max_iter=-1, class_weight=None,
+                 strategy="ovr", backend="auto", selection="mvp",
+                 cache_lines=0, dtype="float32", probability=False,
+                 probability_cv=3, random_state=0):
+        self.C = C
+        self.kernel = kernel
+        self.degree = degree
+        self.gamma = gamma
+        self.coef0 = coef0
+        self.tol = tol
+        self.max_iter = max_iter
+        self.class_weight = class_weight
+        self.strategy = strategy
+        self.backend = backend
+        self.selection = selection
+        self.cache_lines = cache_lines
+        self.dtype = dtype
+        self.probability = probability
+        self.probability_cv = probability_cv
+        self.random_state = random_state
+
+    def _weights(self, y: np.ndarray, classes: np.ndarray) -> tuple[float, float]:
+        """(weight_pos, weight_neg) for a binary problem where classes[1]
+        maps to +1 and classes[0] to -1."""
+        if self.class_weight is None:
+            return 1.0, 1.0
+        if self.class_weight == "balanced":
+            n = y.shape[0]
+            counts = {c: int((y == c).sum()) for c in classes}
+            return (n / (2.0 * counts[classes[1]]),
+                    n / (2.0 * counts[classes[0]]))
+        return (float(self.class_weight.get(classes[1], 1.0)),
+                float(self.class_weight.get(classes[0], 1.0)))
+
+    def fit(self, X, y):
+        from dpsvm_tpu.models.multiclass import train_multiclass
+        from dpsvm_tpu.train import train
+
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        self.classes_ = np.unique(y)
+        if self.classes_.shape[0] < 2:
+            raise ValueError("SVC needs at least 2 classes")
+        cfg = _base_config(self, _resolve_gamma(self.gamma, X))
+
+        if self.classes_.shape[0] == 2:
+            wp, wn = self._weights(y, self.classes_)
+            cfg = cfg.replace(weight_pos=wp, weight_neg=wn)
+            y_pm = np.where(y == self.classes_[1], 1, -1).astype(np.int32)
+            model, res = train(X, y_pm, cfg, backend=self.backend)
+            self._binary_model = model
+            self._multiclass_model = None
+            self.fit_result_ = res
+            sv_mask = np.asarray(res.alpha) > 0
+            self.n_support_ = np.array(
+                [(sv_mask & (y_pm < 0)).sum(), (sv_mask & (y_pm > 0)).sum()])
+            self.n_iter_ = res.iterations
+            if self.probability:
+                self._platt = self._fit_platt_cv(X, y_pm, cfg)
+        else:
+            if self.class_weight is not None:
+                raise ValueError(
+                    "class_weight is only supported for binary problems "
+                    "(per-class weights do not decompose over OvR/OvO splits)")
+            mc, results = train_multiclass(
+                X, y, cfg, strategy=self.strategy, backend=self.backend)
+            self._binary_model = None
+            self._multiclass_model = mc
+            self.fit_result_ = results
+            self.n_iter_ = int(sum(r.iterations for r in results))
+            if self.probability:
+                if self.strategy != "ovr":
+                    raise ValueError(
+                        "probability=True requires strategy='ovr' for "
+                        "multiclass (per-class Platt + normalization)")
+                self._platt = [
+                    self._fit_platt_cv(
+                        X, np.where(y == cl, 1, -1).astype(np.int32), cfg)
+                    for cl in self.classes_]
+        return self
+
+    def _fit_platt_cv(self, X, y_pm, cfg):
+        """(A, B) from decision values on held-out folds, LibSVM-style:
+        k-fold refits so the calibration never sees its own training
+        residuals (in-sample |f| is biased toward the margin)."""
+        from dpsvm_tpu.models.platt import fit_platt
+        from dpsvm_tpu.predict import decision_function
+        from dpsvm_tpu.train import train
+
+        k = max(2, int(self.probability_cv))
+        rng = np.random.default_rng(self.random_state)
+        perm = rng.permutation(len(y_pm))
+        folds = np.array_split(perm, k)
+        dec = np.empty(len(y_pm), np.float64)
+        for i, held in enumerate(folds):
+            tr = np.concatenate([f for j, f in enumerate(folds) if j != i])
+            if len(np.unique(y_pm[tr])) < 2:
+                raise ValueError(
+                    "probability calibration fold lost a class; lower "
+                    "probability_cv or provide more data")
+            m, _ = train(X[tr], y_pm[tr], cfg, backend=self.backend)
+            dec[held] = decision_function(m, X[held])
+        return fit_platt(dec, y_pm)
+
+    def predict_proba(self, X):
+        """Class-probability matrix (n, k), classes in ``classes_`` order."""
+        from dpsvm_tpu.models.platt import platt_probability
+        if not self.probability:
+            raise AttributeError(
+                "predict_proba requires probability=True at fit time")
+        X = np.asarray(X, np.float32)
+        if self._binary_model is not None:
+            p_pos = platt_probability(self.decision_function(X), *self._platt)
+            return np.stack([1.0 - p_pos, p_pos], axis=1)
+        from dpsvm_tpu.models.multiclass import decision_matrix
+        scores = decision_matrix(self._multiclass_model, X)
+        probs = np.stack([
+            platt_probability(scores[:, j], *self._platt[j])
+            for j in range(len(self.classes_))], axis=1)
+        probs = np.clip(probs, 1e-12, 1.0)
+        return probs / probs.sum(axis=1, keepdims=True)
+
+    def decision_function(self, X):
+        """(n,) for binary, (n, k) per-class scores otherwise (OvO models
+        are folded to per-class vote scores, sklearn's default ovr shape)."""
+        from dpsvm_tpu.predict import decision_function
+        X = np.asarray(X, np.float32)
+        if self._binary_model is not None:
+            return decision_function(self._binary_model, X)
+        from dpsvm_tpu.models.multiclass import vote_matrix
+        return vote_matrix(self._multiclass_model, X)
+
+    def predict(self, X):
+        X = np.asarray(X, np.float32)
+        if self._binary_model is not None:
+            d = self.decision_function(X)
+            return np.where(d >= 0, self.classes_[1], self.classes_[0])
+        from dpsvm_tpu.models.multiclass import predict_multiclass
+        return predict_multiclass(self._multiclass_model, X)
+
+    def score(self, X, y, sample_weight=None):
+        pred = self.predict(X)
+        y = np.asarray(y)
+        if sample_weight is not None:
+            sample_weight = np.asarray(sample_weight, np.float64)
+            return float(((pred == y) * sample_weight).sum() / sample_weight.sum())
+        return float((pred == y).mean())
+
+
+class SVR(RegressorMixin, BaseEstimator):
+    """epsilon-SVR with sklearn semantics on the TPU solver."""
+
+    def __init__(self, C=1.0, kernel="rbf", degree=3, gamma="scale",
+                 coef0=0.0, tol=1e-3, epsilon=0.1, max_iter=-1,
+                 backend="auto", selection="mvp", cache_lines=0,
+                 dtype="float32"):
+        self.C = C
+        self.kernel = kernel
+        self.degree = degree
+        self.gamma = gamma
+        self.coef0 = coef0
+        self.tol = tol
+        self.epsilon = epsilon
+        self.max_iter = max_iter
+        self.backend = backend
+        self.selection = selection
+        self.cache_lines = cache_lines
+        self.dtype = dtype
+
+    def fit(self, X, y):
+        from dpsvm_tpu.models.svr import train_svr
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float32)
+        cfg = _base_config(self, _resolve_gamma(self.gamma, X))
+        backend = self.backend
+        if backend == "auto":
+            backend = "single"
+        self._model, res = train_svr(X, y, cfg, svr_epsilon=self.epsilon,
+                                     backend=backend)
+        self.fit_result_ = res
+        self.n_iter_ = res.iterations
+        return self
+
+    def predict(self, X):
+        return self._model.predict(np.asarray(X, np.float32))
+
+    def score(self, X, y, sample_weight=None):
+        # R^2, as sklearn defines it.
+        y = np.asarray(y, np.float64)
+        pred = np.asarray(self.predict(X), np.float64)
+        w = (np.ones_like(y) if sample_weight is None
+             else np.asarray(sample_weight, np.float64))
+        ss_res = float((w * (y - pred) ** 2).sum())
+        ss_tot = float((w * (y - np.average(y, weights=w)) ** 2).sum())
+        return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+
+
+class OneClassSVM(OutlierMixin, BaseEstimator):
+    """nu-one-class SVM with sklearn semantics on the TPU solver."""
+
+    def __init__(self, nu=0.5, kernel="rbf", degree=3, gamma="scale",
+                 coef0=0.0, tol=1e-3, max_iter=-1, backend="auto",
+                 cache_lines=0, dtype="float32"):
+        self.nu = nu
+        self.kernel = kernel
+        self.degree = degree
+        self.gamma = gamma
+        self.coef0 = coef0
+        self.tol = tol
+        self.max_iter = max_iter
+        self.backend = backend
+        self.cache_lines = cache_lines
+        self.dtype = dtype
+
+    def fit(self, X, y=None):
+        from dpsvm_tpu.models.oneclass import train_oneclass
+        X = np.asarray(X, np.float32)
+        cfg = _base_config(self, _resolve_gamma(self.gamma, X))
+        backend = self.backend
+        if backend == "auto":
+            backend = "single"
+        self._model, res = train_oneclass(X, nu=self.nu, config=cfg,
+                                          backend=backend)
+        self.fit_result_ = res
+        self.n_iter_ = res.iterations
+        return self
+
+    def decision_function(self, X):
+        return self._model.decision_function(np.asarray(X, np.float32))
+
+    def predict(self, X):
+        return np.where(self.decision_function(X) >= 0, 1, -1)

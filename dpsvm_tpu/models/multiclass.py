@@ -122,23 +122,41 @@ def predict_multiclass(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
     q = np.asarray(q, np.float32)
     k = len(m.classes)
     if m.strategy == "ovr":
-        scores = np.stack([decision_function(mm, q, block) for mm in m.models])
-        return m.classes[np.argmax(scores, axis=0)]
-    # OvO majority vote; ties broken by summed decision margins.
-    votes = np.zeros((q.shape[0], k), np.int32)
-    margin = np.zeros((q.shape[0], k), np.float64)
+        return m.classes[np.argmax(decision_matrix(m, q, block), axis=1)]
+    # OvO majority vote; the sub-unit confidence term of vote_matrix only
+    # ever breaks ties (it is bounded by 1/3 per class).
+    return m.classes[np.argmax(vote_matrix(m, q, block), axis=1)]
+
+
+def decision_matrix(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
+    """Raw decision values, one column per fitted model: (n, k) per-class
+    scores for OvR, (n, k*(k-1)/2) pairwise columns (a<b order) for OvO."""
+    q = np.asarray(q, np.float32)
+    return np.stack(
+        [decision_function(mm, q, block) for mm in m.models], axis=1)
+
+
+def vote_matrix(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
+    """(n, k) per-class scores for an OvO model: pairwise votes plus a
+    sub-unit confidence term (sklearn's ovo->ovr transformation shape) so
+    ties rank by margin while vote order is never overturned."""
+    if m.strategy != "ovo":
+        return decision_matrix(m, q, block)
+    q = np.asarray(q, np.float32)
+    k = len(m.classes)
+    votes = np.zeros((q.shape[0], k), np.float64)
+    conf = np.zeros((q.shape[0], k), np.float64)
     idx = 0
     for a in range(k):
         for b in range(a + 1, k):
-            d = decision_function(m.models[idx], q, block)
+            d = decision_function(m.models[idx], q, block).astype(np.float64)
             win_a = d >= 0
             votes[:, a] += win_a
             votes[:, b] += ~win_a
-            margin[:, a] += d
-            margin[:, b] -= d
+            conf[:, a] += d
+            conf[:, b] -= d
             idx += 1
-    best = votes + 1e-9 * np.tanh(margin)  # margins only break ties
-    return m.classes[np.argmax(best, axis=1)]
+    return votes + conf / (3.0 * (np.abs(conf) + 1.0))
 
 
 def accuracy_multiclass(m: MulticlassSVM, q, y, block: int = 8192) -> float:

@@ -1,0 +1,74 @@
+"""Platt probability calibration: P(y=+1 | f) = 1 / (1 + exp(A f + B)).
+
+The reference emits raw decision values only (seq_test.cpp:187-210 prints
+sign accuracy); LibSVM-class tools additionally offer calibrated
+probabilities (-b 1). This implements the standard improved Platt fit
+(Newton's method with backtracking on the regularized maximum-likelihood
+objective, per Lin/Weng's note on Platt's algorithm) over held-in decision
+values, and the pairwise-to-multiclass coupling is left to the caller
+(OvR normalization in estimators.SVC).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fit_platt(decision: np.ndarray, y: np.ndarray, max_iter: int = 100,
+              tol: float = 1e-10) -> tuple[float, float]:
+    """Fit (A, B) on decision values and +-1 labels.
+
+    Uses the regularized targets t+ = (N+ + 1)/(N+ + 2), t- = 1/(N- + 2)
+    so the fit is well-posed even when a class is tiny."""
+    f = np.asarray(decision, np.float64)
+    y = np.asarray(y)
+    pos = y > 0
+    n_pos = int(pos.sum())
+    n_neg = int(len(y) - n_pos)
+    if n_pos == 0 or n_neg == 0:
+        raise ValueError("Platt calibration needs both classes present")
+    t = np.where(pos, (n_pos + 1.0) / (n_pos + 2.0), 1.0 / (n_neg + 2.0))
+
+    a = 0.0
+    b = np.log((n_neg + 1.0) / (n_pos + 1.0))
+
+    def nll(a_, b_):
+        z = a_ * f + b_
+        # log(1 + e^z) - t*z, computed stably on both signs of z.
+        return float(np.sum(np.logaddexp(0.0, z) - t * z))
+
+    prev = nll(a, b)
+    for _ in range(max_iter):
+        z = a * f + b
+        p = 1.0 / (1.0 + np.exp(-np.clip(z, -500, 500)))  # sigmoid(z)
+        g_a = float(np.sum(f * (p - t)))
+        g_b = float(np.sum(p - t))
+        if abs(g_a) < tol and abs(g_b) < tol:
+            break
+        w = np.maximum(p * (1.0 - p), 1e-12)
+        h_aa = float(np.sum(f * f * w)) + 1e-12
+        h_ab = float(np.sum(f * w))
+        h_bb = float(np.sum(w)) + 1e-12
+        det = h_aa * h_bb - h_ab * h_ab
+        da = -(h_bb * g_a - h_ab * g_b) / det
+        db = -(-h_ab * g_a + h_aa * g_b) / det
+        # Backtracking line search on the NLL.
+        step = 1.0
+        for _ in range(30):
+            cand = nll(a + step * da, b + step * db)
+            if cand < prev + 1e-4 * step * (g_a * da + g_b * db):
+                a += step * da
+                b += step * db
+                prev = cand
+                break
+            step *= 0.5
+        else:
+            break
+    return float(a), float(b)
+
+
+def platt_probability(decision: np.ndarray, a: float, b: float) -> np.ndarray:
+    """P(y=+1 | f) = sigmoid(a f + b), matching the fit's parameterization
+    (classic Platt writes 1/(1+exp(A f + B)); that A is our -a)."""
+    z = a * np.asarray(decision, np.float64) + b
+    return 1.0 / (1.0 + np.exp(-np.clip(z, -500, 500)))

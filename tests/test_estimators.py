@@ -1,0 +1,168 @@
+"""sklearn-compatible estimator facade (dpsvm_tpu.estimators): parity
+against sklearn's own SVC/SVR/OneClassSVM and compatibility with sklearn
+model-selection tooling (clone / GridSearchCV / cross_val_score)."""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.data.synth import make_blobs_binary
+from dpsvm_tpu.estimators import SVC, SVR, OneClassSVM
+
+
+@pytest.fixture(scope="module")
+def binary_xy():
+    x, y = make_blobs_binary(n=600, d=10, seed=3, sep=1.6)
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def multi_xy():
+    rng = np.random.default_rng(11)
+    centers = rng.normal(scale=3.0, size=(3, 8))
+    x = np.concatenate([
+        centers[k] + rng.normal(scale=1.0, size=(150, 8)) for k in range(3)
+    ]).astype(np.float32)
+    y = np.repeat([4, 7, 9], 150)  # arbitrary labels on purpose
+    return x, y
+
+
+def test_svc_binary_matches_sklearn(binary_xy):
+    from sklearn.svm import SVC as SkSVC
+    x, y = binary_xy
+    ours = SVC(C=5.0, gamma=0.1, tol=1e-3).fit(x, y)
+    theirs = SkSVC(C=5.0, gamma=0.1, tol=1e-3).fit(x, y)
+    assert ours.score(x, y) == pytest.approx(theirs.score(x, y), abs=0.01)
+    assert abs(int(ours.n_support_.sum()) - int(theirs.n_support_.sum())) \
+        <= max(3, int(0.03 * theirs.n_support_.sum()))
+    np.testing.assert_allclose(
+        ours.decision_function(x[:50]), theirs.decision_function(x[:50]),
+        atol=5e-2)
+
+
+def test_svc_accepts_01_labels(binary_xy):
+    x, y = binary_xy
+    y01 = (y > 0).astype(int)
+    est = SVC(C=5.0, gamma=0.1).fit(x, y01)
+    pred = est.predict(x)
+    assert set(np.unique(pred)) <= {0, 1}
+    # Label encoding must not change the model: same accuracy as +-1.
+    ref = SVC(C=5.0, gamma=0.1).fit(x, np.where(y01 > 0, 1, -1))
+    assert est.score(x, y01) == pytest.approx(
+        ref.score(x, np.where(y01 > 0, 1, -1)), abs=1e-6)
+
+
+def test_svc_multiclass(multi_xy):
+    from sklearn.svm import SVC as SkSVC
+    x, y = multi_xy
+    for strategy in ("ovr", "ovo"):
+        est = SVC(C=5.0, gamma=0.1, strategy=strategy).fit(x, y)
+        assert set(np.unique(est.predict(x))) <= {4, 7, 9}
+        sk = SkSVC(C=5.0, gamma=0.1).fit(x, y)
+        assert est.score(x, y) == pytest.approx(sk.score(x, y), abs=0.03)
+    assert est.decision_function(x[:10]).shape == (10, 3)
+
+
+def test_svc_ovo_decision_function_is_per_class(multi_xy):
+    # sklearn's default decision_function_shape='ovr': one column per
+    # class even for OvO, where the pairwise count (k(k-1)/2) differs
+    # from k as soon as k >= 4.
+    rng = np.random.default_rng(2)
+    centers = rng.normal(scale=3.5, size=(4, 6))
+    x = np.concatenate([
+        centers[k] + rng.normal(scale=1.0, size=(80, 6)) for k in range(4)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1, 2, 3], 80)
+    est = SVC(C=5.0, gamma=0.1, strategy="ovo").fit(x, y)
+    d = est.decision_function(x[:17])
+    assert d.shape == (17, 4)  # classes, not the 6 pairs
+    # argmax of the folded scores must agree with predict everywhere.
+    np.testing.assert_array_equal(
+        est.classes_[np.argmax(d, axis=1)], est.predict(x[:17]))
+
+
+def test_svc_class_weight_balanced_matches_sklearn(binary_xy):
+    from sklearn.svm import SVC as SkSVC
+    x, y = binary_xy
+    # Imbalance the data, then ask both to rebalance.
+    keep = np.concatenate([np.where(y < 0)[0][:60], np.where(y > 0)[0]])
+    xi, yi = x[keep], y[keep]
+    ours = SVC(C=5.0, gamma=0.1, class_weight="balanced").fit(xi, yi)
+    theirs = SkSVC(C=5.0, gamma=0.1, class_weight="balanced").fit(xi, yi)
+    assert ours.score(xi, yi) == pytest.approx(theirs.score(xi, yi), abs=0.02)
+
+
+def test_svc_clone_and_gridsearch(binary_xy):
+    from sklearn.base import clone
+    from sklearn.model_selection import GridSearchCV
+    x, y = binary_xy
+    est = SVC(gamma=0.1)
+    est2 = clone(est)
+    assert est2.get_params()["gamma"] == 0.1
+    gs = GridSearchCV(SVC(gamma=0.1, tol=1e-2), {"C": [0.5, 5.0]}, cv=2)
+    gs.fit(x[:300], y[:300])
+    assert gs.best_params_["C"] in (0.5, 5.0)
+
+
+def test_svr_matches_sklearn(binary_xy):
+    from sklearn.svm import SVR as SkSVR
+    x, _ = binary_xy
+    rng = np.random.default_rng(5)
+    z = np.sin(x[:, 0]) + 0.1 * x[:, 1] + 0.05 * rng.standard_normal(len(x))
+    ours = SVR(C=2.0, gamma=0.2, epsilon=0.1).fit(x, z)
+    theirs = SkSVR(C=2.0, gamma=0.2, epsilon=0.1).fit(x, z)
+    assert ours.score(x, z) == pytest.approx(theirs.score(x, z), abs=0.05)
+
+
+def test_oneclass_outlier_fraction(binary_xy):
+    x, _ = binary_xy
+    est = OneClassSVM(nu=0.2, gamma=0.2).fit(x)
+    frac_out = float((est.predict(x) < 0).mean())
+    assert frac_out <= 0.2 + 0.05
+    assert est.decision_function(x).shape == (len(x),)
+
+
+def test_gamma_scale_matches_sklearn_definition(binary_xy):
+    from sklearn.svm import SVC as SkSVC
+    x, y = binary_xy
+    ours = SVC(C=1.0, gamma="scale").fit(x, y)
+    theirs = SkSVC(C=1.0, gamma="scale").fit(x, y)
+    np.testing.assert_allclose(
+        ours.decision_function(x[:30]), theirs.decision_function(x[:30]),
+        atol=5e-2)
+
+
+def test_predict_proba_binary_calibrated(binary_xy):
+    from sklearn.svm import SVC as SkSVC
+    x, y = binary_xy
+    est = SVC(C=5.0, gamma=0.1, probability=True).fit(x, y)
+    p = est.predict_proba(x)
+    assert p.shape == (len(x), 2)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-9)
+    # Probabilities must rank like the decision values (monotone sigmoid)
+    d = est.decision_function(x)
+    order = np.argsort(d)
+    assert np.all(np.diff(p[order, 1]) >= -1e-12)
+    # And calibration quality should be in sklearn's ballpark (Brier score).
+    sk = SkSVC(C=5.0, gamma=0.1, probability=True, random_state=0).fit(x, y)
+    t = (y > 0).astype(np.float64)
+    brier_ours = float(np.mean((p[:, 1] - t) ** 2))
+    brier_sk = float(np.mean((sk.predict_proba(x)[:, 1] - t) ** 2))
+    assert brier_ours <= brier_sk + 0.02
+
+
+def test_predict_proba_multiclass(multi_xy):
+    x, y = multi_xy
+    est = SVC(C=5.0, gamma=0.1, probability=True).fit(x, y)
+    p = est.predict_proba(x)
+    assert p.shape == (len(x), 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-9)
+    # argmax(proba) should agree with predict for the vast majority
+    agree = (est.classes_[np.argmax(p, axis=1)] == est.predict(x)).mean()
+    assert agree > 0.95
+
+
+def test_predict_proba_requires_flag(binary_xy):
+    x, y = binary_xy
+    est = SVC(C=1.0, gamma=0.1).fit(x, y)
+    with pytest.raises(AttributeError, match="probability=True"):
+        est.predict_proba(x)
