@@ -50,9 +50,16 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--selection", choices=["mvp", "second_order"], default="mvp",
                    help="working-set rule: mvp = reference-parity maximal "
                         "violating pair; second_order = LibSVM-style WSS2")
-    p.add_argument("--engine", choices=["xla", "pallas"], default="xla",
+    p.add_argument("--engine", choices=["xla", "pallas", "block"], default="xla",
                    help="single-chip compute engine (pallas = fused "
-                        "update+select TPU kernel)")
+                        "update+select TPU kernel; block = blockwise "
+                        "decomposition with on-core subproblem solve — "
+                        "the fastest path)")
+    p.add_argument("--working-set-size", type=int, default=128,
+                   help="block engine: working-set height q (default 128)")
+    p.add_argument("--inner-iters", type=int, default=0,
+                   help="block engine: pair updates per block "
+                        "(default 0 = working-set-size)")
     p.add_argument("--degree", type=int, default=3)
     p.add_argument("--coef0", type=float, default=0.0)
     p.add_argument("-w1", "--weight-pos", type=float, default=1.0,
@@ -174,6 +181,7 @@ def _cmd_train(args) -> int:
         kernel=args.kernel, degree=args.degree, coef0=args.coef0,
         weight_pos=args.weight_pos, weight_neg=args.weight_neg,
         selection=args.selection, engine=args.engine,
+        working_set_size=args.working_set_size, inner_iters=args.inner_iters,
         dtype=args.dtype, chunk_iters=args.chunk_iters,
         checkpoint_every=args.checkpoint_every, verbose=not args.quiet)
 

@@ -77,7 +77,19 @@ class SVMConfig:
     #               software-pipelined around it. Same optimum; iteration
     #               count may differ by one (the fused path skips the
     #               reference's final degenerate update).
+    #   "block"  -- blockwise working-set decomposition (solver/block.py):
+    #               one batched MXU pass builds kernel rows for the
+    #               `working_set_size` most-violating points, then up to
+    #               `inner_iters` pair updates run inside that block.
+    #               Same optimum and stopping rule; drastically less HBM
+    #               traffic per pair than the per-pair engines.
     engine: str = "xla"
+
+    # Block-engine shape knobs (ignored by other engines). working_set_size
+    # (q) is the block height; inner_iters = 0 means "q" (each selected
+    # point participates in ~2 pairs on average before a refresh).
+    working_set_size: int = 128
+    inner_iters: int = 0
 
     # Numerics / runtime knobs (no reference equivalent).
     tau: float = 1e-12  # eta clamp (LibSVM-style guard, fixes bug B2)
@@ -115,10 +127,15 @@ class SVMConfig:
             raise ValueError("dtype must be 'float32' or 'bfloat16'")
         if self.selection not in ("mvp", "second_order"):
             raise ValueError("selection must be 'mvp' or 'second_order'")
-        if self.engine not in ("xla", "pallas"):
-            raise ValueError("engine must be 'xla' or 'pallas'")
-        if self.engine == "pallas" and self.selection != "mvp":
-            raise ValueError("engine='pallas' currently supports selection='mvp' only")
+        if self.engine not in ("xla", "pallas", "block"):
+            raise ValueError("engine must be 'xla', 'pallas' or 'block'")
+        if self.engine in ("pallas", "block") and self.selection != "mvp":
+            raise ValueError(
+                f"engine={self.engine!r} currently supports selection='mvp' only")
+        if self.working_set_size < 2:
+            raise ValueError("working_set_size must be >= 2")
+        if self.inner_iters < 0:
+            raise ValueError("inner_iters must be >= 0 (0 = working_set_size)")
 
     def replace(self, **kw) -> "SVMConfig":
         return dataclasses.replace(self, **kw)

@@ -120,6 +120,13 @@ class SVC(ClassifierMixin, BaseEstimator):
         self.classes_ = np.unique(y)
         if self.classes_.shape[0] < 2:
             raise ValueError("SVC needs at least 2 classes")
+        if (self.probability and self.classes_.shape[0] > 2
+                and self.strategy != "ovr"):
+            # Constructor-parameter check — fail before k*(k-1)/2 solver
+            # runs are spent, not after.
+            raise ValueError(
+                "probability=True requires strategy='ovr' for multiclass "
+                "(per-class Platt + normalization)")
         cfg = _base_config(self, _resolve_gamma(self.gamma, X))
 
         if self.classes_.shape[0] == 2:
@@ -148,10 +155,6 @@ class SVC(ClassifierMixin, BaseEstimator):
             self.fit_result_ = results
             self.n_iter_ = int(sum(r.iterations for r in results))
             if self.probability:
-                if self.strategy != "ovr":
-                    raise ValueError(
-                        "probability=True requires strategy='ovr' for "
-                        "multiclass (per-class Platt + normalization)")
                 self._platt = [
                     self._fit_platt_cv(
                         X, np.where(y == cl, 1, -1).astype(np.int32), cfg)

@@ -29,8 +29,12 @@ def fit_platt(decision: np.ndarray, y: np.ndarray, max_iter: int = 100,
         raise ValueError("Platt calibration needs both classes present")
     t = np.where(pos, (n_pos + 1.0) / (n_pos + 2.0), 1.0 / (n_neg + 2.0))
 
+    # Warm start: a plane whose p(f=0) is the (regularized) positive-class
+    # prior. LibSVM's B0 = log((N-+1)/(N++1)) belongs to its
+    # 1/(1+exp(Af+B)) form; under this module's p = sigmoid(a f + b) the
+    # sign flips.
     a = 0.0
-    b = np.log((n_neg + 1.0) / (n_pos + 1.0))
+    b = np.log((n_pos + 1.0) / (n_neg + 1.0))
 
     def nll(a_, b_):
         z = a_ * f + b_

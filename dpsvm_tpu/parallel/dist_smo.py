@@ -302,10 +302,11 @@ def solve_mesh(
     `alpha_init` / `f_init` override the standard start point exactly as in
     solver.smo.solve — the hook the SVR / one-class reductions use.
     """
-    if config.engine == "pallas":
+    if config.engine != "xla":
         raise ValueError(
-            "engine='pallas' is implemented for the single-chip solver only; "
-            "the mesh backend would silently run the XLA iteration path")
+            f"engine={config.engine!r} is implemented for the single-chip "
+            "solver only; the mesh backend would silently run the per-pair "
+            "XLA iteration instead")
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)
     n, d = x.shape
@@ -376,28 +377,35 @@ def solve_mesh(
                 b_hi=jax.device_put(jnp.float32(bh0), rep),
                 b_lo=jax.device_put(jnp.float32(bl0), rep),
                 it=jax.device_put(jnp.int32(it0), rep))
-    run_chunk = _make_chunk_runner(mesh, kp, config.c_bounds(), float(config.epsilon),
-                                   float(config.tau), int(config.chunk_iters),
-                                   use_cache, config.selection)
     max_iter = jnp.int32(config.max_iter)
     start_iter = int(state.it)
     ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
+    # One dispatch to convergence when nothing observes chunk boundaries
+    # (device->host transfers are the expensive primitive; see solver/smo.py
+    # _UNOBSERVED_CHUNK).
+    from dpsvm_tpu.solver.smo import _UNOBSERVED_CHUNK, _pack_obs, _unpack_obs
+
+    observe = (callback is not None or config.verbose
+               or config.check_numerics or ckpt.active)
+    chunk_len = int(config.chunk_iters) if observe else _UNOBSERVED_CHUNK
+    run_chunk = _make_chunk_runner(mesh, kp, config.c_bounds(), float(config.epsilon),
+                                   float(config.tau), chunk_len,
+                                   use_cache, config.selection)
     if callback is not None and hasattr(callback, "on_start"):
         callback.on_start(start_iter)
 
     t0 = time.perf_counter()
     while True:
         state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter)
-        it = int(state.it)
-        b_hi = float(state.b_hi)
-        b_lo = float(state.b_lo)
+        it, b_hi, b_lo = _unpack_obs(_pack_obs(state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
         if config.check_numerics:
             assert_finite_state(state, it, f"mesh p={n_dev}")
-        ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
-                        np.asarray(state.f)[:n], b_hi, b_lo)
+        if ckpt.due(it):
+            ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
+                            np.asarray(state.f)[:n], b_hi, b_lo)
         if config.verbose:
             print(f"[smo-mesh p={n_dev}] iter={it} gap={b_lo - b_hi:.6f}")
         if converged or it >= config.max_iter:

@@ -220,6 +220,40 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
 
 _ITERATION_FNS = {"mvp": _smo_iteration, "second_order": _smo_iteration_wss2}
 
+# Chunk length used when nothing on the host needs to observe intermediate
+# state (no callback / verbose / checkpoint / numerics checks): the loop
+# then runs to convergence-or-max_iter in ONE dispatch. A fixed sentinel —
+# not max_iter — so the compiled program is independent of max_iter (which
+# stays a traced scalar) and a short warm-up run compiles the same
+# executable as the real run. Device->host observation is expensive on
+# disaggregated/tunneled TPU runtimes (~80 ms per transfer measured on the
+# dev harness), so the default is to observe only once, at the end.
+_UNOBSERVED_CHUNK = 1 << 30
+
+
+@jax.jit
+def _pack_obs(it, b_hi, b_lo):
+    """Pack (iteration, b_hi, b_lo) into ONE (4,) device array so the host
+    loop pays a single device->host transfer per chunk instead of three.
+    The int32 iteration rides in two 12/19-bit halves, each exactly
+    representable in float32 (a raw bitcast would make small counts
+    denormal floats, which the TPU flushes to zero)."""
+    it = it.astype(jnp.int32)
+    return jnp.stack([
+        (it >> 12).astype(jnp.float32),
+        (it & 0xFFF).astype(jnp.float32),
+        b_hi.astype(jnp.float32),
+        b_lo.astype(jnp.float32),
+    ])
+
+
+def _unpack_obs(packed) -> tuple:
+    import numpy as np
+
+    arr = np.asarray(packed)
+    it = (int(arr[0]) << 12) | int(arr[1])
+    return it, float(arr[2]), float(arr[3])
+
 
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "chunk",
                                    "use_cache", "block_rows", "interpret"))
@@ -374,6 +408,7 @@ def solve(
     dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
 
     use_pallas = config.engine == "pallas"
+    use_block = config.engine == "block"
     block_rows = 64
     if use_pallas:
         # Pad rows to a whole number of (block_rows, 128) kernel blocks;
@@ -426,13 +461,36 @@ def solve(
                 alpha=jnp.asarray(a_pad), f=jnp.asarray(f_pad),
                 b_hi=jnp.float32(bh0), b_lo=jnp.float32(bl0),
                 it=jnp.int32(it0))
+    if use_block:
+        from dpsvm_tpu.solver.block import BlockState, run_chunk_block
+
+        # Clamp the block height to the dataset (top_k k <= n), kept even
+        # so the up/low halves stay balanced.
+        q = max(2, min(config.working_set_size, n_pad))
+        q -= q % 2
+        inner = config.inner_iters or q
+        state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
+                           b_lo=state.b_lo, pairs=state.it,
+                           rounds=jnp.int32(0))
+
     state = jax.device_put(state, device)
     max_iter = jnp.int32(config.max_iter)
-    start_iter = int(state.it)
+    start_iter = int(state.pairs if use_block else state.it)
     ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
     interpret = jax.devices()[0].platform != "tpu"
     if callback is not None and hasattr(callback, "on_start"):
         callback.on_start(start_iter)
+
+    # With nothing to observe between chunks, run the entire solve in one
+    # dispatch (the sentinel chunk never splits the while_loop) and pull
+    # ONE packed scalar triple at the end — device->host latency dominates
+    # chunk cadence on tunneled runtimes.
+    observe = (callback is not None or config.verbose
+               or config.check_numerics or ckpt.active)
+    chunk_len = int(config.chunk_iters) if observe else _UNOBSERVED_CHUNK
+    if use_block:
+        rounds_per_chunk = (max(1, chunk_len // inner)
+                            if observe else _UNOBSERVED_CHUNK)
 
     t0 = time.perf_counter()
     while True:
@@ -440,22 +498,28 @@ def solve(
             state = _run_chunk_pallas(
                 x_dev, y_dev, x_sq, valid_dev, state, max_iter,
                 kp, config.c_bounds(), float(config.epsilon), float(config.tau),
-                int(config.chunk_iters), use_cache, block_rows, interpret)
+                chunk_len, use_cache, block_rows, interpret)
+        elif use_block:
+            state = run_chunk_block(
+                x_dev, y_dev, x_sq, k_diag, state, max_iter,
+                kp, config.c_bounds(), float(config.epsilon), float(config.tau),
+                q, inner, rounds_per_chunk,
+                inner_impl="pallas" if not interpret else "xla")
         else:
             state = _run_chunk(x_dev, y_dev, x_sq, k_diag, None, state, max_iter,
                                kp, config.c_bounds(), float(config.epsilon),
-                               float(config.tau), int(config.chunk_iters), use_cache,
+                               float(config.tau), chunk_len, use_cache,
                                config.selection)
-        it = int(state.it)
-        b_hi = float(state.b_hi)
-        b_lo = float(state.b_lo)
+        it, b_hi, b_lo = _unpack_obs(_pack_obs(
+            state.pairs if use_block else state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
         if config.check_numerics:
             assert_finite_state(state, it, "single-chip")
-        ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
-                        np.asarray(state.f)[:n], b_hi, b_lo)
+        if ckpt.due(it):
+            ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
+                            np.asarray(state.f)[:n], b_hi, b_lo)
         if config.verbose:
             gap = b_lo - b_hi
             print(f"[smo] iter={it} b_lo-b_hi={gap:.6f} "
@@ -480,5 +544,6 @@ def solve(
             "cache_lookups": total_lookups,
             "cache_hit_rate": (int(state.hits) / total_lookups) if total_lookups else 0.0,
             "f": np.asarray(state.f)[:n],
+            **({"outer_rounds": int(state.rounds)} if use_block else {}),
         },
     )

@@ -51,8 +51,9 @@ def train(
         except ImportError:
             mesh_available = False
         multi = (num_devices or len(jax.devices())) > 1
-        # The fused-pallas engine only exists in the single-chip solver.
-        backend = ("mesh" if (multi and mesh_available and config.engine != "pallas")
+        # The pallas and block engines only exist in the single-chip solver;
+        # auto must not silently swap them for the mesh per-pair engine.
+        backend = ("mesh" if (multi and mesh_available and config.engine == "xla")
                    else "single")
 
     if backend in ("reference", "native"):

@@ -81,8 +81,17 @@ class PeriodicCheckpointer:
         self.every = config.checkpoint_every
         self.last = start_iter
 
+    @property
+    def active(self) -> bool:
+        """Whether this checkpointer can ever save (callers use this to
+        skip materialising device arrays on hot paths)."""
+        return bool(self.path and self.every > 0)
+
+    def due(self, iteration: int) -> bool:
+        return self.active and iteration - self.last >= self.every
+
     def maybe_save(self, iteration: int, alpha, f, b_hi: float, b_lo: float) -> bool:
-        if not (self.path and self.every > 0 and iteration - self.last >= self.every):
+        if not self.due(iteration):
             return False
         save_checkpoint(self.path, np.asarray(alpha), np.asarray(f),
                         iteration, b_hi, b_lo, self.config)

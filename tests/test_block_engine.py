@@ -1,0 +1,175 @@
+"""Blockwise working-set (decomposition) engine: same optimum as the
+per-pair engines, KKT at convergence, and XLA/Pallas subproblem parity.
+
+The block engine takes a different path through iterate space (pairs are
+restricted to the current working set between refreshes) so trajectories
+are NOT comparable — the contracts tested here are about the fixed point:
+identical dual objective, intercept, decision function and KKT residuals.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
+from dpsvm_tpu.solver.smo import solve
+
+CFG = SVMConfig(c=5.0, gamma=0.2, epsilon=1e-3, max_iter=200_000)
+
+
+def dual_objective(x, y, alpha, kp):
+    K = np.asarray(kernel_matrix(x, x, kp))
+    ay = alpha * y
+    return alpha.sum() - 0.5 * ay @ K @ ay
+
+
+def kkt_violation(x, y, alpha, c_pos, c_neg, kp):
+    """max over I_up/I_low pairs of (b_lo - b_hi): <= 2 eps at convergence."""
+    K = np.asarray(kernel_matrix(x, x, kp))
+    f = (alpha * y) @ K - y
+    c_i = np.where(y > 0, c_pos, c_neg)
+    up = np.where(y > 0, alpha < c_i - 1e-9, alpha > 1e-9)
+    low = np.where(y > 0, alpha > 1e-9, alpha < c_i - 1e-9)
+    return f[low].max() - f[up].min()
+
+
+@pytest.mark.parametrize("q", [8, 32, 128])
+def test_block_matches_per_pair_optimum(blobs_small, q):
+    x, y = blobs_small
+    kp = KernelParams("rbf", CFG.gamma)
+    r_ref = solve(x, y, CFG)
+    r_blk = solve(x, y, CFG.replace(engine="block", working_set_size=q))
+    assert r_blk.converged
+    assert r_blk.stats["outer_rounds"] > 0
+    obj_ref = dual_objective(x, y, r_ref.alpha, kp)
+    obj_blk = dual_objective(x, y, r_blk.alpha, kp)
+    assert obj_blk == pytest.approx(obj_ref, rel=1e-4)
+    assert r_blk.b == pytest.approx(r_ref.b, abs=5e-3)
+    # Equality constraint conserved exactly by the pair algebra.
+    assert abs(np.dot(r_blk.alpha, y)) < 1e-3
+
+
+def test_block_kkt_at_convergence(blobs_medium):
+    x, y = blobs_medium
+    cfg = CFG.replace(engine="block", working_set_size=64)
+    r = solve(x, y, cfg)
+    assert r.converged
+    viol = kkt_violation(x, y, r.alpha, cfg.c, cfg.c, KernelParams("rbf", cfg.gamma))
+    assert viol <= 2 * cfg.epsilon + 1e-4
+
+
+def test_block_linear_kernel(blobs_small):
+    x, y = blobs_small
+    cfg = CFG.replace(kernel="linear", engine="block", working_set_size=32)
+    r_blk = solve(x, y, cfg)
+    r_ref = solve(x, y, cfg.replace(engine="xla"))
+    assert r_blk.converged and r_ref.converged
+    kp = KernelParams("linear", cfg.gamma)
+    assert dual_objective(x, y, r_blk.alpha, kp) == pytest.approx(
+        dual_objective(x, y, r_ref.alpha, kp), rel=1e-4)
+
+
+def test_block_class_weights(blobs_small):
+    x, y = blobs_small
+    cfg = CFG.replace(weight_pos=2.0, weight_neg=0.5,
+                      engine="block", working_set_size=32)
+    r = solve(x, y, cfg)
+    assert r.converged
+    # Box respected per class.
+    cp, cn = cfg.c_bounds()
+    assert np.all(r.alpha[y > 0] <= cp + 1e-5)
+    assert np.all(r.alpha[y < 0] <= cn + 1e-5)
+    viol = kkt_violation(x, y, r.alpha, cp, cn, KernelParams("rbf", cfg.gamma))
+    assert viol <= 2 * cfg.epsilon + 1e-4
+
+
+def test_block_q_larger_than_n():
+    from dpsvm_tpu.data.synth import make_blobs_binary
+    x, y = make_blobs_binary(n=40, d=5, seed=0, sep=1.0)
+    r = solve(x, y, CFG.replace(engine="block", working_set_size=512))
+    assert r.converged
+
+
+def test_pallas_subproblem_matches_xla(blobs_small):
+    """The on-core Pallas subproblem solve (interpret mode on CPU) must
+    reproduce the XLA while_loop subproblem exactly."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+    from dpsvm_tpu.solver.block import _solve_subproblem, select_block
+
+    x, y = blobs_small
+    kp = KernelParams("rbf", 0.2)
+    n = x.shape[0]
+    rng = np.random.default_rng(0)
+    alpha = np.clip(rng.normal(0.5, 0.5, n), 0, CFG.c).astype(np.float32)
+    K = np.asarray(kernel_matrix(x, x, kp))
+    f = ((alpha * y) @ K - y).astype(np.float32)
+
+    q = 32
+    w, ok = select_block(jnp.asarray(f), jnp.asarray(alpha),
+                         jnp.asarray(y, jnp.float32), CFG.c, q)
+    w_np = np.asarray(w)
+    kb_w = jnp.asarray(K[np.ix_(w_np, w_np)].astype(np.float32))
+    kd_w = jnp.asarray(np.diag(K)[w_np].astype(np.float32))
+    a_w = jnp.asarray(alpha[w_np])
+    y_w = jnp.asarray(y[w_np].astype(np.float32))
+    f_w = jnp.asarray(f[w_np])
+
+    a_xla, _, t_xla = _solve_subproblem(
+        kb_w, kd_w, ok, a_w, y_w, f_w, CFG.c, CFG.epsilon, CFG.tau,
+        jnp.int32(64))
+    a_pl, t_pl = solve_subproblem_pallas(
+        kb_w, a_w, y_w, f_w, kd_w, ok.astype(jnp.float32), jnp.int32(64),
+        CFG.c, CFG.epsilon, CFG.tau, interpret=True)
+    assert int(t_xla) == int(t_pl)
+    np.testing.assert_allclose(np.asarray(a_xla), np.asarray(a_pl),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_block_checkpoint_resume(tmp_path, blobs_small):
+    x, y = blobs_small
+    path = str(tmp_path / "blk.npz")
+    cfg = CFG.replace(engine="block", working_set_size=16,
+                      checkpoint_every=32, chunk_iters=32, max_iter=64)
+    r1 = solve(x, y, cfg, checkpoint_path=path)
+    assert not r1.converged  # capped
+    cfg2 = cfg.replace(max_iter=200_000)
+    r2 = solve(x, y, cfg2, checkpoint_path=path, resume=True)
+    assert r2.converged
+    assert r2.iterations > r1.iterations
+    # Resumed run still reaches the right optimum.
+    r_ref = solve(x, y, CFG)
+    kp = KernelParams("rbf", CFG.gamma)
+    assert dual_objective(x, y, r2.alpha, kp) == pytest.approx(
+        dual_objective(x, y, r_ref.alpha, kp), rel=1e-3)
+
+
+def test_block_respects_max_iter_cap(blobs_small):
+    """Total pair updates must never exceed max_iter (the inner budget is
+    clamped to the remaining global budget each round)."""
+    x, y = blobs_small
+    r = solve(x, y, CFG.replace(engine="block", working_set_size=64,
+                                max_iter=10))
+    assert r.iterations == 10
+    assert not r.converged
+
+
+def test_select_block_filler_does_not_mask_low_candidates():
+    """When I_up runs short, top_k filler indices must not shadow live
+    low-half violators (regression: the dup mask compared against filler
+    slots and could hide the global max violator)."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.solver.block import select_block
+
+    # 8 points: only idx 5 in I_up (y=+1, alpha<C); idx 0 is the top
+    # I_low violator (y=-1, alpha<C, largest f).
+    y = jnp.asarray([-1.0, -1.0, -1.0, -1.0, -1.0, 1.0, -1.0, -1.0])
+    alpha = jnp.asarray([0.0] * 8)
+    f = jnp.asarray([5.0, 1.0, 1.0, 1.0, 1.0, -3.0, 1.0, 1.0])
+    w, ok = select_block(f, alpha, y, 1.0, 8)
+    w, ok = map(lambda a: list(map(int, a)), (w, ok))
+    # idx 0 must be a LIVE low-half slot.
+    low_live = [wi for wi, oki in zip(w[4:], ok[4:]) if oki]
+    assert 0 in low_live

@@ -133,3 +133,14 @@ def test_native_backend_cli(csvs, capsys):
     rc = main(["test", "-f", test_p, "-m", d + "/nat.txt"])
     assert rc == 0
     assert "test accuracy" in capsys.readouterr().out
+
+
+def test_train_cli_block_engine(csvs, capsys):
+    train_p, test_p, d = csvs
+    model_p = d + "/model_blk.txt"
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g", "0.1",
+               "--engine", "block", "--working-set-size", "16",
+               "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "converged at iteration" in out

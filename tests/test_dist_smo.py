@@ -89,3 +89,22 @@ def test_train_api_mesh_backend(blobs_small):
     model, res = train(x, y, CFG, backend="mesh", num_devices=8)
     assert res.converged
     assert accuracy(model, x, y) > 0.8
+
+
+def test_mesh_rejects_single_chip_engines(blobs_small):
+    x, y = blobs_small
+    for engine in ("pallas", "block"):
+        with pytest.raises(ValueError, match="single-chip"):
+            solve_mesh(x, y, CFG.replace(engine=engine), num_devices=2)
+
+
+def test_train_auto_backend_keeps_block_on_single_chip(blobs_small):
+    """auto must not silently swap the block engine for the mesh per-pair
+    engine on a multi-device host."""
+    from dpsvm_tpu.train import train
+
+    x, y = blobs_small
+    model, res = train(x, y, CFG.replace(engine="block", cache_lines=0),
+                       backend="auto")
+    assert "outer_rounds" in res.stats  # ran the block engine
+    assert "num_devices" not in res.stats  # not the mesh backend
