@@ -302,11 +302,12 @@ def solve_mesh(
     `alpha_init` / `f_init` override the standard start point exactly as in
     solver.smo.solve — the hook the SVR / one-class reductions use.
     """
-    if config.engine != "xla":
+    if config.engine not in ("xla", "block"):
         raise ValueError(
             f"engine={config.engine!r} is implemented for the single-chip "
-            "solver only; the mesh backend would silently run the per-pair "
-            "XLA iteration instead")
+            "solver only; the mesh backend supports engine='xla' (per-pair) "
+            "and engine='block' (distributed decomposition)")
+    use_block = config.engine == "block"
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)
     n, d = x.shape
@@ -388,16 +389,38 @@ def solve_mesh(
     observe = (callback is not None or config.verbose
                or config.check_numerics or ckpt.active)
     chunk_len = int(config.chunk_iters) if observe else _UNOBSERVED_CHUNK
-    run_chunk = _make_chunk_runner(mesh, kp, config.c_bounds(), float(config.epsilon),
-                                   float(config.tau), chunk_len,
-                                   use_cache, config.selection)
+    if use_block:
+        from dpsvm_tpu.parallel.dist_block import make_block_chunk_runner
+        from dpsvm_tpu.solver.block import BlockState
+
+        # Block height clamped so each shard can produce q/2 candidates.
+        n_loc = n_pad // n_dev
+        q = max(2, min(config.working_set_size, 2 * n_loc))
+        q -= q % 2
+        inner = config.inner_iters or q
+        rounds_per_chunk = (max(1, chunk_len // inner)
+                            if observe else _UNOBSERVED_CHUNK)
+        inner_impl = ("pallas" if mesh.devices.flat[0].platform == "tpu"
+                      else "xla")
+        run_chunk = make_block_chunk_runner(
+            mesh, kp, config.c_bounds(), float(config.epsilon),
+            float(config.tau), q, inner, rounds_per_chunk, inner_impl)
+        state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
+                           b_lo=state.b_lo, pairs=state.it,
+                           rounds=jax.device_put(jnp.int32(0), rep))
+    else:
+        run_chunk = _make_chunk_runner(mesh, kp, config.c_bounds(),
+                                       float(config.epsilon),
+                                       float(config.tau), chunk_len,
+                                       use_cache, config.selection)
     if callback is not None and hasattr(callback, "on_start"):
         callback.on_start(start_iter)
 
     t0 = time.perf_counter()
     while True:
         state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter)
-        it, b_hi, b_lo = _unpack_obs(_pack_obs(state.it, state.b_hi, state.b_lo))
+        it, b_hi, b_lo = _unpack_obs(_pack_obs(
+            state.pairs if use_block else state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
@@ -429,5 +452,6 @@ def solve_mesh(
             "cache_lookups": lookups,
             "cache_hit_rate": (int(state.hits) / lookups) if lookups else 0.0,
             "f": np.asarray(state.f)[:n],
+            **({"outer_rounds": int(state.rounds)} if use_block else {}),
         },
     )

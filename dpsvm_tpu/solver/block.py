@@ -57,10 +57,10 @@ class BlockState(NamedTuple):
 
     @property
     def hits(self):
-        """Kernel-row uses served from the resident block instead of a
-        fresh X pass — the quantity the LRU cache's hit counter measures
-        in the per-pair engines (MetricsLogger compatibility)."""
-        return jnp.maximum(self.pairs * 2 - self.rounds * 2, 0)
+        """The block engine has no LRU cache (the working-set block IS its
+        reuse mechanism); report 0 so cache stats stay consistent
+        (MetricsLogger reads state.hits on every backend)."""
+        return jnp.int32(0)
 
 
 def select_block(f, alpha, y, c, q: int, valid=None):
@@ -205,7 +205,10 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
         coef = jnp.where(slot_ok, (alpha_w - alpha_w0) * y_w, 0.0)  # (q,)
         k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n) fp32
         f = st.f + coef @ k_rows
-        safe_w = jnp.where(slot_ok, w, -1)
+        # Dead slots must not scatter. The inert index must be OUT OF
+        # RANGE (n), not -1: mode="drop" only drops beyond-range indices,
+        # while -1 wraps to the LAST row and would erase its alpha.
+        safe_w = jnp.where(slot_ok, w, jnp.int32(st.alpha.shape[0]))
         alpha = st.alpha.at[safe_w].set(
             jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
         _, b_hi, _, b_lo = select_working_set(f, alpha, y, c)

@@ -477,7 +477,9 @@ def solve(
     max_iter = jnp.int32(config.max_iter)
     start_iter = int(state.pairs if use_block else state.it)
     ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
-    interpret = jax.devices()[0].platform != "tpu"
+    # Pallas kernels lower for the device the solve actually targets, not
+    # whatever the platform default happens to be.
+    interpret = device.platform != "tpu"
     if callback is not None and hasattr(callback, "on_start"):
         callback.on_start(start_iter)
 

@@ -51,9 +51,10 @@ def train(
         except ImportError:
             mesh_available = False
         multi = (num_devices or len(jax.devices())) > 1
-        # The pallas and block engines only exist in the single-chip solver;
-        # auto must not silently swap them for the mesh per-pair engine.
-        backend = ("mesh" if (multi and mesh_available and config.engine == "xla")
+        # The fused-pallas engine only exists in the single-chip solver;
+        # auto must not silently swap it for a different mesh engine.
+        backend = ("mesh" if (multi and mesh_available
+                              and config.engine in ("xla", "block"))
                    else "single")
 
     if backend in ("reference", "native"):
