@@ -62,6 +62,8 @@ def _base_config(est, gamma: float) -> SVMConfig:
         epsilon=est.tol,
         max_iter=est.max_iter if est.max_iter > 0 else 150_000,
         selection=getattr(est, "selection", "mvp"),
+        engine=getattr(est, "engine", "xla"),
+        working_set_size=getattr(est, "working_set_size", 128),
         cache_lines=est.cache_lines,
         dtype=est.dtype,
     )
@@ -79,6 +81,7 @@ class SVC(ClassifierMixin, BaseEstimator):
     def __init__(self, C=1.0, kernel="rbf", degree=3, gamma="scale",
                  coef0=0.0, tol=1e-3, max_iter=-1, class_weight=None,
                  strategy="ovr", backend="auto", selection="mvp",
+                 engine="xla", working_set_size=128,
                  cache_lines=0, dtype="float32", probability=False,
                  probability_cv=3, random_state=0):
         self.C = C
@@ -92,6 +95,8 @@ class SVC(ClassifierMixin, BaseEstimator):
         self.strategy = strategy
         self.backend = backend
         self.selection = selection
+        self.engine = engine
+        self.working_set_size = working_set_size
         self.cache_lines = cache_lines
         self.dtype = dtype
         self.probability = probability
@@ -234,7 +239,8 @@ class SVR(RegressorMixin, BaseEstimator):
 
     def __init__(self, C=1.0, kernel="rbf", degree=3, gamma="scale",
                  coef0=0.0, tol=1e-3, epsilon=0.1, max_iter=-1,
-                 backend="auto", selection="mvp", cache_lines=0,
+                 backend="auto", selection="mvp", engine="xla",
+                 working_set_size=128, cache_lines=0,
                  dtype="float32"):
         self.C = C
         self.kernel = kernel
@@ -246,6 +252,8 @@ class SVR(RegressorMixin, BaseEstimator):
         self.max_iter = max_iter
         self.backend = backend
         self.selection = selection
+        self.engine = engine
+        self.working_set_size = working_set_size
         self.cache_lines = cache_lines
         self.dtype = dtype
 
@@ -282,6 +290,7 @@ class OneClassSVM(OutlierMixin, BaseEstimator):
 
     def __init__(self, nu=0.5, kernel="rbf", degree=3, gamma="scale",
                  coef0=0.0, tol=1e-3, max_iter=-1, backend="auto",
+                 engine="xla", working_set_size=128,
                  cache_lines=0, dtype="float32"):
         self.nu = nu
         self.kernel = kernel
@@ -291,6 +300,8 @@ class OneClassSVM(OutlierMixin, BaseEstimator):
         self.tol = tol
         self.max_iter = max_iter
         self.backend = backend
+        self.engine = engine
+        self.working_set_size = working_set_size
         self.cache_lines = cache_lines
         self.dtype = dtype
 

@@ -28,26 +28,16 @@ vs the reference's per-update 16P-byte latency-bound allgather.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
 from dpsvm_tpu.ops.select import low_mask, split_c, up_mask
+from dpsvm_tpu.parallel.dist_smo import _global_ids
 from dpsvm_tpu.parallel.mesh import DATA_AXIS
-from dpsvm_tpu.solver.block import BlockState, _solve_subproblem
-
-_I32_MAX = jnp.iinfo(jnp.int32).max
-
-
-def _local_gids(n_loc: int) -> jax.Array:
-    dev = lax.axis_index(DATA_AXIS)
-    return dev.astype(jnp.int32) * n_loc + jnp.arange(n_loc, dtype=jnp.int32)
+from dpsvm_tpu.solver.block import BlockState, _solve_subproblem, combine_halves
 
 
 def _global_top(vals_loc, gids_loc, h: int):
@@ -70,24 +60,21 @@ def _select_block_mesh(f, alpha, y, valid, c, q: int):
     Same semantics as solver/block.py select_block."""
     cp, cn = split_c(c)
     n_loc = f.shape[0]
-    gids = _local_gids(n_loc)
+    gids = _global_ids(n_loc)
     up = up_mask(alpha, y, cp, cn) & valid
     low = low_mask(alpha, y, cp, cn) & valid
     h = q // 2
     up_idx, up_ok = _global_top(jnp.where(up, -f, -jnp.inf), gids, h)
     low_idx, low_ok = _global_top(jnp.where(low, f, -jnp.inf), gids, h)
-    dup = jnp.any((low_idx[:, None] == up_idx[None, :]) & up_ok[None, :],
-                  axis=1)
-    low_ok = low_ok & ~dup
-    w = jnp.concatenate([up_idx, low_idx]).astype(jnp.int32)
-    slot_ok = jnp.concatenate([up_ok, low_ok])
-    return w, slot_ok
+    return combine_halves(up_idx, up_ok, low_idx, low_ok)
 
 
 def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
     """Recover the working set's rows and per-row scalars from the shards
     with one (q, d) + one (q, S) psum. scal_loc: (n_loc, S) stacked
-    per-row scalars. Returns (qx (q, d) f32, scal (q, S) f32), replicated."""
+    per-row scalars. Returns (qx (q, d) f32, scal (q, S) f32, l (q,) i32,
+    own (q,) bool); qx/scal are replicated across devices, while l (local
+    slot index) and own (this-shard ownership mask) are PER-DEVICE."""
     dev = lax.axis_index(DATA_AXIS)
     l = w - dev.astype(jnp.int32) * n_loc
     own = (l >= 0) & (l < n_loc) & slot_ok

@@ -341,7 +341,11 @@ def solve_mesh(
     valid_dev = jax.device_put(jnp.asarray(valid), shard)
 
     cache_lines = min(config.cache_lines, n_pad // n_dev)
-    use_cache = cache_lines > 0
+    # The block engine has no LRU cache; don't allocate the (lines, n)
+    # sharded cache array or report cache stats for it.
+    use_cache = cache_lines > 0 and not use_block
+    if use_block:
+        cache_lines = 0
     state = SMOState(
         alpha=jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard),
         f=jax.device_put(jnp.asarray(-y_p, jnp.float32), shard),

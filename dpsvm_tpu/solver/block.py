@@ -84,12 +84,17 @@ def select_block(f, alpha, y, c, q: int, valid=None):
     h = q // 2
     neg_up, up_idx = lax.top_k(jnp.where(up, -f, -jnp.inf), h)
     low_vals, low_idx = lax.top_k(jnp.where(low, f, -jnp.inf), h)
-    up_ok = jnp.isfinite(neg_up)
-    low_ok = jnp.isfinite(low_vals)
-    # Only LIVE up slots can shadow a low candidate: when I_up runs short,
-    # top_k filler indices are arbitrary row ids and must not mask out real
-    # low-half violators (that could hide the global max violator and
-    # stall the outer loop with the gap open).
+    return combine_halves(up_idx, jnp.isfinite(neg_up),
+                          low_idx, jnp.isfinite(low_vals))
+
+
+def combine_halves(up_idx, up_ok, low_idx, low_ok):
+    """Assemble (w, slot_ok) from the two candidate halves, masking low
+    slots that duplicate a LIVE up slot. Only LIVE up slots can shadow a
+    low candidate: when I_up runs short, top_k filler indices are
+    arbitrary row ids and must not mask out real low-half violators (that
+    could hide the global max violator and stall the outer loop with the
+    gap open). Shared by the single-chip and mesh selectors."""
     dup = jnp.any((low_idx[:, None] == up_idx[None, :]) & up_ok[None, :],
                   axis=1)
     low_ok = low_ok & ~dup

@@ -439,7 +439,9 @@ def solve(
     from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer, resume_solver_state
 
     cache_lines = min(config.cache_lines, n_pad)
-    use_cache = cache_lines > 0
+    # The block engine has no LRU cache (its working-set block is the
+    # reuse mechanism) — don't allocate one or report cache stats for it.
+    use_cache = cache_lines > 0 and not use_block
     state = init_state(n_pad, y_dev, cache_lines if use_cache else 1)
     if alpha_init is not None:
         a_p = np.zeros((n_pad,), np.float32)

@@ -173,3 +173,32 @@ def test_select_block_filler_does_not_mask_low_candidates():
     # idx 0 must be a LIVE low-half slot.
     low_live = [wi for wi, oki in zip(w[4:], ok[4:]) if oki]
     assert 0 in low_live
+
+
+def test_reductions_compose_with_block_engine(blobs_small):
+    """SVR (2n-variable expansion), one-class (alpha starting AT the
+    bound) and multiclass all run on the block engine via alpha_init/
+    f_init and reach the same optimum as the per-pair engine."""
+    import numpy as np
+
+    from dpsvm_tpu.models.oneclass import train_oneclass
+    from dpsvm_tpu.models.svr import train_svr
+
+    x, _ = blobs_small
+    rng = np.random.default_rng(5)
+    z = np.sin(x[:, 0]) + 0.1 * rng.normal(size=x.shape[0]).astype(np.float32)
+
+    cfg = SVMConfig(c=5.0, gamma=0.2, epsilon=1e-3, max_iter=200_000)
+    cfg_blk = cfg.replace(engine="block", working_set_size=16)
+
+    m_x, r_x = train_svr(x, z, cfg, backend="single")
+    m_b, r_b = train_svr(x, z, cfg_blk, backend="single")
+    assert r_b.converged
+    np.testing.assert_allclose(m_b.predict(x), m_x.predict(x), atol=5e-2)
+
+    o_x, s_x = train_oneclass(x, nu=0.3, config=cfg, backend="single")
+    o_b, s_b = train_oneclass(x, nu=0.3, config=cfg_blk, backend="single")
+    assert s_b.converged
+    # Same dual optimum: sum alpha = nu*n conserved, rho within tolerance.
+    assert s_b.alpha.sum() == pytest.approx(s_x.alpha.sum(), rel=1e-6)
+    assert o_b.rho == pytest.approx(o_x.rho, abs=5e-3)
