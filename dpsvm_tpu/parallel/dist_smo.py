@@ -420,9 +420,14 @@ def solve_mesh(
     if callback is not None and hasattr(callback, "on_start"):
         callback.on_start(start_iter)
 
-    t0 = time.perf_counter()
+    # Device time only, clock stopped during host observation — see the
+    # matching loop in solver/smo.py for the rationale.
+    train_seconds = 0.0
     while True:
+        t0 = time.perf_counter()
         state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter)
+        jax.block_until_ready(state)
+        train_seconds += time.perf_counter() - t0
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
@@ -437,7 +442,6 @@ def solve_mesh(
             print(f"[smo-mesh p={n_dev}] iter={it} gap={b_lo - b_hi:.6f}")
         if converged or it >= config.max_iter:
             break
-    train_seconds = time.perf_counter() - t0
 
     alpha = np.asarray(state.alpha)[:n]
     lookups = 2 * (it - start_iter) if use_cache else 0

@@ -179,12 +179,11 @@ def test_reductions_compose_with_block_engine(blobs_small):
     """SVR (2n-variable expansion), one-class (alpha starting AT the
     bound) and multiclass all run on the block engine via alpha_init/
     f_init and reach the same optimum as the per-pair engine."""
-    import numpy as np
-
+    from dpsvm_tpu.models.multiclass import train_multiclass
     from dpsvm_tpu.models.oneclass import train_oneclass
     from dpsvm_tpu.models.svr import train_svr
 
-    x, _ = blobs_small
+    x, y = blobs_small
     rng = np.random.default_rng(5)
     z = np.sin(x[:, 0]) + 0.1 * rng.normal(size=x.shape[0]).astype(np.float32)
 
@@ -199,6 +198,22 @@ def test_reductions_compose_with_block_engine(blobs_small):
     o_x, s_x = train_oneclass(x, nu=0.3, config=cfg, backend="single")
     o_b, s_b = train_oneclass(x, nu=0.3, config=cfg_blk, backend="single")
     assert s_b.converged
-    # Same dual optimum: sum alpha = nu*n conserved, rho within tolerance.
-    assert s_b.alpha.sum() == pytest.approx(s_x.alpha.sum(), rel=1e-6)
+    # Same dual optimum: objective 1/2 a^T K a (sum alpha is conserved by
+    # construction, so compare the part that distinguishes optima), plus
+    # the offset and decision values.
+    K = np.asarray(kernel_matrix(x, x, KernelParams("rbf", cfg.gamma)))
+    assert 0.5 * s_b.alpha @ K @ s_b.alpha == pytest.approx(
+        0.5 * s_x.alpha @ K @ s_x.alpha, rel=1e-4)
     assert o_b.rho == pytest.approx(o_x.rho, abs=5e-3)
+    np.testing.assert_allclose(o_b.decision_function(x),
+                               o_x.decision_function(x), atol=5e-3)
+
+    # Multiclass (3 synthetic classes) through the same engine config.
+    from dpsvm_tpu.models.multiclass import predict_multiclass
+
+    y3 = (np.asarray(y) > 0).astype(int) + (x[:, 0] > 0.5).astype(int)
+    mc_b, _ = train_multiclass(x, y3, cfg_blk, strategy="ovr",
+                               backend="single")
+    mc_x, _ = train_multiclass(x, y3, cfg, strategy="ovr", backend="single")
+    agree = float(np.mean(predict_multiclass(mc_b, x) == predict_multiclass(mc_x, x)))
+    assert agree > 0.98
