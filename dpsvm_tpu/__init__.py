@@ -26,6 +26,7 @@ from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.models.svm_model import SVMModel
 from dpsvm_tpu.models.svr import SVRModel, train_svr
 from dpsvm_tpu.models.oneclass import OneClassModel, train_oneclass
+from dpsvm_tpu.models.nusvm import train_nusvc, train_nusvr
 from dpsvm_tpu.train import train
 from dpsvm_tpu.predict import decision_function, predict, accuracy
 from dpsvm_tpu import data
@@ -48,6 +49,8 @@ __all__ = [
     "train_svr",
     "OneClassModel",
     "train_oneclass",
+    "train_nusvc",
+    "train_nusvr",
     "train",
     "decision_function",
     "predict",

@@ -125,8 +125,13 @@ class SVMConfig:
             raise ValueError("class weights must be > 0")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError("dtype must be 'float32' or 'bfloat16'")
-        if self.selection not in ("mvp", "second_order"):
-            raise ValueError("selection must be 'mvp' or 'second_order'")
+        if self.selection not in ("mvp", "second_order", "nu"):
+            # "nu" is internal: per-class MVP selection for the nu duals,
+            # set by the models/nusvm.py trainers (the solvers reject it
+            # without the feasible warm start those trainers provide).
+            raise ValueError(
+                "selection must be 'mvp' or 'second_order' (selection='nu' "
+                "is internal to train_nusvc/train_nusvr)")
         if self.engine not in ("xla", "pallas", "block"):
             raise ValueError("engine must be 'xla', 'pallas' or 'block'")
         if self.engine in ("pallas", "block") and self.selection != "mvp":

@@ -69,6 +69,25 @@ def _base_config(est, gamma: float) -> SVMConfig:
     )
 
 
+def _weighted_accuracy(pred, y, sample_weight=None) -> float:
+    y = np.asarray(y)
+    if sample_weight is not None:
+        w = np.asarray(sample_weight, np.float64)
+        return float(((pred == y) * w).sum() / w.sum())
+    return float((pred == y).mean())
+
+
+def _weighted_r2(pred, y, sample_weight=None) -> float:
+    """R^2 as sklearn defines it (shared by the regressor facades)."""
+    y = np.asarray(y, np.float64)
+    pred = np.asarray(pred, np.float64)
+    w = (np.ones_like(y) if sample_weight is None
+         else np.asarray(sample_weight, np.float64))
+    ss_res = float((w * (y - pred) ** 2).sum())
+    ss_tot = float((w * (y - np.average(y, weights=w)) ** 2).sum())
+    return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+
+
 class SVC(ClassifierMixin, BaseEstimator):
     """C-SVC with sklearn semantics on the TPU solver.
 
@@ -226,12 +245,7 @@ class SVC(ClassifierMixin, BaseEstimator):
         return predict_multiclass(self._multiclass_model, X)
 
     def score(self, X, y, sample_weight=None):
-        pred = self.predict(X)
-        y = np.asarray(y)
-        if sample_weight is not None:
-            sample_weight = np.asarray(sample_weight, np.float64)
-            return float(((pred == y) * sample_weight).sum() / sample_weight.sum())
-        return float((pred == y).mean())
+        return _weighted_accuracy(self.predict(X), y, sample_weight)
 
 
 class SVR(RegressorMixin, BaseEstimator):
@@ -275,14 +289,7 @@ class SVR(RegressorMixin, BaseEstimator):
         return self._model.predict(np.asarray(X, np.float32))
 
     def score(self, X, y, sample_weight=None):
-        # R^2, as sklearn defines it.
-        y = np.asarray(y, np.float64)
-        pred = np.asarray(self.predict(X), np.float64)
-        w = (np.ones_like(y) if sample_weight is None
-             else np.asarray(sample_weight, np.float64))
-        ss_res = float((w * (y - pred) ** 2).sum())
-        ss_tot = float((w * (y - np.average(y, weights=w)) ** 2).sum())
-        return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0
+        return _weighted_r2(self.predict(X), y, sample_weight)
 
 
 class OneClassSVM(OutlierMixin, BaseEstimator):
@@ -323,3 +330,90 @@ class OneClassSVM(OutlierMixin, BaseEstimator):
 
     def predict(self, X):
         return np.where(self.decision_function(X) >= 0, 1, -1)
+
+
+class NuSVC(ClassifierMixin, BaseEstimator):
+    """Binary nu-SVC with sklearn semantics on the TPU solver (the nu
+    duals run the per-class-selection per-pair engine; see
+    models/nusvm.py). Binary only — reduce multiclass problems with
+    sklearn's OneVsRestClassifier if needed."""
+
+    def __init__(self, nu=0.5, kernel="rbf", degree=3, gamma="scale",
+                 coef0=0.0, tol=1e-3, max_iter=-1, backend="auto",
+                 cache_lines=0, dtype="float32"):
+        self.nu = nu
+        self.kernel = kernel
+        self.degree = degree
+        self.gamma = gamma
+        self.coef0 = coef0
+        self.tol = tol
+        self.max_iter = max_iter
+        self.backend = backend
+        self.cache_lines = cache_lines
+        self.dtype = dtype
+
+    def fit(self, X, y):
+        from dpsvm_tpu.models.nusvm import train_nusvc
+
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        self.classes_ = np.unique(y)
+        if self.classes_.shape[0] != 2:
+            raise ValueError("NuSVC is binary; got "
+                             f"{self.classes_.shape[0]} classes")
+        y_pm = np.where(y == self.classes_[1], 1, -1).astype(np.int32)
+        cfg = _base_config(self, _resolve_gamma(self.gamma, X))
+        self._model, res = train_nusvc(X, y_pm, nu=self.nu, config=cfg,
+                                       backend=self.backend)
+        self.fit_result_ = res
+        self.n_iter_ = res.iterations
+        return self
+
+    def decision_function(self, X):
+        from dpsvm_tpu.predict import decision_function
+        return decision_function(self._model, np.asarray(X, np.float32))
+
+    def predict(self, X):
+        scores = self.decision_function(X)
+        return self.classes_[(scores > 0).astype(int)]
+
+    def score(self, X, y, sample_weight=None):
+        return _weighted_accuracy(self.predict(X), y, sample_weight)
+
+
+class NuSVR(RegressorMixin, BaseEstimator):
+    """nu-SVR with sklearn semantics on the TPU solver: nu replaces the
+    epsilon tube width (see models/nusvm.py)."""
+
+    def __init__(self, nu=0.5, C=1.0, kernel="rbf", degree=3, gamma="scale",
+                 coef0=0.0, tol=1e-3, max_iter=-1, backend="auto",
+                 cache_lines=0, dtype="float32"):
+        self.nu = nu
+        self.C = C
+        self.kernel = kernel
+        self.degree = degree
+        self.gamma = gamma
+        self.coef0 = coef0
+        self.tol = tol
+        self.max_iter = max_iter
+        self.backend = backend
+        self.cache_lines = cache_lines
+        self.dtype = dtype
+
+    def fit(self, X, y):
+        from dpsvm_tpu.models.nusvm import train_nusvr
+
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float32)
+        cfg = _base_config(self, _resolve_gamma(self.gamma, X))
+        self._model, res = train_nusvr(X, y, nu=self.nu, c=self.C,
+                                       config=cfg, backend=self.backend)
+        self.fit_result_ = res
+        self.n_iter_ = res.iterations
+        return self
+
+    def predict(self, X):
+        return self._model.predict(np.asarray(X, np.float32))
+
+    def score(self, X, y, sample_weight=None):
+        return _weighted_r2(self.predict(X), y, sample_weight)

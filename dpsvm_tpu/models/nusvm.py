@@ -1,0 +1,225 @@
+"""nu-SVC and nu-SVR on the same SMO engine.
+
+No reference equivalent (the reference trains binary C-SVC only) — these
+complete the LibSVM model-family matrix (C-SVC / nu-SVC / epsilon-SVR /
+nu-SVR / one-class) as capability extensions.
+
+The nu duals (Scholkopf et al.) differ from the C forms in carrying TWO
+equality constraints — one per (pseudo-)class — so pair updates must stay
+inside a class. That is the only engine-level change: the trainers run the
+standard solver with `selection="nu"` (per-class maximal-violating-pair,
+ops/select.py select_working_set_nu; distributed variant in
+parallel/dist_smo.py), a feasible warm start that fixes both constraint
+values (pair updates conserve them exactly), and a LibSVM-style
+rho/r readout from the final gradient:
+
+  nu-SVC  (box [0,1], p=0):      per class, sum alpha = nu*n/2.
+          After solving, r1/r2 = the free-SV average of grad per class
+          (midpoint of the active-bound envelope if a class has no free
+          SV); the solution is rescaled by r=(r1+r2)/2 so the margin is
+          1:  dual_coef = alpha*y/r, b = -(r1-r2)/2 / r. (svm.cpp
+          solve_nu_svc / Solver_NU::calculate_rho semantics.)
+  nu-SVR  (2n expansion, p=[-z; z]): sum(alpha + alpha*) = C*n*nu,
+          sum(alpha - alpha*) = 0. r1/r2 read the same way; under this
+          module's grad = y*f convention the adaptive tube width comes
+          out as eps = -(r1+r2)/2 and the offset b = (r1-r2)/2 — nu
+          replaces the epsilon hyper-parameter of epsilon-SVR.
+
+Validated against sklearn's NuSVC/NuSVR (LibSVM) in tests/test_nusvm.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.models.svr import SVRModel
+from dpsvm_tpu.ops.kernels import KernelParams, blocked_kernel_matvec
+from dpsvm_tpu.solver.result import SolveResult
+
+
+def _solve(x, y, cfg, backend, num_devices, callback, alpha0, f_init):
+    import jax
+
+    if backend == "auto":
+        backend = "mesh" if (num_devices or len(jax.devices())) > 1 else "single"
+    if backend == "single":
+        from dpsvm_tpu.solver.smo import solve
+        return solve(x, y, cfg, callback=callback,
+                     alpha_init=alpha0, f_init=f_init)
+    if backend == "mesh":
+        from dpsvm_tpu.parallel.dist_smo import solve_mesh
+        return solve_mesh(x, y, cfg, num_devices=num_devices,
+                          callback=callback, alpha_init=alpha0, f_init=f_init)
+    raise ValueError(f"unknown backend {backend!r} (nu trainers support "
+                     "'auto' | 'single' | 'mesh')")
+
+
+def _capped_fill(count: int, total: float, cap: float) -> np.ndarray:
+    """LibSVM warm-start walk, vectorized: assign `cap` per slot in order
+    until `total` is exhausted, fractional remainder on the next slot."""
+    return np.minimum(
+        cap, np.maximum(0.0, total - np.arange(count) * cap)).astype(np.float32)
+
+
+def _rho_r(f, alpha, y, c_cap, eps_box=1e-9):
+    """(r1, r2) from the final state, per Solver_NU::calculate_rho.
+
+    grad_i = y_i * f_i (the engine's f is y * grad). Per class: average
+    grad over free SVs; a class with no free SV takes the midpoint of
+    [max grad at upper bound, min grad at lower bound].
+    """
+    grad = y * f
+    out = []
+    for cls in (y > 0, y < 0):
+        free = cls & (alpha > eps_box) & (alpha < c_cap - eps_box)
+        if free.any():
+            out.append(float(grad[free].mean()))
+        else:
+            at_upper = cls & (alpha >= c_cap - eps_box)
+            at_lower = cls & (alpha <= eps_box)
+            lb = float(grad[at_upper].max()) if at_upper.any() else -np.inf
+            ub = float(grad[at_lower].min()) if at_lower.any() else np.inf
+            out.append((ub + lb) / 2.0)
+    return out[0], out[1]
+
+
+def train_nusvc(
+    x,
+    y,
+    nu: float = 0.5,
+    config: SVMConfig = SVMConfig(),
+    backend: str = "auto",
+    num_devices: Optional[int] = None,
+    callback=None,
+) -> tuple[SVMModel, SolveResult]:
+    """Train binary nu-SVC: nu in (0, 1] bounds the margin-error fraction
+    from above and the SV fraction from below. config.c is ignored (the
+    nu-SVC box is [0, 1] before rescaling); labels must be +-1."""
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int32)
+    n, d = x.shape
+    pos_idx = np.nonzero(y > 0)[0]
+    neg_idx = np.nonzero(y < 0)[0]
+    if len(pos_idx) == 0 or len(neg_idx) == 0:
+        raise ValueError("nu-SVC needs both classes present")
+    if not 0.0 < nu <= 1.0:
+        raise ValueError("nu must be in (0, 1]")
+    # Feasibility (sklearn raises the same way): each class must be able
+    # to absorb nu*n/2 at alpha <= 1.
+    if nu * n / 2.0 > min(len(pos_idx), len(neg_idx)) + 1e-12:
+        raise ValueError("specified nu is infeasible")
+
+    half = nu * n / 2.0
+    alpha0 = np.zeros((n,), np.float32)
+    alpha0[pos_idx] = _capped_fill(len(pos_idx), half, 1.0)
+    alpha0[neg_idx] = _capped_fill(len(neg_idx), half, 1.0)
+
+    gamma = config.resolve_gamma(d)
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    # p = 0: the engine's indicator is f = y * Q alpha = K @ (alpha * y).
+    f_init = blocked_kernel_matvec(x, alpha0 * y, kp, config.dtype)
+    if config.engine != "xla":
+        raise ValueError(
+            f"engine={config.engine!r} does not implement the per-class "
+            "nu selection; the nu trainers run the per-pair XLA engine "
+            "(set engine='xla' or drop the override)")
+    cfg = config.replace(c=1.0, weight_pos=1.0, weight_neg=1.0,
+                         selection="nu")
+
+    result = _solve(x, y, cfg, backend, num_devices, callback,
+                    alpha0, f_init)
+
+    r1, r2 = _rho_r(result.stats["f"], result.alpha, y, 1.0)
+    r = (r1 + r2) / 2.0
+    if r <= 0:
+        raise FloatingPointError(
+            f"nu-SVC margin scale r={r} <= 0; solution degenerate "
+            "(nu too large for this data?)")
+    rho = (r1 - r2) / 2.0
+    alpha_scaled = (result.alpha / r).astype(np.float32)
+
+    mask = alpha_scaled > 0
+    model = SVMModel(
+        sv_x=np.ascontiguousarray(x[mask], np.float32),
+        sv_alpha=alpha_scaled[mask],
+        sv_y=y[mask].astype(np.int32),
+        b=float(rho / r),  # SVMModel decision = sum a y K - b
+        kernel=kp)
+    # Keep the SolveResult self-consistent with every other trainer:
+    # result.alpha/result.b reconstruct the model exactly the way the
+    # C-SVC path's SVMModel.from_dense(x, y, alpha, b) would.
+    result.alpha = alpha_scaled
+    result.b = model.b
+    result.stats["nu_r"] = r
+    result.stats["nu_rho"] = rho
+    return model, result
+
+
+def train_nusvr(
+    x,
+    z,
+    nu: float = 0.5,
+    c: Optional[float] = None,
+    config: SVMConfig = SVMConfig(),
+    backend: str = "auto",
+    num_devices: Optional[int] = None,
+    callback=None,
+) -> tuple[SVRModel, SolveResult]:
+    """Train nu-SVR: nu replaces epsilon-SVR's tube width (the tube
+    adapts so that at most a nu fraction of points fall outside it).
+    `c` defaults to config.c."""
+    x = np.asarray(x, np.float32)
+    z = np.asarray(z, np.float32)
+    n, d = x.shape
+    if z.shape != (n,):
+        raise ValueError(f"targets must be shape ({n},), got {z.shape}")
+    if not 0.0 < nu <= 1.0:
+        raise ValueError("nu must be in (0, 1]")
+    C = float(config.c if c is None else c)
+
+    # 2n expansion (models/svr.py): pseudo-labels fix the block structure.
+    x2 = np.vstack([x, x])
+    y2 = np.concatenate([np.ones(n, np.int32), -np.ones(n, np.int32)])
+    # Warm start (svm.cpp solve_nu_svr): alpha_i = alpha*_i walk C*n*nu/2
+    # down the rows; symmetric start => K-part of the gradient is zero and
+    # f_init = y * p with p = [-z; z], i.e. [-z; -z].
+    total = C * n * nu / 2.0
+    alpha0 = np.zeros((2 * n,), np.float32)
+    a = _capped_fill(n, total, C)
+    alpha0[:n] = a
+    alpha0[n:] = a
+    f_init = np.concatenate([-z, -z]).astype(np.float32)
+
+    if config.engine != "xla":
+        raise ValueError(
+            f"engine={config.engine!r} does not implement the per-class "
+            "nu selection; the nu trainers run the per-pair XLA engine "
+            "(set engine='xla' or drop the override)")
+    cfg = config.replace(c=C, weight_pos=1.0, weight_neg=1.0,
+                         selection="nu")
+    result = _solve(x2, y2, cfg, backend, num_devices, callback,
+                    alpha0, f_init)
+
+    r1, r2 = _rho_r(result.stats["f"], result.alpha,
+                    y2.astype(np.float32), C)
+    b = (r1 - r2) / 2.0
+    result.b = float(b)
+    # Under this module's grad = y*f convention the adaptive tube width
+    # comes out as -(r1+r2)/2 (checked against LibSVM: inactive points'
+    # residuals are bounded by exactly this value).
+    result.stats["nu_tube_eps"] = -(r1 + r2) / 2.0
+
+    coef = result.alpha[:n] - result.alpha[n:]
+    mask = coef != 0
+    gamma = config.resolve_gamma(d)
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    model = SVRModel(
+        sv_x=np.ascontiguousarray(x[mask], np.float32),
+        coef=coef[mask].astype(np.float32),
+        b=float(b),
+        kernel=kp)
+    return model, result

@@ -27,7 +27,7 @@ import numpy as np
 
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.models.svm_model import SVMModel
-from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.ops.kernels import KernelParams, blocked_kernel_matvec
 from dpsvm_tpu.solver.result import SolveResult
 
 
@@ -79,33 +79,6 @@ class OneClassModel:
             kernel=KernelParams.from_npz(z))
 
 
-def _initial_gradient(x: np.ndarray, alpha0: np.ndarray, kp: KernelParams,
-                      dtype: str, block: int = 8192) -> np.ndarray:
-    """f_init = K @ alpha0, evaluated only against the active columns and
-    blocked over query rows to bound HBM.
-
-    `dtype` is the solver's X storage dtype: with bfloat16 storage the
-    solver's own kernel rows see the bf16-rounded features, so the initial
-    gradient must be evaluated on the same rounded values or f starts
-    ~1e-3-relative inconsistent with every subsequent rank-2 update —
-    an error the solver can never repair."""
-    import jax.numpy as jnp
-
-    from dpsvm_tpu.ops.kernels import kernel_matrix
-
-    xj = jnp.asarray(x)
-    if dtype == "bfloat16":
-        xj = xj.astype(jnp.bfloat16)
-    active = alpha0 > 0
-    xa = xj[np.nonzero(active)[0]]
-    aa = jnp.asarray(alpha0[active])
-    out = np.empty((x.shape[0],), np.float32)
-    for s in range(0, x.shape[0], block):
-        k = kernel_matrix(xj[s:s + block], xa, kp)
-        out[s:s + block] = np.asarray(k @ aa)
-    return out
-
-
 def train_oneclass(
     x,
     nu: float = 0.5,
@@ -132,7 +105,7 @@ def train_oneclass(
 
     gamma = config.resolve_gamma(d)
     kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
-    f_init = _initial_gradient(x, alpha0, kp, config.dtype)
+    f_init = blocked_kernel_matvec(x, alpha0, kp, config.dtype)
     y = np.ones((n,), np.int32)
     # The OCSVM box is exactly [0, 1]: neutralize the class weights along
     # with c, else weight_pos would silently rescale the box below the

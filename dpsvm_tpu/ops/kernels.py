@@ -126,6 +126,37 @@ def kernel_rows(
     return kernel_from_dots(row_dots(x, q), x_sq, q_sq, params)
 
 
+def blocked_kernel_matvec(x, coef, params: KernelParams,
+                          dtype: str = "float32", block: int = 8192):
+    """K(x, x_active) @ coef_active without materializing more than a
+    (block, n_active) kernel tile — the initial-gradient evaluator shared
+    by the warm-started reductions (one-class, nu-SVC).
+
+    `dtype` is the solver's X storage dtype: with bfloat16 storage the
+    solver's own kernel rows see the bf16-rounded features, so this must
+    evaluate on the same rounded values or the start gradient is
+    ~1e-3-relative inconsistent with every subsequent rank-2 update — an
+    error the solver can never repair. Returns float32 (n,).
+    """
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    coef = np.asarray(coef, np.float32)
+    xj = jnp.asarray(x)
+    if dtype == "bfloat16":
+        xj = xj.astype(jnp.bfloat16)
+    active = coef != 0
+    if not active.any():
+        return np.zeros((x.shape[0],), np.float32)
+    xa = xj[np.nonzero(active)[0]]
+    ca = jnp.asarray(coef[active])
+    out = np.empty((x.shape[0],), np.float32)
+    for s in range(0, x.shape[0], block):
+        k = kernel_matrix(xj[s:s + block], xa, params)
+        out[s:s + block] = np.asarray(k @ ca)
+    return out
+
+
 @partial(jax.jit, static_argnames=("params",))
 def kernel_matrix(
     a: jax.Array,

@@ -62,6 +62,54 @@ def low_mask(alpha: jax.Array, y: jax.Array, c_pos: float,
     return jnp.where(y > 0, alpha > 0, alpha < c)
 
 
+def select_working_set_nu(
+    f: jax.Array,
+    alpha: jax.Array,
+    y: jax.Array,
+    c: float | tuple,
+    valid: jax.Array | None = None,
+):
+    """Working-set selection for the nu duals (Solver_NU role,
+    LibSVM svm.cpp select_working_set of the nu solver).
+
+    The nu problems carry TWO equality constraints (one per class), so a
+    pair update must stay within one class: select the maximal-violating
+    pair separately inside {y=+1} and {y=-1} and take the class with the
+    larger violation. In f terms the per-class candidate sets are simply
+    the C-SVC I_up/I_low masks intersected with the class.
+
+    Returns (i_up, b_hi, i_low, b_lo) of the chosen class; b_lo - b_hi is
+    max(violation_+, violation_-), so the standard stopping rule
+    b_lo <= b_hi + 2 eps is LibSVM's nu stopping rule.
+
+    No reference equivalent (the reference is C-SVC only).
+    """
+    cp, cn = split_c(c)
+    f = f.astype(jnp.float32)
+    up = up_mask(alpha, y, cp, cn)
+    low = low_mask(alpha, y, cp, cn)
+    if valid is not None:
+        up = up & valid
+        low = low & valid
+    pos = y > 0
+
+    def class_pair(cls):
+        f_up = jnp.where(up & cls, f, _INF)
+        f_low = jnp.where(low & cls, f, -_INF)
+        i_up = jnp.argmin(f_up).astype(jnp.int32)
+        i_low = jnp.argmax(f_low).astype(jnp.int32)
+        return i_up, f_up[i_up], i_low, f_low[i_low]
+
+    iu_p, bh_p, il_p, bl_p = class_pair(pos)
+    iu_n, bh_n, il_n, bl_n = class_pair(~pos)
+    take_p = (bl_p - bh_p) >= (bl_n - bh_n)
+    i_up = jnp.where(take_p, iu_p, iu_n)
+    i_low = jnp.where(take_p, il_p, il_n)
+    b_hi = jnp.where(take_p, bh_p, bh_n)
+    b_lo = jnp.where(take_p, bl_p, bl_n)
+    return i_up, b_hi, i_low, b_lo
+
+
 def select_working_set(
     f: jax.Array,
     alpha: jax.Array,

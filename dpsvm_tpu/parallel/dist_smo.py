@@ -88,6 +88,45 @@ def _select_global(f, alpha, y, c, valid):
     return i_hi, b_hi, i_lo, b_lo
 
 
+def _select_global_nu(f, alpha, y, c, valid):
+    """Distributed per-class most-violating-pair selection (the nu duals'
+    Solver_NU rule; see ops/select.py select_working_set_nu). One
+    all_gather of (4,) candidate values + (4,) int32 indices per
+    iteration."""
+    cp, cn = split_c(c)
+    n_loc = f.shape[0]
+    gids = _global_ids(n_loc)
+    up = up_mask(alpha, y, cp, cn) & valid
+    low = low_mask(alpha, y, cp, cn) & valid
+    pos = y > 0
+
+    def local_pair(cls):
+        f_up = jnp.where(up & cls, f, jnp.inf)
+        f_low = jnp.where(low & cls, f, -jnp.inf)
+        l_hi = jnp.argmin(f_up).astype(jnp.int32)
+        l_lo = jnp.argmax(f_low).astype(jnp.int32)
+        return (f_up[l_hi], f_low[l_lo]), (gids[l_hi], gids[l_lo])
+
+    (bh_p, bl_p), (ih_p, il_p) = local_pair(pos)
+    (bh_n, bl_n), (ih_n, il_n) = local_pair(~pos)
+    g_vals = lax.all_gather(jnp.stack([bh_p, bl_p, bh_n, bl_n]), DATA_AXIS)
+    g_idx = lax.all_gather(jnp.stack([ih_p, il_p, ih_n, il_n]), DATA_AXIS)
+
+    def reduce_col(col, take_min):
+        v = g_vals[:, col]
+        best = jnp.min(v) if take_min else jnp.max(v)
+        idx = jnp.min(jnp.where(v == best, g_idx[:, col], _I32_MAX))
+        return best, idx
+
+    bh_p, ih_p = reduce_col(0, True)
+    bl_p, il_p = reduce_col(1, False)
+    bh_n, ih_n = reduce_col(2, True)
+    bl_n, il_n = reduce_col(3, False)
+    take_p = (bl_p - bh_p) >= (bl_n - bh_n)
+    return (jnp.where(take_p, ih_p, ih_n), jnp.where(take_p, bh_p, bh_n),
+            jnp.where(take_p, il_p, il_n), jnp.where(take_p, bl_p, bl_n))
+
+
 def _gather_row(x_loc, owner_mask):
     """Fetch one global row from the sharded X by masked psum — the
     replicated-X read `g_x[i]` of the reference (svmTrain.cu:222) without
@@ -204,10 +243,13 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
 
 
 def _iteration(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state: SMOState,
-               kp: KernelParams, c: float, tau: float, use_cache: bool) -> SMOState:
-    """One distributed SMO iteration; runs identically on every device."""
+               kp: KernelParams, c: float, tau: float, use_cache: bool,
+               select_fn=_select_global) -> SMOState:
+    """One distributed SMO iteration; runs identically on every device.
+    `select_fn` swaps the C-SVC global MVP rule for the nu duals'
+    per-class variant (see solver/smo.py)."""
     n_loc = x_loc.shape[0]
-    i_hi, b_hi, i_lo, b_lo = _select_global(
+    i_hi, b_hi, i_lo, b_lo = select_fn(
         state.f, state.alpha, y_loc, c, valid_loc)
 
     gids = _global_ids(n_loc)
@@ -247,7 +289,11 @@ def _iteration(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state: SMOState,
     return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
 
 
-_ITERATION_FNS = {"mvp": _iteration, "second_order": _iteration_wss2}
+_ITERATION_FNS = {
+    "mvp": _iteration,
+    "second_order": _iteration_wss2,
+    "nu": partial(_iteration, select_fn=_select_global_nu),
+}
 
 
 def _make_chunk_runner(mesh: Mesh, kp: KernelParams, c: float, eps: float,
@@ -307,6 +353,12 @@ def solve_mesh(
             f"engine={config.engine!r} is implemented for the single-chip "
             "solver only; the mesh backend supports engine='xla' (per-pair) "
             "and engine='block' (distributed decomposition)")
+    if config.selection == "nu" and alpha_init is None:
+        # See solver/smo.py: nu selection is degenerate without the nu
+        # trainers' feasible warm start.
+        raise ValueError(
+            "selection='nu' is internal to the nu duals — call "
+            "train_nusvc/train_nusvr (models/nusvm.py) instead")
     use_block = config.engine == "block"
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)

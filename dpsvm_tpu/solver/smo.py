@@ -30,7 +30,7 @@ from dpsvm_tpu.ops.kernels import (
     squared_norms,
 )
 from dpsvm_tpu.ops.select import (c_of, low_mask, select_working_set,
-                                  split_c, up_mask)
+                                  select_working_set_nu, split_c, up_mask)
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
 
@@ -139,9 +139,16 @@ def _apply_pair_update(state: SMOState, y, i_hi, i_lo, b_hi_pair, b_lo_pair,
 
 
 def _smo_iteration(x, y, x_sq, k_diag, valid, state: SMOState, kp: KernelParams,
-                   c: float, tau: float, use_cache: bool) -> SMOState:
-    """One reference-parity (maximal-violating-pair) SMO iteration."""
-    i_hi, b_hi, i_lo, b_lo = select_working_set(state.f, state.alpha, y, c, valid)
+                   c: float, tau: float, use_cache: bool,
+                   select_fn=select_working_set) -> SMOState:
+    """One reference-parity (maximal-violating-pair) SMO iteration.
+
+    `select_fn` swaps the working-set rule: the default is the C-SVC
+    global MVP; `select_working_set_nu` restricts the pair to one class
+    (the nu duals' two-equality-constraint variant) — everything after
+    selection (kernel rows, pair algebra, f update) is identical.
+    """
+    i_hi, b_hi, i_lo, b_lo = select_fn(state.f, state.alpha, y, c, valid)
 
     q_hi = lax.dynamic_index_in_dim(x, i_hi, 0, keepdims=False)
     q_lo = lax.dynamic_index_in_dim(x, i_lo, 0, keepdims=False)
@@ -218,7 +225,13 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
     return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
 
 
-_ITERATION_FNS = {"mvp": _smo_iteration, "second_order": _smo_iteration_wss2}
+_ITERATION_FNS = {
+    "mvp": _smo_iteration,
+    "second_order": _smo_iteration_wss2,
+    # Internal: per-class MVP for the nu duals (set by models/nusvm.py's
+    # trainers, not meant as a user-facing selection rule for C-SVC).
+    "nu": partial(_smo_iteration, select_fn=select_working_set_nu),
+}
 
 # Chunk length used when nothing on the host needs to observe intermediate
 # state (no callback / verbose / checkpoint / numerics checks): the loop
@@ -399,6 +412,15 @@ def solve(
     A checkpoint resume, when present, takes precedence over both.
     """
     import numpy as np
+
+    if config.selection == "nu" and alpha_init is None:
+        # The nu rule pairs within one class; from the C-SVC zero start no
+        # class has both an I_up and an I_low member, so the gap reads
+        # closed at iteration 0 and a garbage model would return as
+        # "converged". Only the nu trainers provide the feasible start.
+        raise ValueError(
+            "selection='nu' is internal to the nu duals — call "
+            "train_nusvc/train_nusvr (models/nusvm.py) instead")
 
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)
