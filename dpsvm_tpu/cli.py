@@ -28,9 +28,18 @@ import numpy as np
 
 
 def _build_train_parser(sub) -> argparse.ArgumentParser:
-    p = sub.add_parser("train", help="train a binary C-SVC with modified SMO")
+    p = sub.add_parser("train", help="train an SVM with modified SMO")
     p.add_argument("-f", "--file-path", required=True, help="training CSV (label,f1,...,fd)")
     p.add_argument("-m", "--model", required=True, help="output model path (.txt or .npz)")
+    # LibSVM's -s svm_type role (the reference trains C-SVC only).
+    p.add_argument("-t", "--svm-type", default="c-svc",
+                   choices=["c-svc", "nu-svc", "eps-svr", "nu-svr", "one-class"],
+                   help="problem type (default c-svc; svr/one-class models "
+                        "save as .npz)")
+    p.add_argument("--nu", type=float, default=0.5,
+                   help="nu for nu-svc / nu-svr / one-class (default 0.5)")
+    p.add_argument("-p", "--svr-epsilon", type=float, default=0.1,
+                   help="epsilon-SVR tube width (LibSVM -p; default 0.1)")
     p.add_argument("-a", "--num-att", type=int, default=None,
                    help="number of features (inferred from file if omitted)")
     p.add_argument("-x", "--num-ex", type=int, default=None,
@@ -169,8 +178,25 @@ def _cmd_train(args) -> int:
         initialize_multihost(args.coordinator_address, args.num_processes,
                              args.process_id)
 
+    if args.svm_type in ("nu-svc", "nu-svr", "one-class"):
+        # These duals fix their own selection rule / box; an explicitly
+        # requested incompatible flag must fail loudly, not be silently
+        # replaced (their trainers override selection/c/weights).
+        if args.selection != "mvp":
+            print(f"error: --selection {args.selection} is not applicable "
+                  f"to {args.svm_type} (per-class nu selection is fixed)",
+                  file=sys.stderr)
+            return 2
+        if args.svm_type in ("nu-svc", "one-class") and (
+                args.weight_pos != 1.0 or args.weight_neg != 1.0):
+            print(f"error: -w1/-w-1 are not applicable to {args.svm_type} "
+                  "(the nu box is fixed at [0, 1])", file=sys.stderr)
+            return 2
+
     t0 = time.perf_counter()
-    x, y = load_csv(args.file_path, args.num_ex, args.num_att)
+    regression = args.svm_type in ("eps-svr", "nu-svr")
+    x, y = load_csv(args.file_path, args.num_ex, args.num_att,
+                    float_labels=regression)
     if not args.quiet:
         print(f"loaded {x.shape[0]} examples x {x.shape[1]} features "
               f"in {time.perf_counter() - t0:.2f}s")
@@ -189,9 +215,36 @@ def _cmd_train(args) -> int:
         sink=None if args.quiet else sys.stderr,
         jsonl_path=args.metrics_jsonl)
     with profile_trace(args.profile_dir):
-        model, result = train(
-            x, y, config, backend=args.backend, num_devices=args.num_devices,
-            callback=logger, checkpoint_path=args.checkpoint, resume=args.resume)
+        if args.svm_type == "c-svc":
+            model, result = train(
+                x, y, config, backend=args.backend, num_devices=args.num_devices,
+                callback=logger, checkpoint_path=args.checkpoint,
+                resume=args.resume)
+        elif args.svm_type == "nu-svc":
+            from dpsvm_tpu.models.nusvm import train_nusvc
+            model, result = train_nusvc(
+                x, y, nu=args.nu, config=config, backend=args.backend,
+                num_devices=args.num_devices, callback=logger,
+                checkpoint_path=args.checkpoint, resume=args.resume)
+        elif args.svm_type == "eps-svr":
+            from dpsvm_tpu.models.svr import train_svr
+            model, result = train_svr(
+                x, y, config, svr_epsilon=args.svr_epsilon,
+                backend=args.backend, num_devices=args.num_devices,
+                callback=logger,
+                checkpoint_path=args.checkpoint, resume=args.resume)
+        elif args.svm_type == "nu-svr":
+            from dpsvm_tpu.models.nusvm import train_nusvr
+            model, result = train_nusvr(
+                x, y, nu=args.nu, config=config, backend=args.backend,
+                num_devices=args.num_devices, callback=logger,
+                checkpoint_path=args.checkpoint, resume=args.resume)
+        else:  # one-class
+            from dpsvm_tpu.models.oneclass import train_oneclass
+            model, result = train_oneclass(
+                x, nu=args.nu, config=config, backend=args.backend,
+                num_devices=args.num_devices, callback=logger,
+                checkpoint_path=args.checkpoint, resume=args.resume)
     logger.close()
 
     if result.converged:
@@ -204,8 +257,20 @@ def _cmd_train(args) -> int:
     if result.stats.get("cache_lookups"):
         print(f"cache hit rate: {result.stats['cache_hit_rate']:.3f}")
 
-    from dpsvm_tpu.predict import accuracy
-    print(f"train accuracy: {accuracy(model, x, y):.4f}")
+    if args.svm_type in ("c-svc", "nu-svc"):
+        from dpsvm_tpu.predict import accuracy
+        print(f"train accuracy: {accuracy(model, x, y):.4f}")
+    elif args.svm_type in ("eps-svr", "nu-svr"):
+        resid = np.asarray(model.predict(x)) - y
+        print(f"train RMSE: {float(np.sqrt(np.mean(resid ** 2))):.6f}")
+    else:
+        inlier = float(np.mean(model.predict(x) > 0))
+        print(f"train inlier fraction: {inlier:.4f} (nu={args.nu})")
+
+    if args.svm_type in ("eps-svr", "nu-svr", "one-class") \
+            and not args.model.endswith(".npz"):
+        args.model += ".npz"
+        print(f"note: {args.svm_type} models use the .npz format")
     model.save(args.model)
     print(f"model saved to {args.model}")
     return 0
@@ -216,6 +281,38 @@ def _cmd_test(args) -> int:
     from dpsvm_tpu.models.svm_model import SVMModel
     from dpsvm_tpu.ops.kernels import KernelParams
     from dpsvm_tpu.predict import accuracy
+
+    # Type-dispatch: .npz files carry a model_type field (svr / oneclass /
+    # classifier); the reference-compatible .txt format is classifier-only.
+    model_type = "classifier"
+    if args.model.endswith(".npz"):
+        z = np.load(args.model, allow_pickle=False)
+        model_type = {"svr": "svr", "oneclass": "oneclass"}.get(
+            str(z.get("model_type", "")), "classifier")
+
+    if model_type == "svr":
+        from dpsvm_tpu.models.svr import SVRModel
+        model = SVRModel.load(args.model)
+        x, z_true = load_csv(args.file_path, args.num_ex, args.num_att,
+                             float_labels=True)
+        pred = np.asarray(model.predict(x), np.float64)
+        rmse = float(np.sqrt(np.mean((pred - z_true) ** 2)))
+        ss_tot = float(np.sum((z_true - z_true.mean()) ** 2))
+        r2 = 1.0 - float(np.sum((pred - z_true) ** 2)) / ss_tot if ss_tot else 0.0
+        print(f"loaded SVR model: {model.n_sv} SVs, gamma={model.kernel.gamma}")
+        print(f"test RMSE: {rmse:.6f}  R2: {r2:.4f} ({x.shape[0]} examples)")
+        return 0
+    if model_type == "oneclass":
+        from dpsvm_tpu.models.oneclass import OneClassModel
+        model = OneClassModel.load(args.model)
+        x, y = load_csv(args.file_path, args.num_ex, args.num_att)
+        pred = model.predict(x)
+        print(f"loaded one-class model: {model.n_sv} SVs, rho={model.rho:.6f}")
+        print(f"test inlier fraction: {float(np.mean(pred > 0)):.4f} "
+              f"({x.shape[0]} examples)")
+        if set(np.unique(y).tolist()) <= {-1, 1}:
+            print(f"test accuracy vs +-1 labels: {float(np.mean(pred == y)):.4f}")
+        return 0
 
     model = SVMModel.load(args.model)
     if args.gamma is not None:

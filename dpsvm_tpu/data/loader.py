@@ -20,14 +20,21 @@ def load_csv(
     path: str,
     num_rows: int | None = None,
     num_features: int | None = None,
+    float_labels: bool = False,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Load ``label,f1,...,fd`` CSV -> (x (n,d) float32, y (n,) int32).
+    """Load ``label,f1,...,fd`` CSV -> (x (n,d) float32, y (n,)).
+
+    Labels are int32 (the reference's +-1 classification convention,
+    parse.cpp label stoi) unless ``float_labels`` is set — regression
+    targets (SVR) keep the full float32 value.
 
     num_rows / num_features, when given, must match or bound the file
     contents (the reference requires both and reads exactly num_rows lines,
     parse.cpp:25); when omitted they are inferred.
     """
-    parser = native.get_fastcsv()
+    # The native parser's ABI returns int32 labels (the reference's
+    # convention); float regression targets must take the NumPy path.
+    parser = None if float_labels else native.get_fastcsv()
     if parser is not None:
         x, y = parser.parse(path, num_rows)
     else:
@@ -39,7 +46,8 @@ def load_csv(
         x = x[:, :num_features]
     if num_rows is not None and x.shape[0] < num_rows:
         raise ValueError(f"{path}: file has {x.shape[0]} rows, expected {num_rows}")
-    return np.ascontiguousarray(x, np.float32), y.astype(np.int32)
+    y = y.astype(np.float32) if float_labels else y.astype(np.int32)
+    return np.ascontiguousarray(x, np.float32), y
 
 
 def _load_csv_numpy(path: str, num_rows: int | None):
@@ -47,7 +55,7 @@ def _load_csv_numpy(path: str, num_rows: int | None):
                       max_rows=num_rows, ndmin=2)
     if data.size == 0:
         raise ValueError(f"{path}: empty data file")
-    y = data[:, 0].astype(np.int32)
+    y = data[:, 0]  # float32; load_csv applies the label dtype policy
     x = data[:, 1:]
     return x, y
 

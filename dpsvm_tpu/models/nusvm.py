@@ -41,7 +41,8 @@ from dpsvm_tpu.ops.kernels import KernelParams, blocked_kernel_matvec
 from dpsvm_tpu.solver.result import SolveResult
 
 
-def _solve(x, y, cfg, backend, num_devices, callback, alpha0, f_init):
+def _solve(x, y, cfg, backend, num_devices, callback, alpha0, f_init,
+           checkpoint_path=None, resume=False):
     import jax
 
     if backend == "auto":
@@ -49,11 +50,13 @@ def _solve(x, y, cfg, backend, num_devices, callback, alpha0, f_init):
     if backend == "single":
         from dpsvm_tpu.solver.smo import solve
         return solve(x, y, cfg, callback=callback,
-                     alpha_init=alpha0, f_init=f_init)
+                     alpha_init=alpha0, f_init=f_init,
+                     checkpoint_path=checkpoint_path, resume=resume)
     if backend == "mesh":
         from dpsvm_tpu.parallel.dist_smo import solve_mesh
         return solve_mesh(x, y, cfg, num_devices=num_devices,
-                          callback=callback, alpha_init=alpha0, f_init=f_init)
+                          callback=callback, alpha_init=alpha0, f_init=f_init,
+                          checkpoint_path=checkpoint_path, resume=resume)
     raise ValueError(f"unknown backend {backend!r} (nu trainers support "
                      "'auto' | 'single' | 'mesh')")
 
@@ -95,6 +98,8 @@ def train_nusvc(
     backend: str = "auto",
     num_devices: Optional[int] = None,
     callback=None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> tuple[SVMModel, SolveResult]:
     """Train binary nu-SVC: nu in (0, 1] bounds the margin-error fraction
     from above and the SV fraction from below. config.c is ignored (the
@@ -131,7 +136,7 @@ def train_nusvc(
                          selection="nu")
 
     result = _solve(x, y, cfg, backend, num_devices, callback,
-                    alpha0, f_init)
+                    alpha0, f_init, checkpoint_path, resume)
 
     r1, r2 = _rho_r(result.stats["f"], result.alpha, y, 1.0)
     r = (r1 + r2) / 2.0
@@ -168,6 +173,8 @@ def train_nusvr(
     backend: str = "auto",
     num_devices: Optional[int] = None,
     callback=None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> tuple[SVRModel, SolveResult]:
     """Train nu-SVR: nu replaces epsilon-SVR's tube width (the tube
     adapts so that at most a nu fraction of points fall outside it).
@@ -202,7 +209,7 @@ def train_nusvr(
     cfg = config.replace(c=C, weight_pos=1.0, weight_neg=1.0,
                          selection="nu")
     result = _solve(x2, y2, cfg, backend, num_devices, callback,
-                    alpha0, f_init)
+                    alpha0, f_init, checkpoint_path, resume)
 
     r1, r2 = _rho_r(result.stats["f"], result.alpha,
                     y2.astype(np.float32), C)

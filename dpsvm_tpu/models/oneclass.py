@@ -86,6 +86,8 @@ def train_oneclass(
     backend: str = "auto",
     num_devices: Optional[int] = None,
     callback=None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> tuple[OneClassModel, SolveResult]:
     """Fit nu-one-class SVM: nu bounds the outlier fraction from above and
     the SV fraction from below. config.c is ignored (the OCSVM box is
@@ -117,11 +119,13 @@ def train_oneclass(
     if backend == "single":
         from dpsvm_tpu.solver.smo import solve
         result = solve(x, y, cfg, callback=callback,
-                       alpha_init=alpha0, f_init=f_init)
+                       alpha_init=alpha0, f_init=f_init,
+                       checkpoint_path=checkpoint_path, resume=resume)
     elif backend == "mesh":
         from dpsvm_tpu.parallel.dist_smo import solve_mesh
         result = solve_mesh(x, y, cfg, num_devices=num_devices,
-                            callback=callback, alpha_init=alpha0, f_init=f_init)
+                            callback=callback, alpha_init=alpha0, f_init=f_init,
+                            checkpoint_path=checkpoint_path, resume=resume)
     else:
         raise ValueError(f"unknown backend {backend!r} (one-class supports "
                          "'auto' | 'single' | 'mesh')")

@@ -92,6 +92,8 @@ def train_svr(
     backend: str = "auto",
     num_devices: Optional[int] = None,
     callback=None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> tuple[SVRModel, SolveResult]:
     """Train epsilon-SVR: fit z ~ f(x) within an `svr_epsilon` tube.
 
@@ -120,11 +122,13 @@ def train_svr(
         backend = "mesh" if (num_devices or len(jax.devices())) > 1 else "single"
     if backend == "single":
         from dpsvm_tpu.solver.smo import solve
-        result = solve(x2, y2, config, callback=callback, f_init=f_init)
+        result = solve(x2, y2, config, callback=callback, f_init=f_init,
+                       checkpoint_path=checkpoint_path, resume=resume)
     elif backend == "mesh":
         from dpsvm_tpu.parallel.dist_smo import solve_mesh
         result = solve_mesh(x2, y2, config, num_devices=num_devices,
-                            callback=callback, f_init=f_init)
+                            callback=callback, f_init=f_init,
+                            checkpoint_path=checkpoint_path, resume=resume)
     else:
         raise ValueError(f"unknown backend {backend!r} (svr supports "
                          "'auto' | 'single' | 'mesh')")

@@ -144,3 +144,57 @@ def test_train_cli_block_engine(csvs, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "converged at iteration" in out
+
+
+def test_train_cli_svm_types(csvs, capsys, tmp_path):
+    """LibSVM's -s svm_type role: every problem type trains and evaluates
+    through the CLI."""
+    train_p, test_p, d = csvs
+
+    # nu-SVC: classifier flow, text model.
+    mp = str(tmp_path / "nusvc.txt")
+    rc = main(["train", "-f", train_p, "-m", mp, "-t", "nu-svc",
+               "--nu", "0.3", "-g", "0.1", "--backend", "single", "-q"])
+    assert rc == 0
+    rc = main(["test", "-f", test_p, "-m", mp])
+    assert rc == 0
+    out = capsys.readouterr().out
+    acc = float(out.split("test accuracy: ")[1].split()[0])
+    assert acc > 0.85
+
+    # eps-SVR and nu-SVR: regression flow, .npz model, RMSE/R2 metrics.
+    for t, name in [("eps-svr", "esvr"), ("nu-svr", "nsvr")]:
+        mp = str(tmp_path / f"{name}.npz")
+        rc = main(["train", "-f", train_p, "-m", mp, "-t", t,
+                   "-g", "0.1", "-c", "5", "--backend", "single", "-q"])
+        assert rc == 0
+        rc = main(["test", "-f", test_p, "-m", mp])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "RMSE" in out
+        # +-1 labels as regression targets: a CLI-flow smoke check, not a
+        # solver-quality bar (that lives in test_nusvm/test_svr_oneclass).
+        r2 = float(out.split("R2: ")[1].split()[0])
+        assert r2 > 0.3
+
+    # one-class: inlier-fraction flow.
+    mp = str(tmp_path / "oc.npz")
+    rc = main(["train", "-f", train_p, "-m", mp, "-t", "one-class",
+               "--nu", "0.2", "-g", "0.1", "--backend", "single", "-q"])
+    assert rc == 0
+    rc = main(["test", "-f", test_p, "-m", mp])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "inlier fraction" in out
+
+
+def test_train_cli_svr_model_extension_coerced(csvs, capsys, tmp_path):
+    train_p, _, _ = csvs
+    mp = str(tmp_path / "svr_model.txt")  # wrong extension on purpose
+    rc = main(["train", "-f", train_p, "-m", mp, "-t", "eps-svr",
+               "-g", "0.1", "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "models use the .npz format" in out
+    import os
+    assert os.path.exists(mp + ".npz")

@@ -112,3 +112,19 @@ def test_nu_estimators(blobs):
     from sklearn.base import clone
     clone(ours)
     clone(oursr)
+
+
+def test_nusvc_checkpoint_resume(tmp_path, blobs):
+    x, y = blobs
+    path = str(tmp_path / "nusvc.npz")
+    cfg = CFG.replace(checkpoint_every=16, chunk_iters=16, max_iter=48)
+    m1, r1 = train_nusvc(x, y, nu=0.3, config=cfg, backend="single",
+                         checkpoint_path=path)
+    assert not r1.converged
+    cfg2 = cfg.replace(max_iter=300_000)
+    m2, r2 = train_nusvc(x, y, nu=0.3, config=cfg2, backend="single",
+                         checkpoint_path=path, resume=True)
+    assert r2.converged and r2.iterations > r1.iterations
+    m0, r0 = train_nusvc(x, y, nu=0.3, config=CFG, backend="single")
+    np.testing.assert_allclose(decision_function(m2, x),
+                               decision_function(m0, x), atol=5e-3)
