@@ -82,10 +82,13 @@ def select_block(f, alpha, y, c, q: int, valid=None):
         up = up & valid
         low = low & valid
     h = q // 2
-    neg_up, up_idx = lax.top_k(jnp.where(up, -f, -jnp.inf), h)
-    low_vals, low_idx = lax.top_k(jnp.where(low, f, -jnp.inf), h)
-    return combine_halves(up_idx, jnp.isfinite(neg_up),
-                          low_idx, jnp.isfinite(low_vals))
+    # One batched top_k over both candidate sides (halves the selection
+    # dispatches inside the round loop).
+    scores = jnp.stack([jnp.where(up, -f, -jnp.inf),
+                        jnp.where(low, f, -jnp.inf)])
+    vals, idx = lax.top_k(scores, h)  # (2, h)
+    return combine_halves(idx[0], jnp.isfinite(vals[0]),
+                          idx[1], jnp.isfinite(vals[1]))
 
 
 def combine_halves(up_idx, up_ok, low_idx, low_ok):
