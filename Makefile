@@ -7,7 +7,7 @@
 PY ?= python
 DATA ?= data
 
-.PHONY: test bench smoke native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test bench smoke tpu_smoke native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -17,6 +17,12 @@ bench:
 
 smoke:
 	$(PY) -m dpsvm_tpu.cli smoke
+
+# Real-TPU Mosaic lowering checks for the Pallas kernels (pytest covers
+# them in interpret mode only): every subproblem rule x small/unaligned q,
+# plus end-to-end block/pallas engine solves. Needs the axon TPU free.
+tpu_smoke:
+	$(PY) tools/tpu_smoke.py
 
 # Delegates to the Python builder so the compile command lives in exactly
 # one place (dpsvm_tpu/utils/native.py, which also fingerprints the flags).

@@ -187,6 +187,14 @@ def _cmd_train(args) -> int:
                   f"to {args.svm_type} (per-class nu selection is fixed)",
                   file=sys.stderr)
             return 2
+        if args.svm_type in ("nu-svc", "nu-svr") and args.engine == "pallas":
+            # Checked here too (the trainer raises the same constraint) so
+            # the user gets a clean exit-code-2 error before the CSV is
+            # loaded and the initial-gradient matvec runs.
+            print(f"error: --engine pallas is not applicable to "
+                  f"{args.svm_type} (per-class nu selection; use "
+                  "--engine xla or block)", file=sys.stderr)
+            return 2
         if args.svm_type in ("nu-svc", "one-class") and (
                 args.weight_pos != 1.0 or args.weight_neg != 1.0):
             print(f"error: -w1/-w-1 are not applicable to {args.svm_type} "

@@ -134,9 +134,14 @@ class SVMConfig:
                 "is internal to train_nusvc/train_nusvr)")
         if self.engine not in ("xla", "pallas", "block"):
             raise ValueError("engine must be 'xla', 'pallas' or 'block'")
-        if self.engine in ("pallas", "block") and self.selection != "mvp":
+        if self.engine == "pallas" and self.selection != "mvp":
+            # The fused per-pair Pallas engine pipelines the NEXT mvp
+            # selection into the f-update pass (ops/pallas_fused.py);
+            # other rules run on the xla or block engines (the block
+            # engine supports all three).
             raise ValueError(
-                f"engine={self.engine!r} currently supports selection='mvp' only")
+                "engine='pallas' supports selection='mvp' only "
+                "(use engine='xla' or engine='block')")
         if self.working_set_size < 2:
             raise ValueError("working_set_size must be >= 2")
         if self.inner_iters < 0:

@@ -9,7 +9,8 @@ equality constraints — one per (pseudo-)class — so pair updates must stay
 inside a class. That is the only engine-level change: the trainers run the
 standard solver with `selection="nu"` (per-class maximal-violating-pair,
 ops/select.py select_working_set_nu; distributed variant in
-parallel/dist_smo.py), a feasible warm start that fixes both constraint
+parallel/dist_smo.py; the block engine's per-class-quarter variant in
+solver/block.py select_block), a feasible warm start that fixes both constraint
 values (pair updates conserve them exactly), and a LibSVM-style
 rho/r readout from the final gradient:
 
@@ -127,11 +128,11 @@ def train_nusvc(
     kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
     # p = 0: the engine's indicator is f = y * Q alpha = K @ (alpha * y).
     f_init = blocked_kernel_matvec(x, alpha0 * y, kp, config.dtype)
-    if config.engine != "xla":
+    if config.engine == "pallas":
         raise ValueError(
-            f"engine={config.engine!r} does not implement the per-class "
-            "nu selection; the nu trainers run the per-pair XLA engine "
-            "(set engine='xla' or drop the override)")
+            "engine='pallas' does not implement the per-class nu "
+            "selection; use engine='xla' (per-pair) or engine='block' "
+            "(decomposition with per-class quarters)")
     cfg = config.replace(c=1.0, weight_pos=1.0, weight_neg=1.0,
                          selection="nu")
 
@@ -159,6 +160,10 @@ def train_nusvc(
     # C-SVC path's SVMModel.from_dense(x, y, alpha, b) would.
     result.alpha = alpha_scaled
     result.b = model.b
+    # f = y * Q alpha is linear in alpha, so the same 1/r rescale keeps
+    # the returned (alpha, f) pair internally consistent for consumers
+    # that recompute the dual objective or KKT gap from them.
+    result.stats["f"] = (result.stats["f"] / r).astype(np.float32)
     result.stats["nu_r"] = r
     result.stats["nu_rho"] = rho
     return model, result
@@ -201,11 +206,11 @@ def train_nusvr(
     alpha0[n:] = a
     f_init = np.concatenate([-z, -z]).astype(np.float32)
 
-    if config.engine != "xla":
+    if config.engine == "pallas":
         raise ValueError(
-            f"engine={config.engine!r} does not implement the per-class "
-            "nu selection; the nu trainers run the per-pair XLA engine "
-            "(set engine='xla' or drop the override)")
+            "engine='pallas' does not implement the per-class nu "
+            "selection; use engine='xla' (per-pair) or engine='block' "
+            "(decomposition with per-class quarters)")
     cfg = config.replace(c=C, weight_pos=1.0, weight_neg=1.0,
                          selection="nu")
     result = _solve(x2, y2, cfg, backend, num_devices, callback,

@@ -46,7 +46,7 @@ def _pick1(sel, vec):
 def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
                        ok_ref, alpha_out_ref, t_ref,
                        *, q: int, cp: float, cn: float, eps: float,
-                       tau: float):
+                       tau: float, rule: str):
     lanes = lax.broadcasted_iota(jnp.int32, (1, q), 1)
     y = y_ref[:]
     kd = kd_ref[:]
@@ -69,17 +69,71 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
         low = ((pos & gt_0) | (neg & lt_cn)) & ok
         return up, low
 
+    def gap(up, low, f):
+        """b_lo - b_hi under the rule's convergence definition: global
+        extrema for mvp/second_order, max per-class violation for nu."""
+        if rule == "nu":
+            v_p = (jnp.max(jnp.where(low & pos, f, -_INF))
+                   - jnp.min(jnp.where(up & pos, f, _INF)))
+            v_n = (jnp.max(jnp.where(low & neg, f, -_INF))
+                   - jnp.min(jnp.where(up & neg, f, _INF)))
+            return jnp.maximum(v_p, v_n)
+        return (jnp.max(jnp.where(low, f, -_INF))
+                - jnp.min(jnp.where(up, f, _INF)))
+
     def iteration(carry):
         alpha, f, t = carry
         up, low = masks(alpha)
-        f_up = jnp.where(up, f, _INF)
-        f_low = jnp.where(low, f, -_INF)
-        b_hi = jnp.min(f_up)
-        b_lo = jnp.max(f_low)
-        i = jnp.min(jnp.where(f_up == b_hi, lanes, _IMAX))
-        j = jnp.min(jnp.where(f_low == b_lo, lanes, _IMAX))
+        if rule == "nu":
+            # Per-class MVP; pick the class with the larger violation so
+            # the pair shares a class (the nu duals' per-class equality
+            # constraints; ops/select.py select_working_set_nu). Compute
+            # both classes' candidates and select SCALARS only — Mosaic
+            # cannot legalize a select over i1 (mask) vectors.
+            f_up_p = jnp.where(up & pos, f, _INF)
+            f_low_p = jnp.where(low & pos, f, -_INF)
+            f_up_n = jnp.where(up & neg, f, _INF)
+            f_low_n = jnp.where(low & neg, f, -_INF)
+            bh_p = jnp.min(f_up_p)
+            bl_p = jnp.max(f_low_p)
+            bh_n = jnp.min(f_up_n)
+            bl_n = jnp.max(f_low_n)
+            i_p = jnp.min(jnp.where(f_up_p == bh_p, lanes, _IMAX))
+            j_p = jnp.min(jnp.where(f_low_p == bl_p, lanes, _IMAX))
+            i_n = jnp.min(jnp.where(f_up_n == bh_n, lanes, _IMAX))
+            j_n = jnp.min(jnp.where(f_low_n == bl_n, lanes, _IMAX))
+            take_p = (bl_p - bh_p) >= (bl_n - bh_n)
+            b_hi = jnp.where(take_p, bh_p, bh_n)
+            b_lo = jnp.where(take_p, bl_p, bl_n)
+            i = jnp.where(take_p, i_p, i_n)
+            j = jnp.where(take_p, j_p, j_n)
+            row_i = kb_ref[pl.ds(i, 1), :]  # (1, q)
+        elif rule == "second_order":
+            # LibSVM WSS2: i by max violation; j by max second-order gain
+            # (f_j - b_hi)^2 / eta_ij over row i of the VMEM Gram block.
+            f_up = jnp.where(up, f, _INF)
+            b_hi = jnp.min(f_up)
+            i = jnp.min(jnp.where(f_up == b_hi, lanes, _IMAX))
+            row_i = kb_ref[pl.ds(i, 1), :]
+            sel_i0 = lanes == i
+            diff = f - b_hi
+            eta_j = jnp.maximum(_pick1(sel_i0, kd) + kd - 2.0 * row_i, tau)
+            gain = jnp.where(low & (diff > 0.0), diff * diff / eta_j, -_INF)
+            g_best = jnp.max(gain)
+            j = jnp.min(jnp.where(gain == g_best, lanes, _IMAX))
+            # cond() guarantees an eligible j exists when the body runs
+            # (open gap => some f_low > b_hi).
+            sel_j0 = lanes == j
+            b_lo = _pick1(sel_j0, f)
+        else:
+            f_up = jnp.where(up, f, _INF)
+            f_low = jnp.where(low, f, -_INF)
+            b_hi = jnp.min(f_up)
+            b_lo = jnp.max(f_low)
+            i = jnp.min(jnp.where(f_up == b_hi, lanes, _IMAX))
+            j = jnp.min(jnp.where(f_low == b_lo, lanes, _IMAX))
+            row_i = kb_ref[pl.ds(i, 1), :]  # (1, q)
 
-        row_i = kb_ref[pl.ds(i, 1), :]  # (1, q)
         row_j = kb_ref[pl.ds(j, 1), :]
         sel_i = lanes == i
         sel_j = lanes == j
@@ -103,9 +157,7 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
     def cond(carry):
         alpha, f, t = carry
         up, low = masks(alpha)
-        b_hi = jnp.min(jnp.where(up, f, _INF))
-        b_lo = jnp.max(jnp.where(low, f, -_INF))
-        return (t < limit) & (b_lo > b_hi + 2.0 * eps)
+        return (t < limit) & (gap(up, low, f) > 2.0 * eps)
 
     alpha, _, t = lax.while_loop(
         cond, iteration, (alpha_ref[:], f_ref[:], jnp.int32(0)))
@@ -114,9 +166,9 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("c", "eps", "tau", "interpret"))
+                   static_argnames=("c", "eps", "tau", "rule", "interpret"))
 def solve_subproblem_pallas(kb_w, alpha_w, y_w, f_w, kd_w, slot_ok, limit,
-                            c, eps: float, tau: float,
+                            c, eps: float, tau: float, rule: str = "mvp",
                             interpret: bool = False):
     """Solve the q-variable subproblem on-core.
 
@@ -124,12 +176,14 @@ def solve_subproblem_pallas(kb_w, alpha_w, y_w, f_w, kd_w, slot_ok, limit,
     (slot_ok as 1.0/0.0); `limit` is the dynamic pair-update budget (int32
     scalar — per-round inner_iters already clamped to the remaining
     max_iter budget). Returns (alpha_w_new (q,), n_pairs int32).
+    `rule` is the pairing rule ("mvp" | "second_order" | "nu" — see
+    solver/block.py _solve_subproblem).
     """
     cp, cn = split_c(c)
     q = kb_w.shape[0]
     kern = functools.partial(
         _subproblem_kernel, q=q, cp=float(cp), cn=float(cn),
-        eps=float(eps), tau=float(tau))
+        eps=float(eps), tau=float(tau), rule=rule)
     vec = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     alpha_out, t = pl.pallas_call(

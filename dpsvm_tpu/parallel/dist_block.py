@@ -40,33 +40,52 @@ from dpsvm_tpu.parallel.mesh import DATA_AXIS
 from dpsvm_tpu.solver.block import BlockState, _solve_subproblem, combine_halves
 
 
-def _global_top(vals_loc, gids_loc, h: int):
-    """Replicated global top-h from per-shard top-h candidates.
+def _global_top(scores, gids_loc, h: int):
+    """Replicated global top-h PER ROW from per-shard top-h candidates.
 
-    vals_loc: (n_loc,) scores with -inf at inadmissible rows. Returns
-    (g_ids (h,), ok (h,)) — identical on every device. Ties resolve to the
-    lowest global id (stable top_k + device-major gather order == global
-    row order under contiguous partitioning)."""
-    v, i = lax.top_k(vals_loc, h)
+    scores: (r, n_loc) score rows with -inf at inadmissible entries — all
+    candidate sides ride one batched top_k + all_gather dispatch sequence
+    (same batching as the single-chip select_block). Returns
+    (g_ids (r, h), ok (r, h)) — identical on every device. Ties resolve to
+    the lowest global id (stable top_k + device-major gather order ==
+    global row order under contiguous partitioning)."""
+    r = scores.shape[0]
+    v, i = lax.top_k(scores, h)  # (r, h)
     g = jnp.take(gids_loc, i)
-    av = lax.all_gather(v, DATA_AXIS).reshape(-1)  # (P*h,)
-    ag = lax.all_gather(g, DATA_AXIS).reshape(-1)
+    av = lax.all_gather(v, DATA_AXIS)  # (P, r, h)
+    ag = lax.all_gather(g, DATA_AXIS)
+    av = jnp.moveaxis(av, 0, 1).reshape(r, -1)  # (r, P*h), device-major
+    ag = jnp.moveaxis(ag, 0, 1).reshape(r, -1)
     gv, gi = lax.top_k(av, h)
-    return jnp.take(ag, gi), jnp.isfinite(gv)
+    return jnp.take_along_axis(ag, gi, axis=1), jnp.isfinite(gv)
 
 
-def _select_block_mesh(f, alpha, y, valid, c, q: int):
+def _select_block_mesh(f, alpha, y, valid, c, q: int, rule: str = "mvp"):
     """Distributed working-set selection; replicated (w, slot_ok) result.
-    Same semantics as solver/block.py select_block."""
+    Same semantics as solver/block.py select_block (rule="nu" -> per-class
+    quarters, one equality constraint per class)."""
     cp, cn = split_c(c)
     n_loc = f.shape[0]
     gids = _global_ids(n_loc)
     up = up_mask(alpha, y, cp, cn) & valid
     low = low_mask(alpha, y, cp, cn) & valid
+    if rule == "nu":
+        pos = y > 0
+        h = q // 4
+        scores = jnp.stack([jnp.where(up & pos, -f, -jnp.inf),
+                            jnp.where(low & pos, f, -jnp.inf),
+                            jnp.where(up & ~pos, -f, -jnp.inf),
+                            jnp.where(low & ~pos, f, -jnp.inf)])
+        ids, ok = _global_top(scores, gids, h)
+        w_p, ok_p = combine_halves(ids[0], ok[0], ids[1], ok[1])
+        w_n, ok_n = combine_halves(ids[2], ok[2], ids[3], ok[3])
+        return (jnp.concatenate([w_p, w_n]),
+                jnp.concatenate([ok_p, ok_n]))
     h = q // 2
-    up_idx, up_ok = _global_top(jnp.where(up, -f, -jnp.inf), gids, h)
-    low_idx, low_ok = _global_top(jnp.where(low, f, -jnp.inf), gids, h)
-    return combine_halves(up_idx, up_ok, low_idx, low_ok)
+    scores = jnp.stack([jnp.where(up, -f, -jnp.inf),
+                        jnp.where(low, f, -jnp.inf)])
+    ids, ok = _global_top(scores, gids, h)
+    return combine_halves(ids[0], ok[0], ids[1], ok[1])
 
 
 def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
@@ -90,8 +109,10 @@ def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
 def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                             tau: float, q: int, inner_iters: int,
                             rounds_per_chunk: int, inner_impl: str = "xla",
-                            interpret: bool = False):
-    """Build the jitted shard_mapped block-round chunk executor."""
+                            interpret: bool = False,
+                            selection: str = "mvp"):
+    """Build the jitted shard_mapped block-round chunk executor.
+    selection: "mvp" | "second_order" | "nu" (solver/block.py rules)."""
     cp, cn = split_c(c)
 
     def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
@@ -105,7 +126,7 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
 
         def body(st: BlockState):
             w, slot_ok = _select_block_mesh(
-                st.f, st.alpha, y_loc, valid_loc, c, q)
+                st.f, st.alpha, y_loc, valid_loc, c, q, rule=selection)
             scal_loc = jnp.stack(
                 [x_sq_loc, k_diag_loc, st.alpha, y_loc, st.f], axis=1)
             qx, scal, l, own = _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc)
@@ -124,11 +145,11 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                 alpha_w, t = solve_subproblem_pallas(
                     kb_w, alpha_w0, y_w, f_w0, kd_w,
                     slot_ok.astype(jnp.float32), limit, c, eps, tau,
-                    interpret=interpret)
+                    rule=selection, interpret=interpret)
             else:
                 alpha_w, _, t = _solve_subproblem(
                     kb_w, kd_w, slot_ok, alpha_w0, y_w, f_w0, c, eps, tau,
-                    limit)
+                    limit, rule=selection)
 
             # Fold: purely LOCAL (q, n_loc) kernel-row matmul.
             coef = jnp.where(slot_ok, (alpha_w - alpha_w0) * y_w, 0.0)
@@ -147,8 +168,25 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             # Global convergence extrema (values only -> pmin/pmax).
             up = up_mask(alpha, y_loc, cp, cn) & valid_loc
             low = low_mask(alpha, y_loc, cp, cn) & valid_loc
-            b_hi = lax.pmin(jnp.min(jnp.where(up, f, jnp.inf)), DATA_AXIS)
-            b_lo = lax.pmax(jnp.max(jnp.where(low, f, -jnp.inf)), DATA_AXIS)
+            if selection == "nu":
+                # Per-class extrema; report the class with the larger
+                # violation so b_lo - b_hi is LibSVM's nu stopping gap
+                # (ops/select.py select_working_set_nu).
+                pos = y_loc > 0
+                bh_p = lax.pmin(jnp.min(jnp.where(up & pos, f, jnp.inf)),
+                                DATA_AXIS)
+                bl_p = lax.pmax(jnp.max(jnp.where(low & pos, f, -jnp.inf)),
+                                DATA_AXIS)
+                bh_n = lax.pmin(jnp.min(jnp.where(up & ~pos, f, jnp.inf)),
+                                DATA_AXIS)
+                bl_n = lax.pmax(jnp.max(jnp.where(low & ~pos, f, -jnp.inf)),
+                                DATA_AXIS)
+                take_p = (bl_p - bh_p) >= (bl_n - bh_n)
+                b_hi = jnp.where(take_p, bh_p, bh_n)
+                b_lo = jnp.where(take_p, bl_p, bl_n)
+            else:
+                b_hi = lax.pmin(jnp.min(jnp.where(up, f, jnp.inf)), DATA_AXIS)
+                b_lo = lax.pmax(jnp.max(jnp.where(low, f, -jnp.inf)), DATA_AXIS)
             return BlockState(alpha, f, b_hi, b_lo,
                               st.pairs + t, st.rounds + 1)
 

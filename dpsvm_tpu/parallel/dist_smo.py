@@ -449,10 +449,12 @@ def solve_mesh(
         from dpsvm_tpu.parallel.dist_block import make_block_chunk_runner
         from dpsvm_tpu.solver.block import BlockState
 
-        # Block height clamped so each shard can produce q/2 candidates.
+        # Block height clamped so each shard can produce q/2 candidates
+        # (q/4 per class quarter under the nu rule).
         n_loc = n_pad // n_dev
-        q = max(2, min(config.working_set_size, 2 * n_loc))
-        q -= q % 2
+        gran = 4 if config.selection == "nu" else 2
+        q = max(gran, min(config.working_set_size, gran * n_loc))
+        q -= q % gran
         inner = config.inner_iters or q
         rounds_per_chunk = (max(1, chunk_len // inner)
                             if observe else _UNOBSERVED_CHUNK)
@@ -460,7 +462,8 @@ def solve_mesh(
                       else "xla")
         run_chunk = make_block_chunk_runner(
             mesh, kp, config.c_bounds(), float(config.epsilon),
-            float(config.tau), q, inner, rounds_per_chunk, inner_impl)
+            float(config.tau), q, inner, rounds_per_chunk, inner_impl,
+            selection=config.selection)
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
                            rounds=jax.device_put(jnp.int32(0), rep))

@@ -41,7 +41,7 @@ from jax import lax
 
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
 from dpsvm_tpu.ops.select import (c_of, low_mask, select_working_set,
-                                  split_c, up_mask)
+                                  select_working_set_nu, split_c, up_mask)
 from dpsvm_tpu.solver.smo import pair_alpha_update
 
 
@@ -63,7 +63,7 @@ class BlockState(NamedTuple):
         return jnp.int32(0)
 
 
-def select_block(f, alpha, y, c, q: int, valid=None):
+def select_block(f, alpha, y, c, q: int, valid=None, rule: str = "mvp"):
     """Pick the q most-violating points: q/2 from I_up (smallest f) and
     q/2 from I_low (largest f). Returns (w, slot_ok):
 
@@ -74,6 +74,12 @@ def select_block(f, alpha, y, c, q: int, valid=None):
     duplicate low-half slot is masked out so each global index occupies at
     most one live slot (two live slots for one point would let the inner
     loop update the same alpha through two disagreeing copies).
+
+    rule="nu" splits the block into per-class quarters instead (q/4 from
+    each of I_up/I_low within each class): the nu duals carry one equality
+    constraint per class, so the subproblem must be able to pair within
+    BOTH classes (ops/select.py select_working_set_nu) — a W with only one
+    class's violators could stall the other class's gap.
     """
     cp, cn = split_c(c)
     up = up_mask(alpha, y, cp, cn)
@@ -81,6 +87,21 @@ def select_block(f, alpha, y, c, q: int, valid=None):
     if valid is not None:
         up = up & valid
         low = low & valid
+    if rule == "nu":
+        pos = y > 0
+        h = q // 4
+        scores = jnp.stack([jnp.where(up & pos, -f, -jnp.inf),
+                            jnp.where(low & pos, f, -jnp.inf),
+                            jnp.where(up & ~pos, -f, -jnp.inf),
+                            jnp.where(low & ~pos, f, -jnp.inf)])
+        vals, idx = lax.top_k(scores, h)  # (4, h)
+        # Dedup within a class only (the classes are disjoint).
+        w_p, ok_p = combine_halves(idx[0], jnp.isfinite(vals[0]),
+                                   idx[1], jnp.isfinite(vals[1]))
+        w_n, ok_n = combine_halves(idx[2], jnp.isfinite(vals[2]),
+                                   idx[3], jnp.isfinite(vals[3]))
+        return (jnp.concatenate([w_p, w_n]),
+                jnp.concatenate([ok_p, ok_n]))
     h = q // 2
     # One batched top_k over both candidate sides (halves the selection
     # dispatches inside the round loop).
@@ -107,7 +128,7 @@ def combine_halves(up_idx, up_ok, low_idx, low_ok):
 
 
 def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
-                      eps: float, tau: float, limit):
+                      eps: float, tau: float, limit, rule: str = "mvp"):
     """Exact SMO on the q-variable subproblem. All state is q-sized.
 
     kb_w: (q, q) Gram block K(w_i, w_j); kd_w: (q,) its diagonal. `limit`
@@ -116,6 +137,15 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
     Returns (alpha_w, f_w, n_pairs). The first iteration reproduces the
     reference's maximal-violating-pair step exactly (the global argmin /
     argmax live in W by construction).
+
+    rule selects the pairing inside W:
+      "mvp"          — maximal-violating pair (reference algorithm);
+      "second_order" — i by max violation, j by max second-order gain
+                       (f_j - b_hi)^2 / eta_ij over K(W, W)'s row i —
+                       LibSVM's WSS2 at essentially zero extra cost
+                       because the Gram block is already resident;
+      "nu"           — per-class MVP (both pair members share a class;
+                       the nu duals' two-equality-constraint rule).
     """
     cp, cn = split_c(c)
 
@@ -127,15 +157,39 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
         alpha_w, f_w, t, _ = carry
         up = up_mask(alpha_w, y_w, cp, cn) & slot_ok
         low = low_mask(alpha_w, y_w, cp, cn) & slot_ok
-        f_up = jnp.where(up, f_w, jnp.inf)
-        f_low = jnp.where(low, f_w, -jnp.inf)
-        i = jnp.argmin(f_up).astype(jnp.int32)
-        j = jnp.argmax(f_low).astype(jnp.int32)
-        b_hi_l = f_up[i]
-        b_lo_l = f_low[j]
-        gap_open = b_lo_l > b_hi_l + 2.0 * eps
+        if rule == "nu":
+            # The per-class pairing rule already exists as
+            # select_working_set_nu; slot_ok plays the valid-mask role.
+            i, b_hi_l, j, b_lo_l = select_working_set_nu(
+                f_w, alpha_w, y_w, c, valid=slot_ok)
+            gap_open = b_lo_l > b_hi_l + 2.0 * eps
+            row_i = lax.dynamic_index_in_dim(kb_w, i, 0, keepdims=False)
+        elif rule == "second_order":
+            f_up = jnp.where(up, f_w, jnp.inf)
+            f_low = jnp.where(low, f_w, -jnp.inf)
+            i = jnp.argmin(f_up).astype(jnp.int32)
+            b_hi_l = f_up[i]
+            b_lo_max = jnp.max(f_low)  # convergence uses the max violator
+            gap_open = b_lo_max > b_hi_l + 2.0 * eps
+            row_i = lax.dynamic_index_in_dim(kb_w, i, 0, keepdims=False)
+            diff = f_w - b_hi_l
+            eta_j = jnp.maximum(kd_w[i] + kd_w - 2.0 * row_i, tau)
+            gain = jnp.where(low & (diff > 0), diff * diff / eta_j,
+                             -jnp.inf)
+            # gap_open implies an eligible j exists (some f_low > b_hi);
+            # when closed the update is gated off anyway.
+            j = jnp.where(gap_open, jnp.argmax(gain), i).astype(jnp.int32)
+            b_lo_l = f_w[j]
+        else:
+            f_up = jnp.where(up, f_w, jnp.inf)
+            f_low = jnp.where(low, f_w, -jnp.inf)
+            i = jnp.argmin(f_up).astype(jnp.int32)
+            j = jnp.argmax(f_low).astype(jnp.int32)
+            b_hi_l = f_up[i]
+            b_lo_l = f_low[j]
+            gap_open = b_lo_l > b_hi_l + 2.0 * eps
+            row_i = lax.dynamic_index_in_dim(kb_w, i, 0, keepdims=False)
 
-        row_i = lax.dynamic_index_in_dim(kb_w, i, 0, keepdims=False)  # (q,)
         row_j = lax.dynamic_index_in_dim(kb_w, j, 0, keepdims=False)
         eta = jnp.maximum(kd_w[i] + kd_w[j] - 2.0 * row_i[j], tau)
         y_i = y_w[i]
@@ -161,18 +215,23 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
 
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
                                   "inner_iters", "rounds_per_chunk",
-                                  "inner_impl", "interpret"))
+                                  "inner_impl", "interpret", "selection"))
 def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
                     kp: KernelParams, c, eps: float, tau: float,
                     q: int, inner_iters: int, rounds_per_chunk: int,
                     inner_impl: str = "xla",
-                    interpret: bool = False) -> BlockState:
+                    interpret: bool = False,
+                    selection: str = "mvp") -> BlockState:
     """Run up to `rounds_per_chunk` outer rounds fully on device.
 
     inner_impl: "xla" runs the subproblem as a lax.while_loop of XLA ops
     (portable); "pallas" runs it as one on-core kernel
     (ops/pallas_subproblem.py) — same algebra, far lower per-pair dispatch
-    cost on real TPUs."""
+    cost on real TPUs.
+
+    selection: "mvp" | "second_order" | "nu" — the subproblem pairing rule
+    (see _solve_subproblem). "nu" also switches the outer block selection
+    to per-class quarters and the convergence gap to the per-class rule."""
     end = state.rounds + rounds_per_chunk
 
     def cond(st: BlockState):
@@ -180,7 +239,8 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
                 & (st.b_lo > st.b_hi + 2.0 * eps))
 
     def body(st: BlockState):
-        w, slot_ok = select_block(st.f, st.alpha, y, c, q)
+        w, slot_ok = select_block(st.f, st.alpha, y, c, q,
+                                  rule=selection)
         qx = jnp.take(x, w, axis=0)  # (q, d)
         qsq = jnp.take(x_sq, w)
         dots_w = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
@@ -200,11 +260,11 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
             alpha_w, t = solve_subproblem_pallas(
                 kb_w, alpha_w0, y_w, f_w0, kd_w,
                 slot_ok.astype(jnp.float32), limit, c, eps, tau,
-                interpret=interpret)
+                rule=selection, interpret=interpret)
         else:
             alpha_w, _, t = _solve_subproblem(
                 kb_w, kd_w, slot_ok, alpha_w0, y_w, f_w0, c, eps, tau,
-                limit)
+                limit, rule=selection)
 
         # Fold the round's alpha deltas into the global state with one
         # fused matmul chain over X (the single O(n d q) pass per round):
@@ -219,7 +279,9 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
         safe_w = jnp.where(slot_ok, w, jnp.int32(st.alpha.shape[0]))
         alpha = st.alpha.at[safe_w].set(
             jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
-        _, b_hi, _, b_lo = select_working_set(f, alpha, y, c)
+        select_global = (select_working_set_nu if selection == "nu"
+                         else select_working_set)
+        _, b_hi, _, b_lo = select_global(f, alpha, y, c)
         return BlockState(alpha, f, b_hi, b_lo, st.pairs + t, st.rounds + 1)
 
     return lax.while_loop(cond, body, state)

@@ -87,9 +87,19 @@ def smo_reference(
     it = 0
     b_hi = np.float32(0.0)
     b_lo = np.float32(0.0)
+    empty_iset = False
     while it < config.max_iter:
         up = np.where(yp, alpha < c_arr, alpha > 0)
         low = np.where(yp, alpha > 0, alpha < c_arr)
+        if not up.any() or not low.any():
+            # Degenerate I-set (single-class data, extreme class-weight/C
+            # corners): no feasible ascent pair exists, so the current
+            # iterate is optimal. Without this guard the argmin below
+            # reads a finite junk f value through the all-inf mask and
+            # can mis-decide convergence. Mirrors the native twin's
+            # `if (i_hi < 0 || i_lo < 0) break` (native/seqsmo.cpp).
+            empty_iset = True
+            break
         f_up = np.where(up, f, np.inf)
         f_low = np.where(low, f, -np.inf)
         i_hi = int(np.argmin(f_up))
@@ -142,7 +152,10 @@ def smo_reference(
         if not (b_lo > b_hi + 2.0 * eps):
             break
 
-    converged = not (b_lo > b_hi + 2.0 * eps)
+    # On the empty-I-set break b_hi/b_lo are the PREVIOUS iteration's
+    # (pre-update) envelope, whose gap may still read open — but the break
+    # itself certifies optimality (the true gap is -inf).
+    converged = empty_iset or not (b_lo > b_hi + 2.0 * eps)
     return SolveResult(
         alpha=alpha,
         b=float((b_lo + b_hi) / 2.0),

@@ -489,9 +489,11 @@ def solve(
         from dpsvm_tpu.solver.block import BlockState, run_chunk_block
 
         # Clamp the block height to the dataset (top_k k <= n), kept even
-        # so the up/low halves stay balanced.
-        q = max(2, min(config.working_set_size, n_pad))
-        q -= q % 2
+        # so the up/low halves stay balanced (multiple of 4 for the nu
+        # rule's per-class quarters).
+        gran = 4 if config.selection == "nu" else 2
+        q = max(gran, min(config.working_set_size, n_pad))
+        q -= q % gran
         inner = config.inner_iters or q
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
@@ -540,7 +542,8 @@ def solve(
                 x_dev, y_dev, x_sq, k_diag, state, max_iter,
                 kp, config.c_bounds(), float(config.epsilon), float(config.tau),
                 q, inner, rounds_per_chunk,
-                inner_impl="pallas" if not interpret else "xla")
+                inner_impl="pallas" if not interpret else "xla",
+                selection=config.selection)
         else:
             state = _run_chunk(x_dev, y_dev, x_sq, k_diag, None, state, max_iter,
                                kp, config.c_bounds(), float(config.epsilon),

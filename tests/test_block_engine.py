@@ -127,6 +127,65 @@ def test_pallas_subproblem_matches_xla(blobs_small):
                                rtol=1e-6, atol=1e-7)
 
 
+def test_block_wss2_matches_per_pair_optimum(blobs_small):
+    """engine='block' + selection='second_order' (WSS2 j-selection inside
+    the subproblem, nearly free since K(W,W) is resident) reaches the same
+    fixed point as the per-pair engine."""
+    x, y = blobs_small
+    kp = KernelParams("rbf", CFG.gamma)
+    r_ref = solve(x, y, CFG)
+    r_w2 = solve(x, y, CFG.replace(engine="block", working_set_size=32,
+                                   selection="second_order"))
+    assert r_w2.converged
+    assert r_w2.stats["outer_rounds"] > 0
+    assert dual_objective(x, y, r_w2.alpha, kp) == pytest.approx(
+        dual_objective(x, y, r_ref.alpha, kp), rel=1e-4)
+    assert r_w2.b == pytest.approx(r_ref.b, abs=5e-3)
+    viol = kkt_violation(x, y, r_w2.alpha, CFG.c, CFG.c, kp)
+    assert viol <= 2 * CFG.epsilon + 1e-4
+
+
+@pytest.mark.parametrize("rule", ["mvp", "second_order", "nu"])
+def test_pallas_subproblem_rules_match_xla(blobs_small, rule):
+    """Every subproblem pairing rule must agree between the XLA while_loop
+    and the Pallas kernel (interpret mode on CPU): same pair count, same
+    final alpha."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+    from dpsvm_tpu.solver.block import _solve_subproblem, select_block
+
+    x, y = blobs_small
+    kp = KernelParams("rbf", 0.2)
+    n = x.shape[0]
+    rng = np.random.default_rng(1)
+    alpha = np.clip(rng.normal(0.5, 0.5, n), 0, CFG.c).astype(np.float32)
+    K = np.asarray(kernel_matrix(x, x, kp))
+    f = ((alpha * y) @ K - y).astype(np.float32)
+
+    q = 32
+    w, ok = select_block(jnp.asarray(f), jnp.asarray(alpha),
+                         jnp.asarray(y, jnp.float32), CFG.c, q,
+                         rule=rule)
+    w_np = np.asarray(w)
+    kb_w = jnp.asarray(K[np.ix_(w_np, w_np)].astype(np.float32))
+    kd_w = jnp.asarray(np.diag(K)[w_np].astype(np.float32))
+    a_w = jnp.asarray(alpha[w_np])
+    y_w = jnp.asarray(y[w_np].astype(np.float32))
+    f_w = jnp.asarray(f[w_np])
+
+    a_xla, _, t_xla = _solve_subproblem(
+        kb_w, kd_w, ok, a_w, y_w, f_w, CFG.c, CFG.epsilon, CFG.tau,
+        jnp.int32(64), rule=rule)
+    a_pl, t_pl = solve_subproblem_pallas(
+        kb_w, a_w, y_w, f_w, kd_w, ok.astype(jnp.float32), jnp.int32(64),
+        CFG.c, CFG.epsilon, CFG.tau, rule=rule, interpret=True)
+    assert int(t_xla) > 0
+    assert int(t_xla) == int(t_pl)
+    np.testing.assert_allclose(np.asarray(a_xla), np.asarray(a_pl),
+                               rtol=1e-6, atol=1e-7)
+
+
 def test_block_checkpoint_resume(tmp_path, blobs_small):
     x, y = blobs_small
     path = str(tmp_path / "blk.npz")

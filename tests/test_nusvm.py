@@ -94,6 +94,51 @@ def test_nusvr_mesh_matches_single(blobs):
     np.testing.assert_allclose(m8.predict(x), m1.predict(x), atol=1e-3)
 
 
+def test_nusvc_block_engine_matches_xla(blobs):
+    """The block engine's per-class-quarter selection + per-class-pair
+    subproblem reaches the same nu-SVC solution as the per-pair engine."""
+    x, y = blobs
+    m1, r1 = train_nusvc(x, y, nu=0.3, config=CFG, backend="single")
+    mb, rb = train_nusvc(x, y, nu=0.3,
+                         config=CFG.replace(engine="block",
+                                            working_set_size=32),
+                         backend="single")
+    assert rb.converged
+    assert rb.stats["outer_rounds"] > 0
+    assert abs(mb.n_sv - m1.n_sv) <= max(3, 0.03 * m1.n_sv)
+    np.testing.assert_allclose(decision_function(mb, x),
+                               decision_function(m1, x), atol=8e-2)
+    assert rb.stats["nu_r"] == pytest.approx(r1.stats["nu_r"], rel=1e-2)
+
+
+def test_nusvr_block_engine_matches_xla(blobs):
+    x, _ = blobs
+    rng = np.random.default_rng(1)
+    z = (np.sin(x[:, 0] * 2) + 0.1 * rng.normal(size=x.shape[0])).astype(np.float32)
+    m1, r1 = train_nusvr(x, z, nu=0.4, c=2.0, config=CFG, backend="single")
+    mb, rb = train_nusvr(x, z, nu=0.4, c=2.0,
+                         config=CFG.replace(engine="block",
+                                            working_set_size=32),
+                         backend="single")
+    assert rb.converged
+    np.testing.assert_allclose(mb.predict(x), m1.predict(x), atol=5e-2)
+    assert rb.stats["nu_tube_eps"] == pytest.approx(
+        r1.stats["nu_tube_eps"], abs=2e-2)
+
+
+def test_nusvc_block_mesh_matches_single(blobs):
+    """Distributed block engine under the nu rule (per-class quarters via
+    all_gather, per-class pmin/pmax stopping gap)."""
+    x, y = blobs
+    cfg = CFG.replace(engine="block", working_set_size=32)
+    m1, r1 = train_nusvc(x, y, nu=0.3, config=cfg, backend="single")
+    m8, r8 = train_nusvc(x, y, nu=0.3, config=cfg, backend="mesh",
+                         num_devices=8)
+    assert r8.converged
+    np.testing.assert_allclose(decision_function(m8, x),
+                               decision_function(m1, x), atol=8e-2)
+
+
 def test_nu_estimators(blobs):
     from dpsvm_tpu.estimators import NuSVC as OurNuSVC, NuSVR as OurNuSVR
     from sklearn.svm import NuSVC, NuSVR

@@ -91,6 +91,21 @@ def test_oracle_dual_objective_matches_libsvm(blobs_small):
     assert ours == pytest.approx(theirs, rel=0.02)
 
 
+def test_oracle_empty_iset_guard():
+    # Single-class data: at alpha=0 the I_low set is empty (no y=+1 with
+    # alpha>0, no y=-1 at all). Without the guard, argmax over the all-inf
+    # masked f reads a finite junk value and the solver performs a bogus
+    # pair update; with it, the iterate is recognized as optimal at once
+    # (mirrors native/seqsmo.cpp's i_hi<0 || i_lo<0 break).
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = np.ones(64, np.int32)
+    res = smo_reference(x, y, SVMConfig(c=1.0, gamma=0.1, max_iter=1000))
+    assert res.converged
+    assert res.iterations == 0
+    assert np.all(res.alpha == 0.0)
+
+
 @pytest.mark.parametrize("kernel", ["linear", "poly", "sigmoid"])
 def test_oracle_other_kernels_converge(blobs_small, kernel):
     x, y = blobs_small

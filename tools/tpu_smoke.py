@@ -1,0 +1,118 @@
+"""On-device (real TPU) smoke checks for the Pallas kernels.
+
+The pytest suite runs everything on CPU (interpret mode for Pallas), so
+real Mosaic lowering is only otherwise exercised by bench.py's single
+q=128 mvp configuration. This script drives the lowering-sensitive
+surface on the actual chip:
+
+  * solve_subproblem_pallas for every pairing rule (mvp / second_order /
+    nu) x q in {16, 40, 128} — small and non-lane-aligned q included
+    (solve/solve_mesh auto-select the Pallas inner for arbitrary even q);
+  * one end-to-end block-engine solve per rule (the inner_impl="pallas"
+    path of solver/block.py run_chunk_block);
+  * one fused per-pair Pallas engine solve (ops/pallas_fused.py).
+
+Each Pallas result is compared against the XLA implementation of the same
+computation. Exits nonzero on any mismatch. Run via `make tpu_smoke`
+(needs the axon TPU free — one client process at a time).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print(f"SKIP: first device is {dev.platform!r}, not tpu")
+        return 0
+    print(f"device: {dev.device_kind}")
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.synth import make_blobs_binary
+    from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
+    from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+    from dpsvm_tpu.solver.block import _solve_subproblem, select_block
+    from dpsvm_tpu.solver.smo import solve
+
+    cfg = SVMConfig(c=5.0, gamma=0.2, epsilon=1e-3)
+    kp = KernelParams("rbf", cfg.gamma)
+    x, y = make_blobs_binary(n=300, d=10, seed=3, sep=1.2)
+    rng = np.random.default_rng(1)
+    alpha = np.clip(rng.normal(0.5, 0.5, 300), 0, cfg.c).astype(np.float32)
+    K = np.asarray(kernel_matrix(x, x, kp))
+    f = ((alpha * y) @ K - y).astype(np.float32)
+
+    failures = 0
+    for rule in ("mvp", "second_order", "nu"):
+        for q in (16, 40, 128):
+            w, ok = select_block(jnp.asarray(f), jnp.asarray(alpha),
+                                 jnp.asarray(y, jnp.float32), cfg.c, q,
+                                 rule=rule)
+            w_np = np.asarray(w)
+            kb_w = jnp.asarray(K[np.ix_(w_np, w_np)].astype(np.float32))
+            kd_w = jnp.asarray(np.diag(K)[w_np].astype(np.float32))
+            a_w = jnp.asarray(alpha[w_np])
+            y_w = jnp.asarray(y[w_np].astype(np.float32))
+            f_w = jnp.asarray(f[w_np])
+            a_x, _, t_x = _solve_subproblem(
+                kb_w, kd_w, ok, a_w, y_w, f_w, cfg.c, cfg.epsilon,
+                cfg.tau, jnp.int32(64), rule=rule)
+            a_p, t_p = solve_subproblem_pallas(
+                kb_w, a_w, y_w, f_w, kd_w, ok.astype(jnp.float32),
+                jnp.int32(64), cfg.c, cfg.epsilon, cfg.tau, rule=rule)
+            same_t = int(t_x) == int(t_p)
+            close = np.allclose(np.asarray(a_x), np.asarray(a_p),
+                                rtol=1e-5, atol=1e-6)
+            status = "OK" if (same_t and close) else "FAIL"
+            failures += status == "FAIL"
+            print(f"subproblem rule={rule:13s} q={q:4d} pairs={int(t_p):3d} "
+                  f"{status}")
+
+    # End-to-end block solves on device (inner_impl='pallas' path).
+    r_ref = solve(x, y, cfg)
+    for rule in ("mvp", "second_order"):
+        r = solve(x, y, cfg.replace(engine="block", working_set_size=40,
+                                    selection=rule))
+        db = abs(r.b - r_ref.b)
+        status = "OK" if (r.converged and db < 5e-2) else "FAIL"
+        failures += status == "FAIL"
+        print(f"block-engine selection={rule:13s} pairs={r.iterations} "
+              f"|b-b_ref|={db:.4f} {status}")
+    from dpsvm_tpu.models.nusvm import train_nusvc
+
+    m1, _ = train_nusvc(x, y, nu=0.3, config=cfg)
+    mb, rb = train_nusvc(x, y, nu=0.3,
+                         config=cfg.replace(engine="block",
+                                            working_set_size=40))
+    from dpsvm_tpu.predict import decision_function
+
+    dd = float(np.max(np.abs(decision_function(m1, x)
+                             - decision_function(mb, x))))
+    status = "OK" if (rb.converged and dd < 0.1) else "FAIL"
+    failures += status == "FAIL"
+    print(f"block-engine nu-svc max|ddec|={dd:.4f} {status}")
+
+    # Fused per-pair Pallas engine.
+    r_pl = solve(x, y, cfg.replace(engine="pallas"))
+    db = abs(r_pl.b - r_ref.b)
+    status = "OK" if (r_pl.converged and db < 5e-3) else "FAIL"
+    failures += status == "FAIL"
+    print(f"pallas per-pair engine iters={r_pl.iterations} "
+          f"|b-b_ref|={db:.5f} {status}")
+
+    print("TPU SMOKE:", "PASS" if failures == 0 else f"{failures} FAILURES")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
