@@ -7,9 +7,14 @@
 PY ?= python
 DATA ?= data
 
-.PHONY: test bench smoke tpu_smoke native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all bench smoke tpu_smoke multihost_check parity native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
+# Quick loop (slow-marked parity/scale tests deselected); test_all is the
+# full suite the CI/driver runs.
 test:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+test_all:
 	$(PY) -m pytest tests/ -q
 
 bench:
@@ -23,6 +28,17 @@ smoke:
 # plus end-to-end block/pallas engine solves. Needs the axon TPU free.
 tpu_smoke:
 	$(PY) tools/tpu_smoke.py
+
+# Two-process jax.distributed bring-up (the mpirun --hostfile equivalent,
+# ref Makefile:74): cross-process mesh + collectives + a distributed
+# block-engine chunk, all on CPU.
+multihost_check:
+	$(PY) tools/multihost_check.py
+
+# Mid-scale LibSVM parity table -> PARITY.md (single-chip cases on the
+# real TPU; mesh cases on the virtual 8-device CPU platform).
+parity:
+	$(PY) tools/parity.py
 
 # Delegates to the Python builder so the compile command lives in exactly
 # one place (dpsvm_tpu/utils/native.py, which also fingerprints the flags).

@@ -21,7 +21,10 @@ both sides (CUDA kernels are prebuilt; the XLA chunk executor is warmed
 first). Reported value is the best of three measured runs to absorb
 first-execution device ramp and harness jitter.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus the
+honesty fields {"pair_updates", "pairs_per_second",
+"projected_seconds_at_ref_cap", "dataset"} (see the comment above the
+final print for what each asserts).
 """
 
 from __future__ import annotations
@@ -88,17 +91,36 @@ def main() -> int:
     assert abs(obj_t - obj_r) <= 0.005 * abs(obj_r), (obj_t, obj_r)
     assert abs(res.n_sv - ref.n_sv) <= 0.10 * ref.n_sv, (res.n_sv, ref.n_sv)
 
+    pairs_per_second = res.iterations / max(seconds, 1e-9)
     print(
         f"[bench] device={jax.devices()[0]} iters={res.iterations} "
         f"converged={res.converged} n_sv={res.n_sv} "
-        f"iters/s={res.iterations / max(seconds, 1e-9):.0f}",
+        f"iters/s={pairs_per_second:.0f}",
         file=sys.stderr)
 
+    # Honesty notes, embedded in the output rather than buried here:
+    # the dataset is SYNTHETIC (real MNIST is not shipped in this image)
+    # and its iteration count to convergence differs from real MNIST's, so
+    # the wall-clock ratio is not iteration-for-iteration comparable. Two
+    # fields make the claim robust to that: pairs_per_second is the
+    # data-independent invariant rate, and projected_seconds_at_ref_cap is
+    # the wall-clock this configuration would need for 100k pair updates —
+    # the reference config's max_iter budget (reference Makefile:74),
+    # which bounds any run the reference itself would have accepted.
     print(json.dumps({
-        "metric": "mnist-even-odd-60kx784 RBF modified-SMO training wall-clock, 1 chip (ref: 46s on 10x GTX780 / 137s on 1x GTX780)",
+        "metric": (
+            f"synthetic MNIST-even-odd-shaped 60kx784 RBF modified-SMO "
+            f"training wall-clock, 1 chip, {res.iterations} pair updates "
+            f"to eps=0.01 convergence (ref baseline: 46 s on 10x GTX780 "
+            f"on real MNIST; iteration counts differ across datasets — "
+            f"see pairs_per_second / projected_seconds_at_ref_cap)"),
         "value": round(seconds, 3),
         "unit": "seconds",
         "vs_baseline": round(BASELINE_10GPU_SECONDS / seconds, 3),
+        "pair_updates": int(res.iterations),
+        "pairs_per_second": round(pairs_per_second),
+        "projected_seconds_at_ref_cap": round(100_000 / pairs_per_second, 3),
+        "dataset": "synthetic make_mnist_like(n=60000, d=784, seed=7, noise=0.1)",
     }))
     return 0
 

@@ -1,5 +1,6 @@
 from dpsvm_tpu.data.loader import load_csv, save_csv
-from dpsvm_tpu.data.synth import make_blobs_binary, make_mnist_like
+from dpsvm_tpu.data.synth import (make_adult_like, make_blobs_binary,
+                                  make_mnist_like)
 from dpsvm_tpu.data.converters import (
     libsvm_to_csv,
     mnist_to_odd_even,
@@ -10,6 +11,7 @@ from dpsvm_tpu.data.converters import (
 __all__ = [
     "load_csv",
     "save_csv",
+    "make_adult_like",
     "make_blobs_binary",
     "make_mnist_like",
     "libsvm_to_csv",

@@ -1,0 +1,32 @@
+"""Environment construction for subprocess re-execs that need a virtual
+multi-device CPU JAX platform.
+
+In this image a sitecustomize hook registers the (single-chip, tunneled)
+axon TPU backend at interpreter startup, keyed on PALLAS_AXON_POOL_IPS;
+once any backend initializes, the platform can no longer be switched
+in-process. Every harness that wants an N-device CPU platform therefore
+re-execs a child with this cleaned environment. Shared here so the
+stripping rules live in exactly one place (used by
+__graft_entry__.dryrun_multichip, tools/parity.py,
+tools/multihost_check.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def cleaned_cpu_env(n_devices: int,
+                    base: Optional[dict] = None) -> dict:
+    """A copy of `base` (default os.environ) configured so a fresh Python
+    child comes up as an ``n_devices``-device CPU JAX platform."""
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disable the axon startup hook
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    return env

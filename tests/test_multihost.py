@@ -1,0 +1,24 @@
+"""Multi-host bring-up: 2 OS processes wired by jax.distributed — the
+executable stand-in for the reference's `mpirun --hostfile` launch
+(reference Makefile:74, hf:1-11), which its repo could only exercise on a
+real 11-host cluster (SURVEY.md section 4: "multi-node testing without a
+cluster: not supported").
+
+Exercises parallel/mesh.py initialize_multihost + cross-process psum /
+all_gather / a distributed block-engine chunk with process-local shards.
+The harness lives in tools/multihost_check.py (also `make multihost_check`).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_distributed_bringup():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "multihost_check.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "MULTIHOST CHECK: PASS" in proc.stdout

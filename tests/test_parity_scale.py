@@ -1,0 +1,137 @@
+"""Mid-scale LibSVM parity (reference README.md:27: "same number of
+Support Vectors as LibSVM") at the reference's own pinned hyperparameters
+(reference Makefile:74,86), beyond the toy sizes of the other tests.
+
+SV-count parity is sensitive near the alpha bounds precisely at scale
+(SURVEY.md section 7.3 item 3) — these runs are the in-suite guard for
+that; the full 8-10k harness with real-TPU single-chip runs is
+`python tools/parity.py` (writes PARITY.md, including the methodology:
+duplicate-merged SV counts, SV assertion at the reference parity claim's
+eps=0.001, decision-sign agreement at the pinned configs).
+
+Marked slow: several minutes of CPU; deselect with `-m "not slow"`.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.parallel.dist_smo import solve_mesh
+from dpsvm_tpu.predict import decision_function
+from dpsvm_tpu.solver.smo import solve
+
+SV_TOL = 0.01
+SIGN_TOL = 0.998
+
+pytestmark = pytest.mark.slow
+
+
+def _fit_libsvm(x, y, cfg):
+    from sklearn.svm import SVC
+    return SVC(C=cfg.c, gamma=cfg.gamma, tol=cfg.epsilon,
+               cache_size=1000).fit(x, y)
+
+
+def _merged_sv(alpha, group):
+    """SV count after summing alpha over duplicate (row, label) groups —
+    with duplicated rows the dual optimum is a face and the raw per-row
+    count is solver-path-dependent (see tools/parity.py)."""
+    s = np.zeros(group.max() + 1)
+    np.add.at(s, group, np.abs(alpha))
+    return int((s > 0).sum())
+
+
+def _dup_groups(x, y):
+    _, inv = np.unique(x, axis=0, return_inverse=True)
+    return inv.astype(np.int64) * 2 + (y > 0)
+
+
+def _check_agreement(x, y, cfg, sk, res):
+    assert res.converged
+    kp = KernelParams("rbf", cfg.resolve_gamma(x.shape[1]))
+    model = SVMModel.from_dense(x, y, res.alpha, res.b, kp)
+    dec = decision_function(model, x)
+    agree = float(np.mean(np.sign(dec) == np.sign(sk.decision_function(x))))
+    assert agree >= SIGN_TOL, f"decision-sign agreement {agree:.4f}"
+
+
+def _check_sv_parity(x, y, sk, res):
+    group = _dup_groups(x, y)
+    a_sk = np.zeros(len(y))
+    a_sk[sk.support_] = np.abs(sk.dual_coef_[0])
+    ours = _merged_sv(res.alpha, group)
+    theirs = _merged_sv(a_sk, group)
+    assert abs(ours - theirs) <= SV_TOL * theirs, (
+        f"merged SV count {ours} vs LibSVM {theirs}")
+
+
+MNIST_PINNED = SVMConfig(c=10.0, gamma=0.125, epsilon=0.01,
+                         max_iter=2_000_000, engine="block",
+                         working_set_size=128)
+MNIST_CLAIM = MNIST_PINNED.replace(epsilon=1e-3)
+
+
+@pytest.fixture(scope="module")
+def mnist_shaped():
+    from dpsvm_tpu.data.synth import make_mnist_like
+    x, y = make_mnist_like(n=4000, d=784, seed=7, noise=0.1)
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def mnist_sk_pinned(mnist_shaped):
+    x, y = mnist_shaped
+    return _fit_libsvm(x, y, MNIST_PINNED)
+
+
+@pytest.fixture(scope="module")
+def adult_shaped():
+    from dpsvm_tpu.data.synth import make_adult_like
+    x, y = make_adult_like(n=4000, d=123, seed=13)
+    cfg = SVMConfig(c=100.0, gamma=0.5, epsilon=1e-3, max_iter=2_000_000)
+    return x, y, cfg, _fit_libsvm(x, y, cfg)
+
+
+@pytest.mark.parametrize("backend", ["single", "mesh8"])
+def test_mnist_shaped_pinned_agreement(mnist_shaped, mnist_sk_pinned,
+                                       backend):
+    """Reference MNIST config (c=10 gamma=0.125 eps=0.01, Makefile:74):
+    judged on decision agreement — the loose eps leaves the SV set
+    underdetermined (see tools/parity.py)."""
+    x, y = mnist_shaped
+    if backend == "mesh8":
+        res = solve_mesh(x, y, MNIST_PINNED, num_devices=8)
+    else:
+        res = solve(x, y, MNIST_PINNED)
+    _check_agreement(x, y, MNIST_PINNED, mnist_sk_pinned, res)
+
+
+def test_mnist_shaped_sv_parity_at_claim_eps(mnist_shaped):
+    """SV-count parity at eps=0.001 — the tolerance of the reference's
+    own "same number of SVs as LibSVM" claim (README.md:23,27)."""
+    x, y = mnist_shaped
+    sk = _fit_libsvm(x, y, MNIST_CLAIM)
+    res = solve(x, y, MNIST_CLAIM)
+    _check_agreement(x, y, MNIST_CLAIM, sk, res)
+    _check_sv_parity(x, y, sk, res)
+
+
+def test_adult_shaped_per_pair_parity(adult_shaped):
+    x, y, cfg, sk = adult_shaped
+    res = solve(x, y, cfg)  # engine="xla": reference-parity per-pair path
+    _check_agreement(x, y, cfg, sk, res)
+    _check_sv_parity(x, y, sk, res)
+
+
+@pytest.mark.parametrize("backend", ["single", "mesh8"])
+def test_adult_shaped_block_parity(adult_shaped, backend):
+    x, y, cfg, sk = adult_shaped
+    bcfg = cfg.replace(engine="block", working_set_size=128)
+    if backend == "mesh8":
+        res = solve_mesh(x, y, bcfg, num_devices=8)
+    else:
+        res = solve(x, y, bcfg)
+    _check_agreement(x, y, bcfg, sk, res)
+    _check_sv_parity(x, y, sk, res)
