@@ -50,16 +50,17 @@ def main() -> int:
     x, y = make_mnist_like(n=N, d=D, seed=7, noise=0.1)
 
     # Measured on v5e-1 (2026-07): the blockwise decomposition engine
-    # (solver/block.py: top-q violator working set, on-core Pallas
-    # subproblem solve, one fused (n,q) fold per round) runs this config
-    # ~2.5x faster than the best per-pair engine — the full-X kernel-row
-    # pass is amortized over ~50 pair updates instead of 1. bf16 X halves
-    # the per-round fold read (f and all solver state stay float32);
-    # q=128 measured most consistent across reps. cache_lines=0: the
-    # working-set block IS the cache.
+    # (solver/block.py: top-q violator working set via approx_max_k,
+    # on-core Pallas subproblem solve, one fused (n,q) fold per round)
+    # runs this config far faster than the best per-pair engine — the
+    # full-X kernel-row pass is amortized over hundreds of pair updates
+    # instead of 1. bf16 X halves the per-round fold read (f and all
+    # solver state stay float32); q=256 with the 2q inner budget measured
+    # best in the tools/sweep_block.py grid (q=512/inner=1024 within
+    # jitter). cache_lines=0: the working-set block IS the cache.
     config = SVMConfig(
         c=10.0, gamma=0.125, epsilon=0.01, max_iter=100_000,
-        cache_lines=0, engine="block", working_set_size=128,
+        cache_lines=0, engine="block", working_set_size=256,
         dtype="bfloat16")
 
     # Warm-up: compile the REAL chunk executor (chunk_iters is a static

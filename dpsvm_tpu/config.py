@@ -86,8 +86,11 @@ class SVMConfig:
     engine: str = "xla"
 
     # Block-engine shape knobs (ignored by other engines). working_set_size
-    # (q) is the block height; inner_iters = 0 means "q" (each selected
-    # point participates in ~2 pairs on average before a refresh).
+    # (q) is the block height; inner_iters = 0 means "2*q" (measured best
+    # across 60k x 784 and 500k x 54 sweeps, tools/sweep_block.py: the
+    # subproblem usually closes its local gap before the budget, so a
+    # larger cap costs nothing when unused and saves a full-X round when
+    # the block still has violators; q pairs leaves work on the table).
     working_set_size: int = 128
     inner_iters: int = 0
 

@@ -37,20 +37,26 @@ from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
 from dpsvm_tpu.ops.select import low_mask, split_c, up_mask
 from dpsvm_tpu.parallel.dist_smo import _global_ids
 from dpsvm_tpu.parallel.mesh import DATA_AXIS
-from dpsvm_tpu.solver.block import BlockState, _solve_subproblem, combine_halves
+from dpsvm_tpu.solver.block import (BlockState, _solve_subproblem, _top_h,
+                                    combine_halves)
 
 
 def _global_top(scores, gids_loc, h: int):
     """Replicated global top-h PER ROW from per-shard top-h candidates.
 
     scores: (r, n_loc) score rows with -inf at inadmissible entries — all
-    candidate sides ride one batched top_k + all_gather dispatch sequence
-    (same batching as the single-chip select_block). Returns
-    (g_ids (r, h), ok (r, h)) — identical on every device. Ties resolve to
-    the lowest global id (stable top_k + device-major gather order ==
-    global row order under contiguous partitioning)."""
+    candidate sides ride one batched selection + all_gather dispatch
+    sequence (same batching as the single-chip select_block). Returns
+    (g_ids (r, h), ok (r, h)) — identical on every device (every device
+    reduces the same gathered candidates), though WHICH mid-rank
+    candidates surface is not index-stable under ties on TPU
+    (approx_max_k's bin layout, not lowest-id order; each row's true
+    extremum is always included)."""
     r = scores.shape[0]
-    v, i = lax.top_k(scores, h)  # (r, h)
+    # Local stage: TPU-native approximate top-k (exact maxima, ~1-2%
+    # recall on the tail; see solver/block.py _top_h). The global stage
+    # below stays exact — it reduces only (P*h,) gathered candidates.
+    v, i = _top_h(scores, h)  # (r, h)
     g = jnp.take(gids_loc, i)
     av = lax.all_gather(v, DATA_AXIS)  # (P, r, h)
     ag = lax.all_gather(g, DATA_AXIS)

@@ -455,7 +455,7 @@ def solve_mesh(
         gran = 4 if config.selection == "nu" else 2
         q = max(gran, min(config.working_set_size, gran * n_loc))
         q -= q % gran
-        inner = config.inner_iters or q
+        inner = config.inner_iters or 2 * q
         rounds_per_chunk = (max(1, chunk_len // inner)
                             if observe else _UNOBSERVED_CHUNK)
         inner_impl = ("pallas" if mesh.devices.flat[0].platform == "tpu"

@@ -94,7 +94,7 @@ def select_block(f, alpha, y, c, q: int, valid=None, rule: str = "mvp"):
                             jnp.where(low & pos, f, -jnp.inf),
                             jnp.where(up & ~pos, -f, -jnp.inf),
                             jnp.where(low & ~pos, f, -jnp.inf)])
-        vals, idx = lax.top_k(scores, h)  # (4, h)
+        vals, idx = _top_h(scores, h)  # (4, h)
         # Dedup within a class only (the classes are disjoint).
         w_p, ok_p = combine_halves(idx[0], jnp.isfinite(vals[0]),
                                    idx[1], jnp.isfinite(vals[1]))
@@ -103,13 +103,28 @@ def select_block(f, alpha, y, c, q: int, valid=None, rule: str = "mvp"):
         return (jnp.concatenate([w_p, w_n]),
                 jnp.concatenate([ok_p, ok_n]))
     h = q // 2
-    # One batched top_k over both candidate sides (halves the selection
-    # dispatches inside the round loop).
+    # One batched selection over both candidate sides.
     scores = jnp.stack([jnp.where(up, -f, -jnp.inf),
                         jnp.where(low, f, -jnp.inf)])
-    vals, idx = lax.top_k(scores, h)  # (2, h)
+    vals, idx = _top_h(scores, h)  # (2, h)
     return combine_halves(idx[0], jnp.isfinite(vals[0]),
                           idx[1], jnp.isfinite(vals[1]))
+
+
+def _top_h(scores, h: int):
+    """Top-h per row via the TPU-native approximate top-k.
+
+    ``lax.top_k`` over a stacked (r, n) operand falls off XLA's fast path
+    for h > ~128 (measured 6.7 ms at n=500k vs 0.77 ms for approx — see
+    tools/profile_round.py). ``approx_max_k``'s bin-max construction
+    ALWAYS retains each row's true maximum, so the convergence invariant
+    (the globally most-violating pair is in W) and the b_hi/b_lo extrema
+    are exact; the ~1-2% recall loss only swaps interchangeable mid-rank
+    violators. Falls back to exact top_k on non-TPU backends where
+    approx_max_k has no fast lowering anyway."""
+    if jax.default_backend() == "tpu":
+        return lax.approx_max_k(scores, h)
+    return lax.top_k(scores, h)
 
 
 def combine_halves(up_idx, up_ok, low_idx, low_ok):

@@ -494,7 +494,7 @@ def solve(
         gran = 4 if config.selection == "nu" else 2
         q = max(gran, min(config.working_set_size, n_pad))
         q -= q % gran
-        inner = config.inner_iters or q
+        inner = config.inner_iters or 2 * q
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
                            rounds=jnp.int32(0))

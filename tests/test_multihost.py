@@ -19,6 +19,6 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_two_process_distributed_bringup():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "multihost_check.py")],
-        cwd=REPO, capture_output=True, text=True, timeout=600)
+        cwd=REPO, capture_output=True, text=True, timeout=1200)
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     assert "MULTIHOST CHECK: PASS" in proc.stdout

@@ -140,7 +140,10 @@ def main() -> int:
              coordinator, str(pid)], env=env, cwd=REPO)
             for pid in range(NPROC)]
         try:
-            rcs = [p.wait(timeout=600) for p in procs]
+            # Well under the callers' own timeouts (tests/test_multihost.py
+            # allows 1200 s total) so the finally-kill below always gets
+            # to run before an outer SIGKILL would orphan the children.
+            rcs = [p.wait(timeout=240) for p in procs]
         except subprocess.TimeoutExpired:
             rcs = [1] * NPROC
         finally:

@@ -1,0 +1,192 @@
+"""Per-stage cost breakdown of one block-engine round on the real TPU.
+
+Times each stage of solver/block.py's round body in isolation by running
+it repeatedly inside a jitted fori_loop (host-side timing of single ops is
+meaningless through the tunnel — see utils/metrics.py). Stages:
+
+  select   — select_block (masks + batched top_k over n)
+  gather   — working-set row/scalar gathers (q HBM row DMAs)
+  gram     — (q,d)x(d,q) Gram block + diag
+  inner    — the Pallas subproblem solve (`limit` pair updates)
+  fold     — kernel_rows (n,d)x(d,q) + f += coef @ k_rows
+  scatter  — alpha scatter + the outer select_working_set pass
+  full     — the real run_chunk_block round for comparison
+
+Run: `python tools/profile_round.py [--dataset mnist|covtype] [--q 512]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def timed(fn, *args, reps: int) -> float:
+    """Seconds per repetition of fn, measured inside one dispatch."""
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def loop(*a):
+        def body(i, carry):
+            return fn(*carry)
+        return lax.fori_loop(0, reps, body, a)
+
+    out = loop(*args)
+    jax.block_until_ready(out)  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(loop(*args))
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="mnist",
+                    choices=["mnist", "covtype"])
+    ap.add_argument("--q", type=int, default=512)
+    ap.add_argument("--reps", type=int, default=200)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.ops.kernels import (KernelParams, kernel_diag,
+                                       kernel_from_dots, kernel_rows,
+                                       squared_norms)
+    from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+    from dpsvm_tpu.ops.select import select_working_set
+    from dpsvm_tpu.solver.block import select_block
+
+    if args.dataset == "mnist":
+        from dpsvm_tpu.data.synth import make_mnist_like
+        x, y = make_mnist_like(n=60_000, d=784, seed=7, noise=0.1)
+        cfg = SVMConfig(c=10.0, gamma=0.125, epsilon=0.01)
+    else:
+        rng = np.random.default_rng(0)
+        x = (rng.normal(size=(500_000, 54)) * 0.3).astype(np.float32)
+        y = np.where(x[:, 0] + 0.2 * rng.standard_normal(len(x)) > 0,
+                     1, -1).astype(np.int32)
+        cfg = SVMConfig(c=2048.0, gamma=0.03125, epsilon=1e-3)
+
+    q = args.q
+    n, d = x.shape
+    kp = KernelParams("rbf", cfg.resolve_gamma(d))
+    xd = jnp.asarray(x, jnp.bfloat16)
+    yd = jnp.asarray(y, jnp.float32)
+    x_sq = jax.jit(squared_norms)(xd)
+    k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq, params=kp)
+    rng = np.random.default_rng(1)
+    alpha = jnp.asarray(np.clip(rng.normal(1.0, 1.0, n), 0, cfg.c),
+                        jnp.float32)
+    f = jnp.asarray(rng.normal(0, 1, n), jnp.float32)
+    print(f"dataset={args.dataset} n={n} d={d} q={q} reps={args.reps}")
+
+    c = cfg.c_bounds()
+
+    # --- select
+    def s_select(f, alpha):
+        w, ok = select_block(f, alpha, yd, c, q)
+        return f + 1e-20 * w[0], alpha  # data-dependence, no real change
+
+    t_sel = timed(s_select, f, alpha, reps=args.reps)
+
+    w, ok = jax.jit(lambda f, a: select_block(f, a, yd, c, q))(f, alpha)
+
+    # --- gather
+    def s_gather(f, alpha):
+        qx = jnp.take(xd, w, axis=0)
+        qsq = jnp.take(x_sq, w)
+        aw = jnp.take(alpha, w)
+        yw = jnp.take(yd, w)
+        fw = jnp.take(f, w)
+        kdw = jnp.take(k_diag, w)
+        return f + 1e-20 * (jnp.sum(qx.astype(jnp.float32)) + qsq[0]
+                            + aw[0] + yw[0] + fw[0] + kdw[0]), alpha
+
+    t_gather = timed(s_gather, f, alpha, reps=args.reps)
+
+    qx = jax.jit(lambda: jnp.take(xd, w, axis=0))()
+    qsq = jnp.take(x_sq, w)
+    aw = jnp.take(alpha, w)
+    yw = jnp.take(yd, w)
+    fw = jnp.take(f, w)
+    kdw = jnp.take(k_diag, w)
+
+    # --- gram
+    def s_gram(f, alpha):
+        dots = jnp.dot(qx, qx.T, preferred_element_type=jnp.float32)
+        kb = kernel_from_dots(dots, qsq, qsq, kp)
+        return f + 1e-20 * kb[0, 0], alpha
+
+    t_gram = timed(s_gram, f, alpha, reps=args.reps)
+
+    kb = jax.jit(lambda: kernel_from_dots(
+        jnp.dot(qx, qx.T, preferred_element_type=jnp.float32),
+        qsq, qsq, kp))()
+
+    # --- inner (pallas subproblem, full budget)
+    def s_inner(f, alpha):
+        aw2, t = solve_subproblem_pallas(
+            kb, aw, yw, fw, kdw, ok.astype(jnp.float32),
+            jnp.int32(q), c, float(cfg.epsilon), float(cfg.tau))
+        return f + 1e-20 * (aw2[0] + t), alpha
+
+    t_inner = timed(s_inner, f, alpha, reps=max(20, args.reps // 4))
+
+    # --- fold
+    coef = jnp.asarray(rng.normal(0, 0.1, q), jnp.float32)
+
+    def s_fold(f, alpha):
+        k_rows = kernel_rows(xd, x_sq, qx, qsq, kp)
+        return f + coef @ k_rows, alpha
+
+    t_fold = timed(s_fold, f, alpha, reps=args.reps)
+
+    # --- scatter + outer extrema pass
+    def s_scatter(f, alpha):
+        safe_w = jnp.where(ok, w, jnp.int32(n))
+        alpha = alpha.at[safe_w].set(jnp.where(ok, aw, 0.0), mode="drop")
+        _, b_hi, _, b_lo = select_working_set(f, alpha, yd, c)
+        return f + 1e-20 * (b_hi + b_lo), alpha
+
+    t_scatter = timed(s_scatter, f, alpha, reps=args.reps)
+
+    # --- full round for comparison
+    from dpsvm_tpu.solver.block import BlockState, run_chunk_block
+
+    st = BlockState(alpha=alpha, f=f, b_hi=jnp.float32(-1e9),
+                    b_lo=jnp.float32(1e9), pairs=jnp.int32(0),
+                    rounds=jnp.int32(0))
+    runner = lambda st: run_chunk_block(
+        xd, yd, x_sq, k_diag, st, jnp.int32(10**9), kp, c,
+        float(cfg.epsilon), float(cfg.tau), q, q, args.reps,
+        inner_impl="pallas")
+    out = runner(st)
+    jax.block_until_ready(out)
+    st2 = out._replace(rounds=jnp.int32(0), pairs=jnp.int32(0))
+    t0 = time.perf_counter()
+    out2 = runner(st2)
+    jax.block_until_ready(out2)
+    t_full = (time.perf_counter() - t0) / max(int(out2.rounds), 1)
+    print(f"  (full-round chunk executed {int(out2.rounds)} rounds, "
+          f"{int(out2.pairs)} pairs)")
+
+    total = t_sel + t_gather + t_gram + t_inner + t_fold + t_scatter
+    for name, t in [("select", t_sel), ("gather", t_gather),
+                    ("gram", t_gram), ("inner(pallas)", t_inner),
+                    ("fold", t_fold), ("scatter+extrema", t_scatter),
+                    ("SUM", total), ("FULL ROUND", t_full)]:
+        print(f"  {name:15s} {1e3 * t:8.3f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Block-engine shape sweep on the real TPU: q x inner_iters x dataset.
+
+Measures pair-update throughput and round cost for the blockwise engine
+(solver/block.py) to pick the default working-set shape. Fixed pair
+budget per cell so cells are comparable; reports per-round cost (the
+dispatch-floor diagnostic) and pairs/s.
+
+Run: `python tools/sweep_block.py [--dataset mnist|covtype|both]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def make(dataset: str):
+    if dataset == "mnist":
+        from dpsvm_tpu.data.synth import make_mnist_like
+        x, y = make_mnist_like(n=60_000, d=784, seed=7, noise=0.1)
+        kw = dict(c=10.0, gamma=0.125, epsilon=0.01)
+    else:
+        rng = np.random.default_rng(0)
+        x = (rng.normal(size=(500_000, 54)) * 0.3).astype(np.float32)
+        y = np.where(x[:, 0] + 0.2 * rng.standard_normal(len(x)) > 0,
+                     1, -1).astype(np.int32)
+        kw = dict(c=2048.0, gamma=0.03125, epsilon=1e-3)
+    return x, y, kw
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="both",
+                    choices=["mnist", "covtype", "both"])
+    ap.add_argument("--budget", type=int, default=400_000,
+                    help="pair budget per cell (covtype); mnist runs to "
+                    "convergence")
+    args = ap.parse_args()
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.solver.smo import solve
+
+    datasets = (["mnist", "covtype"] if args.dataset == "both"
+                else [args.dataset])
+    for ds in datasets:
+        x, y, kw = make(ds)
+        print(f"== {ds}: n={len(x)} d={x.shape[1]} {kw}")
+        for q in (128, 256, 512, 1024):
+            for ii_mult in (1, 2, 4):
+                inner = q * ii_mult
+                cfg = SVMConfig(**kw, engine="block", working_set_size=q,
+                                inner_iters=inner, dtype="bfloat16",
+                                cache_lines=0,
+                                max_iter=(args.budget if ds == "covtype"
+                                          else 100_000))
+                solve(x, y, cfg.replace(max_iter=64))  # compile
+                best = None
+                for _ in range(2):
+                    r = solve(x, y, cfg)
+                    if best is None or r.train_seconds < best.train_seconds:
+                        best = r
+                rounds = best.stats["outer_rounds"]
+                s = best.train_seconds
+                print(f"  q={q:5d} inner={inner:5d}: pairs={best.iterations:8d} "
+                      f"rounds={rounds:6d} s={s:7.3f} "
+                      f"pairs/s={best.iterations / s:9.0f} "
+                      f"ms/round={1e3 * s / max(rounds, 1):7.3f} "
+                      f"conv={best.converged}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
