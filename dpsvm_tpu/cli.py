@@ -221,7 +221,8 @@ def _cmd_train(args) -> int:
 
     logger = MetricsLogger(
         sink=None if args.quiet else sys.stderr,
-        jsonl_path=args.metrics_jsonl)
+        jsonl_path=args.metrics_jsonl,
+        lookups_per_iter=0 if args.engine == "block" else 2)
     with profile_trace(args.profile_dir):
         if args.svm_type == "c-svc":
             model, result = train(

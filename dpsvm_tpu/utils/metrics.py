@@ -22,8 +22,15 @@ class MetricsLogger:
     """Chunk-cadence metrics recorder; usable as the solver `callback`."""
 
     def __init__(self, sink: Optional[IO] = None, jsonl_path: Optional[str] = None,
-                 print_every: int = 0):
+                 print_every: int = 0, lookups_per_iter: int = 2):
+        """`lookups_per_iter` is the engine's cache-lookup cadence: the
+        per-pair engines (xla/pallas) probe the row cache twice per pair
+        update (hi and lo rows, mirroring the reference's two
+        lookup_cache calls per iteration, svmTrain.cu:203,238); the block
+        engine never probes it (its working-set block is the reuse
+        mechanism), so callers pass 0 and the rate reports as 0.0."""
         self.records: list[dict] = []
+        self._lookups_per_iter = lookups_per_iter
         self._sink = sink
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self._t0 = time.perf_counter()
@@ -55,7 +62,8 @@ class MetricsLogger:
             "gap": b_lo - b_hi,
             "sv_estimate": int(np.asarray(alpha > 0).sum()),
             "cache_hits": hits,
-            "cache_hit_rate": hits / max(2 * this_run_iters, 1),
+            "cache_hit_rate": hits / max(
+                self._lookups_per_iter * this_run_iters, 1),
             "iters_per_sec": d_it / d_t,
             "elapsed_sec": now - self._t0,
         }
