@@ -39,27 +39,38 @@ def split_c(c: float | tuple) -> tuple:
     return c if isinstance(c, tuple) else (c, c)
 
 
-def c_of(y: jax.Array, c_pos: float, c_neg: float):
+def c_of(y: jax.Array, c_pos: float, c_neg: float, xp=jnp):
     """Per-row upper bound C_i = C * w_{y_i} (LibSVM -w class weights).
     Statically collapses to the scalar when the weights are equal, so the
-    unweighted hot path compiles with zero extra ops."""
+    unweighted hot path compiles with zero extra ops. `xp` selects the
+    array namespace (jnp on device; np for the host-side extrema_np) so
+    the set definitions exist exactly once."""
     if c_pos == c_neg:
         return c_pos
-    return jnp.where(y > 0, c_pos, c_neg)
+    return xp.where(y > 0, c_pos, c_neg)
 
 
 def up_mask(alpha: jax.Array, y: jax.Array, c_pos: float,
-            c_neg: float | None = None) -> jax.Array:
+            c_neg: float | None = None, xp=jnp) -> jax.Array:
     """Membership in I_up."""
-    c = c_of(y, c_pos, c_pos if c_neg is None else c_neg)
-    return jnp.where(y > 0, alpha < c, alpha > 0)
+    c = c_of(y, c_pos, c_pos if c_neg is None else c_neg, xp)
+    return xp.where(y > 0, alpha < c, alpha > 0)
 
 
 def low_mask(alpha: jax.Array, y: jax.Array, c_pos: float,
-             c_neg: float | None = None) -> jax.Array:
+             c_neg: float | None = None, xp=jnp) -> jax.Array:
     """Membership in I_low."""
-    c = c_of(y, c_pos, c_pos if c_neg is None else c_neg)
-    return jnp.where(y > 0, alpha > 0, alpha < c)
+    c = c_of(y, c_pos, c_pos if c_neg is None else c_neg, xp)
+    return xp.where(y > 0, alpha > 0, alpha < c)
+
+
+def nu_stopping_pair(bh_p, bl_p, bh_n, bl_n, xp=jnp):
+    """LibSVM's nu stopping gap: report the per-class (b_hi, b_lo) of the
+    class with the larger violation, so b_lo - b_hi ==
+    max(violation_+, violation_-) (select_working_set_nu's rule, shared
+    by the block engines' selection extrema and the host-side refresh)."""
+    take_p = (bl_p - bh_p) >= (bl_n - bh_n)
+    return (xp.where(take_p, bh_p, bh_n), xp.where(take_p, bl_p, bl_n))
 
 
 def select_working_set_nu(
@@ -108,6 +119,39 @@ def select_working_set_nu(
     b_hi = jnp.where(take_p, bh_p, bh_n)
     b_lo = jnp.where(take_p, bl_p, bl_n)
     return i_up, b_hi, i_low, b_lo
+
+
+def extrema_np(f, alpha, y, c, rule: str = "mvp"):
+    """Host-side (NumPy) stopping extrema (b_hi, b_lo) of a final state.
+
+    The block engines' loop carry holds extrema that are one fold behind
+    when the solve exits on the iteration budget (solver/block.py: the
+    selection that would refresh them belongs to the round that never
+    ran). Callers use this on the already-pulled final (f, alpha) to
+    report exact b_hi/b_lo — no extra device dispatch. The set
+    definitions are the SAME up_mask/low_mask/nu_stopping_pair the device
+    loop compiles, evaluated under NumPy via their `xp` parameter."""
+    import numpy as np
+
+    cp, cn = split_c(c)
+    f = np.asarray(f, np.float32)
+    alpha = np.asarray(alpha)
+    y = np.asarray(y)
+    up = up_mask(alpha, y, cp, cn, xp=np)
+    low = low_mask(alpha, y, cp, cn, xp=np)
+
+    def pair(u, lo):
+        b_hi = float(np.min(np.where(u, f, np.inf)))
+        b_lo = float(np.max(np.where(lo, f, -np.inf)))
+        return b_hi, b_lo
+
+    if rule != "nu":
+        return pair(up, low)
+    pos = y > 0
+    bh_p, bl_p = pair(up & pos, low & pos)
+    bh_n, bl_n = pair(up & ~pos, low & ~pos)
+    b_hi, b_lo = nu_stopping_pair(bh_p, bl_p, bh_n, bl_n, xp=np)
+    return float(b_hi), float(b_lo)
 
 
 def select_working_set(

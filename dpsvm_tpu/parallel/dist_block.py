@@ -19,7 +19,11 @@ Per round, per device:
   3. one masked-psum recovers the W rows (q, d) + their per-row scalars
   4. replicated on-core subproblem solve (identical on every device)
   5. local fold f_loc += coef @ K(W, shard); owned alpha slots scattered
-  6. pmin/pmax of the local selection extrema -> global b_hi/b_lo
+
+The stopping extrema b_hi/b_lo ride step 2's gathered candidate values
+(every device reduces the same gathered tops, so the result is replicated
+with zero extra collectives); the loop carry is therefore one fold behind,
+compensated exactly as in solver/block.py run_chunk_block.
 
 Steady-state traffic per ROUND: one (h,2) f32 + (h,2) i32 all_gather pair
 and one (q, d+5) psum — a few hundred KB amortized over ~q pair updates,
@@ -34,7 +38,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
-from dpsvm_tpu.ops.select import low_mask, split_c, up_mask
+from dpsvm_tpu.ops.select import (low_mask, nu_stopping_pair, split_c,
+                                  up_mask)
 from dpsvm_tpu.parallel.dist_smo import _global_ids
 from dpsvm_tpu.parallel.mesh import DATA_AXIS
 from dpsvm_tpu.solver.block import (BlockState, _solve_subproblem, _top_h,
@@ -47,11 +52,12 @@ def _global_top(scores, gids_loc, h: int):
     scores: (r, n_loc) score rows with -inf at inadmissible entries — all
     candidate sides ride one batched selection + all_gather dispatch
     sequence (same batching as the single-chip select_block). Returns
-    (g_ids (r, h), ok (r, h)) — identical on every device (every device
-    reduces the same gathered candidates), though WHICH mid-rank
-    candidates surface is not index-stable under ties on TPU
-    (approx_max_k's bin layout, not lowest-id order; each row's true
-    extremum is always included)."""
+    (g_ids (r, h), ok (r, h), vals (r, h)) — identical on every device
+    (every device reduces the same gathered candidates; vals are the
+    gathered top scores, whose row maxima are the exact global extrema) —
+    though WHICH mid-rank candidates surface is not index-stable under
+    ties on TPU (approx_max_k's bin layout, not lowest-id order; each
+    row's true extremum is always included)."""
     r = scores.shape[0]
     # Local stage: TPU-native approximate top-k (exact maxima, ~1-2%
     # recall on the tail; see solver/block.py _top_h). The global stage
@@ -63,13 +69,17 @@ def _global_top(scores, gids_loc, h: int):
     av = jnp.moveaxis(av, 0, 1).reshape(r, -1)  # (r, P*h), device-major
     ag = jnp.moveaxis(ag, 0, 1).reshape(r, -1)
     gv, gi = lax.top_k(av, h)
-    return jnp.take_along_axis(ag, gi, axis=1), jnp.isfinite(gv)
+    return jnp.take_along_axis(ag, gi, axis=1), jnp.isfinite(gv), gv
 
 
 def _select_block_mesh(f, alpha, y, valid, c, q: int, rule: str = "mvp"):
-    """Distributed working-set selection; replicated (w, slot_ok) result.
-    Same semantics as solver/block.py select_block (rule="nu" -> per-class
-    quarters, one equality constraint per class)."""
+    """Distributed working-set selection; replicated (w, slot_ok, b_hi,
+    b_lo) result. Same semantics as solver/block.py select_block (rule=
+    "nu" -> per-class quarters, one equality constraint per class; the
+    extrema are the larger-violation class's pair). The extrema are exact
+    and globally reduced: the local stage always retains each score row's
+    true maximum and the gathered global stage is an exact top_k, so every
+    device computes the identical b_hi/b_lo with zero extra collectives."""
     cp, cn = split_c(c)
     n_loc = f.shape[0]
     gids = _global_ids(n_loc)
@@ -82,16 +92,19 @@ def _select_block_mesh(f, alpha, y, valid, c, q: int, rule: str = "mvp"):
                             jnp.where(low & pos, f, -jnp.inf),
                             jnp.where(up & ~pos, -f, -jnp.inf),
                             jnp.where(low & ~pos, f, -jnp.inf)])
-        ids, ok = _global_top(scores, gids, h)
+        ids, ok, gv = _global_top(scores, gids, h)
         w_p, ok_p = combine_halves(ids[0], ok[0], ids[1], ok[1])
         w_n, ok_n = combine_halves(ids[2], ok[2], ids[3], ok[3])
+        b_hi, b_lo = nu_stopping_pair(-jnp.max(gv[0]), jnp.max(gv[1]),
+                                      -jnp.max(gv[2]), jnp.max(gv[3]))
         return (jnp.concatenate([w_p, w_n]),
-                jnp.concatenate([ok_p, ok_n]))
+                jnp.concatenate([ok_p, ok_n]), b_hi, b_lo)
     h = q // 2
     scores = jnp.stack([jnp.where(up, -f, -jnp.inf),
                         jnp.where(low, f, -jnp.inf)])
-    ids, ok = _global_top(scores, gids, h)
-    return combine_halves(ids[0], ok[0], ids[1], ok[1])
+    ids, ok, gv = _global_top(scores, gids, h)
+    w, slot_ok = combine_halves(ids[0], ok[0], ids[1], ok[1])
+    return w, slot_ok, -jnp.max(gv[0]), jnp.max(gv[1])
 
 
 def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
@@ -119,7 +132,6 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                             selection: str = "mvp"):
     """Build the jitted shard_mapped block-round chunk executor.
     selection: "mvp" | "second_order" | "nu" (solver/block.py rules)."""
-    cp, cn = split_c(c)
 
     def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
                    state: BlockState, max_iter):
@@ -131,8 +143,14 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                     & (st.b_lo > st.b_hi + 2.0 * eps))
 
         def body(st: BlockState):
-            w, slot_ok = _select_block_mesh(
+            # ONE distributed selection per round: the candidate gather
+            # also yields the stopping extrema of the CURRENT f (see
+            # solver/block.py run_chunk_block for the one-fold-behind
+            # convergence semantics; the final round runs gated to 0
+            # pair updates).
+            w, slot_ok, b_hi, b_lo = _select_block_mesh(
                 st.f, st.alpha, y_loc, valid_loc, c, q, rule=selection)
+            gap_open = b_lo > b_hi + 2.0 * eps
             scal_loc = jnp.stack(
                 [x_sq_loc, k_diag_loc, st.alpha, y_loc, st.f], axis=1)
             qx, scal, l, own = _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc)
@@ -145,6 +163,7 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             dots_w = jnp.dot(qx, qx.T, preferred_element_type=jnp.float32)
             kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)
             limit = jnp.minimum(jnp.int32(inner_iters), max_iter - st.pairs)
+            limit = jnp.where(gap_open, limit, 0)
             if inner_impl == "pallas":
                 from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
 
@@ -170,29 +189,6 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             l_scatter = jnp.where(own, l, jnp.int32(n_loc))
             alpha = st.alpha.at[l_scatter].set(
                 jnp.where(own, alpha_w, 0.0), mode="drop")
-
-            # Global convergence extrema (values only -> pmin/pmax).
-            up = up_mask(alpha, y_loc, cp, cn) & valid_loc
-            low = low_mask(alpha, y_loc, cp, cn) & valid_loc
-            if selection == "nu":
-                # Per-class extrema; report the class with the larger
-                # violation so b_lo - b_hi is LibSVM's nu stopping gap
-                # (ops/select.py select_working_set_nu).
-                pos = y_loc > 0
-                bh_p = lax.pmin(jnp.min(jnp.where(up & pos, f, jnp.inf)),
-                                DATA_AXIS)
-                bl_p = lax.pmax(jnp.max(jnp.where(low & pos, f, -jnp.inf)),
-                                DATA_AXIS)
-                bh_n = lax.pmin(jnp.min(jnp.where(up & ~pos, f, jnp.inf)),
-                                DATA_AXIS)
-                bl_n = lax.pmax(jnp.max(jnp.where(low & ~pos, f, -jnp.inf)),
-                                DATA_AXIS)
-                take_p = (bl_p - bh_p) >= (bl_n - bh_n)
-                b_hi = jnp.where(take_p, bh_p, bh_n)
-                b_lo = jnp.where(take_p, bl_p, bl_n)
-            else:
-                b_hi = lax.pmin(jnp.min(jnp.where(up, f, jnp.inf)), DATA_AXIS)
-                b_lo = lax.pmax(jnp.max(jnp.where(low, f, -jnp.inf)), DATA_AXIS)
             return BlockState(alpha, f, b_hi, b_lo,
                               st.pairs + t, st.rounds + 1)
 

@@ -483,6 +483,9 @@ def solve_mesh(
         state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter)
         jax.block_until_ready(state)
         train_seconds += time.perf_counter() - t0
+        # Block-engine observability lags by <= one round here — see the
+        # matching note in solver/smo.py (control flow is unaffected;
+        # budget exits are refreshed exactly below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
@@ -499,6 +502,14 @@ def solve_mesh(
             break
 
     alpha = np.asarray(state.alpha)[:n]
+    if use_block and not converged:
+        # Budget exit: the block carry's extrema are one fold behind —
+        # refresh exactly from the pulled final state (see solver/smo.py).
+        from dpsvm_tpu.ops.select import extrema_np
+
+        b_hi, b_lo = extrema_np(np.asarray(state.f)[:n], alpha, y_np,
+                                config.c_bounds(), rule=config.selection)
+        converged = not (b_lo > b_hi + 2.0 * config.epsilon)
     lookups = 2 * (it - start_iter) if use_cache else 0
     return SolveResult(
         alpha=alpha,

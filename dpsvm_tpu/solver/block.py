@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
-from dpsvm_tpu.ops.select import (c_of, low_mask, select_working_set,
+from dpsvm_tpu.ops.select import (c_of, low_mask, nu_stopping_pair,
                                   select_working_set_nu, split_c, up_mask)
 from dpsvm_tpu.solver.smo import pair_alpha_update
 
@@ -65,10 +65,17 @@ class BlockState(NamedTuple):
 
 def select_block(f, alpha, y, c, q: int, valid=None, rule: str = "mvp"):
     """Pick the q most-violating points: q/2 from I_up (smallest f) and
-    q/2 from I_low (largest f). Returns (w, slot_ok):
+    q/2 from I_low (largest f). Returns (w, slot_ok, b_hi, b_lo):
 
       w        (q,) int32 global indices (junk filler where a set ran short)
       slot_ok  (q,) bool — slot holds a real, unique candidate
+      b_hi     f32 min f over I_up   (exact: _top_h retains each row's
+      b_lo     f32 max f over I_low   true extremum even on the approx path)
+
+    The extrema ride the SAME selection pass, so one call per round serves
+    both the working set and the reference's stopping rule
+    b_lo <= b_hi + 2 eps (svmTrainMain.cpp:310) — the round body needs no
+    separate select_working_set sweep over n.
 
     A point in I_0 (0 < alpha < C) may appear in both halves; the
     duplicate low-half slot is masked out so each global index occupies at
@@ -79,7 +86,9 @@ def select_block(f, alpha, y, c, q: int, valid=None, rule: str = "mvp"):
     each of I_up/I_low within each class): the nu duals carry one equality
     constraint per class, so the subproblem must be able to pair within
     BOTH classes (ops/select.py select_working_set_nu) — a W with only one
-    class's violators could stall the other class's gap.
+    class's violators could stall the other class's gap. Its (b_hi, b_lo)
+    are the larger-violation class's pair, matching
+    select_working_set_nu's stopping gap.
     """
     cp, cn = split_c(c)
     up = up_mask(alpha, y, cp, cn)
@@ -100,15 +109,21 @@ def select_block(f, alpha, y, c, q: int, valid=None, rule: str = "mvp"):
                                    idx[1], jnp.isfinite(vals[1]))
         w_n, ok_n = combine_halves(idx[2], jnp.isfinite(vals[2]),
                                    idx[3], jnp.isfinite(vals[3]))
+        b_hi, b_lo = nu_stopping_pair(-jnp.max(vals[0]), jnp.max(vals[1]),
+                                      -jnp.max(vals[2]), jnp.max(vals[3]))
         return (jnp.concatenate([w_p, w_n]),
-                jnp.concatenate([ok_p, ok_n]))
+                jnp.concatenate([ok_p, ok_n]),
+                b_hi.astype(jnp.float32), b_lo.astype(jnp.float32))
     h = q // 2
     # One batched selection over both candidate sides.
     scores = jnp.stack([jnp.where(up, -f, -jnp.inf),
                         jnp.where(low, f, -jnp.inf)])
     vals, idx = _top_h(scores, h)  # (2, h)
-    return combine_halves(idx[0], jnp.isfinite(vals[0]),
-                          idx[1], jnp.isfinite(vals[1]))
+    w, slot_ok = combine_halves(idx[0], jnp.isfinite(vals[0]),
+                                idx[1], jnp.isfinite(vals[1]))
+    # Empty-set semantics match select_working_set: all-(-inf) scores give
+    # b_hi=+inf / b_lo=-inf, which reads as a closed gap.
+    return w, slot_ok, -jnp.max(vals[0]), jnp.max(vals[1])
 
 
 def _top_h(scores, h: int):
@@ -254,8 +269,16 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
                 & (st.b_lo > st.b_hi + 2.0 * eps))
 
     def body(st: BlockState):
-        w, slot_ok = select_block(st.f, st.alpha, y, c, q,
-                                  rule=selection)
+        # ONE selection pass per round: the same sweep yields the working
+        # set for this round AND the stopping extrema of the CURRENT f.
+        # The loop cond therefore sees extrema one fold behind; the final
+        # convergence round runs with `limit` gated to 0 (a selection +
+        # one inert fold), and the exit-state b_hi/b_lo are exact for the
+        # final f. Callers that exit on the iteration budget instead
+        # refresh the extrema host-side (solver/smo.py).
+        w, slot_ok, b_hi, b_lo = select_block(st.f, st.alpha, y, c, q,
+                                              rule=selection)
+        gap_open = b_lo > b_hi + 2.0 * eps
         qx = jnp.take(x, w, axis=0)  # (q, d)
         qsq = jnp.take(x_sq, w)
         dots_w = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
@@ -267,8 +290,10 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
         f_w0 = jnp.take(st.f, w)
 
         # Per-round pair budget, clamped so total pairs never exceed
-        # max_iter (the per-pair engines cap exactly; so must this one).
+        # max_iter (the per-pair engines cap exactly; so must this one)
+        # and gated to 0 on the final (already-converged) round.
         limit = jnp.minimum(jnp.int32(inner_iters), max_iter - st.pairs)
+        limit = jnp.where(gap_open, limit, 0)
         if inner_impl == "pallas":
             from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
 
@@ -294,9 +319,6 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
         safe_w = jnp.where(slot_ok, w, jnp.int32(st.alpha.shape[0]))
         alpha = st.alpha.at[safe_w].set(
             jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
-        select_global = (select_working_set_nu if selection == "nu"
-                         else select_working_set)
-        _, b_hi, _, b_lo = select_global(f, alpha, y, c)
         return BlockState(alpha, f, b_hi, b_lo, st.pairs + t, st.rounds + 1)
 
     return lax.while_loop(cond, body, state)

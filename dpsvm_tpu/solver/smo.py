@@ -29,7 +29,7 @@ from dpsvm_tpu.ops.kernels import (
     row_dots,
     squared_norms,
 )
-from dpsvm_tpu.ops.select import (c_of, low_mask, select_working_set,
+from dpsvm_tpu.ops.select import (c_of, extrema_np, low_mask, select_working_set,
                                   select_working_set_nu, split_c, up_mask)
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
@@ -551,6 +551,14 @@ def solve(
                                config.selection)
         jax.block_until_ready(state)
         train_seconds += time.perf_counter() - t0
+        # Block-engine note: the carried extrema are computed by each
+        # round's selection BEFORE its fold, so the (b_hi, b_lo) observed
+        # here — callback/verbose gap, checkpointed b's — lag the pair
+        # count by up to one round (<= inner_iters pairs). Harmless for
+        # control flow: a stale-open gap just dispatches one more (gated)
+        # chunk, a restored stale checkpoint gap is re-derived by the
+        # next round's selection, and the final SolveResult refreshes
+        # budget exits exactly (extrema_np below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
@@ -569,6 +577,15 @@ def solve(
             break
 
     alpha = np.asarray(state.alpha)[:n]
+    if use_block and not converged:
+        # Budget exit: the carried extrema are one fold behind (the
+        # selection that would refresh them belongs to the round that
+        # never ran). Recompute exactly from the pulled final state —
+        # also catches a solve whose very last in-budget round closed
+        # the gap.
+        b_hi, b_lo = extrema_np(np.asarray(state.f)[:n], alpha, y_np,
+                                config.c_bounds(), rule=config.selection)
+        converged = not (b_lo > b_hi + 2.0 * config.epsilon)
     # Hit-rate denominator covers only THIS run's lookups (post-resume).
     total_lookups = 2 * (it - start_iter) if use_cache else 0
     return SolveResult(

@@ -107,7 +107,7 @@ def test_pallas_subproblem_matches_xla(blobs_small):
     f = ((alpha * y) @ K - y).astype(np.float32)
 
     q = 32
-    w, ok = select_block(jnp.asarray(f), jnp.asarray(alpha),
+    w, ok, _, _ = select_block(jnp.asarray(f), jnp.asarray(alpha),
                          jnp.asarray(y, jnp.float32), CFG.c, q)
     w_np = np.asarray(w)
     kb_w = jnp.asarray(K[np.ix_(w_np, w_np)].astype(np.float32))
@@ -164,7 +164,7 @@ def test_pallas_subproblem_rules_match_xla(blobs_small, rule):
     f = ((alpha * y) @ K - y).astype(np.float32)
 
     q = 32
-    w, ok = select_block(jnp.asarray(f), jnp.asarray(alpha),
+    w, ok, _, _ = select_block(jnp.asarray(f), jnp.asarray(alpha),
                          jnp.asarray(y, jnp.float32), CFG.c, q,
                          rule=rule)
     w_np = np.asarray(w)
@@ -227,11 +227,61 @@ def test_select_block_filler_does_not_mask_low_candidates():
     y = jnp.asarray([-1.0, -1.0, -1.0, -1.0, -1.0, 1.0, -1.0, -1.0])
     alpha = jnp.asarray([0.0] * 8)
     f = jnp.asarray([5.0, 1.0, 1.0, 1.0, 1.0, -3.0, 1.0, 1.0])
-    w, ok = select_block(f, alpha, y, 1.0, 8)
+    w, ok, _, _ = select_block(f, alpha, y, 1.0, 8)
     w, ok = map(lambda a: list(map(int, a)), (w, ok))
     # idx 0 must be a LIVE low-half slot.
     low_live = [wi for wi, oki in zip(w[4:], ok[4:]) if oki]
     assert 0 in low_live
+
+
+def test_select_block_extrema_match_canonical_selectors():
+    """The b_hi/b_lo riding select_block's top-k pass ARE the stopping
+    extrema: they must equal select_working_set(_nu)'s over randomized
+    states (bound-saturated alphas included), and the host-side
+    extrema_np refresh must agree with both (regression guard: a sign or
+    axis slip here would silently burn the iteration budget — the device
+    loop would never see the gap close)."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.select import (extrema_np, select_working_set,
+                                      select_working_set_nu)
+    from dpsvm_tpu.solver.block import select_block
+
+    rng = np.random.default_rng(5)
+    for seed in range(6):
+        n = 160
+        c = (4.0, 2.5) if seed % 2 else 3.0
+        cp, cn = c if isinstance(c, tuple) else (c, c)
+        y = np.where(rng.random(n) > 0.5, 1.0, -1.0).astype(np.float32)
+        # Mass at both bounds so I-set membership edges are exercised.
+        alpha = rng.choice(
+            [0.0, 1.0, -1.0], n, p=[0.4, 0.3, 0.3]).astype(np.float32)
+        alpha = np.where(alpha < 0, np.where(y > 0, cp, cn),
+                         np.where(alpha > 0, rng.random(n) *
+                                  np.where(y > 0, cp, cn), 0.0))
+        alpha = alpha.astype(np.float32)
+        f = rng.normal(0, 2, n).astype(np.float32)
+        fj, aj, yj = map(jnp.asarray, (f, alpha, y))
+
+        _, bh_ref, _, bl_ref = select_working_set(fj, aj, yj, c)
+        _, _, bh, bl = select_block(fj, aj, yj, c, 16)
+        assert float(bh) == float(bh_ref) and float(bl) == float(bl_ref)
+        assert extrema_np(f, alpha, y, c) == (float(bh_ref), float(bl_ref))
+
+        _, bh_ref, _, bl_ref = select_working_set_nu(fj, aj, yj, c)
+        _, _, bh, bl = select_block(fj, aj, yj, c, 16, rule="nu")
+        assert float(bh) == float(bh_ref) and float(bl) == float(bl_ref)
+        assert extrema_np(f, alpha, y, c, rule="nu") == (
+            float(bh_ref), float(bl_ref))
+
+    # Empty I_up: every +1 point at its bound, every -1 point at 0 —
+    # extrema must read as a closed gap (inf sentinels), not junk.
+    y = np.array([1.0, 1.0, -1.0, -1.0], np.float32)
+    alpha = np.array([3.0, 3.0, 0.0, 0.0], np.float32)
+    f = np.arange(4, dtype=np.float32)
+    _, _, bh, bl = select_block(*map(jnp.asarray, (f, alpha, y)), 3.0, 4)
+    assert float(bh) == np.inf
+    assert extrema_np(f, alpha, y, 3.0)[0] == np.inf
 
 
 def test_reductions_compose_with_block_engine(blobs_small):

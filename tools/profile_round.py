@@ -9,7 +9,7 @@ meaningless through the tunnel — see utils/metrics.py). Stages:
   gram     — (q,d)x(d,q) Gram block + diag
   inner    — the Pallas subproblem solve (`limit` pair updates)
   fold     — kernel_rows (n,d)x(d,q) + f += coef @ k_rows
-  scatter  — alpha scatter + the outer select_working_set pass
+  scatter  — owned-slot alpha scatter (extrema ride the select stage)
   full     — the real run_chunk_block round for comparison
 
 Run: `python tools/profile_round.py [--dataset mnist|covtype] [--q 512]`.
@@ -62,7 +62,6 @@ def main() -> int:
                                        kernel_from_dots, kernel_rows,
                                        squared_norms)
     from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
-    from dpsvm_tpu.ops.select import select_working_set
     from dpsvm_tpu.solver.block import select_block
 
     if args.dataset == "mnist":
@@ -93,12 +92,12 @@ def main() -> int:
 
     # --- select
     def s_select(f, alpha):
-        w, ok = select_block(f, alpha, yd, c, q)
+        w, ok, b_hi, b_lo = select_block(f, alpha, yd, c, q)
         return f + 1e-20 * w[0], alpha  # data-dependence, no real change
 
     t_sel = timed(s_select, f, alpha, reps=args.reps)
 
-    w, ok = jax.jit(lambda f, a: select_block(f, a, yd, c, q))(f, alpha)
+    w, ok, _, _ = jax.jit(lambda f, a: select_block(f, a, yd, c, q))(f, alpha)
 
     # --- gather
     def s_gather(f, alpha):
@@ -150,12 +149,11 @@ def main() -> int:
 
     t_fold = timed(s_fold, f, alpha, reps=args.reps)
 
-    # --- scatter + outer extrema pass
+    # --- scatter (the round's extrema now ride the selection pass)
     def s_scatter(f, alpha):
         safe_w = jnp.where(ok, w, jnp.int32(n))
         alpha = alpha.at[safe_w].set(jnp.where(ok, aw, 0.0), mode="drop")
-        _, b_hi, _, b_lo = select_working_set(f, alpha, yd, c)
-        return f + 1e-20 * (b_hi + b_lo), alpha
+        return f + 1e-20 * alpha[0], alpha
 
     t_scatter = timed(s_scatter, f, alpha, reps=args.reps)
 
@@ -169,11 +167,13 @@ def main() -> int:
         xd, yd, x_sq, k_diag, st, jnp.int32(10**9), kp, c,
         float(cfg.epsilon), float(cfg.tau), q, q, args.reps,
         inner_impl="pallas")
-    out = runner(st)
+    out = runner(st)  # compile + warm
     jax.block_until_ready(out)
-    st2 = out._replace(rounds=jnp.int32(0), pairs=jnp.int32(0))
+    # Time a SECOND execution from the same fresh state: continuing from
+    # the warmed-up state instead would run degenerate near-converged
+    # rounds (or zero rounds once the gap closes) and poison the average.
     t0 = time.perf_counter()
-    out2 = runner(st2)
+    out2 = runner(st)
     jax.block_until_ready(out2)
     t_full = (time.perf_counter() - t0) / max(int(out2.rounds), 1)
     print(f"  (full-round chunk executed {int(out2.rounds)} rounds, "
@@ -182,7 +182,7 @@ def main() -> int:
     total = t_sel + t_gather + t_gram + t_inner + t_fold + t_scatter
     for name, t in [("select", t_sel), ("gather", t_gather),
                     ("gram", t_gram), ("inner(pallas)", t_inner),
-                    ("fold", t_fold), ("scatter+extrema", t_scatter),
+                    ("fold", t_fold), ("scatter", t_scatter),
                     ("SUM", total), ("FULL ROUND", t_full)]:
         print(f"  {name:15s} {1e3 * t:8.3f} ms")
     return 0

@@ -55,9 +55,9 @@ def main() -> int:
     failures = 0
     for rule in ("mvp", "second_order", "nu"):
         for q in (16, 40, 128):
-            w, ok = select_block(jnp.asarray(f), jnp.asarray(alpha),
-                                 jnp.asarray(y, jnp.float32), cfg.c, q,
-                                 rule=rule)
+            w, ok, _, _ = select_block(jnp.asarray(f), jnp.asarray(alpha),
+                                       jnp.asarray(y, jnp.float32), cfg.c,
+                                       q, rule=rule)
             w_np = np.asarray(w)
             kb_w = jnp.asarray(K[np.ix_(w_np, w_np)].astype(np.float32))
             kd_w = jnp.asarray(np.diag(K)[w_np].astype(np.float32))
