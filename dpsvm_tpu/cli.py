@@ -69,6 +69,13 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--inner-iters", type=int, default=0,
                    help="block engine: pair updates per block "
                         "(default 0 = working-set-size)")
+    p.add_argument("--active-set-size", type=int, default=0,
+                   help="block engine: shrink per-round work to the m "
+                        "most-violating rows, reconciling the full "
+                        "gradient in batches (0 = off; single-chip only)")
+    p.add_argument("--reconcile-rounds", type=int, default=8,
+                   help="block engine shrinking: rounds between full-"
+                        "gradient reconciliations (default 8)")
     p.add_argument("--degree", type=int, default=3)
     p.add_argument("--coef0", type=float, default=0.0)
     p.add_argument("-w1", "--weight-pos", type=float, default=1.0,
@@ -216,6 +223,8 @@ def _cmd_train(args) -> int:
         weight_pos=args.weight_pos, weight_neg=args.weight_neg,
         selection=args.selection, engine=args.engine,
         working_set_size=args.working_set_size, inner_iters=args.inner_iters,
+        active_set_size=args.active_set_size,
+        reconcile_rounds=args.reconcile_rounds,
         dtype=args.dtype, chunk_iters=args.chunk_iters,
         checkpoint_every=args.checkpoint_every, verbose=not args.quiet)
 

@@ -94,6 +94,17 @@ class SVMConfig:
     working_set_size: int = 128
     inner_iters: int = 0
 
+    # Active-set shrinking for the block engine (0 = off). When > 0, the
+    # solver runs cycles of `reconcile_rounds` block rounds whose
+    # selection and fold touch only the `active_set_size` most-violating
+    # rows, then applies the accumulated deltas to the full gradient with
+    # one batched matmul (solver/block.py run_chunk_block_active — the
+    # static-shape re-derivation of LibSVM's do_shrinking). Exact: same
+    # optimum and stopping rule; pays off when n is large enough that the
+    # full-n fold dominates the round (n >> active_set_size).
+    active_set_size: int = 0
+    reconcile_rounds: int = 8
+
     # Numerics / runtime knobs (no reference equivalent).
     tau: float = 1e-12  # eta clamp (LibSVM-style guard, fixes bug B2)
     # Debug mode (SURVEY.md 5.2: the reference has no sanitizers at all):
@@ -149,6 +160,15 @@ class SVMConfig:
             raise ValueError("working_set_size must be >= 2")
         if self.inner_iters < 0:
             raise ValueError("inner_iters must be >= 0 (0 = working_set_size)")
+        if self.active_set_size < 0:
+            raise ValueError("active_set_size must be >= 0 (0 = shrinking off)")
+        if self.active_set_size and self.engine != "block":
+            raise ValueError(
+                "active_set_size (shrinking) is a block-engine knob; the "
+                "per-pair engines already touch O(1) rows per iteration "
+                "(use engine='block')")
+        if self.reconcile_rounds < 1:
+            raise ValueError("reconcile_rounds must be >= 1")
 
     def replace(self, **kw) -> "SVMConfig":
         return dataclasses.replace(self, **kw)

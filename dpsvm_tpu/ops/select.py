@@ -154,6 +154,16 @@ def extrema_np(f, alpha, y, c, rule: str = "mvp"):
     return float(b_hi), float(b_lo)
 
 
+def refresh_extrema_host(f, alpha, y, c, epsilon: float, rule: str = "mvp"):
+    """Budget-exit refresh shared by solve() and solve_mesh(): the block
+    engines' carried extrema are one fold behind when the loop exits on
+    the iteration budget, so recompute (b_hi, b_lo, converged) exactly
+    from the pulled final state — this also catches a solve whose very
+    last in-budget round closed the gap."""
+    b_hi, b_lo = extrema_np(f, alpha, y, c, rule)
+    return b_hi, b_lo, not (b_lo > b_hi + 2.0 * epsilon)
+
+
 def select_working_set(
     f: jax.Array,
     alpha: jax.Array,

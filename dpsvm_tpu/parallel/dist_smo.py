@@ -353,6 +353,11 @@ def solve_mesh(
             f"engine={config.engine!r} is implemented for the single-chip "
             "solver only; the mesh backend supports engine='xla' (per-pair) "
             "and engine='block' (distributed decomposition)")
+    if config.active_set_size:
+        raise ValueError(
+            "active_set_size (shrinking) is implemented for the "
+            "single-chip block engine only; on the mesh each shard's fold "
+            "is already n/P-sized — set active_set_size=0")
     if config.selection == "nu" and alpha_init is None:
         # See solver/smo.py: nu selection is degenerate without the nu
         # trainers' feasible warm start.
@@ -503,13 +508,11 @@ def solve_mesh(
 
     alpha = np.asarray(state.alpha)[:n]
     if use_block and not converged:
-        # Budget exit: the block carry's extrema are one fold behind —
-        # refresh exactly from the pulled final state (see solver/smo.py).
-        from dpsvm_tpu.ops.select import extrema_np
+        from dpsvm_tpu.ops.select import refresh_extrema_host
 
-        b_hi, b_lo = extrema_np(np.asarray(state.f)[:n], alpha, y_np,
-                                config.c_bounds(), rule=config.selection)
-        converged = not (b_lo > b_hi + 2.0 * config.epsilon)
+        b_hi, b_lo, converged = refresh_extrema_host(
+            np.asarray(state.f)[:n], alpha, y_np, config.c_bounds(),
+            config.epsilon, rule=config.selection)
     lookups = 2 * (it - start_iter) if use_cache else 0
     return SolveResult(
         alpha=alpha,

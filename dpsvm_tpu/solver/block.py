@@ -243,6 +243,55 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
     return alpha_w, f_w, t
 
 
+def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
+                kp: KernelParams, c, eps: float, tau: float,
+                q: int, inner_iters: int, inner_impl: str,
+                interpret: bool, selection: str):
+    """The shared block-round step: ONE selection pass (whose top-k values
+    also carry the stopping extrema of the CURRENT f), working-set
+    gathers, the (q, q) Gram block, the subproblem dispatch, and the fold
+    coefficients. `x`/`f`/`alpha` may be the full-n arrays
+    (run_chunk_block) or the (m,)-sized active views
+    (run_chunk_block_active) — the two engines differ only in what they
+    fold `coef` into and how they scatter `a_w` back.
+
+    The loop cond therefore sees extrema one fold behind; the final
+    convergence round runs with `limit` gated to 0 (a selection + one
+    inert fold), and budget exits are refreshed host-side
+    (ops/select.py refresh_extrema_host).
+
+    Returns (w, slot_ok, b_hi, b_lo, a_w, coef, t, qx, qsq)."""
+    w, slot_ok, b_hi, b_lo = select_block(f, alpha, y, c, q,
+                                          valid=valid, rule=selection)
+    gap_open = b_lo > b_hi + 2.0 * eps
+    qx = jnp.take(x, w, axis=0)  # (q, d)
+    qsq = jnp.take(x_sq, w)
+    dots_w = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
+                     preferred_element_type=jnp.float32)
+    kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)  # (q, q)
+    kd_w = jnp.take(k_diag, w)
+    a_w0 = jnp.take(alpha, w)
+    y_w = jnp.take(y, w)
+    f_w0 = jnp.take(f, w)
+    # Per-round pair budget, clamped so total pairs never exceed the
+    # caller's remaining budget (the per-pair engines cap exactly; so
+    # must this one) and gated to 0 on the terminal round.
+    limit = jnp.minimum(jnp.int32(inner_iters), budget_left)
+    limit = jnp.where(gap_open, limit, 0)
+    if inner_impl == "pallas":
+        from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+
+        a_w, t = solve_subproblem_pallas(
+            kb_w, a_w0, y_w, f_w0, kd_w, slot_ok.astype(jnp.float32),
+            limit, c, eps, tau, rule=selection, interpret=interpret)
+    else:
+        a_w, _, t = _solve_subproblem(
+            kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
+            limit, rule=selection)
+    coef = jnp.where(slot_ok, (a_w - a_w0) * y_w, 0.0)  # (q,)
+    return w, slot_ok, b_hi, b_lo, a_w, coef, t, qx, qsq
+
+
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
                                   "inner_iters", "rounds_per_chunk",
                                   "inner_impl", "interpret", "selection"))
@@ -269,48 +318,14 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
                 & (st.b_lo > st.b_hi + 2.0 * eps))
 
     def body(st: BlockState):
-        # ONE selection pass per round: the same sweep yields the working
-        # set for this round AND the stopping extrema of the CURRENT f.
-        # The loop cond therefore sees extrema one fold behind; the final
-        # convergence round runs with `limit` gated to 0 (a selection +
-        # one inert fold), and the exit-state b_hi/b_lo are exact for the
-        # final f. Callers that exit on the iteration budget instead
-        # refresh the extrema host-side (solver/smo.py).
-        w, slot_ok, b_hi, b_lo = select_block(st.f, st.alpha, y, c, q,
-                                              rule=selection)
-        gap_open = b_lo > b_hi + 2.0 * eps
-        qx = jnp.take(x, w, axis=0)  # (q, d)
-        qsq = jnp.take(x_sq, w)
-        dots_w = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
-                         preferred_element_type=jnp.float32)
-        kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)  # (q, q)
-        kd_w = jnp.take(k_diag, w)
-        alpha_w0 = jnp.take(st.alpha, w)
-        y_w = jnp.take(y, w)
-        f_w0 = jnp.take(st.f, w)
-
-        # Per-round pair budget, clamped so total pairs never exceed
-        # max_iter (the per-pair engines cap exactly; so must this one)
-        # and gated to 0 on the final (already-converged) round.
-        limit = jnp.minimum(jnp.int32(inner_iters), max_iter - st.pairs)
-        limit = jnp.where(gap_open, limit, 0)
-        if inner_impl == "pallas":
-            from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
-
-            alpha_w, t = solve_subproblem_pallas(
-                kb_w, alpha_w0, y_w, f_w0, kd_w,
-                slot_ok.astype(jnp.float32), limit, c, eps, tau,
-                rule=selection, interpret=interpret)
-        else:
-            alpha_w, _, t = _solve_subproblem(
-                kb_w, kd_w, slot_ok, alpha_w0, y_w, f_w0, c, eps, tau,
-                limit, rule=selection)
-
+        w, slot_ok, b_hi, b_lo, alpha_w, coef, t, qx, qsq = _round_core(
+            x, y, x_sq, k_diag, st.f, st.alpha, None, max_iter - st.pairs,
+            kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
+            selection)
         # Fold the round's alpha deltas into the global state with one
         # fused matmul chain over X (the single O(n d q) pass per round):
         # f += (dalpha * y)_W @ K(W, :), with K(W, :) from the same
         # kernel_rows machinery every other engine uses.
-        coef = jnp.where(slot_ok, (alpha_w - alpha_w0) * y_w, 0.0)  # (q,)
         k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n) fp32
         f = st.f + coef @ k_rows
         # Dead slots must not scatter. The inert index must be OUT OF
@@ -323,3 +338,122 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
 
     return lax.while_loop(cond, body, state)
 
+
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
+                                  "inner_iters", "rounds_per_chunk",
+                                  "m", "k_rounds",
+                                  "inner_impl", "interpret", "selection"))
+def run_chunk_block_active(x, y, x_sq, k_diag, state: BlockState, max_iter,
+                           kp: KernelParams, c, eps: float, tau: float,
+                           q: int, inner_iters: int, rounds_per_chunk: int,
+                           m: int, k_rounds: int,
+                           inner_impl: str = "xla",
+                           interpret: bool = False,
+                           selection: str = "mvp") -> BlockState:
+    """Active-set ("shrinking") variant of run_chunk_block.
+
+    LibSVM shrinks by dropping bound-saturated rows from its scans and
+    reconstructing the gradient when the shrunken problem converges
+    (svm.cpp Solver::do_shrinking) — a dynamic-size strategy XLA can't
+    compile. This is the same idea re-derived for static shapes. One
+    CYCLE:
+
+      1. active selection: A = the m most-violating rows (select_block
+         with q=m — top m/2 of I_up and of I_low), which also yields the
+         EXACT global stopping extrema of the current f;
+      2. up to `k_rounds` ordinary block rounds whose selection, Gram
+         gathers and fold all run on (m,)-sized state only — the per
+         round full-n fold becomes a (q, d) x (d, m) pass;
+      3. one batched reconciliation fold applies every round's
+         accumulated (W, coef) deltas to the full gradient with a single
+         (k_rounds*q, d) x (d, n) matmul chain, then the active slots are
+         scattered back.
+
+    Exactness: f updates are linear in the per-round coefs, so deferring
+    the non-active rows' fold to step 3 changes floating-point grouping
+    only, never the math; convergence is only ever declared from step 1's
+    full-f extrema (the A-restricted gap merely ends a cycle early).
+    Why it's faster: the full-X HBM stream — the block engine's dominant
+    cost — happens once per cycle instead of once per round (same FLOPs,
+    ~k_rounds x less X traffic, and a (k_rounds*q)-row matmul tiles the
+    MXU better than q-row passes).
+
+    Requires q <= m <= n. `rounds_per_chunk` is checked at cycle
+    granularity, so a chunk can overshoot by up to k_rounds-1 rounds.
+    """
+    n = y.shape[0]
+    end = state.rounds + rounds_per_chunk
+
+    def cond(st: BlockState):
+        return ((st.rounds < end) & (st.pairs < max_iter)
+                & (st.b_lo > st.b_hi + 2.0 * eps))
+
+    def cycle(st: BlockState):
+        act_ids, act_ok, b_hi, b_lo = select_block(
+            st.f, st.alpha, y, c, m, rule=selection)
+        gap_open = b_lo > b_hi + 2.0 * eps
+        x_act = jnp.take(x, act_ids, axis=0)  # (m, d)
+        sq_act = jnp.take(x_sq, act_ids)
+        kd_act = jnp.take(k_diag, act_ids)
+        y_act = jnp.take(y, act_ids)
+        a_act0 = jnp.take(st.alpha, act_ids)
+        f_act0 = jnp.take(st.f, act_ids)
+        pend_w0 = jnp.zeros((k_rounds, q), jnp.int32)
+        pend_c0 = jnp.zeros((k_rounds, q), jnp.float32)
+
+        def inner_cond(carry):
+            _, _, _, _, k, t_tot, open_a = carry
+            return ((k < k_rounds) & open_a
+                    & (st.pairs + t_tot < max_iter))
+
+        def inner_body(carry):
+            a_act, f_act, pend_w, pend_c, k, t_tot, _ = carry
+            # The shared round step, restricted to the active views
+            # (valid=act_ok keeps dead filler slots out of every mask).
+            w, slot_ok, bh_a, bl_a, a_w, coef, t, qx, qsq = _round_core(
+                x_act, y_act, sq_act, kd_act, f_act, a_act, act_ok,
+                max_iter - st.pairs - t_tot,
+                kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
+                selection)
+            open_a = bl_a > bh_a + 2.0 * eps
+            k_rows_act = kernel_rows(x_act, sq_act, qx, qsq, kp)  # (q, m)
+            f_act = f_act + coef @ k_rows_act
+            safe_w = jnp.where(slot_ok, w, jnp.int32(m))
+            a_act = a_act.at[safe_w].set(
+                jnp.where(slot_ok, a_w, 0.0), mode="drop")
+            # Record this round's deltas for the reconciliation fold
+            # (dead slots carry coef 0 and contribute nothing).
+            pend_w = pend_w.at[k].set(jnp.take(act_ids, w))
+            pend_c = pend_c.at[k].set(coef)
+            return a_act, f_act, pend_w, pend_c, k + 1, t_tot + t, open_a
+
+        a_act, f_act, pend_w, pend_c, k_done, t_tot, _ = lax.while_loop(
+            inner_cond, inner_body,
+            (a_act0, f_act0, pend_w0, pend_c0, jnp.int32(0), jnp.int32(0),
+             gap_open))
+
+        # Reconciliation: one batched fold applies the cycle's deltas to
+        # the FULL gradient (skipped entirely on the terminal all-zero
+        # cycle). XLA fuses the kernel evaluation into the contraction
+        # exactly as in run_chunk_block's per-round fold.
+        def do_fold(f):
+            wf = pend_w.reshape(-1)
+            cf = pend_c.reshape(-1)
+            xw = jnp.take(x, wf, axis=0)  # (k_rounds*q, d)
+            sqw = jnp.take(x_sq, wf)
+            return f + cf @ kernel_rows(x, x_sq, xw, sqw, kp)
+
+        f = lax.cond(t_tot > 0, do_fold, lambda f: f, st.f)
+        # Active slots hold the incrementally-maintained values the inner
+        # selections actually saw — scatter them over the fold's
+        # (numerically regrouped) results so the two views agree exactly.
+        # Only LIVE slots scatter (a dead duplicate slot holds stale
+        # copies of a live row's state).
+        safe_ids = jnp.where(act_ok, act_ids, jnp.int32(n))
+        f = f.at[safe_ids].set(jnp.where(act_ok, f_act, 0.0), mode="drop")
+        alpha = st.alpha.at[safe_ids].set(
+            jnp.where(act_ok, a_act, 0.0), mode="drop")
+        return BlockState(alpha, f, b_hi, b_lo,
+                          st.pairs + t_tot, st.rounds + k_done)
+
+    return lax.while_loop(cond, cycle, state)

@@ -29,7 +29,8 @@ from dpsvm_tpu.ops.kernels import (
     row_dots,
     squared_norms,
 )
-from dpsvm_tpu.ops.select import (c_of, extrema_np, low_mask, select_working_set,
+from dpsvm_tpu.ops.select import (c_of, low_mask, refresh_extrema_host,
+                                  select_working_set,
                                   select_working_set_nu, split_c, up_mask)
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
@@ -495,6 +496,15 @@ def solve(
         q = max(gran, min(config.working_set_size, n_pad))
         q -= q % gran
         inner = config.inner_iters or 2 * q
+        # Active-set shrinking: clamp m into [q, n] on the same class
+        # granularity. (Even m == n is not quite the plain engine: each
+        # selection side still gets only m/2 slots, so one class's
+        # low-rank violators can sit out a cycle — still exact, just a
+        # different, restricted round sequence.)
+        m_act = 0
+        if config.active_set_size:
+            m_act = max(q, min(config.active_set_size, n_pad))
+            m_act -= m_act % gran
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
                            rounds=jnp.int32(0))
@@ -537,6 +547,16 @@ def solve(
                 x_dev, y_dev, x_sq, valid_dev, state, max_iter,
                 kp, config.c_bounds(), float(config.epsilon), float(config.tau),
                 chunk_len, use_cache, block_rows, interpret)
+        elif use_block and m_act:
+            from dpsvm_tpu.solver.block import run_chunk_block_active
+
+            state = run_chunk_block_active(
+                x_dev, y_dev, x_sq, k_diag, state, max_iter,
+                kp, config.c_bounds(), float(config.epsilon), float(config.tau),
+                q, inner, rounds_per_chunk,
+                m_act, int(config.reconcile_rounds),
+                inner_impl="pallas" if not interpret else "xla",
+                selection=config.selection)
         elif use_block:
             state = run_chunk_block(
                 x_dev, y_dev, x_sq, k_diag, state, max_iter,
@@ -558,7 +578,7 @@ def solve(
         # control flow: a stale-open gap just dispatches one more (gated)
         # chunk, a restored stale checkpoint gap is re-derived by the
         # next round's selection, and the final SolveResult refreshes
-        # budget exits exactly (extrema_np below).
+        # budget exits exactly (refresh_extrema_host below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * config.epsilon)
@@ -578,14 +598,9 @@ def solve(
 
     alpha = np.asarray(state.alpha)[:n]
     if use_block and not converged:
-        # Budget exit: the carried extrema are one fold behind (the
-        # selection that would refresh them belongs to the round that
-        # never ran). Recompute exactly from the pulled final state —
-        # also catches a solve whose very last in-budget round closed
-        # the gap.
-        b_hi, b_lo = extrema_np(np.asarray(state.f)[:n], alpha, y_np,
-                                config.c_bounds(), rule=config.selection)
-        converged = not (b_lo > b_hi + 2.0 * config.epsilon)
+        b_hi, b_lo, converged = refresh_extrema_host(
+            np.asarray(state.f)[:n], alpha, y_np, config.c_bounds(),
+            config.epsilon, rule=config.selection)
     # Hit-rate denominator covers only THIS run's lookups (post-resume).
     total_lookups = 2 * (it - start_iter) if use_cache else 0
     return SolveResult(

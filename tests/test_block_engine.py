@@ -234,6 +234,66 @@ def test_select_block_filler_does_not_mask_low_candidates():
     assert 0 in low_live
 
 
+def test_active_block_matches_plain_optimum(blobs_medium):
+    """The active-set (shrinking) variant must reach the SAME optimum as
+    the plain block engine — shrinking defers the non-active rows' linear
+    f updates, it never changes the math — across small/large active sets
+    (m >= n still restricts each side to m/2 slots) and reconcile
+    cadences, with and without class weights."""
+    x, y = blobs_medium
+    base = CFG.replace(engine="block", working_set_size=32)
+    rb = solve(x, y, base)
+
+    def obj(r):
+        a, f = r.alpha, r.stats["f"]
+        return float(a.sum() - 0.5 * np.sum(a * y * (f + y)))
+
+    for m, k in [(64, 4), (256, 2), (4096, 8)]:
+        ra = solve(x, y, base.replace(active_set_size=m, reconcile_rounds=k))
+        assert ra.converged
+        # Both engines stop at eps-approximate optima via different pair
+        # sequences, so borderline SVs may legitimately differ by a few.
+        assert abs(ra.n_sv - rb.n_sv) <= max(2, 0.01 * rb.n_sv)
+        assert abs(ra.b - rb.b) < 5e-3
+        assert abs(obj(ra) - obj(rb)) <= 1e-3 * abs(obj(rb))
+
+    w = base.replace(weight_pos=2.0, weight_neg=0.5)
+    rw = solve(x, y, w)
+    ra = solve(x, y, w.replace(active_set_size=128, reconcile_rounds=8))
+    assert ra.converged
+    assert abs(obj(ra) - obj(rw)) <= 1e-3 * abs(obj(rw))
+
+
+def test_active_block_budget_cap_exact(blobs_medium):
+    """Shrinking must respect max_iter exactly (the inner limit is
+    clamped to the remaining budget), and a budget exit must report
+    refreshed, non-stale extrema (extrema_np path)."""
+    from dpsvm_tpu.ops.select import extrema_np
+
+    x, y = blobs_medium
+    r = solve(x, y, CFG.replace(engine="block", working_set_size=32,
+                                active_set_size=64, max_iter=37))
+    assert r.iterations == 37
+    assert not r.converged
+    b_hi, b_lo = extrema_np(r.stats["f"], r.alpha, y, CFG.c)
+    assert r.b_hi == b_hi and r.b_lo == b_lo
+
+
+def test_active_block_rejected_on_mesh_and_nonblock_engines(blobs_small):
+    """Loud failures, not silent ignores: shrinking is a single-chip
+    block-engine knob."""
+    import pytest
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    with pytest.raises(ValueError, match="block-engine knob"):
+        SVMConfig(engine="xla", active_set_size=64)
+    with pytest.raises(ValueError, match="single-chip block engine only"):
+        solve_mesh(x, y, CFG.replace(engine="block", active_set_size=64))
+
+
 def test_select_block_extrema_match_canonical_selectors():
     """The b_hi/b_lo riding select_block's top-k pass ARE the stopping
     extrema: they must equal select_working_set(_nu)'s over randomized
