@@ -37,8 +37,13 @@ multihost_check:
 
 # Mid-scale LibSVM parity table -> PARITY.md (single-chip cases on the
 # real TPU; mesh cases on the virtual 8-device CPU platform).
+# parity_full additionally runs adult-shaped at the reference's exact
+# n=32561 (reference Makefile:86).
 parity:
 	$(PY) tools/parity.py
+
+parity_full:
+	$(PY) tools/parity.py --full
 
 # Delegates to the Python builder so the compile command lives in exactly
 # one place (dpsvm_tpu/utils/native.py, which also fingerprints the flags).
