@@ -29,7 +29,13 @@ import numpy as np
 
 def _build_train_parser(sub) -> argparse.ArgumentParser:
     p = sub.add_parser("train", help="train an SVM with modified SMO")
-    p.add_argument("-f", "--file-path", required=True, help="training CSV (label,f1,...,fd)")
+    p.add_argument("-f", "--file-path", required=True,
+                   help="training data: reference CSV (label,f1,...,fd) or "
+                        "sparse LIBSVM format (label idx:val ...)")
+    p.add_argument("--format", choices=["auto", "csv", "libsvm"],
+                   default="auto",
+                   help="input format (default auto: LIBSVM rows are "
+                        "recognized by their idx:val tokens)")
     p.add_argument("-m", "--model", required=True, help="output model path (.txt or .npz)")
     # LibSVM's -s svm_type role (the reference trains C-SVC only).
     p.add_argument("-t", "--svm-type", default="c-svc",
@@ -116,7 +122,12 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
 
 def _build_test_parser(sub) -> argparse.ArgumentParser:
     p = sub.add_parser("test", help="evaluate a trained model on a CSV")
-    p.add_argument("-f", "--file-path", required=True, help="test CSV")
+    p.add_argument("-f", "--file-path", required=True,
+                   help="test data (CSV or sparse LIBSVM format)")
+    p.add_argument("--format", choices=["auto", "csv", "libsvm"],
+                   default="auto",
+                   help="input format (default auto: LIBSVM rows are "
+                        "recognized by their idx:val tokens)")
     p.add_argument("-m", "--model", required=True, help="model path (.txt or .npz)")
     p.add_argument("-a", "--num-att", type=int, default=None)
     p.add_argument("-x", "--num-ex", type=int, default=None)
@@ -176,7 +187,7 @@ def _cmd_smoke(args) -> int:
 
 def _cmd_train(args) -> int:
     from dpsvm_tpu.config import SVMConfig
-    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.data.loader import load_data
     from dpsvm_tpu.train import train
     from dpsvm_tpu.utils.metrics import MetricsLogger, profile_trace
 
@@ -210,8 +221,8 @@ def _cmd_train(args) -> int:
 
     t0 = time.perf_counter()
     regression = args.svm_type in ("eps-svr", "nu-svr")
-    x, y = load_csv(args.file_path, args.num_ex, args.num_att,
-                    float_labels=regression)
+    x, y = load_data(args.file_path, args.num_ex, args.num_att,
+                     float_labels=regression, fmt=args.format)
     if not args.quiet:
         print(f"loaded {x.shape[0]} examples x {x.shape[1]} features "
               f"in {time.perf_counter() - t0:.2f}s")
@@ -295,7 +306,7 @@ def _cmd_train(args) -> int:
 
 
 def _cmd_test(args) -> int:
-    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.data.loader import load_data
     from dpsvm_tpu.models.svm_model import SVMModel
     from dpsvm_tpu.ops.kernels import KernelParams
     from dpsvm_tpu.predict import accuracy
@@ -311,8 +322,8 @@ def _cmd_test(args) -> int:
     if model_type == "svr":
         from dpsvm_tpu.models.svr import SVRModel
         model = SVRModel.load(args.model)
-        x, z_true = load_csv(args.file_path, args.num_ex, args.num_att,
-                             float_labels=True)
+        x, z_true = load_data(args.file_path, args.num_ex, args.num_att,
+                              float_labels=True, fmt=args.format)
         pred = np.asarray(model.predict(x), np.float64)
         rmse = float(np.sqrt(np.mean((pred - z_true) ** 2)))
         ss_tot = float(np.sum((z_true - z_true.mean()) ** 2))
@@ -323,7 +334,8 @@ def _cmd_test(args) -> int:
     if model_type == "oneclass":
         from dpsvm_tpu.models.oneclass import OneClassModel
         model = OneClassModel.load(args.model)
-        x, y = load_csv(args.file_path, args.num_ex, args.num_att)
+        x, y = load_data(args.file_path, args.num_ex, args.num_att,
+                         fmt=args.format)
         pred = model.predict(x)
         print(f"loaded one-class model: {model.n_sv} SVs, rho={model.rho:.6f}")
         print(f"test inlier fraction: {float(np.mean(pred > 0)):.4f} "
@@ -336,7 +348,8 @@ def _cmd_test(args) -> int:
     if args.gamma is not None:
         model.kernel = KernelParams(
             model.kernel.kind, args.gamma, model.kernel.degree, model.kernel.coef0)
-    x, y = load_csv(args.file_path, args.num_ex, args.num_att)
+    x, y = load_data(args.file_path, args.num_ex, args.num_att,
+                     fmt=args.format)
     acc = accuracy(model, x, y)
     print(f"loaded model: {model.n_sv} SVs, gamma={model.kernel.gamma}, "
           f"b={model.b:.6f}")

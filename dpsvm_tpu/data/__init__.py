@@ -1,4 +1,4 @@
-from dpsvm_tpu.data.loader import load_csv, save_csv
+from dpsvm_tpu.data.loader import load_csv, load_data, save_csv, sniff_format
 from dpsvm_tpu.data.synth import (make_adult_like, make_blobs_binary,
                                   make_mnist_like)
 from dpsvm_tpu.data.converters import (
@@ -10,6 +10,8 @@ from dpsvm_tpu.data.converters import (
 
 __all__ = [
     "load_csv",
+    "load_data",
+    "sniff_format",
     "save_csv",
     "make_adult_like",
     "make_blobs_binary",

@@ -14,14 +14,19 @@ from __future__ import annotations
 import numpy as np
 
 
-def parse_libsvm(path: str, num_features: int | None = None):
+def parse_libsvm(path: str, num_features: int | None = None,
+                 num_rows: int | None = None):
     """Parse sparse LIBSVM lines ``label idx:val idx:val ...`` (1-based
-    indices) into dense arrays (x float32 (n,d), y int32 +-1)."""
+    indices) into dense arrays (x float32 (n,d), y int32 +-1). Reading
+    stops after `num_rows` examples when given (matching load_csv's
+    bounded read of the reference parser, parse.cpp:25)."""
     rows: list[dict[int, float]] = []
     labels: list[int] = []
     max_idx = 0
     with open(path) as fh:
         for lineno, line in enumerate(fh, 1):
+            if num_rows is not None and len(rows) >= num_rows:
+                break
             parts = line.split()
             if not parts:
                 continue

@@ -16,6 +16,59 @@ import numpy as np
 from dpsvm_tpu.utils import native
 
 
+def sniff_format(path: str, max_lines: int = 32) -> str:
+    """Detect "csv" vs "libsvm" from the leading non-empty lines: sparse
+    LIBSVM rows carry ``idx:val`` tokens while the reference CSV always
+    contains commas (parse.cpp:10-43). Several lines are examined because
+    a legal LIBSVM row with no nonzero features is a bare label with
+    neither marker; an undecided file (all label-only rows) falls back to
+    csv."""
+    seen = 0
+    with open(path) as fh:
+        for line in fh:
+            if not line.strip():
+                continue
+            if ":" in line:
+                return "libsvm"
+            if "," in line:
+                return "csv"
+            seen += 1
+            if seen >= max_lines:
+                break
+    return "csv"
+
+
+def load_data(
+    path: str,
+    num_rows: int | None = None,
+    num_features: int | None = None,
+    float_labels: bool = False,
+    fmt: str = "auto",
+) -> tuple[np.ndarray, np.ndarray]:
+    """Format-dispatching loader: the reference's dense CSV or the sparse
+    LIBSVM format its prep scripts consume (scripts/convert_adult.py) —
+    so `.libsvm`/`a9a`-style files train directly, no offline conversion
+    step. fmt: "auto" (sniff), "csv", "libsvm"."""
+    if fmt == "auto":
+        fmt = sniff_format(path)
+    if fmt == "csv":
+        return load_csv(path, num_rows, num_features, float_labels)
+    if fmt != "libsvm":
+        raise ValueError(f"unknown data format {fmt!r} (csv | libsvm | auto)")
+    if float_labels:
+        raise ValueError(
+            "LIBSVM-format regression targets are not supported; convert "
+            "to CSV (data/converters.py libsvm_to_csv handles +-1 "
+            "classification files only)")
+    from dpsvm_tpu.data.converters import parse_libsvm
+
+    x, y = parse_libsvm(path, num_features, num_rows=num_rows)
+    if num_rows is not None and x.shape[0] < num_rows:
+        raise ValueError(
+            f"{path}: file has {x.shape[0]} rows, expected {num_rows}")
+    return np.ascontiguousarray(x, np.float32), y
+
+
 def load_csv(
     path: str,
     num_rows: int | None = None,

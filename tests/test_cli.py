@@ -198,3 +198,31 @@ def test_train_cli_svr_model_extension_coerced(csvs, capsys, tmp_path):
     assert "models use the .npz format" in out
     import os
     assert os.path.exists(mp + ".npz")
+
+
+def test_train_cli_libsvm_format(csvs, capsys, tmp_path):
+    """A sparse LIBSVM-format file trains directly (auto-sniffed), no
+    offline conversion step — and matches the CSV-trained model."""
+    import numpy as np
+
+    from dpsvm_tpu.data.loader import load_csv
+
+    train_p, _, d = csvs
+    x, y = load_csv(train_p)
+    lib_p = str(tmp_path / "train.libsvm")
+    with open(lib_p, "w") as fh:
+        for row, lab in zip(x, y):
+            toks = [f"{j + 1}:{v}" for j, v in enumerate(row)]
+            fh.write(("+1" if lab > 0 else "-1") + " " + " ".join(toks) + "\n")
+    m_csv = str(tmp_path / "m_csv.txt")
+    m_lib = str(tmp_path / "m_lib.txt")
+    common = ["-c", "5", "-g", "0.1", "--backend", "single", "-q"]
+    assert main(["train", "-f", train_p, "-m", m_csv] + common) == 0
+    assert main(["train", "-f", lib_p, "-m", m_lib] + common) == 0
+    capsys.readouterr()
+    from dpsvm_tpu.models.svm_model import SVMModel
+
+    a, b = SVMModel.load(m_csv), SVMModel.load(m_lib)
+    assert a.sv_x.shape == b.sv_x.shape
+    assert abs(a.b - b.b) < 1e-5
+    np.testing.assert_allclose(a.sv_alpha, b.sv_alpha, atol=1e-5)

@@ -133,3 +133,61 @@ def test_from_dense_filters_zero_alpha():
     m = SVMModel.from_dense(x, y, alpha, 0.1, KernelParams("rbf", 1.0))
     assert m.n_sv == 2
     np.testing.assert_array_equal(m.sv_y, [-1, -1])
+
+
+@pytest.fixture()
+def libsvm_file(tmp_path):
+    rng = np.random.default_rng(7)
+    x = np.round(rng.random((30, 6)), 4).astype(np.float32)
+    x[x < 0.4] = 0.0  # sparsity so some idx:val tokens are omitted
+    y = np.where(rng.random(30) < 0.5, 1, -1).astype(np.int32)
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as fh:
+        for row, lab in zip(x, y):
+            toks = [f"{j + 1}:{v}" for j, v in enumerate(row) if v != 0]
+            fh.write(("+1" if lab > 0 else "-1") + " " + " ".join(toks) + "\n")
+    return path, x, y
+
+
+def test_sniff_format(csv_file, libsvm_file):
+    from dpsvm_tpu.data.loader import sniff_format
+
+    assert sniff_format(csv_file[0]) == "csv"
+    assert sniff_format(libsvm_file[0]) == "libsvm"
+
+
+def test_load_data_libsvm_matches_converted_csv(tmp_path, libsvm_file):
+    """Direct LIBSVM loading must equal the convert-then-load path (the
+    reference's offline scripts/convert_adult.py workflow)."""
+    from dpsvm_tpu.data.converters import libsvm_to_csv
+    from dpsvm_tpu.data.loader import load_data
+
+    path, x, y = libsvm_file
+    x1, y1 = load_data(path, num_features=6)  # auto-sniffed
+    csv_path = str(tmp_path / "conv.csv")
+    libsvm_to_csv(path, csv_path, num_features=6)
+    x2, y2 = load_data(csv_path)
+    np.testing.assert_allclose(x1, x2, atol=1e-6)
+    np.testing.assert_array_equal(y1, y2)
+    np.testing.assert_allclose(x1, x, atol=1e-6)
+    np.testing.assert_array_equal(y1, y)
+    # Row bound honored; regression targets rejected with a clear error.
+    xr, yr = load_data(path, num_rows=10, num_features=6)
+    assert xr.shape == (10, 6)
+    with pytest.raises(ValueError, match="regression"):
+        load_data(path, float_labels=True)
+
+
+def test_sniff_format_label_only_first_row(tmp_path):
+    """A legal LIBSVM row with no nonzero features is a bare label —
+    sniffing must look past it instead of misreading the file as CSV."""
+    from dpsvm_tpu.data.loader import load_data, sniff_format
+
+    p = str(tmp_path / "lead.libsvm")
+    with open(p, "w") as fh:
+        fh.write("-1\n+1 2:0.5 3:1.0\n-1 1:0.25\n")
+    assert sniff_format(p) == "libsvm"
+    x, y = load_data(p)
+    assert x.shape == (3, 3)
+    np.testing.assert_array_equal(y, [-1, 1, -1])
+    assert x[0].sum() == 0.0
