@@ -322,7 +322,10 @@ def _cmd_test(args) -> int:
     if model_type == "svr":
         from dpsvm_tpu.models.svr import SVRModel
         model = SVRModel.load(args.model)
-        x, z_true = load_data(args.file_path, args.num_ex, args.num_att,
+        # Sparse LIBSVM test files can omit trailing all-zero features;
+        # default the width to the model's so the kernel shapes line up.
+        natt = args.num_att or model.sv_x.shape[1]
+        x, z_true = load_data(args.file_path, args.num_ex, natt,
                               float_labels=True, fmt=args.format)
         pred = np.asarray(model.predict(x), np.float64)
         rmse = float(np.sqrt(np.mean((pred - z_true) ** 2)))
@@ -334,7 +337,8 @@ def _cmd_test(args) -> int:
     if model_type == "oneclass":
         from dpsvm_tpu.models.oneclass import OneClassModel
         model = OneClassModel.load(args.model)
-        x, y = load_data(args.file_path, args.num_ex, args.num_att,
+        natt = args.num_att or model.sv_x.shape[1]
+        x, y = load_data(args.file_path, args.num_ex, natt,
                          fmt=args.format)
         pred = model.predict(x)
         print(f"loaded one-class model: {model.n_sv} SVs, rho={model.rho:.6f}")
@@ -348,7 +352,8 @@ def _cmd_test(args) -> int:
     if args.gamma is not None:
         model.kernel = KernelParams(
             model.kernel.kind, args.gamma, model.kernel.degree, model.kernel.coef0)
-    x, y = load_data(args.file_path, args.num_ex, args.num_att,
+    natt = args.num_att or model.sv_x.shape[1]
+    x, y = load_data(args.file_path, args.num_ex, natt,
                      fmt=args.format)
     acc = accuracy(model, x, y)
     print(f"loaded model: {model.n_sv} SVs, gamma={model.kernel.gamma}, "

@@ -69,20 +69,15 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
         low = ((pos & gt_0) | (neg & lt_cn)) & ok
         return up, low
 
-    def gap(up, low, f):
-        """b_lo - b_hi under the rule's convergence definition: global
-        extrema for mvp/second_order, max per-class violation for nu."""
-        if rule == "nu":
-            v_p = (jnp.max(jnp.where(low & pos, f, -_INF))
-                   - jnp.min(jnp.where(up & pos, f, _INF)))
-            v_n = (jnp.max(jnp.where(low & neg, f, -_INF))
-                   - jnp.min(jnp.where(up & neg, f, _INF)))
-            return jnp.maximum(v_p, v_n)
-        return (jnp.max(jnp.where(low, f, -_INF))
-                - jnp.min(jnp.where(up, f, _INF)))
-
     def iteration(carry):
-        alpha, f, t = carry
+        # One mask/extrema computation per pair update: the selection
+        # below yields the pair AND the stopping gap of the CURRENT
+        # (alpha, f), so `cond` only tests the carried flag — the old
+        # structure recomputed masks + both extrema a second time in
+        # cond on every trip. The final trip runs with the update gated
+        # to a no-op (pair_alpha_update's `gate`), exactly like the
+        # outer block round's terminal-round gating (solver/block.py).
+        alpha, f, t, _ = carry
         up, low = masks(alpha)
         if rule == "nu":
             # Per-class MVP; pick the class with the larger violation so
@@ -111,8 +106,16 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
         elif rule == "second_order":
             # LibSVM WSS2: i by max violation; j by max second-order gain
             # (f_j - b_hi)^2 / eta_ij over row i of the VMEM Gram block.
+            # CRITICAL: the stopping gap uses the MAX violator (b_lo_stop),
+            # not the gain-selected j's violation — the best-gain j can sit
+            # within 2 eps while a larger violator with a bigger eta stays
+            # open; gating on f[j] - b_hi would end the subproblem with
+            # zero pairs, the outer fold would change nothing, and the
+            # outer round loop would re-select the same W forever (a
+            # single dispatch spinning until the device watchdog kills it).
             f_up = jnp.where(up, f, _INF)
             b_hi = jnp.min(f_up)
+            b_lo_stop = jnp.max(jnp.where(low, f, -_INF))
             i = jnp.min(jnp.where(f_up == b_hi, lanes, _IMAX))
             row_i = kb_ref[pl.ds(i, 1), :]
             sel_i0 = lanes == i
@@ -121,8 +124,8 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
             gain = jnp.where(low & (diff > 0.0), diff * diff / eta_j, -_INF)
             g_best = jnp.max(gain)
             j = jnp.min(jnp.where(gain == g_best, lanes, _IMAX))
-            # cond() guarantees an eligible j exists when the body runs
-            # (open gap => some f_low > b_hi).
+            # An eligible j exists whenever the stop gap is open
+            # (some f_low > b_hi); when closed the update is gated off.
             sel_j0 = lanes == j
             b_lo = _pick1(sel_j0, f)
         else:
@@ -134,9 +137,14 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
             j = jnp.min(jnp.where(f_low == b_lo, lanes, _IMAX))
             row_i = kb_ref[pl.ds(i, 1), :]  # (1, q)
 
+        b_lo_gap = b_lo_stop if rule == "second_order" else b_lo
+        gap_open = (b_lo_gap - b_hi) > 2.0 * eps
         row_j = kb_ref[pl.ds(j, 1), :]
         sel_i = lanes == i
         sel_j = lanes == j
+        # (A stacked (3, q) masked-reduce extraction was tried here and
+        # rejected by Mosaic — i1 vregs cannot be reshaped/concatenated:
+        # "Invalid vector register cast" on vector<8x128xi1>.)
         y_i = _pick1(sel_i, y)
         y_j = _pick1(sel_j, y)
         k_ij = _pick1(sel_j, row_i)
@@ -147,20 +155,21 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
         c_i = cp if cp == cn else jnp.where(y_i > 0, cp, cn)
         c_j = cp if cp == cn else jnp.where(y_j > 0, cp, cn)
         a_i_new, a_j_new = pair_alpha_update(
-            a_i_old, a_j_old, y_i, y_j, b_hi, b_lo, eta, c_i, c_j)
+            a_i_old, a_j_old, y_i, y_j, b_hi, b_lo, eta, c_i, c_j,
+            gate=gap_open)
         alpha = jnp.where(sel_i, a_i_new, alpha)
         alpha = jnp.where(sel_j, a_j_new, alpha)
         f = f + (a_i_new - a_i_old) * y_i * row_i \
               + (a_j_new - a_j_old) * y_j * row_j
-        return alpha, f, t + 1
+        return alpha, f, t + jnp.int32(gap_open), gap_open
 
     def cond(carry):
-        alpha, f, t = carry
-        up, low = masks(alpha)
-        return (t < limit) & (gap(up, low, f) > 2.0 * eps)
+        _, _, t, gap_open = carry
+        return (t < limit) & gap_open
 
-    alpha, _, t = lax.while_loop(
-        cond, iteration, (alpha_ref[:], f_ref[:], jnp.int32(0)))
+    alpha, _, t, _ = lax.while_loop(
+        cond, iteration,
+        (alpha_ref[:], f_ref[:], jnp.int32(0), limit > 0))
     alpha_out_ref[:] = alpha
     t_ref[0] = t
 

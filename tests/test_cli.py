@@ -226,3 +226,27 @@ def test_train_cli_libsvm_format(csvs, capsys, tmp_path):
     assert a.sv_x.shape == b.sv_x.shape
     assert abs(a.b - b.b) < 1e-5
     np.testing.assert_allclose(a.sv_alpha, b.sv_alpha, atol=1e-5)
+
+
+def test_test_cli_libsvm_narrower_file_uses_model_width(csvs, capsys, tmp_path):
+    """A sparse LIBSVM test file whose trailing features are all zero has
+    a smaller max index than the model's width (the canonical a9a.t case);
+    the test command must default the feature dim to the model's."""
+    import numpy as np
+
+    from dpsvm_tpu.data.loader import load_csv
+
+    train_p, _, d = csvs
+    model_p = str(tmp_path / "m.txt")
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    x, y = load_csv(train_p)
+    lib_p = str(tmp_path / "test_narrow.libsvm")
+    with open(lib_p, "w") as fh:
+        for row, lab in zip(x[:50], y[:50]):
+            # Omit the last feature column entirely -> max index = d-1.
+            toks = [f"{j + 1}:{v}" for j, v in enumerate(row[:-1])]
+            fh.write(("+1" if lab > 0 else "-1") + " " + " ".join(toks) + "\n")
+    assert main(["test", "-f", lib_p, "-m", model_p]) == 0
+    out = capsys.readouterr().out
+    assert "test accuracy:" in out
