@@ -37,17 +37,23 @@ _IMAX = 2**31 - 1
 
 
 def _pick1(sel, vec):
-    """Extract vec[i] as a scalar given the one-hot mask sel = (lanes == i).
+    """Extract vec[i] as a scalar given the one-hot mask sel = (idx == i).
     Random scalar gathers are not a Mosaic primitive; a masked reduce is
-    one VPU pass over a (1, q) register tile."""
+    one VPU pass over the (rows, 128) register tile."""
     return jnp.sum(jnp.where(sel, vec, 0.0))
 
 
 def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
                        ok_ref, alpha_out_ref, t_ref,
-                       *, q: int, cp: float, cn: float, eps: float,
+                       *, rows: int, cp: float, cn: float, eps: float,
                        tau: float, rule: str):
-    lanes = lax.broadcasted_iota(jnp.int32, (1, q), 1)
+    # All working-set state lives in (rows, 128) tiles: a (1, q) vector
+    # occupies ceil(q/128) vregs with 7 of 8 sublanes idle, while the
+    # (rows, 128) layout packs the same q values 8x denser — every
+    # elementwise op and reduction below runs on ~1/4 the vector
+    # instructions at q=512. `lanes` becomes the flattened slot index.
+    lanes = (lax.broadcasted_iota(jnp.int32, (rows, 128), 0) * 128
+             + lax.broadcasted_iota(jnp.int32, (rows, 128), 1))
     y = y_ref[:]
     kd = kd_ref[:]
     ok = ok_ref[:] > 0.0
@@ -102,7 +108,7 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
             b_lo = jnp.where(take_p, bl_p, bl_n)
             i = jnp.where(take_p, i_p, i_n)
             j = jnp.where(take_p, j_p, j_n)
-            row_i = kb_ref[pl.ds(i, 1), :]  # (1, q)
+            row_i = jnp.reshape(kb_ref[pl.ds(i, 1)], (rows, 128))
         elif rule == "second_order":
             # LibSVM WSS2: i by max violation; j by max second-order gain
             # (f_j - b_hi)^2 / eta_ij over row i of the VMEM Gram block.
@@ -117,7 +123,7 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
             b_hi = jnp.min(f_up)
             b_lo_stop = jnp.max(jnp.where(low, f, -_INF))
             i = jnp.min(jnp.where(f_up == b_hi, lanes, _IMAX))
-            row_i = kb_ref[pl.ds(i, 1), :]
+            row_i = jnp.reshape(kb_ref[pl.ds(i, 1)], (rows, 128))
             sel_i0 = lanes == i
             diff = f - b_hi
             eta_j = jnp.maximum(_pick1(sel_i0, kd) + kd - 2.0 * row_i, tau)
@@ -135,16 +141,19 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
             b_lo = jnp.max(f_low)
             i = jnp.min(jnp.where(f_up == b_hi, lanes, _IMAX))
             j = jnp.min(jnp.where(f_low == b_lo, lanes, _IMAX))
-            row_i = kb_ref[pl.ds(i, 1), :]  # (1, q)
+            row_i = jnp.reshape(kb_ref[pl.ds(i, 1)], (rows, 128))
 
         b_lo_gap = b_lo_stop if rule == "second_order" else b_lo
         gap_open = (b_lo_gap - b_hi) > 2.0 * eps
-        row_j = kb_ref[pl.ds(j, 1), :]
+        row_j = jnp.reshape(kb_ref[pl.ds(j, 1)], (rows, 128))
         sel_i = lanes == i
         sel_j = lanes == j
-        # (A stacked (3, q) masked-reduce extraction was tried here and
-        # rejected by Mosaic — i1 vregs cannot be reshaped/concatenated:
-        # "Invalid vector register cast" on vector<8x128xi1>.)
+        # Measured dead ends, recorded so they are not retried: (1) a
+        # stacked (3, q) masked-reduce extraction — Mosaic rejects i1
+        # vreg concatenation ("Invalid vector register cast"); (2) SMEM
+        # scalar mirrors of y/kd/alpha serving these picks as scalar-core
+        # loads — lowered fine but moved nothing (the loop is bound by
+        # its serial dependency chain, not by reduction count).
         y_i = _pick1(sel_i, y)
         y_j = _pick1(sel_j, y)
         k_ij = _pick1(sel_j, row_i)
@@ -190,8 +199,24 @@ def solve_subproblem_pallas(kb_w, alpha_w, y_w, f_w, kd_w, slot_ok, limit,
     """
     cp, cn = split_c(c)
     q = kb_w.shape[0]
+    # Pad the working set up to whole 128-lane rows and hand the kernel
+    # (rows, 128) tiles (see the layout note in _subproblem_kernel). Pad
+    # slots carry ok=0 so the masks exclude them everywhere; padded Gram
+    # columns are zero so row broadcasts leave their (dead) f untouched
+    # in any way that matters.
+    qp = -(-q // 128) * 128
+    rows = qp // 128
+    pad = qp - q
+
+    def padv(v, fill):
+        v = v.astype(jnp.float32)
+        if pad:
+            v = jnp.pad(v, (0, pad), constant_values=fill)
+        return v.reshape(rows, 128)
+
+    kb_p = kb_w if not pad else jnp.pad(kb_w, ((0, pad), (0, pad)))
     kern = functools.partial(
-        _subproblem_kernel, q=q, cp=float(cp), cn=float(cn),
+        _subproblem_kernel, rows=rows, cp=float(cp), cn=float(cn),
         eps=float(eps), tau=float(tau), rule=rule)
     vec = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -200,11 +225,12 @@ def solve_subproblem_pallas(kb_w, alpha_w, y_w, f_w, kd_w, slot_ok, limit,
         in_specs=[smem] + [vec] * 6,
         out_specs=[vec, smem],
         out_shape=[
-            jax.ShapeDtypeStruct((1, q), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 128), jnp.float32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
         ],
         interpret=interpret,
-    )(jnp.asarray(limit, jnp.int32).reshape(1), kb_w,
-      alpha_w.reshape(1, q), y_w.reshape(1, q), f_w.reshape(1, q),
-      kd_w.reshape(1, q), slot_ok.reshape(1, q))
-    return alpha_out.reshape(q), t[0]
+    )(jnp.asarray(limit, jnp.int32).reshape(1),
+      kb_p.reshape(qp, rows, 128),
+      padv(alpha_w, 0.0), padv(y_w, 1.0), padv(f_w, 0.0),
+      padv(kd_w, 1.0), padv(slot_ok, 0.0))
+    return alpha_out.reshape(qp)[:q], t[0]
