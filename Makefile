@@ -7,7 +7,7 @@
 PY ?= python
 DATA ?= data
 
-.PHONY: test test_all bench smoke tpu_smoke multihost_check parity native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all bench bench_predict smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs.
@@ -44,6 +44,11 @@ parity:
 
 parity_full:
 	$(PY) tools/parity.py --full
+
+# Batched-inference throughput -> BENCH_PREDICT.md (the svmTest role,
+# timed; the reference's CPU tester publishes no timing).
+bench_predict:
+	$(PY) tools/bench_predict.py
 
 # Delegates to the Python builder so the compile command lives in exactly
 # one place (dpsvm_tpu/utils/native.py, which also fingerprints the flags).

@@ -1,0 +1,140 @@
+"""Inference-throughput artifact: the svmTest role, timed.
+
+The reference's test program (seq_test.cpp:187-210) scores each point with
+an O(n_sv * d) CBLAS loop on one CPU core and publishes no timing. Here
+the same computation is one (n_test, d) x (d, n_sv) MXU matmul chain
+(dpsvm_tpu/predict.py); this tool measures it at the reference's two test
+shapes (MNIST 10k x 784, Adult 16281 x 123) against models with the SV
+counts the parity harness produced (PARITY.md), and REWRITES
+BENCH_PREDICT.md with one JSON line per shape (the artifact records the
+current build; history lives in git).
+
+Run on the real TPU: `python tools/bench_predict.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SHAPES = [
+    # name, n_test, d, n_sv (parity-harness scale), reference anchor
+    ("mnist-test-shaped", 10_000, 784, 3364, "reference Makefile:80"),
+    ("adult-test-shaped", 16_281, 123, 11905, "reference Makefile:83"),
+]
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.models.svm_model import SVMModel
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.predict import _decision_batch
+
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(3)
+    lines = []
+    for name, n_test, d, n_sv, anchor in SHAPES:
+        kp = KernelParams("rbf", 0.125 if d == 784 else 0.5)
+        model = SVMModel(
+            sv_x=rng.random((n_sv, d), np.float32),
+            sv_alpha=rng.random(n_sv).astype(np.float32),
+            sv_y=np.where(rng.random(n_sv) < 0.5, 1, -1).astype(np.int32),
+            b=0.1,
+            kernel=kp)
+        # DEVICE time only: queries and SVs staged to HBM outside the
+        # timer (this dev harness reaches the chip over a tunnel whose
+        # ~15 MB/s upload would otherwise be the whole measurement; a
+        # real deployment pays PCIe/ICI, and the reference's CPU tester
+        # has no transfer at all).
+        q = jnp.asarray(rng.random((n_test, d), np.float32))
+        sv_x = jnp.asarray(model.sv_x)
+        coef = jnp.asarray(model.dual_coef)
+        b = jnp.float32(model.b)
+        # Per-execution time by DIFFERENCING two in-dispatch rep counts
+        # ((t_hi - t_lo) / (hi - lo)): the tunnel adds tens of ms of
+        # fixed per-dispatch latency, and single executions on repeated
+        # identical dispatches can return in ~60 us (served without
+        # re-execution), so neither a lone call nor one rep count is
+        # trustworthy. The summed-decision carry keeps the full batch
+        # live (a sliced carry lets XLA compute one kernel row instead),
+        # and the acc*1e-30 term chains the trips.
+        LO, HI = 50, 500
+
+        def make_loop(reps):
+            @jax.jit
+            def loop(q, sv_x, coef, b):
+                def body(t, acc):
+                    dec = _decision_batch(q + acc * 1e-30, sv_x, coef, b,
+                                          kp)
+                    return jnp.sum(dec)
+                return jax.lax.fori_loop(0, reps, body, jnp.float32(0))
+            return loop
+
+        lo_fn, hi_fn = make_loop(LO), make_loop(HI)
+        # Timing discipline for this tunneled harness (each clause is a
+        # measured failure mode of a simpler formulation): the pipeline
+        # is drained by a VALUE PULL before the clock starts and the
+        # timed region ends with a value pull of the result —
+        # block_until_ready alone returns in ~60 us with the work still
+        # queued; every call gets distinct input contents; the fixed
+        # pull/dispatch latency cancels in the LO/HI difference.
+        qs = [q + jnp.float32(k * 1e-6) for k in range(7)]
+        float(lo_fn(qs[0], sv_x, coef, b))  # compile + sync
+        float(hi_fn(qs[0], sv_x, coef, b))
+        t_lo = min(_timed(lo_fn, (qs[k], sv_x, coef, b))
+                   for k in (1, 2, 3))
+        t_hi = min(_timed(hi_fn, (qs[k], sv_x, coef, b))
+                   for k in (4, 5, 6))
+        best = max((t_hi - t_lo) / (HI - LO), 1e-9)
+        # Sanity gate: a per-execution time implying more than the
+        # chip's bf16 peak means the measurement collapsed (cache /
+        # dead-code) — fail loudly rather than publish nonsense.
+        flops = 2.0 * n_test * d * n_sv
+        if flops / best > 400e12:
+            raise RuntimeError(
+                f"{name}: measured {flops / best / 1e12:.0f} TFLOP/s "
+                "> v5e peak; timing collapsed")
+        rec = {
+            "metric": f"{name} batched RBF decision function, "
+                      f"{n_test}x{d} against {n_sv} SVs ({anchor}; the "
+                      "reference's CPU tester publishes no timing)",
+            "value": round(best, 4),
+            "unit": "seconds",
+            "examples_per_second": round(n_test / best),
+            "device": str(dev),
+        }
+        print(json.dumps(rec))
+        lines.append(rec)
+
+    with open(os.path.join(REPO, "BENCH_PREDICT.md"), "w") as fh:
+        fh.write("# BENCH_PREDICT — batched inference throughput\n\n"
+                 "Command: `python tools/bench_predict.py` (real TPU; "
+                 "device time per execution via in-dispatch rep-count "
+                 "differencing, value-pull-synced, best of 3; synthetic "
+                 "SV sets at PARITY.md's oracle SV counts)."
+                 "\n\n```json\n"
+                 + "\n".join(json.dumps(r) for r in lines)
+                 + "\n```\n")
+    return 0
+
+
+def _timed(fn, args) -> float:
+    import jax.numpy as jnp
+
+    float(jnp.sum(args[0]))  # drain the dispatch pipeline
+    t0 = time.perf_counter()
+    float(fn(*args))  # dispatch + value pull = full sync
+    return time.perf_counter() - t0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
