@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-KERNELS = ("rbf", "linear", "poly", "sigmoid")
+KERNELS = ("rbf", "linear", "poly", "sigmoid", "precomputed")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,7 +50,11 @@ class SVMConfig:
 
     # Kernel family. The reference hardcodes RBF (svmTrain.cu:696-714);
     # linear/poly/sigmoid are capability extensions sharing the same
-    # dot-product row machinery.
+    # dot-product row machinery. "precomputed" (LibSVM -t 4) treats the
+    # training input as the (n, n) Gram matrix itself — single-chip
+    # xla/block engines; models carry SV indices, not feature rows
+    # (use solve() or the estimators.SVC facade, not the file-model
+    # train() path).
     kernel: str = "rbf"
     degree: int = 3
     coef0: float = 0.0
@@ -148,6 +152,23 @@ class SVMConfig:
                 "is internal to train_nusvc/train_nusvr)")
         if self.engine not in ("xla", "pallas", "block"):
             raise ValueError("engine must be 'xla', 'pallas' or 'block'")
+        if self.kernel == "precomputed":
+            if self.engine == "pallas":
+                raise ValueError(
+                    "kernel='precomputed' is not implemented for the fused "
+                    "pallas per-pair engine (its kernel evaluation is "
+                    "baked into the on-chip pass); use engine='xla' or "
+                    "'block'")
+            if self.cache_lines:
+                raise ValueError(
+                    "kernel='precomputed' has nothing to cache (rows are "
+                    "gathers, not matvecs); set cache_lines=0")
+            if self.active_set_size:
+                raise ValueError(
+                    "kernel='precomputed' does not compose with active-set "
+                    "shrinking (the active view re-indexes rows but the "
+                    "Gram block gather needs global column ids); set "
+                    "active_set_size=0")
         if self.engine == "pallas" and self.selection != "mvp":
             # The fused per-pair Pallas engine pipelines the NEXT mvp
             # selection into the f-update pass (ops/pallas_fused.py);

@@ -151,6 +151,49 @@ class SVC(ClassifierMixin, BaseEstimator):
             raise ValueError(
                 "probability=True requires strategy='ovr' for multiclass "
                 "(per-class Platt + normalization)")
+        if self.kernel == "precomputed":
+            # gamma is meaningless here (and gamma='scale' would run an
+            # O(n^2) variance pass over the Gram matrix to produce it);
+            # pin a dummy value instead of resolving it.
+            cfg = _base_config(self, 1.0)
+            # LibSVM -t 4: X is the (n, n) Gram matrix. The model is
+            # (support indices, dual coef, b) — there are no feature
+            # rows — and prediction takes K(test, train) columns, exactly
+            # sklearn's contract for kernel='precomputed'.
+            from dpsvm_tpu.solver.smo import solve
+
+            if self.backend not in ("auto", "single"):
+                raise ValueError(
+                    "kernel='precomputed' is single-chip only this round; "
+                    "use backend='auto' or 'single'")
+            if self.classes_.shape[0] != 2:
+                raise ValueError(
+                    "kernel='precomputed' supports binary problems only "
+                    "(the OvR/OvO reductions would need per-split Gram "
+                    "sub-matrices)")
+            if self.probability:
+                raise ValueError(
+                    "probability=True is not supported with "
+                    "kernel='precomputed' (the CV folds would need "
+                    "per-fold Gram sub-matrices)")
+            wp, wn = self._weights(y, self.classes_)
+            cfg = cfg.replace(weight_pos=wp, weight_neg=wn)
+            y_pm = np.where(y == self.classes_[1], 1, -1).astype(np.int32)
+            res = solve(np.asarray(X, np.float32), y_pm, cfg)
+            self._binary_model = None
+            self._multiclass_model = None
+            self.fit_result_ = res
+            self._pre_n = int(X.shape[0])
+            alpha = np.asarray(res.alpha)
+            self.support_ = np.nonzero(alpha > 0)[0].astype(np.int32)
+            self._pre_coef = (alpha * y_pm)[self.support_].astype(np.float64)
+            self._pre_b = float(res.b)
+            sv_mask = alpha > 0
+            self.n_support_ = np.array(
+                [(sv_mask & (y_pm < 0)).sum(), (sv_mask & (y_pm > 0)).sum()])
+            self.n_iter_ = res.iterations
+            return self
+        self._pre_coef = None
         cfg = _base_config(self, _resolve_gamma(self.gamma, X))
 
         if self.classes_.shape[0] == 2:
@@ -231,6 +274,15 @@ class SVC(ClassifierMixin, BaseEstimator):
         are folded to per-class vote scores, sklearn's default ovr shape)."""
         from dpsvm_tpu.predict import decision_function
         X = np.asarray(X, np.float32)
+        if getattr(self, "_pre_coef", None) is not None:
+            # X is K(test, train): kernel values against every TRAINING
+            # row, columns indexed by the stored support set.
+            if X.ndim != 2 or X.shape[1] != self._pre_n:
+                raise ValueError(
+                    f"kernel='precomputed' prediction needs K(test, train) "
+                    f"with {self._pre_n} columns (one per training row); "
+                    f"got shape {X.shape}")
+            return X[:, self.support_] @ self._pre_coef - self._pre_b
         if self._binary_model is not None:
             return decision_function(self._binary_model, X)
         from dpsvm_tpu.models.multiclass import vote_matrix
@@ -238,7 +290,8 @@ class SVC(ClassifierMixin, BaseEstimator):
 
     def predict(self, X):
         X = np.asarray(X, np.float32)
-        if self._binary_model is not None:
+        if (getattr(self, "_pre_coef", None) is not None
+                or self._binary_model is not None):
             d = self.decision_function(X)
             return np.where(d >= 0, self.classes_[1], self.classes_[0])
         from dpsvm_tpu.models.multiclass import predict_multiclass

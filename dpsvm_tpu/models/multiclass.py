@@ -77,6 +77,11 @@ def train_multiclass(
     verbose: bool = False,
 ) -> tuple[MulticlassSVM, list]:
     """Train a multiclass SVM; y may hold arbitrary integer labels."""
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "kernel='precomputed' is implemented for binary C-SVC only "
+            "(each OvR/OvO split needs its own Gram sub-matrix); the reduction would need "
+            "a transformed Gram matrix, not transformed features")
     from dpsvm_tpu.train import train
 
     x = np.asarray(x, np.float32)

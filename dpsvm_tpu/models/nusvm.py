@@ -105,6 +105,11 @@ def train_nusvc(
     """Train binary nu-SVC: nu in (0, 1] bounds the margin-error fraction
     from above and the SV fraction from below. config.c is ignored (the
     nu-SVC box is [0, 1] before rescaling); labels must be +-1."""
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "kernel='precomputed' is implemented for binary C-SVC only "
+            "(the nu-SVC dual rescales alpha); the reduction would need "
+            "a transformed Gram matrix, not transformed features")
     x = np.asarray(x, np.float32)
     y = np.asarray(y, np.int32)
     n, d = x.shape
@@ -184,6 +189,11 @@ def train_nusvr(
     """Train nu-SVR: nu replaces epsilon-SVR's tube width (the tube
     adapts so that at most a nu fraction of points fall outside it).
     `c` defaults to config.c."""
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "kernel='precomputed' is implemented for binary C-SVC only "
+            "(nu-SVR doubles the variable set); the reduction would need "
+            "a transformed Gram matrix, not transformed features")
     x = np.asarray(x, np.float32)
     z = np.asarray(z, np.float32)
     n, d = x.shape

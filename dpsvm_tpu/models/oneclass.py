@@ -92,6 +92,11 @@ def train_oneclass(
     """Fit nu-one-class SVM: nu bounds the outlier fraction from above and
     the SV fraction from below. config.c is ignored (the OCSVM box is
     [0, 1]); config.epsilon remains the convergence tolerance."""
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "kernel='precomputed' is implemented for binary C-SVC only "
+            "(one-class has no labels to pair with kernel rows); the reduction would need "
+            "a transformed Gram matrix, not transformed features")
     import jax
 
     x = np.asarray(x, np.float32)

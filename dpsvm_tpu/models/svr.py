@@ -100,6 +100,11 @@ def train_svr(
     `config.epsilon` remains the SMO convergence tolerance; the tube width
     is this function's `svr_epsilon` (LibSVM's -p vs -e distinction).
     """
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "kernel='precomputed' is implemented for binary C-SVC only "
+            "(epsilon-SVR doubles the variable set); the reduction would need "
+            "a transformed Gram matrix, not transformed features")
     import jax
 
     x = np.asarray(x, np.float32)

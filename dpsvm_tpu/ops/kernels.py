@@ -26,7 +26,7 @@ import jax.numpy as jnp
 class KernelParams:
     """Static kernel parameters (hashable -> usable as a jit static arg)."""
 
-    kind: str = "rbf"  # rbf | linear | poly | sigmoid
+    kind: str = "rbf"  # rbf | linear | poly | sigmoid | precomputed
     gamma: float = 1.0
     degree: int = 3
     coef0: float = 0.0
@@ -89,6 +89,10 @@ def kernel_from_dots(
     exp(-gamma (x_sq + q_sq - 2 dot)) (svmTrain.cu:128-135).
     """
     dots = dots.astype(jnp.float32)
+    if params.kind == "precomputed":
+        raise ValueError(
+            "precomputed kernels have no dot-product form; gather rows of "
+            "the Gram matrix instead (kernel_rows handles this)")
     if params.kind == "linear":
         return dots
     if params.kind == "rbf":
@@ -122,7 +126,13 @@ def kernel_rows(
     q_sq: jax.Array,
     params: KernelParams,
 ) -> jax.Array:
-    """Full kernel rows K(q_k, x_i): (k, n) or (n,)."""
+    """Full kernel rows K(q_k, x_i): (k, n) or (n,).
+
+    kind="precomputed" (LibSVM -t 4): `x` IS the (n, n) Gram matrix, so a
+    gathered query row already holds its kernel values — return it
+    verbatim (no dot products exist to compute)."""
+    if params.kind == "precomputed":
+        return q.astype(jnp.float32)
     return kernel_from_dots(row_dots(x, q), x_sq, q_sq, params)
 
 
@@ -169,6 +179,10 @@ def kernel_matrix(
     materialises the full Gram matrix (it is O(n^2) — the reason the
     reference exists at all; see SURVEY.md section 5.7).
     """
+    if params.kind == "precomputed":
+        raise ValueError(
+            "precomputed kernels carry no feature vectors; index the "
+            "user-supplied Gram matrix (K_test[:, support]) instead")
     a_sq = squared_norms(a)
     b_sq = squared_norms(b)
     dots = jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32).T,

@@ -358,6 +358,11 @@ def solve_mesh(
             "active_set_size (shrinking) is implemented for the "
             "single-chip block engine only; on the mesh each shard's fold "
             "is already n/P-sized — set active_set_size=0")
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "kernel='precomputed' is single-chip only this round (a "
+            "row-sharded Gram matrix would make every working-set gather "
+            "a cross-shard column exchange); use backend='single'")
     if config.selection == "nu" and alpha_init is None:
         # See solver/smo.py: nu selection is degenerate without the nu
         # trainers' feasible warm start.

@@ -266,9 +266,15 @@ def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
     gap_open = b_lo > b_hi + 2.0 * eps
     qx = jnp.take(x, w, axis=0)  # (q, d)
     qsq = jnp.take(x_sq, w)
-    dots_w = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
-                     preferred_element_type=jnp.float32)
-    kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)  # (q, q)
+    if kp.kind == "precomputed":
+        # x IS the Gram matrix: the (q, q) block is a column gather of
+        # the already-gathered rows (kernel_rows likewise returns qx
+        # verbatim for the fold).
+        kb_w = jnp.take(qx.astype(jnp.float32), w, axis=1)
+    else:
+        dots_w = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
+                         preferred_element_type=jnp.float32)
+        kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)  # (q, q)
     kd_w = jnp.take(k_diag, w)
     a_w0 = jnp.take(alpha, w)
     y_w = jnp.take(y, w)

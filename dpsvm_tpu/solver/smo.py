@@ -153,15 +153,22 @@ def _smo_iteration(x, y, x_sq, k_diag, valid, state: SMOState, kp: KernelParams,
 
     q_hi = lax.dynamic_index_in_dim(x, i_hi, 0, keepdims=False)
     q_lo = lax.dynamic_index_in_dim(x, i_lo, 0, keepdims=False)
-    if use_cache:
-        d_hi, d_lo, cache, n_hits = lookup_pair(
-            state.cache, x, i_hi, i_lo, q_hi, q_lo, state.it)
+    if kp.kind == "precomputed":
+        # x IS the Gram matrix: the gathered rows already hold K values
+        # (no dot products or cache; config forbids cache_lines here).
+        k_hi = q_hi.astype(jnp.float32)
+        k_lo = q_lo.astype(jnp.float32)
+        cache, n_hits = state.cache, jnp.int32(0)
     else:
-        d2 = row_dots(x, jnp.stack([q_hi, q_lo]))
-        d_hi, d_lo, cache, n_hits = d2[0], d2[1], state.cache, jnp.int32(0)
+        if use_cache:
+            d_hi, d_lo, cache, n_hits = lookup_pair(
+                state.cache, x, i_hi, i_lo, q_hi, q_lo, state.it)
+        else:
+            d2 = row_dots(x, jnp.stack([q_hi, q_lo]))
+            d_hi, d_lo, cache, n_hits = d2[0], d2[1], state.cache, jnp.int32(0)
 
-    k_hi = kernel_from_dots(d_hi, x_sq, x_sq[i_hi], kp)
-    k_lo = kernel_from_dots(d_lo, x_sq, x_sq[i_lo], kp)
+        k_hi = kernel_from_dots(d_hi, x_sq, x_sq[i_hi], kp)
+        k_lo = kernel_from_dots(d_lo, x_sq, x_sq[i_lo], kp)
 
     # eta = K(hi,hi) + K(lo,lo) - 2 K(hi,lo), clamped (fixes bug B2; the
     # reference divides unguarded at svmTrainMain.cpp:290).
@@ -197,11 +204,15 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
 
     q_hi = lax.dynamic_index_in_dim(x, i_hi, 0, keepdims=False)
     stamp = 2 * state.it.astype(jnp.int32)
-    if use_cache:
+    if kp.kind == "precomputed":
+        k_hi, cache, hit_hi = (q_hi.astype(jnp.float32), state.cache,
+                               jnp.bool_(False))
+    elif use_cache:
         d_hi, cache, hit_hi = lookup_one(state.cache, x, i_hi, q_hi, stamp + 1)
+        k_hi = kernel_from_dots(d_hi, x_sq, x_sq[i_hi], kp)
     else:
         d_hi, cache, hit_hi = row_dots(x, q_hi), state.cache, jnp.bool_(False)
-    k_hi = kernel_from_dots(d_hi, x_sq, x_sq[i_hi], kp)
+        k_hi = kernel_from_dots(d_hi, x_sq, x_sq[i_hi], kp)
 
     diff = state.f - b_hi  # f_j - f_i
     eta_j = jnp.maximum(k_diag[i_hi] + k_diag - 2.0 * k_hi, tau)
@@ -213,11 +224,14 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
     b_lo_pair = state.f[i_lo]
 
     q_lo = lax.dynamic_index_in_dim(x, i_lo, 0, keepdims=False)
-    if use_cache:
+    if kp.kind == "precomputed":
+        k_lo, hit_lo = q_lo.astype(jnp.float32), jnp.bool_(False)
+    elif use_cache:
         d_lo, cache, hit_lo = lookup_one(cache, x, i_lo, q_lo, stamp + 2)
+        k_lo = kernel_from_dots(d_lo, x_sq, x_sq[i_lo], kp)
     else:
         d_lo, hit_lo = row_dots(x, q_lo), jnp.bool_(False)
-    k_lo = kernel_from_dots(d_lo, x_sq, x_sq[i_lo], kp)
+        k_lo = kernel_from_dots(d_lo, x_sq, x_sq[i_lo], kp)
 
     eta = jnp.maximum(k_diag[i_hi] + k_diag[i_lo] - 2.0 * k_hi[i_lo], tau)
     n_hits = hit_hi.astype(jnp.int32) + hit_lo.astype(jnp.int32)
@@ -453,11 +467,23 @@ def solve(
 
     if device is None:
         device = jax.devices()[0]
+    if kp.kind == "precomputed" and x.shape[0] != x.shape[1]:
+        # Checked before any device transfer or compute is spent.
+        raise ValueError(
+            f"kernel='precomputed' needs the square (n, n) Gram "
+            f"matrix as x; got {x.shape}")
     x_dev = jax.device_put(jnp.asarray(x_p, dtype), device)
     y_dev = jax.device_put(jnp.asarray(y_p, jnp.float32), device)
     valid_dev = jax.device_put(jnp.asarray(valid_np), device) if use_pallas else None
-    x_sq = jax.jit(squared_norms)(x_dev)
-    k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq, params=kp)
+    if kp.kind == "precomputed":
+        # x IS the Gram matrix: its diagonal is the kernel diag, and the
+        # squared-norm pass (an O(n^2) read no precomputed branch ever
+        # consumes) is replaced by a zero placeholder.
+        x_sq = jnp.zeros((n_pad,), jnp.float32)
+        k_diag = jnp.diagonal(x_dev).astype(jnp.float32)
+    else:
+        x_sq = jax.jit(squared_norms)(x_dev)
+        k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq, params=kp)
 
     from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer, resume_solver_state
 

@@ -57,6 +57,12 @@ def train(
                               and config.engine in ("xla", "block"))
                    else "single")
 
+    if config.kernel == "precomputed":
+        raise ValueError(
+            "kernel='precomputed' models carry SV indices, not feature "
+            "rows — the reference-format model file cannot represent "
+            "them. Solve directly (dpsvm_tpu.solver.smo.solve) or use "
+            "the sklearn facade (dpsvm_tpu.estimators.SVC)")
     if backend in ("reference", "native"):
         if config.engine != "xla" or config.selection != "mvp":
             raise ValueError(

@@ -1,0 +1,115 @@
+"""Precomputed-kernel support (LibSVM -t 4): the training input IS the
+(n, n) Gram matrix; models carry SV indices and prediction consumes
+K(test, train) columns — sklearn's kernel='precomputed' contract.
+
+The reference has no equivalent (it hardcodes RBF, svmTrain.cu:696-714);
+the oracle here is twofold: the repo's own rbf solve on the underlying
+features (a precomputed solve over K_rbf must reproduce it exactly — the
+iteration algebra never sees features, only kernel values), and
+sklearn.svm.SVC(kernel='precomputed').
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
+from dpsvm_tpu.solver.smo import solve
+
+
+@pytest.fixture(scope="module")
+def gram_problem():
+    from dpsvm_tpu.data.synth import make_blobs_binary
+
+    x, y = make_blobs_binary(n=600, d=12, seed=3, sep=1.2)
+    kp = KernelParams("rbf", 0.1)
+    K = np.asarray(kernel_matrix(x, x, kp), np.float32)
+    return x, y, K
+
+
+def test_precomputed_reproduces_rbf_solve(gram_problem):
+    """Feeding K_rbf as a precomputed kernel must match the rbf solve on
+    the features — same trajectory on the per-pair engine (the algebra
+    only ever consumes kernel values), same optimum on block/WSS2."""
+    x, y, K = gram_problem
+    r_rbf = solve(x, y, SVMConfig(c=10.0, gamma=0.1))
+    pre = SVMConfig(c=10.0, kernel="precomputed")
+    r_pre = solve(K, y, pre)
+    assert r_pre.converged
+    # The rbf path evaluates kernel rows per iteration while the Gram
+    # matrix here comes from one kernel_matrix matmul; a last-ulp
+    # difference can shift the MVP trajectory, so near-identity (not
+    # bitwise identity) is the contract.
+    assert abs(r_pre.iterations - r_rbf.iterations) <= 0.02 * r_rbf.iterations
+    assert abs(r_pre.n_sv - r_rbf.n_sv) <= max(2, 0.01 * r_rbf.n_sv)
+    assert abs(r_pre.b - r_rbf.b) < 1e-3
+    np.testing.assert_allclose(r_pre.alpha, r_rbf.alpha, atol=5e-3)
+
+    for cfg in (pre.replace(engine="block", working_set_size=32),
+                pre.replace(selection="second_order")):
+        r = solve(K, y, cfg)
+        assert r.converged
+        assert abs(r.n_sv - r_rbf.n_sv) <= max(2, 0.01 * r_rbf.n_sv)
+        assert abs(r.b - r_rbf.b) < 5e-3
+
+
+def test_precomputed_facade_matches_sklearn(gram_problem):
+    from sklearn.svm import SVC as SkSVC
+
+    from dpsvm_tpu.data.synth import make_blobs_binary
+    from dpsvm_tpu.estimators import SVC
+
+    xall, yall = make_blobs_binary(n=900, d=15, seed=9, sep=1.3)
+    xtr, ytr, xte, yte = xall[:600], yall[:600], xall[600:], yall[600:]
+    kp = KernelParams("rbf", 0.08)
+    Ktr = np.asarray(kernel_matrix(xtr, xtr, kp), np.float32)
+    Kte = np.asarray(kernel_matrix(xte, xtr, kp), np.float32)
+    ours = SVC(C=10.0, kernel="precomputed").fit(Ktr, ytr)
+    sk = SkSVC(C=10.0, kernel="precomputed").fit(Ktr, ytr)
+    assert abs(int(ours.n_support_.sum()) - int(sk.n_support_.sum())) <= max(
+        2, 0.01 * sk.n_support_.sum())
+    assert abs(ours.score(Kte, yte) - sk.score(Kte, yte)) <= 1.0 / len(yte)
+    assert np.mean(np.sign(ours.decision_function(Kte))
+                   == np.sign(sk.decision_function(Kte))) >= 0.998
+    # Block engine through the facade reaches the same answers.
+    blk = SVC(C=10.0, kernel="precomputed", engine="block",
+              working_set_size=32).fit(Ktr, ytr)
+    assert abs(blk.score(Kte, yte) - sk.score(Kte, yte)) <= 1.0 / len(yte)
+
+
+def test_precomputed_loud_rejections(gram_problem):
+    """Unsupported combinations fail before any device work: fused pallas
+    engine, kernel-row cache, mesh backend, file-model train() path,
+    non-square input, multiclass/probability facade."""
+    from dpsvm_tpu.estimators import SVC
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+    from dpsvm_tpu.train import train
+
+    x, y, K = gram_problem
+    with pytest.raises(ValueError, match="pallas"):
+        SVMConfig(kernel="precomputed", engine="pallas")
+    with pytest.raises(ValueError, match="nothing to cache"):
+        SVMConfig(kernel="precomputed", cache_lines=8)
+    pre = SVMConfig(c=10.0, kernel="precomputed")
+    with pytest.raises(ValueError, match="single-chip"):
+        solve_mesh(K, y, pre)
+    with pytest.raises(ValueError, match="SV indices"):
+        train(K, y, pre)
+    with pytest.raises(ValueError, match="square"):
+        solve(K[:, :100], y, pre)
+    y3 = y.copy()
+    y3[:200] = 2
+    with pytest.raises(ValueError, match="binary"):
+        SVC(kernel="precomputed").fit(K, y3)
+    with pytest.raises(ValueError, match="probability"):
+        SVC(kernel="precomputed", probability=True).fit(K, y)
+    with pytest.raises(ValueError, match="shrinking"):
+        SVMConfig(kernel="precomputed", engine="block", active_set_size=64)
+    from dpsvm_tpu.models.svr import train_svr
+    with pytest.raises(ValueError, match="binary C-SVC only"):
+        train_svr(K, y.astype(np.float32), config=pre)
+    # Wrong-width test Gram rejected at predict time.
+    from dpsvm_tpu.estimators import SVC as OurSVC
+    est = OurSVC(C=10.0, kernel="precomputed").fit(K, y)
+    with pytest.raises(ValueError, match="columns"):
+        est.decision_function(K[:, :300])
