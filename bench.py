@@ -11,9 +11,22 @@ on 1x GTX 780 and 46 s on 10x GTX 780 over Ethernet MPI. vs_baseline
 reported here is 46 / value — i.e. >1 means one TPU chip beats the
 reference's ten-GPU cluster.
 
+TWO runs, both measured on device:
+
+* PRIMARY (the reported `value`): a budget-mode run that executes the
+  reference's full max_iter=100,000 pair-update budget
+  (config.budget_mode — the stopping test is disabled so the loop runs
+  to the exact budget). Iteration counts to convergence differ between
+  the synthetic set and real MNIST, so the honest apples-to-apples
+  wall-clock is "time to execute the reference's own iteration budget",
+  which this MEASURES (round 2 only projected it from pairs/s).
+* SECONDARY (`seconds_to_convergence`): the same configuration run to
+  the eps=0.01 stopping rule, with a solution-quality gate against an
+  fp32 per-pair solve.
+
 Timer placement matches the reference: its CycleTimer starts AFTER data
 load, H2D copies and setup barriers and stops at convergence
-(svmTrainMain.cpp:206-208 -> :312), so the value reported here is
+(svmTrainMain.cpp:206-208 -> :312), so both values are
 SolveResult.train_seconds — the on-device solve loop, excluding the
 one-time host->device upload of X (which on this harness rides a network
 tunnel the reference's PCIe copy never paid). Compilation is excluded on
@@ -22,20 +35,18 @@ first). Reported value is the best of three measured runs to absorb
 first-execution device ramp and harness jitter.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus the
-honesty fields {"pair_updates", "pairs_per_second",
-"projected_seconds_at_ref_cap", "dataset"} (see the comment above the
-final print for what each asserts).
+honesty fields (see the comment above the final print).
 """
 
 from __future__ import annotations
 
 import json
 import sys
-import time
 
 N = 60_000
 D = 784
 BASELINE_10GPU_SECONDS = 46.0
+REF_BUDGET = 100_000  # reference Makefile:74 --max-iter
 
 
 def main() -> int:
@@ -59,22 +70,30 @@ def main() -> int:
     # best in the tools/sweep_block.py grid (q=512/inner=1024 within
     # jitter). cache_lines=0: the working-set block IS the cache.
     config = SVMConfig(
-        c=10.0, gamma=0.125, epsilon=0.01, max_iter=100_000,
+        c=10.0, gamma=0.125, epsilon=0.01, max_iter=REF_BUDGET,
         cache_lines=0, engine="block", working_set_size=256,
         dtype="bfloat16")
+    budget_config = config.replace(budget_mode=True)
 
-    # Warm-up: compile the REAL chunk executor (chunk_iters is a static
-    # argument — a different chunk size is a different XLA program, and
-    # compilation costs ~4s that the timed run must not pay; the GPU
-    # baseline excludes CUDA compilation too). max_iter only caps the
-    # traced loop counter, so 64 warm-up iterations compile everything.
+    # Warm-up: compile BOTH chunk executors (budget_mode bakes a
+    # different epsilon into the stopping test, so it is a different XLA
+    # program; compilation costs ~4s that the timed runs must not pay —
+    # the GPU baseline excludes CUDA compilation too). max_iter only caps
+    # the traced loop counter, so 64 warm-up iterations compile
+    # everything.
     solve(x, y, config.replace(max_iter=64))
+    solve(x, y, budget_config.replace(max_iter=64))
 
     # Best of three: the tunneled dev harness shows tens-of-ms run-to-run
     # jitter that min-of-N absorbs (real local TPU runtimes don't).
-    runs = [solve(x, y, config) for _ in range(3)]
-    res = min(runs, key=lambda r: r.train_seconds)
-    seconds = res.train_seconds
+    budget_runs = [solve(x, y, budget_config) for _ in range(3)]
+    bres = min(budget_runs, key=lambda r: r.train_seconds)
+    assert bres.iterations >= REF_BUDGET, bres.iterations
+    budget_seconds = bres.train_seconds
+
+    conv_runs = [solve(x, y, config) for _ in range(3)]
+    res = min(conv_runs, key=lambda r: r.train_seconds)
+    conv_seconds = res.train_seconds
 
     # Solution-quality gate: the timed bf16/block run must reach the same
     # optimum as an fp32 per-pair-parity solve — the speedup must come
@@ -87,40 +106,52 @@ def main() -> int:
         return float(a.sum() - 0.5 * np.sum(a * y * (f + y)))
 
     ref = solve(x, y, config.replace(engine="xla", dtype="float32"))
-    assert res.converged, "timed run did not converge"
+    assert res.converged, "convergence run did not converge"
     obj_t, obj_r = dual_obj(res), dual_obj(ref)
     assert abs(obj_t - obj_r) <= 0.005 * abs(obj_r), (obj_t, obj_r)
     assert abs(res.n_sv - ref.n_sv) <= 0.10 * ref.n_sv, (res.n_sv, ref.n_sv)
 
-    pairs_per_second = res.iterations / max(seconds, 1e-9)
+    # The PRIMARY (budget) run gets its own gate: its forced post-optimum
+    # steps oscillate around the optimum, so demand dual feasibility
+    # (box + equality constraint — a drift here means corrupted updates)
+    # and a dual objective within 2% of the fp32 reference optimum.
+    import numpy as np
+    assert bres.alpha.min() >= 0.0 and bres.alpha.max() <= config.c + 1e-5
+    assert abs(float(np.dot(bres.alpha, y))) < 1e-2, "equality drift"
+    obj_b = dual_obj(bres)
+    assert abs(obj_b - obj_r) <= 0.02 * abs(obj_r), (obj_b, obj_r)
+
+    pairs_per_second = bres.iterations / max(budget_seconds, 1e-9)
     print(
-        f"[bench] device={jax.devices()[0]} iters={res.iterations} "
-        f"converged={res.converged} n_sv={res.n_sv} "
-        f"iters/s={pairs_per_second:.0f}",
+        f"[bench] device={jax.devices()[0]} budget: {bres.iterations} pairs "
+        f"in {budget_seconds:.3f}s ({pairs_per_second:.0f}/s); convergence: "
+        f"{res.iterations} pairs in {conv_seconds:.3f}s "
+        f"(converged={res.converged} n_sv={res.n_sv})",
         file=sys.stderr)
 
     # Honesty notes, embedded in the output rather than buried here:
     # the dataset is SYNTHETIC (real MNIST is not shipped in this image)
-    # and its iteration count to convergence differs from real MNIST's, so
-    # the wall-clock ratio is not iteration-for-iteration comparable. Two
-    # fields make the claim robust to that: pairs_per_second is the
-    # data-independent invariant rate, and projected_seconds_at_ref_cap is
-    # the wall-clock this configuration would need for 100k pair updates —
-    # the reference config's max_iter budget (reference Makefile:74),
-    # which bounds any run the reference itself would have accepted.
+    # and its iteration count to convergence differs from real MNIST's,
+    # so the PRIMARY value is the measured device time to execute the
+    # reference's own 100k pair-update budget (reference Makefile:74) —
+    # the iteration-budget-for-iteration-budget comparison that needs no
+    # convergence-difficulty caveat. seconds_to_convergence is the
+    # eps=0.01 run on this dataset (faster, but dataset-dependent).
     print(json.dumps({
         "metric": (
             f"synthetic MNIST-even-odd-shaped 60kx784 RBF modified-SMO "
-            f"training wall-clock, 1 chip, {res.iterations} pair updates "
-            f"to eps=0.01 convergence (ref baseline: 46 s on 10x GTX780 "
-            f"on real MNIST; iteration counts differ across datasets — "
-            f"see pairs_per_second / projected_seconds_at_ref_cap)"),
-        "value": round(seconds, 3),
+            f"training wall-clock, 1 chip, MEASURED at the reference's "
+            f"full {REF_BUDGET} pair-update budget (ref baseline: 46 s "
+            f"on 10x GTX780, max_iter=100000, ref Makefile:74; "
+            f"convergence on this dataset is faster — see "
+            f"seconds_to_convergence)"),
+        "value": round(budget_seconds, 3),
         "unit": "seconds",
-        "vs_baseline": round(BASELINE_10GPU_SECONDS / seconds, 3),
-        "pair_updates": int(res.iterations),
+        "vs_baseline": round(BASELINE_10GPU_SECONDS / budget_seconds, 3),
+        "pair_updates": int(bres.iterations),
         "pairs_per_second": round(pairs_per_second),
-        "projected_seconds_at_ref_cap": round(100_000 / pairs_per_second, 3),
+        "seconds_to_convergence": round(conv_seconds, 3),
+        "pairs_to_convergence": int(res.iterations),
         "dataset": "synthetic make_mnist_like(n=60000, d=784, seed=7, noise=0.1)",
     }))
     return 0

@@ -130,8 +130,14 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
             gain = jnp.where(low & (diff > 0.0), diff * diff / eta_j, -_INF)
             g_best = jnp.max(gain)
             j = jnp.min(jnp.where(gain == g_best, lanes, _IMAX))
-            # An eligible j exists whenever the stop gap is open
-            # (some f_low > b_hi); when closed the update is gated off.
+            # At the honest epsilon an eligible j exists whenever the stop
+            # gap is open (some f_low > b_hi). budget_mode compiles
+            # eps=-1e30, which keeps the gap open after the eligible set
+            # empties — then gain is all -inf and j degenerates to lane 0,
+            # so the update must ALSO be gated on has_j (a counted no-op;
+            # gating the loop itself would stall the pair counter and
+            # spin the budget-mode outer loop forever).
+            has_j = g_best > -_INF
             sel_j0 = lanes == j
             b_lo = _pick1(sel_j0, f)
         else:
@@ -145,6 +151,7 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
 
         b_lo_gap = b_lo_stop if rule == "second_order" else b_lo
         gap_open = (b_lo_gap - b_hi) > 2.0 * eps
+        upd_ok = gap_open & has_j if rule == "second_order" else gap_open
         row_j = jnp.reshape(kb_ref[pl.ds(j, 1)], (rows, 128))
         sel_i = lanes == i
         sel_j = lanes == j
@@ -165,7 +172,7 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
         c_j = cp if cp == cn else jnp.where(y_j > 0, cp, cn)
         a_i_new, a_j_new = pair_alpha_update(
             a_i_old, a_j_old, y_i, y_j, b_hi, b_lo, eta, c_i, c_j,
-            gate=gap_open)
+            gate=upd_ok)
         alpha = jnp.where(sel_i, a_i_new, alpha)
         alpha = jnp.where(sel_j, a_j_new, alpha)
         f = f + (a_i_new - a_i_old) * y_i * row_i \

@@ -450,7 +450,14 @@ def solve_mesh(
     # One dispatch to convergence when nothing observes chunk boundaries
     # (device->host transfers are the expensive primitive; see solver/smo.py
     # _UNOBSERVED_CHUNK).
-    from dpsvm_tpu.solver.smo import _UNOBSERVED_CHUNK, _pack_obs, _unpack_obs
+    from dpsvm_tpu.solver.smo import (_BUDGET_EPS, _UNOBSERVED_CHUNK,
+                                      _pack_obs, _unpack_obs)
+
+    # budget_mode: same contract as the single-chip solver — the chunk
+    # runners compile the stopping test with _BUDGET_EPS so the loop runs
+    # to the exact max_iter pair budget; `converged` is re-derived from
+    # the final state at the real epsilon below.
+    eps_run = _BUDGET_EPS if config.budget_mode else float(config.epsilon)
 
     observe = (callback is not None or config.verbose
                or config.check_numerics or ckpt.active)
@@ -471,7 +478,7 @@ def solve_mesh(
         inner_impl = ("pallas" if mesh.devices.flat[0].platform == "tpu"
                       else "xla")
         run_chunk = make_block_chunk_runner(
-            mesh, kp, config.c_bounds(), float(config.epsilon),
+            mesh, kp, config.c_bounds(), eps_run,
             float(config.tau), q, inner, rounds_per_chunk, inner_impl,
             selection=config.selection)
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
@@ -479,7 +486,7 @@ def solve_mesh(
                            rounds=jax.device_put(jnp.int32(0), rep))
     else:
         run_chunk = _make_chunk_runner(mesh, kp, config.c_bounds(),
-                                       float(config.epsilon),
+                                       eps_run,
                                        float(config.tau), chunk_len,
                                        use_cache, config.selection)
     if callback is not None and hasattr(callback, "on_start"):
@@ -498,7 +505,7 @@ def solve_mesh(
         # budget exits are refreshed exactly below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
-        converged = not (b_lo > b_hi + 2.0 * config.epsilon)
+        converged = not (b_lo > b_hi + 2.0 * eps_run)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
         if config.check_numerics:
@@ -512,7 +519,7 @@ def solve_mesh(
             break
 
     alpha = np.asarray(state.alpha)[:n]
-    if use_block and not converged:
+    if (use_block or config.budget_mode) and not converged:
         from dpsvm_tpu.ops.select import refresh_extrema_host
 
         b_hi, b_lo, converged = refresh_extrema_host(

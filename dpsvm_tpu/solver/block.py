@@ -193,6 +193,7 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
             i, b_hi_l, j, b_lo_l = select_working_set_nu(
                 f_w, alpha_w, y_w, c, valid=slot_ok)
             gap_open = b_lo_l > b_hi_l + 2.0 * eps
+            upd_ok = gap_open
             row_i = lax.dynamic_index_in_dim(kb_w, i, 0, keepdims=False)
         elif rule == "second_order":
             f_up = jnp.where(up, f_w, jnp.inf)
@@ -206,9 +207,19 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
             eta_j = jnp.maximum(kd_w[i] + kd_w - 2.0 * row_i, tau)
             gain = jnp.where(low & (diff > 0), diff * diff / eta_j,
                              -jnp.inf)
-            # gap_open implies an eligible j exists (some f_low > b_hi);
-            # when closed the update is gated off anyway.
-            j = jnp.where(gap_open, jnp.argmax(gain), i).astype(jnp.int32)
+            # At the honest epsilon gap_open implies an eligible j exists
+            # (some f_low > b_hi) — but budget_mode compiles eps=-1e30,
+            # which keeps gap_open True after the eligible set empties;
+            # without the has_j gate argmax over all-(-inf) gains would
+            # pick slot 0 (possibly a dead filler slot) as the partner
+            # and drift alpha off the dual equality constraint. gap_open
+            # itself stays ungated: it drives the loop and the pair
+            # counter, and a stalled counter would leave the budget-mode
+            # outer loop spinning; the ineligible update is a counted
+            # no-op instead.
+            has_j = jnp.max(gain) > -jnp.inf
+            upd_ok = gap_open & has_j
+            j = jnp.where(upd_ok, jnp.argmax(gain), i).astype(jnp.int32)
             b_lo_l = f_w[j]
         else:
             f_up = jnp.where(up, f_w, jnp.inf)
@@ -218,6 +229,7 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
             b_hi_l = f_up[i]
             b_lo_l = f_low[j]
             gap_open = b_lo_l > b_hi_l + 2.0 * eps
+            upd_ok = gap_open
             row_i = lax.dynamic_index_in_dim(kb_w, i, 0, keepdims=False)
 
         row_j = lax.dynamic_index_in_dim(kb_w, j, 0, keepdims=False)
@@ -228,7 +240,7 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
         a_j_old = alpha_w[j]
         a_i_new, a_j_new = pair_alpha_update(
             a_i_old, a_j_old, y_i, y_j, b_hi_l, b_lo_l, eta,
-            c_of(y_i, cp, cn), c_of(y_j, cp, cn), gate=gap_open)
+            c_of(y_i, cp, cn), c_of(y_j, cp, cn), gate=upd_ok)
         # One-hot writes instead of scatters: q-sized selects fuse into the
         # surrounding elementwise work.
         lanes = jnp.arange(alpha_w.shape[0], dtype=jnp.int32)

@@ -258,6 +258,14 @@ _ITERATION_FNS = {
 # dev harness), so the default is to observe only once, at the end.
 _UNOBSERVED_CHUNK = 1 << 30
 
+# config.budget_mode compiles the chunk executors with this epsilon: the
+# stopping test b_lo > b_hi + 2*eps then never closes (the gap is bounded
+# well above 2*-1e30), so the loop exits exactly at the max_iter budget —
+# the reference's own benchmark regime (its published runs are
+# max_iter-capped, reference Makefile:74,77). Finite so the b_hi + 2*eps
+# arithmetic stays inf-free.
+_BUDGET_EPS = -1e30
+
 
 @jax.jit
 def _pack_obs(it, b_hi, b_lo):
@@ -537,6 +545,10 @@ def solve(
 
     state = jax.device_put(state, device)
     max_iter = jnp.int32(config.max_iter)
+    # budget_mode: compile the stopping test with _BUDGET_EPS so the loop
+    # runs to the exact max_iter pair budget; the returned `converged` is
+    # re-derived from the final state at the real epsilon below.
+    eps_run = _BUDGET_EPS if config.budget_mode else float(config.epsilon)
     start_iter = int(state.pairs if use_block else state.it)
     ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
     # Pallas kernels lower for the device the solve actually targets, not
@@ -571,14 +583,14 @@ def solve(
         if use_pallas:
             state = _run_chunk_pallas(
                 x_dev, y_dev, x_sq, valid_dev, state, max_iter,
-                kp, config.c_bounds(), float(config.epsilon), float(config.tau),
+                kp, config.c_bounds(), eps_run, float(config.tau),
                 chunk_len, use_cache, block_rows, interpret)
         elif use_block and m_act:
             from dpsvm_tpu.solver.block import run_chunk_block_active
 
             state = run_chunk_block_active(
                 x_dev, y_dev, x_sq, k_diag, state, max_iter,
-                kp, config.c_bounds(), float(config.epsilon), float(config.tau),
+                kp, config.c_bounds(), eps_run, float(config.tau),
                 q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds),
                 inner_impl="pallas" if not interpret else "xla",
@@ -586,13 +598,13 @@ def solve(
         elif use_block:
             state = run_chunk_block(
                 x_dev, y_dev, x_sq, k_diag, state, max_iter,
-                kp, config.c_bounds(), float(config.epsilon), float(config.tau),
+                kp, config.c_bounds(), eps_run, float(config.tau),
                 q, inner, rounds_per_chunk,
                 inner_impl="pallas" if not interpret else "xla",
                 selection=config.selection)
         else:
             state = _run_chunk(x_dev, y_dev, x_sq, k_diag, None, state, max_iter,
-                               kp, config.c_bounds(), float(config.epsilon),
+                               kp, config.c_bounds(), eps_run,
                                float(config.tau), chunk_len, use_cache,
                                config.selection)
         jax.block_until_ready(state)
@@ -607,7 +619,7 @@ def solve(
         # budget exits exactly (refresh_extrema_host below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
-        converged = not (b_lo > b_hi + 2.0 * config.epsilon)
+        converged = not (b_lo > b_hi + 2.0 * eps_run)
         if callback is not None:
             callback(it, b_hi, b_lo, state)
         if config.check_numerics:
@@ -623,7 +635,10 @@ def solve(
             break
 
     alpha = np.asarray(state.alpha)[:n]
-    if use_block and not converged:
+    if (use_block or config.budget_mode) and not converged:
+        # Budget exits report the honest stopping rule at the REAL
+        # epsilon on the final state (budget_mode runs the loop itself
+        # with _BUDGET_EPS, which never closes).
         b_hi, b_lo, converged = refresh_extrema_host(
             np.asarray(state.f)[:n], alpha, y_np, config.c_bounds(),
             config.epsilon, rule=config.selection)
