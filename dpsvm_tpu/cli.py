@@ -84,6 +84,12 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "gradient reconciliations (default 8)")
     p.add_argument("--degree", type=int, default=3)
     p.add_argument("--coef0", type=float, default=0.0)
+    p.add_argument("-b", "--probability", type=int, choices=[0, 1],
+                   default=0,
+                   help="1 = fit Platt probability calibration on the "
+                        "training decision values after training (LibSVM "
+                        "-b; c-svc/nu-svc only; the model saves as .npz "
+                        "— the reference text format cannot carry it)")
     p.add_argument("-w1", "--weight-pos", type=float, default=1.0,
                    help="C multiplier for the +1 class (LibSVM -w1)")
     p.add_argument("-w-1", "--weight-neg", type=float, default=1.0,
@@ -133,6 +139,13 @@ def _build_test_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("-x", "--num-ex", type=int, default=None)
     p.add_argument("-g", "--gamma", type=float, default=None,
                    help="override the model file's gamma")
+    p.add_argument("-b", "--probability", type=int, choices=[0, 1],
+                   default=0,
+                   help="1 = report calibrated-probability metrics "
+                        "(model must have been trained with -b 1)")
+    p.add_argument("-o", "--output", default=None,
+                   help="write per-row predictions here (with -b 1: "
+                        "'label p(+1)' per line, LibSVM svm-predict style)")
     return p
 
 
@@ -219,10 +232,24 @@ def _cmd_train(args) -> int:
                   "(the nu box is fixed at [0, 1])", file=sys.stderr)
             return 2
 
+    if args.probability and args.svm_type not in ("c-svc", "nu-svc"):
+        print(f"error: -b 1 (Platt probability) applies to classifiers "
+              f"only, not {args.svm_type}", file=sys.stderr)
+        return 2
+
     t0 = time.perf_counter()
     regression = args.svm_type in ("eps-svr", "nu-svr")
-    x, y = load_data(args.file_path, args.num_ex, args.num_att,
-                     float_labels=regression, fmt=args.format)
+    try:
+        x, y = load_data(args.file_path, args.num_ex, args.num_att,
+                         float_labels=regression, fmt=args.format)
+    except ValueError as e:
+        # Clean one-line diagnostic instead of a traceback (e.g. an SVR
+        # task fed a LIBSVM-format file, or a mis-sniffed format).
+        print(f"error: could not load {args.file_path} "
+              f"(format={args.format}): {e}\n"
+              f"hint: pass --format csv|libsvm to override auto-detection",
+              file=sys.stderr)
+        return 2
     if not args.quiet:
         print(f"loaded {x.shape[0]} examples x {x.shape[1]} features "
               f"in {time.perf_counter() - t0:.2f}s")
@@ -296,6 +323,39 @@ def _cmd_train(args) -> int:
         inlier = float(np.mean(model.predict(x) > 0))
         print(f"train inlier fraction: {inlier:.4f} (nu={args.nu})")
 
+    if args.probability:
+        from dpsvm_tpu.models.platt import fit_platt_cv
+        from dpsvm_tpu.predict import decision_function
+
+        # LibSVM-style 5-fold CV refits: in-sample decision values are
+        # margin-biased and overfit the sigmoid (see fit_platt_cv). The
+        # folds must refit the SAME dual, so nu-svc passes its trainer.
+        if args.svm_type == "nu-svc":
+            from dpsvm_tpu.models.nusvm import train_nusvc
+
+            def train_fn(xf, yf, cfg, backend, num_devices,
+                         _t=train_nusvc, _nu=args.nu):
+                return _t(xf, yf, nu=_nu, config=cfg, backend=backend,
+                          num_devices=num_devices)
+        else:
+            train_fn = None
+        model.prob_a, model.prob_b = fit_platt_cv(
+            x, y, config, backend=args.backend,
+            num_devices=args.num_devices, train_fn=train_fn)
+        from dpsvm_tpu.models.platt import platt_probability
+
+        dec = np.asarray(decision_function(model, x), np.float64)
+        p = np.clip(platt_probability(dec, model.prob_a, model.prob_b),
+                    1e-15, 1 - 1e-15)
+        t = (y > 0).astype(np.float64)
+        print(f"platt calibration: A={model.prob_a:.6f} "
+              f"B={model.prob_b:.6f} "
+              f"train log-loss={float(-np.mean(t * np.log(p) + (1 - t) * np.log(1 - p))):.4f}")
+        if not args.model.endswith(".npz"):
+            args.model += ".npz"
+            print("note: probability models use the .npz format (the "
+                  "reference text format cannot carry the calibration)")
+
     if args.svm_type in ("eps-svr", "nu-svr", "one-class") \
             and not args.model.endswith(".npz"):
         args.model += ".npz"
@@ -305,11 +365,67 @@ def _cmd_train(args) -> int:
     return 0
 
 
+def _load_eval_data(args, model_width: int, float_labels: bool = False):
+    """Load the test file at its OWN inferred width, then reconcile with
+    the model's width. Silent truncation of a wider file is the failure
+    mode to avoid (a wrong model for the dataset would print a plausible
+    but meaningless accuracy): CSV wider than the model is an error, a
+    sparse LIBSVM file gets a loud warning (its width is just the largest
+    seen index, so an off-by-a-few mismatch can be legitimate), and an
+    explicit -a is taken as consent. A narrower LIBSVM file is padded
+    (trailing all-zero features are legitimately absent); a narrower CSV
+    is an error as before. Returns (x, y) or None after printing a
+    diagnostic."""
+    from dpsvm_tpu.data.loader import load_data, sniff_format
+
+    fmt = args.format
+    if fmt == "auto":
+        fmt = sniff_format(args.file_path)
+    # The kernel shapes are pinned by the MODEL; -a is consent to
+    # truncate a wider file, never a way to feed a different width (that
+    # would only move the crash into the kernel matmul).
+    if args.num_att is not None and args.num_att != model_width:
+        print(f"error: -a {args.num_att} conflicts with the model's "
+              f"{model_width} features (the model fixes the width; use "
+              f"-a {model_width} to consent to truncation)",
+              file=sys.stderr)
+        return None
+    natt = model_width
+    try:
+        x, y = load_data(args.file_path, args.num_ex, None,
+                         float_labels=float_labels, fmt=fmt)
+    except ValueError as e:
+        print(f"error: could not load {args.file_path} (format={fmt}): "
+              f"{e}\nhint: pass --format csv|libsvm to override "
+              f"auto-detection", file=sys.stderr)
+        return None
+    w = x.shape[1]
+    if w < natt:
+        if fmt == "libsvm":
+            x = np.pad(x, ((0, 0), (0, natt - w)))
+        else:
+            print(f"error: {args.file_path} has {w} features but the "
+                  f"model expects {natt} (CSV columns are positional — "
+                  f"this looks like the wrong model for the dataset)",
+                  file=sys.stderr)
+            return None
+    elif w > natt:
+        if args.num_att is not None or fmt == "libsvm":
+            msg = (f"warning: {args.file_path} has {w} features; using "
+                   f"the first {natt} the model expects")
+            print(msg, file=sys.stderr)
+            x = x[:, :natt]
+        else:
+            print(f"error: {args.file_path} has {w} features but the "
+                  f"model expects {natt}; pass -a {natt} to truncate "
+                  f"explicitly if this is intended", file=sys.stderr)
+            return None
+    return x, y
+
+
 def _cmd_test(args) -> int:
-    from dpsvm_tpu.data.loader import load_data
     from dpsvm_tpu.models.svm_model import SVMModel
     from dpsvm_tpu.ops.kernels import KernelParams
-    from dpsvm_tpu.predict import accuracy
 
     # Type-dispatch: .npz files carry a model_type field (svr / oneclass /
     # classifier); the reference-compatible .txt format is classifier-only.
@@ -322,11 +438,11 @@ def _cmd_test(args) -> int:
     if model_type == "svr":
         from dpsvm_tpu.models.svr import SVRModel
         model = SVRModel.load(args.model)
-        # Sparse LIBSVM test files can omit trailing all-zero features;
-        # default the width to the model's so the kernel shapes line up.
-        natt = args.num_att or model.sv_x.shape[1]
-        x, z_true = load_data(args.file_path, args.num_ex, natt,
-                              float_labels=True, fmt=args.format)
+        loaded = _load_eval_data(args, model.sv_x.shape[1],
+                                 float_labels=True)
+        if loaded is None:
+            return 2
+        x, z_true = loaded
         pred = np.asarray(model.predict(x), np.float64)
         rmse = float(np.sqrt(np.mean((pred - z_true) ** 2)))
         ss_tot = float(np.sum((z_true - z_true.mean()) ** 2))
@@ -337,9 +453,10 @@ def _cmd_test(args) -> int:
     if model_type == "oneclass":
         from dpsvm_tpu.models.oneclass import OneClassModel
         model = OneClassModel.load(args.model)
-        natt = args.num_att or model.sv_x.shape[1]
-        x, y = load_data(args.file_path, args.num_ex, natt,
-                         fmt=args.format)
+        loaded = _load_eval_data(args, model.sv_x.shape[1])
+        if loaded is None:
+            return 2
+        x, y = loaded
         pred = model.predict(x)
         print(f"loaded one-class model: {model.n_sv} SVs, rho={model.rho:.6f}")
         print(f"test inlier fraction: {float(np.mean(pred > 0)):.4f} "
@@ -352,13 +469,42 @@ def _cmd_test(args) -> int:
     if args.gamma is not None:
         model.kernel = KernelParams(
             model.kernel.kind, args.gamma, model.kernel.degree, model.kernel.coef0)
-    natt = args.num_att or model.sv_x.shape[1]
-    x, y = load_data(args.file_path, args.num_ex, natt,
-                     fmt=args.format)
-    acc = accuracy(model, x, y)
+    loaded = _load_eval_data(args, model.sv_x.shape[1])
+    if loaded is None:
+        return 2
+    x, y = loaded
+    from dpsvm_tpu.predict import decision_function
+
+    dec = np.asarray(decision_function(model, x))
+    pred = np.where(dec >= 0, 1, -1)
+    acc = float(np.mean(pred == y))
     print(f"loaded model: {model.n_sv} SVs, gamma={model.kernel.gamma}, "
-          f"b={model.b:.6f}")
+          f"b={model.b:.6f}"
+          + (", platt-calibrated" if model.has_probability else ""))
     print(f"test accuracy: {acc:.4f} ({x.shape[0]} examples)")
+    proba = None
+    if args.probability:
+        if not model.has_probability:
+            print("error: -b 1 needs a model trained with -b 1 (no Platt "
+                  "calibration in this model file)", file=sys.stderr)
+            return 2
+        from dpsvm_tpu.models.platt import platt_probability
+
+        proba = platt_probability(dec, model.prob_a, model.prob_b)
+        p = np.clip(proba, 1e-15, 1 - 1e-15)
+        t = (y > 0).astype(np.float64)
+        ll = float(-np.mean(t * np.log(p) + (1 - t) * np.log(1 - p)))
+        print(f"test log-loss: {ll:.4f} (Platt A={model.prob_a:.6f} "
+              f"B={model.prob_b:.6f})")
+    if args.output:
+        with open(args.output, "w") as fh:
+            if proba is not None:
+                fh.write("label p(+1)\n")
+                for pi, pr in zip(pred, proba):
+                    fh.write(f"{int(pi)} {pr:.6f}\n")
+            else:
+                fh.writelines(f"{int(pi)}\n" for pi in pred)
+        print(f"predictions written to {args.output}")
     return 0
 
 

@@ -229,27 +229,11 @@ class SVC(ClassifierMixin, BaseEstimator):
         return self
 
     def _fit_platt_cv(self, X, y_pm, cfg):
-        """(A, B) from decision values on held-out folds, LibSVM-style:
-        k-fold refits so the calibration never sees its own training
-        residuals (in-sample |f| is biased toward the margin)."""
-        from dpsvm_tpu.models.platt import fit_platt
-        from dpsvm_tpu.predict import decision_function
-        from dpsvm_tpu.train import train
+        from dpsvm_tpu.models.platt import fit_platt_cv
 
-        k = max(2, int(self.probability_cv))
-        rng = np.random.default_rng(self.random_state)
-        perm = rng.permutation(len(y_pm))
-        folds = np.array_split(perm, k)
-        dec = np.empty(len(y_pm), np.float64)
-        for i, held in enumerate(folds):
-            tr = np.concatenate([f for j, f in enumerate(folds) if j != i])
-            if len(np.unique(y_pm[tr])) < 2:
-                raise ValueError(
-                    "probability calibration fold lost a class; lower "
-                    "probability_cv or provide more data")
-            m, _ = train(X[tr], y_pm[tr], cfg, backend=self.backend)
-            dec[held] = decision_function(m, X[held])
-        return fit_platt(dec, y_pm)
+        return fit_platt_cv(X, y_pm, cfg, backend=self.backend,
+                            k=self.probability_cv,
+                            seed=self.random_state or 0)
 
     def predict_proba(self, X):
         """Class-probability matrix (n, k), classes in ``classes_`` order."""

@@ -41,6 +41,28 @@ class SVMModel:
     sv_y: np.ndarray  # (n_sv,) labels in {-1, +1}
     b: float
     kernel: KernelParams
+    # Platt calibration plane (LibSVM -b 1; no reference equivalent):
+    # P(y=+1 | f) = sigmoid(prob_a * f + prob_b), fit by models/platt.py.
+    # None = uncalibrated. Carried by the .npz format only (the text
+    # format is the reference's, svmTrainMain.cpp:386-416).
+    prob_a: float | None = None
+    prob_b: float | None = None
+
+    @property
+    def has_probability(self) -> bool:
+        return self.prob_a is not None
+
+    def predict_proba(self, q) -> np.ndarray:
+        """P(y=+1) per row of q (requires Platt calibration)."""
+        if not self.has_probability:
+            raise ValueError(
+                "model carries no Platt calibration; train with "
+                "probability (cli: -b 1) first")
+        from dpsvm_tpu.models.platt import platt_probability
+        from dpsvm_tpu.predict import decision_function
+
+        return platt_probability(decision_function(self, q),
+                                 self.prob_a, self.prob_b)
 
     @property
     def n_sv(self) -> int:
@@ -75,6 +97,9 @@ class SVMModel:
     # ------------------------------------------------------------------ io
     def save(self, path: str) -> None:
         if path.endswith(".npz"):
+            prob = ({"prob_a": np.float64(self.prob_a),
+                     "prob_b": np.float64(self.prob_b)}
+                    if self.has_probability else {})
             np.savez_compressed(
                 path,
                 format_version=1,
@@ -83,12 +108,17 @@ class SVMModel:
                 sv_y=self.sv_y,
                 b=np.float32(self.b),
                 **self.kernel.npz_fields(),
+                **prob,
             )
             return
         if self.kernel.kind != "rbf":
             raise ValueError(
                 "the text model format only expresses RBF (reference format, "
                 "svmTrainMain.cpp:386-416); save non-RBF models to .npz")
+        if self.has_probability:
+            raise ValueError(
+                "the text model format cannot carry Platt calibration "
+                "(reference format); save probability models to .npz")
         from dpsvm_tpu.utils import native
         writer = native.get_fastcsv()
         if writer is not None:
@@ -112,6 +142,8 @@ class SVMModel:
                 sv_y=z["sv_y"].astype(np.int32),
                 b=float(z["b"]),
                 kernel=KernelParams.from_npz(z),
+                prob_a=float(z["prob_a"]) if "prob_a" in z else None,
+                prob_b=float(z["prob_b"]) if "prob_b" in z else None,
             )
         return cls._load_text(path)
 

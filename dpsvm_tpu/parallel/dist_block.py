@@ -42,7 +42,8 @@ from dpsvm_tpu.ops.select import (low_mask, nu_stopping_pair, split_c,
                                   up_mask)
 from dpsvm_tpu.parallel.dist_smo import _global_ids
 from dpsvm_tpu.parallel.mesh import DATA_AXIS
-from dpsvm_tpu.solver.block import (BlockState, _solve_subproblem, _top_h,
+from dpsvm_tpu.solver.block import (BlockState, _round_core,
+                                    _solve_subproblem, _top_h,
                                     combine_halves)
 
 
@@ -193,6 +194,137 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                               st.pairs + t, st.rounds + 1)
 
         return lax.while_loop(cond, body, state)
+
+    shard = P(DATA_AXIS)
+    rep = P()
+    state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
+                             pairs=rep, rounds=rep)
+    mapped = jax.shard_map(
+        chunk_body,
+        mesh=mesh,
+        in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
+        out_specs=state_specs,
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
+                                   eps: float, tau: float, q: int,
+                                   inner_iters: int, rounds_per_chunk: int,
+                                   m: int, k_rounds: int,
+                                   inner_impl: str = "xla",
+                                   interpret: bool = False,
+                                   selection: str = "mvp"):
+    """Active-set ("shrinking") variant of make_block_chunk_runner — the
+    mesh port of solver/block.py run_chunk_block_active (the layer the
+    reference scales with MPI ranks, svmTrainMain.cpp:244). One CYCLE:
+
+      1. ONE distributed active selection: the m globally most-violating
+         rows (_select_block_mesh with q=m), which also yields the exact
+         global stopping extrema; the winning ids are REPLICATED on every
+         device;
+      2. one (m, d+5) masked psum replicates the active rows' features
+         and per-row scalars (x, x_sq, k_diag, alpha, y, f);
+      3. up to `k_rounds` block rounds run on the REPLICATED (m,)-sized
+         active state — every device executes the identical subproblem
+         and active fold (the reference's replicated-update trick,
+         svmTrainMain.cpp:285-299, lifted from one pair to the whole
+         cycle), so the inner rounds need ZERO collectives: the round
+         cadence is no longer bounded by all_gather/psum latency, which
+         is exactly what shrinking must fix on a pod (per-round exchange
+         was the mesh block engine's latency floor);
+      4. one batched reconciliation fold applies the cycle's accumulated
+         (slot, coef) deltas to the SHARDED gradient with a purely local
+         (k_rounds*q, n_loc) kernel-row matmul, then each shard scatters
+         back the active rows it owns.
+
+    Exactness mirrors run_chunk_block_active: f is linear in the round
+    coefs so deferring non-active rows' folds changes fp grouping only;
+    convergence is only declared from step 1's full-f extrema. Replicated
+    inner compute is deterministic, so every device carries bit-identical
+    active state. Requires q <= m and m/2 (m/4 under nu) candidates per
+    shard, i.e. m <= gran * n_loc (solve_mesh clamps).
+    """
+
+    def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
+                   state: BlockState, max_iter):
+        n_loc = x_loc.shape[0]
+        end = state.rounds + rounds_per_chunk
+
+        def cond(st: BlockState):
+            return ((st.rounds < end) & (st.pairs < max_iter)
+                    & (st.b_lo > st.b_hi + 2.0 * eps))
+
+        def cycle(st: BlockState):
+            act_ids, act_ok, b_hi, b_lo = _select_block_mesh(
+                st.f, st.alpha, y_loc, valid_loc, c, m, rule=selection)
+            gap_open = b_lo > b_hi + 2.0 * eps
+            scal_loc = jnp.stack(
+                [x_sq_loc, k_diag_loc, st.alpha, y_loc, st.f], axis=1)
+            x_act, scal, l_act, own_act = _gather_ws(
+                x_loc, scal_loc, act_ids, act_ok, n_loc)
+            sq_act, kd_act, a_act0, y_act, f_act0 = (
+                scal[:, 0], scal[:, 1], scal[:, 2], scal[:, 3], scal[:, 4])
+            x_act = x_act.astype(x_loc.dtype)
+            pend_w0 = jnp.zeros((k_rounds, q), jnp.int32)
+            pend_c0 = jnp.zeros((k_rounds, q), jnp.float32)
+
+            def inner_cond(carry):
+                _, _, _, _, k, t_tot, open_a = carry
+                return ((k < k_rounds) & open_a
+                        & (st.pairs + t_tot < max_iter))
+
+            def inner_body(carry):
+                a_act, f_act, pend_w, pend_c, k, t_tot, _ = carry
+                # The shared single-chip round step, on the replicated
+                # active views (valid=act_ok masks dead filler slots).
+                w, slot_ok, bh_a, bl_a, a_w, coef, t, qx, qsq = _round_core(
+                    x_act, y_act, sq_act, kd_act, f_act, a_act, act_ok,
+                    max_iter - st.pairs - t_tot,
+                    kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
+                    selection)
+                open_a = bl_a > bh_a + 2.0 * eps
+                k_rows_act = kernel_rows(x_act, sq_act, qx, qsq, kp)
+                f_act = f_act + coef @ k_rows_act
+                safe_w = jnp.where(slot_ok, w, jnp.int32(m))
+                a_act = a_act.at[safe_w].set(
+                    jnp.where(slot_ok, a_w, 0.0), mode="drop")
+                # Deltas recorded by ACTIVE-SLOT index (the reconciliation
+                # fold reads features from the replicated x_act, not the
+                # full x as the single-chip engine does).
+                pend_w = pend_w.at[k].set(w)
+                pend_c = pend_c.at[k].set(coef)
+                return a_act, f_act, pend_w, pend_c, k + 1, t_tot + t, open_a
+
+            a_act, f_act, pend_w, pend_c, k_done, t_tot, _ = lax.while_loop(
+                inner_cond, inner_body,
+                (a_act0, f_act0, pend_w0, pend_c0, jnp.int32(0),
+                 jnp.int32(0), gap_open))
+
+            # Reconciliation: one LOCAL batched fold of the cycle's deltas
+            # into the shard's gradient (dead slots carry coef 0).
+            def do_fold(f):
+                wf = pend_w.reshape(-1)
+                cf = pend_c.reshape(-1)
+                xw = jnp.take(x_act, wf, axis=0)  # (k_rounds*q, d)
+                sqw = jnp.take(sq_act, wf)
+                return f + cf @ kernel_rows(x_loc, x_sq_loc, xw, sqw, kp)
+
+            f = lax.cond(t_tot > 0, do_fold, lambda f: f, st.f)
+            # Scatter back the active rows THIS shard owns: the
+            # incrementally-maintained replicated values overwrite the
+            # fold's regrouped results so all views agree exactly (see
+            # run_chunk_block_active). Only live owned slots scatter.
+            l_scatter = jnp.where(own_act, l_act, jnp.int32(n_loc))
+            f = f.at[l_scatter].set(
+                jnp.where(own_act, f_act, 0.0), mode="drop")
+            alpha = st.alpha.at[l_scatter].set(
+                jnp.where(own_act, a_act, 0.0), mode="drop")
+            return BlockState(alpha, f, b_hi, b_lo,
+                              st.pairs + t_tot, st.rounds + k_done)
+
+        return lax.while_loop(cond, cycle, state)
 
     shard = P(DATA_AXIS)
     rep = P()

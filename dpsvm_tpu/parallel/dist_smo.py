@@ -353,11 +353,10 @@ def solve_mesh(
             f"engine={config.engine!r} is implemented for the single-chip "
             "solver only; the mesh backend supports engine='xla' (per-pair) "
             "and engine='block' (distributed decomposition)")
-    if config.active_set_size:
+    if config.active_set_size and config.engine != "block":
         raise ValueError(
-            "active_set_size (shrinking) is implemented for the "
-            "single-chip block engine only; on the mesh each shard's fold "
-            "is already n/P-sized — set active_set_size=0")
+            "active_set_size (shrinking) needs engine='block' "
+            "(the per-pair engines have no cycle structure to restrict)")
     if config.kernel == "precomputed":
         raise ValueError(
             "kernel='precomputed' is single-chip only this round (a "
@@ -477,10 +476,25 @@ def solve_mesh(
                             if observe else _UNOBSERVED_CHUNK)
         inner_impl = ("pallas" if mesh.devices.flat[0].platform == "tpu"
                       else "xla")
-        run_chunk = make_block_chunk_runner(
-            mesh, kp, config.c_bounds(), eps_run,
-            float(config.tau), q, inner, rounds_per_chunk, inner_impl,
-            selection=config.selection)
+        if config.active_set_size:
+            from dpsvm_tpu.parallel.dist_block import (
+                make_block_active_chunk_runner)
+
+            # Active-set size clamped like q: [q, gran*n_loc] so each
+            # shard can supply m/gran candidates per selection side, on
+            # the class granularity (see make_block_active_chunk_runner).
+            m_act = max(q, min(config.active_set_size, gran * n_loc))
+            m_act -= m_act % gran
+            run_chunk = make_block_active_chunk_runner(
+                mesh, kp, config.c_bounds(), eps_run,
+                float(config.tau), q, inner, rounds_per_chunk,
+                m_act, int(config.reconcile_rounds), inner_impl,
+                selection=config.selection)
+        else:
+            run_chunk = make_block_chunk_runner(
+                mesh, kp, config.c_bounds(), eps_run,
+                float(config.tau), q, inner, rounds_per_chunk, inner_impl,
+                selection=config.selection)
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
                            rounds=jax.device_put(jnp.int32(0), rep))

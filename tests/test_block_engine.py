@@ -279,19 +279,16 @@ def test_active_block_budget_cap_exact(blobs_medium):
     assert r.b_hi == b_hi and r.b_lo == b_lo
 
 
-def test_active_block_rejected_on_mesh_and_nonblock_engines(blobs_small):
-    """Loud failures, not silent ignores: shrinking is a single-chip
-    block-engine knob."""
+def test_active_block_rejected_on_nonblock_engines():
+    """Loud failures, not silent ignores: shrinking needs the block
+    engine's cycle structure (mesh acceptance is covered in
+    test_dist_smo.py)."""
     import pytest
 
     from dpsvm_tpu.config import SVMConfig
-    from dpsvm_tpu.parallel.dist_smo import solve_mesh
 
-    x, y = blobs_small
     with pytest.raises(ValueError, match="block-engine knob"):
         SVMConfig(engine="xla", active_set_size=64)
-    with pytest.raises(ValueError, match="single-chip block engine only"):
-        solve_mesh(x, y, CFG.replace(engine="block", active_set_size=64))
 
 
 def test_select_block_extrema_match_canonical_selectors():

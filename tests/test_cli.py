@@ -250,3 +250,107 @@ def test_test_cli_libsvm_narrower_file_uses_model_width(csvs, capsys, tmp_path):
     assert main(["test", "-f", lib_p, "-m", model_p]) == 0
     out = capsys.readouterr().out
     assert "test accuracy:" in out
+
+
+def test_probability_roundtrip(csvs, capsys):
+    """-b 1: train fits Platt calibration, model round-trips it through
+    .npz, test -b 1 reports log-loss and -o writes probabilities."""
+    train_p, test_p, d = csvs
+    model_p = d + "/pmodel.txt"  # auto-switched to .npz
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g", "0.1",
+               "-b", "1", "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "platt calibration: A=" in out
+    assert "pmodel.txt.npz" in out
+
+    pred_p = d + "/pred.txt"
+    rc = main(["test", "-f", test_p, "-m", model_p + ".npz", "-b", "1",
+               "-o", pred_p])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "platt-calibrated" in out
+    ll = float(out.split("test log-loss: ")[1].split()[0])
+    # This fixture's test accuracy is ~0.87, so a CALIBRATED model sits
+    # near ll ~ 0.5 (measured 0.51); > 0.7 would mean the fit is broken.
+    assert 0.0 < ll < 0.7
+    rows = open(pred_p).read().strip().splitlines()
+    assert rows[0] == "label p(+1)"
+    probs = np.array([float(r.split()[1]) for r in rows[1:]])
+    assert len(probs) == 100 and (probs >= 0).all() and (probs <= 1).all()
+    # Probabilities must actually separate the classes.
+    labels = np.array([int(r.split()[0]) for r in rows[1:]])
+    assert probs[labels > 0].mean() > 0.7 and probs[labels < 0].mean() < 0.3
+
+
+def test_probability_flag_rejections(csvs, capsys):
+    train_p, test_p, d = csvs
+    # -b on a non-classifier type fails loudly before loading data.
+    rc = main(["train", "-f", train_p, "-m", d + "/x.npz", "-t", "eps-svr",
+               "-b", "1", "-q"])
+    assert rc == 2
+    assert "classifiers only" in capsys.readouterr().err
+    # test -b 1 against an uncalibrated model fails loudly.
+    model_p = d + "/nopro.txt"
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    capsys.readouterr()
+    rc = main(["test", "-f", test_p, "-m", model_p, "-b", "1"])
+    assert rc == 2
+    assert "no Platt calibration" in capsys.readouterr().err
+
+
+def test_test_width_mismatch_policy(csvs, capsys):
+    """A test file WIDER than the model must not be silently truncated
+    (ADVICE round 2): CSV errors (with the -a escape hatch), and the
+    explicit -a truncates with a warning."""
+    train_p, test_p, d = csvs
+    model_p = d + "/wm.txt"
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    capsys.readouterr()
+    # Build a wider test csv (2 junk columns appended).
+    import numpy as np
+    from dpsvm_tpu.data.loader import load_csv
+    x, y = load_csv(test_p)
+    wide_p = d + "/wide.csv"
+    save_csv(wide_p, np.hstack([x, np.ones((len(y), 2), np.float32)]), y)
+    rc = main(["test", "-f", wide_p, "-m", model_p])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "14 features" in err and "expects 12" in err
+    # Explicit -a = consent: truncates, warns, evaluates.
+    rc = main(["test", "-f", wide_p, "-m", model_p, "-a", "12"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "warning" in cap.err
+    assert "test accuracy" in cap.out
+
+
+def test_loader_error_is_clean_diagnostic(csvs, capsys):
+    """An unloadable file prints a one-line error + --format hint, not a
+    traceback (ADVICE round 2)."""
+    train_p, test_p, d = csvs
+    bad_p = d + "/bad.libsvm"
+    with open(bad_p, "w") as fh:
+        fh.write("1 1:not_a_number\n-1 2:0.5\n")
+    rc = main(["train", "-f", bad_p, "-m", d + "/x.txt", "-q"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "could not load" in err and "--format" in err
+
+
+def test_probability_nusvc(csvs, capsys):
+    """-b 1 with -t nu-svc: CV folds must refit the nu dual (the
+    calibration plane comes from nu-SVC decision values)."""
+    train_p, test_p, d = csvs
+    model_p = d + "/nupro"
+    rc = main(["train", "-f", train_p, "-m", model_p, "-t", "nu-svc",
+               "--nu", "0.3", "-g", "0.1", "-b", "1",
+               "--backend", "single", "-q"])
+    assert rc == 0
+    assert "platt calibration" in capsys.readouterr().out
+    rc = main(["test", "-f", test_p, "-m", model_p + ".npz", "-b", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "test log-loss" in out

@@ -158,3 +158,68 @@ def test_mesh_budget_mode_exact_budget(blobs_medium, engine):
     assert r.alpha.min() >= 0.0 and r.alpha.max() <= CFG.c + 1e-6
     # Measured drift ~1e-6; the has_j-bug failure mode drifts by O(C).
     assert abs(float(np.dot(r.alpha, y))) < 1e-4
+
+
+def test_mesh_active_block_matches_plain_optimum(blobs_medium):
+    """Mesh shrinking (make_block_active_chunk_runner) must reach the
+    same optimum as the plain mesh block engine and the single-chip
+    solver — the cycle structure defers linear f updates, never changes
+    the math. Mirrors test_block_engine.py
+    test_active_block_matches_plain_optimum."""
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_medium
+
+    def obj(r):
+        return float(np.sum(r.alpha)
+                     - 0.5 * np.sum(r.alpha * y * (r.stats["f"] + y)))
+
+    base = CFG.replace(engine="block", working_set_size=32, cache_lines=0)
+    rb = solve_mesh(x, y, base, num_devices=8)
+    assert rb.converged
+    for m, k in ((64, 4), (128, 8), (1200, 2)):
+        ra = solve_mesh(x, y, base.replace(active_set_size=m,
+                                           reconcile_rounds=k),
+                        num_devices=8)
+        assert ra.converged
+        assert abs(ra.n_sv - rb.n_sv) <= max(2, 0.01 * rb.n_sv)
+        assert abs(ra.b - rb.b) < 5e-3
+        assert abs(obj(ra) - obj(rb)) <= 1e-3 * abs(obj(rb))
+    # Cross-check against the single-chip active engine at one setting.
+    rs = solve(x, y, base.replace(active_set_size=128, reconcile_rounds=8))
+    ra = solve_mesh(x, y, base.replace(active_set_size=128,
+                                       reconcile_rounds=8), num_devices=8)
+    assert abs(obj(ra) - obj(rs)) <= 1e-3 * abs(obj(rs))
+
+
+def test_mesh_active_block_budget_cap_exact(blobs_medium):
+    """Mesh shrinking must respect max_iter exactly and report refreshed
+    extrema on budget exits."""
+    from dpsvm_tpu.ops.select import extrema_np
+
+    x, y = blobs_medium
+    r = solve_mesh(x, y, CFG.replace(engine="block", working_set_size=32,
+                                     active_set_size=64, max_iter=37),
+                   num_devices=8)
+    assert r.iterations == 37
+    assert not r.converged
+    b_hi, b_lo = extrema_np(r.stats["f"], r.alpha, y, CFG.c)
+    assert r.b_hi == b_hi and r.b_lo == b_lo
+
+
+def test_mesh_active_block_device_counts(blobs_medium):
+    """Same solution at 1/2/8 devices (solution-level: approx_max_k bin
+    order may reorder mid-rank violators across device counts)."""
+    x, y = blobs_medium
+    cfg = CFG.replace(engine="block", working_set_size=32,
+                      active_set_size=128, reconcile_rounds=4)
+
+    def obj(r):
+        return float(np.sum(r.alpha)
+                     - 0.5 * np.sum(r.alpha * y * (r.stats["f"] + y)))
+
+    rs = [solve_mesh(x, y, cfg, num_devices=p) for p in (1, 2, 8)]
+    assert all(r.converged for r in rs)
+    for r in rs[1:]:
+        assert abs(obj(r) - obj(rs[0])) <= 1e-3 * abs(obj(rs[0]))
+        assert abs(r.b - rs[0].b) < 5e-3

@@ -1,0 +1,171 @@
+"""SV parity vs LibSVM at the reference's exact MNIST scale (n=60000).
+
+The reference's headline correctness claim is "same number of Support
+Vectors as LibSVM" on MNIST even-odd 60000x784 (reference README.md:27,
+run config reference Makefile:74). tools/parity.py demonstrates parity at
+n=10000/32561; this harness closes the gap at the claim's own scale:
+
+  * oracle: the one-time sklearn.svm.SVC run saved by tools/oracle60k.py
+    (eps=0.001 — the tolerance of the reference's parity claim);
+  * ours: single-chip xla / pallas / block on the real TPU, plus
+    block/mesh8 in a virtual-8-device CPU child (same mechanism as
+    tools/parity.py).
+
+Pass criteria match tools/parity.py: duplicate-merged SV count within 1%
+of LibSVM and >= 99.8% decision-sign agreement. Appends/replaces the
+"mnist-shaped / n=60000" section of PARITY.md. Run AFTER oracle60k:
+`python tools/oracle60k.py && python tools/parity60k.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SV_TOL = 0.01
+SIGN_TOL = 0.998
+SECTION = ("## mnist-shaped / full-scale "
+           "(n=60000, achieved KKT gap 1e-3; SV parity asserted)")
+# epsilon is HALF the oracle's tol: LibSVM stops when its KKT gap drops
+# below tol, while this framework inherits the reference's stopping rule
+# b_lo > b_hi + 2*eps (svmTrainMain.cpp:310), which stops at gap <= 2*eps.
+# Equal ACHIEVED gap (the quantity that determines which borderline points
+# become SVs) therefore requires eps = tol/2. Measured on this dataset:
+# at eps=0.001 (achieved gap 2e-3 vs the oracle's 1e-3) the count sits
+# 1.3-1.8% under LibSVM's; at the aligned eps the engines land 0.4-0.6%.
+CFG_KW = dict(c=10.0, gamma=0.125, epsilon=0.0005, max_iter=2_000_000)
+TPU_CASES = ["xla", "pallas", "block"]
+
+
+def child_main() -> int:
+    """CPU child: block/mesh8 on the virtual 8-device platform."""
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.synth import make_mnist_like
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = make_mnist_like(n=60_000, d=784, seed=7, noise=0.1)
+    res = solve_mesh(x, y, SVMConfig(engine="block", working_set_size=256,
+                                     **CFG_KW), num_devices=8)
+    np.save(os.path.join(REPO, "artifacts", "parity60k_mesh_alpha.npy"),
+            res.alpha)
+    print(json.dumps({"case": "block/mesh8", "b": float(res.b),
+                      "iterations": int(res.iterations),
+                      "converged": bool(res.converged),
+                      "device_seconds": round(res.train_seconds, 1)}),
+          flush=True)
+    return 0
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return child_main()
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.synth import make_mnist_like
+    from dpsvm_tpu.models.svm_model import SVMModel
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.predict import decision_function
+    from dpsvm_tpu.solver.smo import solve
+    from dpsvm_tpu.utils.hostenv import cleaned_cpu_env
+
+    with open(os.path.join(REPO, "artifacts", "oracle60k.json")) as fh:
+        oracle = json.load(fh)
+    z = np.load(os.path.join(REPO, "artifacts", "oracle60k.npz"))
+    sk_dec = z["dec"]
+    x, y = make_mnist_like(n=oracle["n"], d=oracle["d"], seed=oracle["seed"],
+                           noise=oracle["noise"])
+
+    _, inv = np.unique(x, axis=0, return_inverse=True)
+    group = inv.astype(np.int64) * 2 + (y > 0)
+
+    def merged_sv(alpha):
+        s = np.zeros(group.max() + 1)
+        np.add.at(s, group, np.abs(alpha))
+        return int((s > 0).sum())
+
+    # Start the CPU mesh child first; it runs while the TPU cases go.
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=cleaned_cpu_env(8), cwd=REPO, stdout=subprocess.PIPE, text=True)
+
+    rows = []
+
+    def add_row(case, alpha, rec):
+        dec = decision_function(
+            SVMModel.from_dense(x, y, alpha, rec["b"],
+                                KernelParams("rbf", CFG_KW["gamma"])), x)
+        msv = merged_sv(alpha)
+        sv_dev = abs(msv - oracle["merged_sv"]) / oracle["merged_sv"]
+        agree = float(np.mean(np.sign(dec) == np.sign(sk_dec)))
+        acc = float(np.mean(np.where(dec >= 0, 1, -1) == y))
+        ok = rec["converged"] and sv_dev <= SV_TOL and agree >= SIGN_TOL
+        rows.append(dict(case=case, n_sv=int((alpha > 0).sum()), msv=msv,
+                         sv_dev=sv_dev, agree=agree, acc=acc,
+                         iters=rec["iterations"],
+                         secs=rec["device_seconds"], ok=ok))
+        print(f"[60k] {case:12s} n_sv={rows[-1]['n_sv']} merged={msv} "
+              f"(dev {sv_dev * 100:.2f}%) agree={agree * 100:.2f}% "
+              f"acc={acc:.4f} iters={rec['iterations']} "
+              f"{'OK' if ok else 'FAIL'}", flush=True)
+
+    for engine in TPU_CASES:
+        cfg = SVMConfig(engine=engine, working_set_size=256, **CFG_KW)
+        res = solve(x, y, cfg)
+        add_row(f"{engine}/single",
+                res.alpha, dict(b=res.b, iterations=int(res.iterations),
+                                converged=bool(res.converged),
+                                device_seconds=round(res.train_seconds, 2)))
+
+    out, _ = child.communicate(timeout=7200)
+    if child.returncode != 0:
+        raise RuntimeError("mesh child failed")
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    alpha_mesh = np.load(os.path.join(REPO, "artifacts",
+                                      "parity60k_mesh_alpha.npy"))
+    add_row("block/mesh8", alpha_mesh, rec)
+
+    lines = [
+        SECTION, "",
+        f"Oracle: sklearn.svm.SVC at the same pinned hyperparameters on "
+        f"the benchmark dataset (make_mnist_like seed=7 noise=0.1) at "
+        f"tol=0.001; ours run at eps=0.0005 so both stop at the same "
+        f"ACHIEVED KKT gap of 1e-3 (LibSVM stops at gap < tol, the "
+        f"reference rule b_lo > b_hi + 2*eps at gap <= 2*eps) — "
+        f"**{oracle['n_sv']} SVs** ({oracle['merged_sv']} merged), train "
+        f"accuracy {oracle['acc']:.4f}, fit in {oracle['seconds']:.0f} s "
+        f"(tools/oracle60k.py; single-chip rows ran on the real TPU, the "
+        f"mesh row on the virtual 8-device CPU platform).", "",
+        "| engine/backend | n_sv | merged | Δmerged | sign agree | "
+        "train acc | pair updates | device s | status |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['case']} | {r['n_sv']} | {r['msv']} | "
+            f"{r['sv_dev'] * 100:.2f}% | {r['agree'] * 100:.2f}% | "
+            f"{r['acc']:.4f} | {r['iters']} | {r['secs']} | "
+            f"{'OK' if r['ok'] else '**FAIL**'} |")
+    lines.append("")
+
+    path = os.path.join(REPO, "PARITY.md")
+    text = open(path).read()
+    if SECTION in text:  # replace the existing section (idempotent re-runs)
+        head, rest = text.split(SECTION, 1)
+        tail = rest.split("\n## ", 1)
+        text = head + ("\n## " + tail[1] if len(tail) > 1 else "")
+    open(path, "w").write(text.rstrip("\n") + "\n\n" + "\n".join(lines))
+    failures = sum(not r["ok"] for r in rows)
+    print(f"wrote {path}; {'ALL OK' if not failures else f'{failures} FAILURES'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
