@@ -11,18 +11,31 @@ tests/test_scale_and_debug.py) so the number is reproducible:
     x = rng.normal(size=(500000, 54)) * 0.3
     y = sign(x[:, 0] + 0.2 * N(0,1))
 
-It substantiates docs/ARCHITECTURE.md's covtype-scale claim (block
-engine: ~3M pair updates in tens of seconds on one v5e chip) with a
-committed artifact. Run on the real TPU: `python tools/bench_covtype.py`
-(writes BENCH_COVTYPE.md at the repo root).
+Two modes:
+
+* default — the headline artifact: run the best-known config to the
+  reference's full 3M-pair budget, recording a gap-vs-pairs trajectory
+  (per-chunk callback) and the final TRAIN ACCURACY, so the throughput
+  number is anchored to optimization quality (a pairs/s figure on an
+  unconverged run proves speed, not usefulness).
+* --sweep — the operating-point study: short (--sweep-pairs) runs over
+  (selection in {mvp, second_order}) x (q, inner) x shrinking, ranked by
+  device seconds to reach the common reachable gap. PROFILE.md explains
+  why large inner budgets are the lever (the round is dominated by its
+  fixed O(n) cost; the serial chain is ~0.5 us/pair): pairs on a stale
+  working set are cheap but less useful, so the sweep ranks by
+  TIME-TO-GAP, never raw pairs/s.
+
+Run on the real TPU: `python tools/bench_covtype.py [--sweep]`
+(default mode rewrites BENCH_COVTYPE.md at the repo root).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -33,38 +46,173 @@ N, D = 500_000, 54
 MAX_ITER = 3_000_000  # the reference's covtype budget (Makefile:77)
 
 
-def main() -> int:
-    import jax
-
-    from dpsvm_tpu.config import SVMConfig
-    from dpsvm_tpu.solver.smo import solve
-
+def make_data():
     rng = np.random.default_rng(0)
     x = (rng.normal(size=(N, D)) * 0.3).astype(np.float32)
     y = np.where(x[:, 0] + 0.2 * rng.standard_normal(N) > 0, 1, -1).astype(
         np.int32)
+    return x, y
 
-    # chunk_iters + a (no-op) callback split the solve into ~12 dispatches
-    # of ~250k pair updates: a single 3M-pair dispatch (~50k while_loop
-    # rounds) faults the tunneled device runtime, and chunk boundaries
-    # also give the run a heartbeat. The ~80 ms observation cost per chunk
-    # is noise against the ~tens-of-seconds solve.
-    # q=512 with a 4q inner budget measured best at this n in the
-    # tools/sweep_block.py grid (~636k pair updates/s).
-    config = SVMConfig(
+
+def sweep(x, y, base, budget: int):
+    """Budget-ladder study: each config runs UNOBSERVED (single
+    dispatch, device-clean time) to budget/5, 2/5 budget and the full
+    budget; the gap at each exit comes from the solver's host-side
+    extrema refresh. Chunked per-chunk observation was measured to
+    charge configs UNEVENLY (~70-80 ms tunnel latency per dispatch,
+    and configs whose subproblems exit early pay more dispatches per
+    pair), which inverted the pairs/s ordering vs PROFILE.md's
+    single-dispatch ablation — the ladder gives every probe exactly one
+    dispatch."""
+    from dpsvm_tpu.solver.smo import solve
+
+    grid = []
+    for sel in ("mvp", "second_order"):
+        for q, inner in ((512, 2048), (512, 4096), (512, 16384),
+                         (1024, 4096), (1024, 8192)):
+            grid.append(base.replace(selection=sel, working_set_size=q,
+                                     inner_iters=inner))
+        # Shrinking rows (PROFILE.md: the fixed cost is the bottleneck;
+        # shrinking divides its O(n) terms by n/m for k_rounds per cycle).
+        grid.append(base.replace(selection=sel, working_set_size=512,
+                                 inner_iters=2048, active_set_size=65536,
+                                 reconcile_rounds=8))
+    ladder = [budget // 5, 2 * budget // 5, budget]
+    results = []  # (label, cfg, points=[(pairs, gap, dev_s), ...])
+    for cfg in grid:
+        label = (f"{cfg.selection}/q{cfg.working_set_size}"
+                 f"/i{cfg.inner_iters}"
+                 + (f"/m{cfg.active_set_size}" if cfg.active_set_size else ""))
+        solve(x, y, cfg.replace(max_iter=64))  # compile (same executor)
+        points = []
+        for b in ladder:
+            res = solve(x, y, cfg.replace(max_iter=b))
+            points.append((int(res.iterations),
+                           float(res.b_lo - res.b_hi),
+                           res.train_seconds))
+        results.append((label, cfg, points))
+        print(f"[{label}] " + "  ".join(
+            f"{p}p/{g:.3f}g/{t:.2f}s" for p, g, t in points), flush=True)
+
+    def seconds_to_gap(points, g):
+        for p, gap, t in points:
+            if gap <= g:
+                return t
+        return None
+
+    # Rank by device seconds to a DISCRIMINATING target: 110% of the
+    # best full-budget gap (runs that never reach it rank last by
+    # final gap).
+    best_gap = min(pts[-1][1] for _, _, pts in results)
+    target = max(1.1 * best_gap, 2 * base.epsilon)
+    ranked = sorted(
+        results,
+        key=lambda e: (seconds_to_gap(e[2], target)
+                       or 1e9 + e[2][-1][1]))
+    print(f"\nsweep ranking (device s to gap <= {target:.4f}, "
+          f"ladder {ladder} pairs):")
+    lines = [f"Budget ladder {ladder} pairs/config, each point one "
+             f"unobserved dispatch. Ranked by device seconds to reach "
+             f"gap <= {target:.4f} (110% of the best full-budget gap); "
+             f"runs that never reach it rank last by final gap.", "",
+             "| config | s to target gap | final gap | pairs | dev s | "
+             "pairs/s |", "|---|---|---|---|---|---|"]
+    for label, cfg, pts in ranked:
+        s = seconds_to_gap(pts, target)
+        pairs, gap, t = pts[-1]
+        pps = pairs / max(t, 1e-9)
+        print(f"  {label:28s} "
+              f"{f'{s:.2f}' if s is not None else '-':>8} "
+              f"gap={gap:8.4f} pairs={pairs} dev_s={t:.2f}")
+        lines.append(
+            f"| {label} | {f'{s:.2f}' if s is not None else '—'} | "
+            f"{gap:.4f} | {pairs} | {t:.2f} | {pps:,.0f} |")
+    return ranked, lines
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--sweep-pairs", type=int, default=768_000)
+    args = ap.parse_args()
+
+    import jax
+
+    from dpsvm_tpu.config import SVMConfig
+
+    x, y = make_data()
+
+    # Operating point from the --sweep ranking (2026-07-30): mvp with a
+    # large inner budget amortizes the fixed ~0.74 ms round cost
+    # (PROFILE.md) over every pair the working set can absorb (i8192 and
+    # i16384 measure identically — the subproblem exits when the local
+    # gap closes, ~4-8k useful pairs per q=512 set — so the budget is a
+    # ceiling, not a forcing). WSS2 measured SLOWER at equal quality on
+    # both this shape and adult-shape (the block engine's pair
+    # redundancy comes from working-set restriction, not partner choice
+    # within W; see BENCH_COVTYPE_SWEEP.md) — defaults stay mvp.
+    # dtype=float32: at THIS gamma (0.03125, pairwise distances^2
+    # clustered ~9.7) the discriminative signal is ~1% variations around
+    # K~0.74, which bf16 X rounding destroys — measured on a 20k
+    # subsample at 50M pairs: fp32 reaches train acc 0.973, bf16 0.593
+    # at the same pair count (speed is identical: 912k vs 900k pairs/s).
+    # The mnist-shaped headline bench keeps bf16, where its quality gate
+    # passes; this is a per-shape numerics decision, not a default.
+    base = SVMConfig(
         c=2048.0, gamma=0.03125, epsilon=1e-3, max_iter=MAX_ITER,
         cache_lines=0, engine="block", working_set_size=512,
-        inner_iters=2048, dtype="bfloat16", chunk_iters=250_000)
+        inner_iters=16384, selection="mvp", dtype="float32")
 
-    def heartbeat(it, b_hi, b_lo, state):
-        print(f"  ... {it} pairs, gap={b_lo - b_hi:.5f}", file=sys.stderr)
+    if args.sweep:
+        _, lines = sweep(x, y, base, args.sweep_pairs)
+        out = os.path.join(REPO, "BENCH_COVTYPE_SWEEP.md")
+        with open(out, "w") as fh:
+            fh.write("# BENCH_COVTYPE_SWEEP — operating-point study\n\n"
+                     "Command: `python tools/bench_covtype.py --sweep` "
+                     "(real TPU).\n\n" + "\n".join(lines) + "\n")
+        print(f"wrote {out}", file=sys.stderr)
+        return 0
 
-    # Warm-up compiles the chunk executor (max_iter is traced, so a short
-    # run builds the same program the timed run uses).
-    solve(x, y, config.replace(max_iter=64), callback=heartbeat)
-    t0 = time.perf_counter()
-    res = solve(x, y, config, callback=heartbeat)
-    wall = time.perf_counter() - t0
+    from dpsvm_tpu.solver.smo import solve
+
+    solve(x, y, base.replace(max_iter=64))  # compile
+    # Headline time: ONE unobserved dispatch of the full budget (chunked
+    # observation pays ~70-80 ms tunnel latency per chunk and was
+    # measured to distort config comparisons; see sweep()). The
+    # trajectory comes from a ladder of shorter unobserved runs — each
+    # point an independent solve from the zero start, so its time is
+    # directly the device-seconds-to-that-many-pairs.
+    res = solve(x, y, base)
+    traj_rows = []
+    for b in (250_000, 500_000, 1_000_000, 1_500_000, 2_000_000,
+              2_500_000):
+        r = solve(x, y, base.replace(max_iter=b))
+        traj_rows.append((int(r.iterations), float(r.b_lo - r.b_hi),
+                          r.train_seconds))
+        print(f"  ladder {r.iterations} pairs: gap="
+              f"{float(r.b_lo - r.b_hi):.5f} {r.train_seconds:.2f}s",
+              file=sys.stderr)
+    traj_rows.append((int(res.iterations), float(res.b_lo - res.b_hi),
+                      res.train_seconds))
+
+    # Quality anchors: final train accuracy (the reference prints its own
+    # train accuracy after covtype runs, svmTrainMain.cpp:335), the gap
+    # trajectory, and a 20k-subsample run at a per-row-comparable budget
+    # showing the machinery optimizes to high accuracy.
+    from dpsvm_tpu.models.svm_model import SVMModel
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.predict import accuracy
+
+    kp = KernelParams("rbf", base.resolve_gamma(D))
+    model = SVMModel.from_dense(x, y, res.alpha, res.b, kp)
+    acc = accuracy(model, x, y)
+
+    xs, ys = x[:20_000], y[:20_000]
+    cfg20 = base.replace(max_iter=50_000_000, inner_iters=4096)
+    solve(xs, ys, cfg20.replace(max_iter=64))  # compile (new n shape)
+    r20 = solve(xs, ys, cfg20)
+    m20 = SVMModel.from_dense(xs, ys, r20.alpha, r20.b, kp)
+    acc20 = accuracy(m20, xs, ys)
 
     dev = str(jax.devices()[0])
     pps = res.iterations / max(res.train_seconds, 1e-9)
@@ -79,6 +227,8 @@ def main() -> int:
         "pairs_per_second": round(pps),
         "converged": bool(res.converged),
         "final_gap": round(float(res.b_lo - res.b_hi), 6),
+        "train_accuracy": round(float(acc), 4),
+        "subsample20k_50M_train_accuracy": round(float(acc20), 4),
         "n_sv": int(res.n_sv),
         "device": dev,
     }
@@ -88,26 +238,45 @@ def main() -> int:
         "# BENCH_COVTYPE — covtype-scale artifact",
         "",
         "Command: `python tools/bench_covtype.py` (real TPU; synthetic",
-        "covtype-shaped data, generation pinned in the tool's docstring).",
+        "covtype-shaped data, generation pinned in the tool's docstring;",
+        "operating point from BENCH_COVTYPE_SWEEP.md).",
         "",
         f"* device: {dev}",
-        f"* config: n={N} d={D} c={config.c:g} gamma={config.gamma:g} "
-        f"eps={config.epsilon:g} engine={config.engine} "
-        f"q={config.working_set_size} inner={config.inner_iters} "
-        f"dtype={config.dtype}, max_iter={MAX_ITER} "
-        "(reference Makefile:77 budget)",
+        f"* config: n={N} d={D} c={base.c:g} gamma={base.gamma:g} "
+        f"eps={base.epsilon:g} engine={base.engine} "
+        f"selection={base.selection} q={base.working_set_size} "
+        f"inner={base.inner_iters} dtype={base.dtype}, "
+        f"max_iter={MAX_ITER} (reference Makefile:77 budget)",
         f"* pair updates: **{res.iterations}** "
         f"(converged={res.converged}, final gap "
         f"{float(res.b_lo - res.b_hi):.6f})",
         f"* device solve time: **{res.train_seconds:.1f} s** "
-        f"({pps:,.0f} pair updates/s); wall incl. host: {wall:.1f} s",
+        f"({pps:,.0f} pair updates/s)",
         f"* support vectors: {res.n_sv}",
+        f"* train accuracy at the 3M budget: **{acc:.4f}** — honest "
+        "context: the reference's own covtype cap is 3M pair updates "
+        "for n=500k (6 updates/row), far below what c=2048 needs; the "
+        "reference publishes no covtype accuracy or wall-clock either "
+        "(Makefile:77 is the only trace). The anchor below shows the "
+        "same solver reaching high accuracy when the per-row budget is "
+        "realistic.",
+        f"* quality verification (20k subsample of the same "
+        f"distribution, same hyperparameters, 50M pairs = 2500/row): "
+        f"train accuracy **{acc20:.4f}** in {r20.train_seconds:.1f} s "
+        f"device time (fp32; the same run with bf16 X reaches only "
+        f"0.59 — at gamma=0.03125 the kernel's discriminative signal "
+        f"is ~1% variations that bf16 rounding destroys, which is why "
+        f"this benchmark pins dtype=float32).",
         "",
-        "```json",
-        json.dumps(line),
-        "```",
+        "Gap-vs-pairs trajectory (each row an independent unobserved "
+        "run from the zero start to that pair budget; time is "
+        "device-seconds to reach it):",
         "",
+        "| pair updates | KKT gap (b_lo - b_hi) | device s |",
+        "|---|---|---|",
     ]
+    md += [f"| {it} | {gap:.5f} | {t:.2f} |" for it, gap, t in traj_rows]
+    md += ["", "```json", json.dumps(line), "```", ""]
     out = os.path.join(REPO, "BENCH_COVTYPE.md")
     with open(out, "w") as fh:
         fh.write("\n".join(md))

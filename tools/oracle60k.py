@@ -29,17 +29,10 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from tools.parity_common import merged_sv as merged_sv_count
+
 N, D, SEED, NOISE = 60_000, 784, 7, 0.1
 C, GAMMA, EPS = 10.0, 0.125, 0.001
-
-
-def merged_sv_count(x: np.ndarray, y: np.ndarray, alpha: np.ndarray) -> int:
-    """Duplicate-merged SV count (see tools/parity.py methodology)."""
-    _, inv = np.unique(x, axis=0, return_inverse=True)
-    group = inv.astype(np.int64) * 2 + (y > 0)
-    s = np.zeros(group.max() + 1)
-    np.add.at(s, group, np.abs(alpha))
-    return int((s > 0).sum())
 
 
 def main() -> int:

@@ -30,6 +30,9 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from tools.parity_common import merged_sv as merged_sv_xy
+from tools.parity_common import replace_section
+
 SV_TOL = 0.01
 SIGN_TOL = 0.998
 SECTION = ("## mnist-shaped / full-scale "
@@ -83,13 +86,8 @@ def main() -> int:
     x, y = make_mnist_like(n=oracle["n"], d=oracle["d"], seed=oracle["seed"],
                            noise=oracle["noise"])
 
-    _, inv = np.unique(x, axis=0, return_inverse=True)
-    group = inv.astype(np.int64) * 2 + (y > 0)
-
     def merged_sv(alpha):
-        s = np.zeros(group.max() + 1)
-        np.add.at(s, group, np.abs(alpha))
-        return int((s > 0).sum())
+        return merged_sv_xy(x, y, alpha)
 
     # Start the CPU mesh child first; it runs while the TPU cases go.
     child = subprocess.Popen(
@@ -156,12 +154,7 @@ def main() -> int:
     lines.append("")
 
     path = os.path.join(REPO, "PARITY.md")
-    text = open(path).read()
-    if SECTION in text:  # replace the existing section (idempotent re-runs)
-        head, rest = text.split(SECTION, 1)
-        tail = rest.split("\n## ", 1)
-        text = head + ("\n## " + tail[1] if len(tail) > 1 else "")
-    open(path, "w").write(text.rstrip("\n") + "\n\n" + "\n".join(lines))
+    replace_section(path, SECTION, lines)
     failures = sum(not r["ok"] for r in rows)
     print(f"wrote {path}; {'ALL OK' if not failures else f'{failures} FAILURES'}")
     return 1 if failures else 0

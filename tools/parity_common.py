@@ -1,0 +1,34 @@
+"""Shared helpers for the parity harnesses (parity60k / parity_covtype).
+
+One implementation of the duplicate-merged SV metric and the PARITY.md
+section splice, so the full-scale and covtype-shaped sections can never
+drift onto different rules.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def merged_sv(x: np.ndarray, y: np.ndarray, alpha: np.ndarray) -> int:
+    """Duplicate-merged SV count: sum |alpha| over identical (row, label)
+    groups first — with duplicates the dual optimum is a face and the raw
+    count is solver-path-dependent (see tools/parity.py methodology)."""
+    _, inv = np.unique(x, axis=0, return_inverse=True)
+    group = inv.astype(np.int64) * 2 + (y > 0)
+    s = np.zeros(group.max() + 1)
+    np.add.at(s, group, np.abs(alpha))
+    return int((s > 0).sum())
+
+
+def replace_section(path: str, section: str, lines: list) -> None:
+    """Idempotently replace (or append) one '## ...' section of a
+    markdown file. `section` is the exact heading line; `lines` the full
+    replacement including that heading."""
+    text = open(path).read()
+    if section in text:
+        head, rest = text.split(section, 1)
+        tail = rest.split("\n## ", 1)
+        text = head.rstrip("\n") + ("\n\n## " + tail[1].lstrip("\n")
+                                     if len(tail) > 1 else "")
+    open(path, "w").write(text.rstrip("\n") + "\n\n" + "\n".join(lines))

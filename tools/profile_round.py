@@ -28,22 +28,138 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def timed(fn, *args, reps: int) -> float:
-    """Seconds per repetition of fn, measured inside one dispatch."""
+def salted(x, k: int):
+    """Return a copy of float array/scalar x whose contents differ
+    REPRESENTABLY from x (relative 2^-20 bump, exact in fp32 for any
+    magnitude) in a fresh device buffer. Both properties matter on the
+    tunneled runtime: re-dispatching the same buffer OR content-identical
+    values can be served from the result cache without executing
+    (measured ~0 ms readings; see the bench_predict.py trap notes). The
+    perturbation is harmless to cost profiling — probe runs never need
+    exact optima."""
     import jax
+    import jax.numpy as jnp
+
+    out = x * jnp.float32(1.0 + k * 2.0 ** -20)
+    jax.block_until_ready(out)
+    return out
+
+
+def timed(fn, *args, reps: int) -> float:
+    """Seconds per repetition of fn, measured inside one dispatch.
+
+    Differences two in-dispatch repetition counts (reps and 2*reps) so the
+    tunnel's fixed per-dispatch latency cancels — a single-dispatch
+    measurement reads tens of ms of sync overhead into every stage
+    (the trap documented in tools/bench_predict.py; on a local TPU the
+    two estimates agree)."""
+    import jax
+    from functools import partial
     from jax import lax
 
-    @jax.jit
-    def loop(*a):
+    @partial(jax.jit, static_argnames="n")
+    def loop(*a, n):
         def body(i, carry):
             return fn(*carry)
-        return lax.fori_loop(0, reps, body, a)
+        return lax.fori_loop(0, n, body, a)
 
-    out = loop(*args)
-    jax.block_until_ready(out)  # compile
-    t0 = time.perf_counter()
-    jax.block_until_ready(loop(*args))
-    return (time.perf_counter() - t0) / reps
+    jax.block_until_ready(loop(*args, n=reps))      # compile 1
+    jax.block_until_ready(loop(*args, n=2 * reps))  # compile 2
+
+    salt = [0]
+
+    def run(n):
+        # Off-clock representable perturbation of the first float arg —
+        # see salted() for why both fresh buffer and fresh contents are
+        # required on this runtime.
+        salt[0] += 1
+        a = (salted(args[0], salt[0]),) + args[1:]
+        t0 = time.perf_counter()
+        jax.block_until_ready(loop(*a, n=n))
+        return time.perf_counter() - t0
+
+    # best-of-2 per count absorbs tunnel jitter between the two probes.
+    t1 = min(run(reps), run(reps))
+    t2 = min(run(2 * reps), run(2 * reps))
+    return max(t2 - t1, 0.0) / reps
+
+
+def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int):
+    """Stage attribution from WHOLE-CHUNK ablation — the only timing
+    method the tunnel cannot distort (one dispatch per probe, big-state
+    output, salted fresh start each time). Runs `reps` rounds at
+    inner budgets {1, q//4, q, 2q} and derives:
+
+      fixed ms/round   = chunk time at inner=1 (selection + gathers +
+                         Gram + fold + scatter + ONE pair)
+      marginal us/pair = slope of chunk time vs executed pairs across
+                         budgets (the serial subproblem chain's per-pair
+                         cost, free of every per-round fixed term)
+
+    Returns (rows, fixed_ms, marginal_us)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.solver.block import BlockState, run_chunk_block
+    from dpsvm_tpu.solver.smo import _BUDGET_EPS
+
+    base = BlockState(alpha=jnp.zeros_like(yd),
+                      f=(-yd).astype(jnp.float32),
+                      b_hi=jnp.float32(-1e9), b_lo=jnp.float32(1e9),
+                      pairs=jnp.int32(0), rounds=jnp.int32(0))
+    rows = []
+    salt = [0]
+
+    def probe(run, reps_n):
+        best = None
+        for _ in range(3):
+            salt[0] += 1
+            st = base._replace(f=salted(base.f, salt[0]))
+            t0 = time.perf_counter()
+            out = run(st, reps_n)
+            jax.block_until_ready(out)
+            t = time.perf_counter() - t0
+            if best is None or t < best[0]:
+                best = (t, int(out.rounds), int(out.pairs))
+        return best
+
+    for inner in (1, max(2, q // 4), q, 2 * q):
+        # _BUDGET_EPS keeps the stopping test open so EVERY probe runs
+        # its exact round budget with its full inner budget — from the
+        # zero start the mnist shape otherwise converges mid-probe,
+        # making rounds/pairs differ across budgets and the slope
+        # meaningless. Post-optimum rounds execute the identical
+        # instruction stream, so the cost model is unaffected.
+        run = lambda st, n: run_chunk_block(
+            xd, yd, x_sq, k_diag, st, jnp.int32(10 ** 9), kp,
+            cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
+            n, inner_impl="pallas")
+        jax.block_until_ready(run(base, reps))       # compile + warm
+        jax.block_until_ready(run(base, 2 * reps))
+        t1, r1, p1 = probe(run, reps)
+        t2, r2, p2 = probe(run, 2 * reps)
+        # Differencing the two round counts cancels the tunnel's fixed
+        # per-dispatch latency (~60-80 ms — it otherwise reads as
+        # +F/reps ms on every round, HALVING when reps doubles).
+        t = max(t2 - t1, 0.0)
+        rounds, pairs = r2 - r1, p2 - p1
+        rows.append((inner, rounds, pairs, 1e3 * t / max(rounds, 1),
+                     1e6 * t / max(pairs, 1), t))
+        print(f"  inner={inner:5d}: {rounds} rounds, {pairs} pairs, "
+              f"{1e3 * t / max(rounds, 1):7.3f} ms/round, "
+              f"{1e6 * t / max(pairs, 1):7.2f} us/pair  "
+              f"(differenced {reps}/{2 * reps}-round chunks)")
+    # Report LOCAL marginals between consecutive budgets (a single global
+    # slope hides tunnel drift between probes; consecutive pairs taken
+    # minutes apart still carry +-5-15% drift — treat each as an
+    # independent estimate and read the spread as the error bar).
+    for (i0, _, p0, _, _, t0), (i1, _, p1, _, _, t1) in zip(rows, rows[1:]):
+        if p1 > p0:
+            print(f"  marginal {i0}->{i1}: "
+                  f"{1e6 * (t1 - t0) / (p1 - p0):6.2f} us/pair")
+    fixed_ms = rows[0][3]
+    marg = 1e6 * (rows[-1][5] - rows[0][5]) / max(rows[-1][2] - rows[0][2], 1)
+    return rows, fixed_ms, marg
 
 
 def main() -> int:
@@ -52,6 +168,9 @@ def main() -> int:
                     choices=["mnist", "covtype"])
     ap.add_argument("--q", type=int, default=512)
     ap.add_argument("--reps", type=int, default=200)
+    ap.add_argument("--n", type=int, default=None,
+                    help="row-count override (docs/SCALING.md uses the "
+                         "fixed-cost slope between two n's at equal d/q)")
     args = ap.parse_args()
 
     import jax
@@ -66,11 +185,12 @@ def main() -> int:
 
     if args.dataset == "mnist":
         from dpsvm_tpu.data.synth import make_mnist_like
-        x, y = make_mnist_like(n=60_000, d=784, seed=7, noise=0.1)
+        x, y = make_mnist_like(n=args.n or 60_000, d=784, seed=7, noise=0.1)
         cfg = SVMConfig(c=10.0, gamma=0.125, epsilon=0.01)
     else:
         rng = np.random.default_rng(0)
-        x = (rng.normal(size=(500_000, 54)) * 0.3).astype(np.float32)
+        nn = args.n or 500_000
+        x = (rng.normal(size=(nn, 54)) * 0.3).astype(np.float32)
         y = np.where(x[:, 0] + 0.2 * rng.standard_normal(len(x)) > 0,
                      1, -1).astype(np.int32)
         cfg = SVMConfig(c=2048.0, gamma=0.03125, epsilon=1e-3)
@@ -169,22 +289,35 @@ def main() -> int:
         inner_impl="pallas")
     out = runner(st)  # compile + warm
     jax.block_until_ready(out)
-    # Time a SECOND execution from the same fresh state: continuing from
-    # the warmed-up state instead would run degenerate near-converged
-    # rounds (or zero rounds once the gap closes) and poison the average.
+    # Time a SECOND execution from an (epsilon-perturbed) fresh state:
+    # continuing from the warmed-up state would run degenerate
+    # near-converged rounds, and re-dispatching the IDENTICAL state lets
+    # the tunnel serve the cached result without executing (measured
+    # ~0 ms) — hence the off-clock salt.
+    st2 = st._replace(f=salted(st.f, 1))
     t0 = time.perf_counter()
-    out2 = runner(st)
+    out2 = runner(st2)
     jax.block_until_ready(out2)
     t_full = (time.perf_counter() - t0) / max(int(out2.rounds), 1)
     print(f"  (full-round chunk executed {int(out2.rounds)} rounds, "
           f"{int(out2.pairs)} pairs)")
 
     total = t_sel + t_gather + t_gram + t_inner + t_fold + t_scatter
+    print("  isolated stages (differenced fori_loop probes — INDICATIVE "
+          "only; the tunnel's dispatch elision/latency can distort them):")
     for name, t in [("select", t_sel), ("gather", t_gather),
                     ("gram", t_gram), ("inner(pallas)", t_inner),
                     ("fold", t_fold), ("scatter", t_scatter),
                     ("SUM", total), ("FULL ROUND", t_full)]:
         print(f"  {name:15s} {1e3 * t:8.3f} ms")
+
+    # Whole-chunk ablation: the authoritative attribution (see ablate()).
+    print("  whole-chunk ablation over inner budgets (authoritative):")
+    rows, fixed_ms, marg_us = ablate(xd, yd, x_sq, k_diag, kp, cfg, q,
+                                     args.reps)
+    print(f"  => fixed round cost {fixed_ms:.3f} ms "
+          f"(select+gather+gram+fold+scatter), marginal "
+          f"{marg_us:.2f} us/pair (serial subproblem chain)")
     return 0
 
 
