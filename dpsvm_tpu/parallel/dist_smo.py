@@ -520,16 +520,23 @@ def solve_mesh(
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * eps_run)
-        if callback is not None:
-            callback(it, b_hi, b_lo, state)
+        abort = bool(callback is not None
+                     and callback(it, b_hi, b_lo, state))
         if config.check_numerics:
             assert_finite_state(state, it, f"mesh p={n_dev}")
-        if ckpt.due(it):
-            ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
+        if ckpt.due(it) or (abort and ckpt.active):
+            # Abort exits force a save: the state being stopped at must
+            # not exist only in memory (a stall-stop can sit up to
+            # chunk_iters past the last cadence save).
+            ckpt.force_save(it, np.asarray(state.alpha)[:n],
                             np.asarray(state.f)[:n], b_hi, b_lo)
         if config.verbose:
             print(f"[smo-mesh p={n_dev}] iter={it} gap={b_lo - b_hi:.6f}")
         if converged or it >= config.max_iter:
+            break
+        if abort:
+            # See solver/smo.py: clean callback stop, checked after the
+            # convergence test so it cannot mask a converged chunk.
             break
 
     alpha = np.asarray(state.alpha)[:n]

@@ -620,18 +620,29 @@ def solve(
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
         converged = not (b_lo > b_hi + 2.0 * eps_run)
-        if callback is not None:
-            callback(it, b_hi, b_lo, state)
+        abort = bool(callback is not None
+                     and callback(it, b_hi, b_lo, state))
         if config.check_numerics:
             assert_finite_state(state, it, "single-chip")
-        if ckpt.due(it):
-            ckpt.maybe_save(it, np.asarray(state.alpha)[:n],
+        if ckpt.due(it) or (abort and ckpt.active):
+            # Abort exits force a save: the state being stopped at must
+            # not exist only in memory (a stall-stop can sit up to
+            # chunk_iters past the last cadence save).
+            ckpt.force_save(it, np.asarray(state.alpha)[:n],
                             np.asarray(state.f)[:n], b_hi, b_lo)
         if config.verbose:
             gap = b_lo - b_hi
             print(f"[smo] iter={it} b_lo-b_hi={gap:.6f} "
                   f"hits={int(state.hits)}")
         if converged or it >= config.max_iter:
+            break
+        if abort:
+            # Clean callback-initiated stop at the chunk boundary (used
+            # e.g. to stop at a measured true-gap plateau; see
+            # docs/ARCHITECTURE.md round-3 findings). Checked AFTER the
+            # convergence test so an abort on the closing chunk still
+            # reports converged=True. No reference equivalent: its loop
+            # is uninterruptible to max_iter.
             break
 
     alpha = np.asarray(state.alpha)[:n]

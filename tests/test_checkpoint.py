@@ -85,3 +85,23 @@ def test_periodic_checkpoint_written_during_solve(blobs_small, tmp_path):
     a, f, it, *_ = load_checkpoint(p)
     assert 0 < it <= 200
     assert a.shape == (x.shape[0],)
+
+
+def test_callback_abort_forces_checkpoint(tmp_path, blobs_small):
+    """An abort exit must persist the state it stopped at, even when the
+    periodic cadence isn't due (the stall-stop scenario)."""
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.solver.smo import solve
+    from dpsvm_tpu.utils.checkpoint import load_checkpoint
+
+    x, y = blobs_small
+    path = str(tmp_path / "abort.npz")
+    cfg = SVMConfig(c=1.0, gamma=0.1, max_iter=100_000, chunk_iters=64,
+                    checkpoint_every=1_000_000)  # cadence never due
+    res = solve(x, y, cfg, callback=lambda it, bh, bl, st: it >= 128,
+                checkpoint_path=path)
+    assert not res.converged and res.iterations < 100_000
+    alpha, f, it, b_hi, b_lo, _ = load_checkpoint(path)
+    assert it == res.iterations  # the abort state, not a stale cadence one
+    import numpy as np
+    np.testing.assert_array_equal(alpha, res.alpha)

