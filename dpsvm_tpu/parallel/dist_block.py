@@ -108,21 +108,34 @@ def _select_block_mesh(f, alpha, y, valid, c, q: int, rule: str = "mvp"):
     return w, slot_ok, -jnp.max(gv[0]), jnp.max(gv[1])
 
 
+def _ws_owners(w, slot_ok, n_loc: int):
+    """Per-device ownership of the replicated working-set ids: (l local
+    slot index, own mask, l_safe clipped index). THE single definition of
+    the shard-offset convention — every gather/scatter derives from it."""
+    dev = lax.axis_index(DATA_AXIS)
+    l = w - dev.astype(jnp.int32) * n_loc
+    own = (l >= 0) & (l < n_loc) & slot_ok
+    return l, own, jnp.clip(l, 0, n_loc - 1)
+
+
+def _psum_scal(scal_loc, own, l_safe):
+    """Replicate the working set's per-row scalars: one (q, S) psum."""
+    return lax.psum(jnp.where(own[:, None],
+                              jnp.take(scal_loc, l_safe, axis=0), 0.0),
+                    DATA_AXIS)
+
+
 def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
     """Recover the working set's rows and per-row scalars from the shards
     with one (q, d) + one (q, S) psum. scal_loc: (n_loc, S) stacked
     per-row scalars. Returns (qx (q, d) f32, scal (q, S) f32, l (q,) i32,
     own (q,) bool); qx/scal are replicated across devices, while l (local
     slot index) and own (this-shard ownership mask) are PER-DEVICE."""
-    dev = lax.axis_index(DATA_AXIS)
-    l = w - dev.astype(jnp.int32) * n_loc
-    own = (l >= 0) & (l < n_loc) & slot_ok
-    l_safe = jnp.clip(l, 0, n_loc - 1)
+    l, own, l_safe = _ws_owners(w, slot_ok, n_loc)
     qx_own = jnp.where(own[:, None], jnp.take(x_loc, l_safe, axis=0)
                        .astype(jnp.float32), 0.0)
-    sc_own = jnp.where(own[:, None], jnp.take(scal_loc, l_safe, axis=0), 0.0)
     qx = lax.psum(qx_own, DATA_AXIS)
-    scal = lax.psum(sc_own, DATA_AXIS)
+    scal = _psum_scal(scal_loc, own, l_safe)
     return qx, scal, l, own
 
 
@@ -154,15 +167,35 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             gap_open = b_lo > b_hi + 2.0 * eps
             scal_loc = jnp.stack(
                 [x_sq_loc, k_diag_loc, st.alpha, y_loc, st.f], axis=1)
-            qx, scal, l, own = _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc)
-            qsq, kd_w, alpha_w0, y_w, f_w0 = (
-                scal[:, 0], scal[:, 1], scal[:, 2], scal[:, 3], scal[:, 4])
+            if kp.kind == "precomputed":
+                # x_loc holds this shard's ROWS of the (symmetric) Gram
+                # matrix. Symmetry makes everything local or tiny:
+                # K(W, W) = psum of each shard's owned rows' W-columns
+                # ((q, q) traffic — never the (q, n) row psum), and the
+                # fold's K(W, shard) is the transpose of the LOCAL
+                # column gather x_loc[:, W] (zero traffic).
+                l, own, l_safe = _ws_owners(w, slot_ok, n_loc)
+                scal = _psum_scal(scal_loc, own, l_safe)
+                rows_own = jnp.where(
+                    own[:, None],
+                    jnp.take(x_loc, l_safe, axis=0).astype(jnp.float32),
+                    0.0)  # (q, n_pad) — local view of the owned W rows
+                kb_w = lax.psum(jnp.take(rows_own, w, axis=1), DATA_AXIS)
+                qx = qsq = None
+            else:
+                qx, scal, l, own = _gather_ws(x_loc, scal_loc, w, slot_ok,
+                                              n_loc)
+                qsq = scal[:, 0]
+            kd_w, alpha_w0, y_w, f_w0 = (
+                scal[:, 1], scal[:, 2], scal[:, 3], scal[:, 4])
 
             # Replicated (q, q) Gram block and subproblem solve — every
             # device computes the identical result, like the reference's
             # replicated alpha-pair update (svmTrainMain.cpp:285-299).
-            dots_w = jnp.dot(qx, qx.T, preferred_element_type=jnp.float32)
-            kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)
+            if kp.kind != "precomputed":
+                dots_w = jnp.dot(qx, qx.T,
+                                 preferred_element_type=jnp.float32)
+                kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)
             limit = jnp.minimum(jnp.int32(inner_iters), max_iter - st.pairs)
             limit = jnp.where(gap_open, limit, 0)
             if inner_impl == "pallas":
@@ -177,10 +210,15 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                     kb_w, kd_w, slot_ok, alpha_w0, y_w, f_w0, c, eps, tau,
                     limit, rule=selection)
 
-            # Fold: purely LOCAL (q, n_loc) kernel-row matmul.
+            # Fold: purely LOCAL (q, n_loc) kernel-row matmul (or, for
+            # a precomputed Gram, the symmetric local column gather).
             coef = jnp.where(slot_ok, (alpha_w - alpha_w0) * y_w, 0.0)
-            k_rows_loc = kernel_rows(
-                x_loc, x_sq_loc, qx.astype(x_loc.dtype), qsq, kp)
+            if kp.kind == "precomputed":
+                k_rows_loc = jnp.take(x_loc, w, axis=1) \
+                                .astype(jnp.float32).T
+            else:
+                k_rows_loc = kernel_rows(
+                    x_loc, x_sq_loc, qx.astype(x_loc.dtype), qsq, kp)
             f = st.f + coef @ k_rows_loc
 
             # Scatter owned alpha slots into the shard. The inert index

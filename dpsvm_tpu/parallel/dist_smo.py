@@ -357,11 +357,14 @@ def solve_mesh(
         raise ValueError(
             "active_set_size (shrinking) needs engine='block' "
             "(the per-pair engines have no cycle structure to restrict)")
-    if config.kernel == "precomputed":
+    if config.kernel == "precomputed" and config.engine != "block":
         raise ValueError(
-            "kernel='precomputed' is single-chip only this round (a "
-            "row-sharded Gram matrix would make every working-set gather "
-            "a cross-shard column exchange); use backend='single'")
+            "kernel='precomputed' on the mesh is implemented for "
+            "engine='block' (Gram symmetry makes its fold a local column "
+            "gather and the (q, q) block a q^2-sized psum — "
+            "parallel/dist_block.py); the per-pair mesh engine would "
+            "move a full (n,) Gram row per pair update — use "
+            "engine='block' or backend='single'")
     if config.selection == "nu" and alpha_init is None:
         # See solver/smo.py: nu selection is degenerate without the nu
         # trainers' feasible warm start.
@@ -381,8 +384,18 @@ def solve_mesh(
     n_dev = mesh.devices.size
 
     n_pad = pad_rows(n, n_dev)
+    if kp.kind == "precomputed":
+        if n != d:
+            raise ValueError(
+                f"kernel='precomputed' needs the square (n, n) Gram "
+                f"matrix as x; got {x.shape}")
+        # Pad BOTH axes: rows shard over devices, and the runner's
+        # symmetric column gathers index columns by the same padded
+        # global ids (padded rows/columns are zero and masked out of
+        # selection by `valid`).
+        d = n_pad
     x_p = np.zeros((n_pad, d), np.float32)
-    x_p[:n] = x
+    x_p[:n, :x.shape[1]] = x
     y_p = np.ones((n_pad,), np.float32)
     y_p[:n] = y_np
     valid = np.zeros((n_pad,), bool)
@@ -396,9 +409,25 @@ def solve_mesh(
     # of the rounded values, exactly like the single-chip path) so mesh and
     # single-chip kernel values — and hence trajectories — are bit-equal.
     from dpsvm_tpu.ops.kernels import squared_norms
-    x_sq = jax.jit(squared_norms, out_shardings=shard)(x_dev)
-    k_diag = jax.jit(kernel_diag, static_argnames="params",
-                     out_shardings=shard)(x_sq, params=kp)
+    if kp.kind == "precomputed":
+        # x IS the Gram matrix: its diagonal is the kernel diagonal and
+        # the squared-norm pass has no meaning (mirrors solver/smo.py).
+        x_sq = jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard)
+        diag_p = np.zeros((n_pad,), np.float32)
+        # Diagonal through the SAME storage rounding as x_dev (the
+        # single-chip path reads jnp.diagonal of the stored-dtype array,
+        # solver/smo.py): under dtype='bfloat16' eta must mix equal
+        # precisions or mesh and single-chip trajectories diverge.
+        import ml_dtypes
+        diag_src = np.diagonal(x)
+        if config.dtype == "bfloat16":
+            diag_src = diag_src.astype(ml_dtypes.bfloat16)
+        diag_p[:n] = diag_src.astype(np.float32)
+        k_diag = jax.device_put(jnp.asarray(diag_p), shard)
+    else:
+        x_sq = jax.jit(squared_norms, out_shardings=shard)(x_dev)
+        k_diag = jax.jit(kernel_diag, static_argnames="params",
+                         out_shardings=shard)(x_sq, params=kp)
     valid_dev = jax.device_put(jnp.asarray(valid), shard)
 
     cache_lines = min(config.cache_lines, n_pad // n_dev)
@@ -525,11 +554,12 @@ def solve_mesh(
         if config.check_numerics:
             assert_finite_state(state, it, f"mesh p={n_dev}")
         if ckpt.due(it) or (abort and ckpt.active):
-            # Abort exits force a save: the state being stopped at must
-            # not exist only in memory (a stall-stop can sit up to
-            # chunk_iters past the last cadence save).
-            ckpt.force_save(it, np.asarray(state.alpha)[:n],
-                            np.asarray(state.f)[:n], b_hi, b_lo)
+            # The gate runs BEFORE the np.asarray materialization (hot
+            # paths must not pull device arrays when nothing will be
+            # written); abort exits force the save — the state being
+            # stopped at must not exist only in memory.
+            ckpt.save(it, np.asarray(state.alpha)[:n],
+                      np.asarray(state.f)[:n], b_hi, b_lo, force=True)
         if config.verbose:
             print(f"[smo-mesh p={n_dev}] iter={it} gap={b_lo - b_hi:.6f}")
         if converged or it >= config.max_iter:

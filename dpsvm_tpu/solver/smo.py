@@ -625,11 +625,12 @@ def solve(
         if config.check_numerics:
             assert_finite_state(state, it, "single-chip")
         if ckpt.due(it) or (abort and ckpt.active):
-            # Abort exits force a save: the state being stopped at must
-            # not exist only in memory (a stall-stop can sit up to
-            # chunk_iters past the last cadence save).
-            ckpt.force_save(it, np.asarray(state.alpha)[:n],
-                            np.asarray(state.f)[:n], b_hi, b_lo)
+            # The gate runs BEFORE the np.asarray materialization (hot
+            # paths must not pull device arrays when nothing will be
+            # written); abort exits force the save — the state being
+            # stopped at must not exist only in memory.
+            ckpt.save(it, np.asarray(state.alpha)[:n],
+                      np.asarray(state.f)[:n], b_hi, b_lo, force=True)
         if config.verbose:
             gap = b_lo - b_hi
             print(f"[smo] iter={it} b_lo-b_hi={gap:.6f} "

@@ -90,16 +90,12 @@ class PeriodicCheckpointer:
     def due(self, iteration: int) -> bool:
         return self.active and iteration - self.last >= self.every
 
-    def maybe_save(self, iteration: int, alpha, f, b_hi: float, b_lo: float) -> bool:
-        if not self.due(iteration):
-            return False
-        return self.force_save(iteration, alpha, f, b_hi, b_lo)
-
-    def force_save(self, iteration: int, alpha, f, b_hi: float,
-                   b_lo: float) -> bool:
-        """Save regardless of cadence (abort exits: the state being
-        stopped at must not exist only in memory)."""
-        if not self.active:
+    def save(self, iteration: int, alpha, f, b_hi: float, b_lo: float,
+             force: bool = False) -> bool:
+        """Save when the cadence is due, or unconditionally with
+        ``force`` (abort exits: the state being stopped at must not
+        exist only in memory)."""
+        if not (self.active and (force or self.due(iteration))):
             return False
         save_checkpoint(self.path, np.asarray(alpha), np.asarray(f),
                         iteration, b_hi, b_lo, self.config)

@@ -91,8 +91,10 @@ def test_precomputed_loud_rejections(gram_problem):
     with pytest.raises(ValueError, match="nothing to cache"):
         SVMConfig(kernel="precomputed", cache_lines=8)
     pre = SVMConfig(c=10.0, kernel="precomputed")
-    with pytest.raises(ValueError, match="single-chip"):
-        solve_mesh(K, y, pre)
+    # Mesh per-pair still rejects (a full Gram row per pair update);
+    # mesh BLOCK is supported (test_precomputed_mesh_block_matches_dense).
+    with pytest.raises(ValueError, match="engine='block'"):
+        solve_mesh(K, y, pre.replace(engine="xla"))
     with pytest.raises(ValueError, match="SV indices"):
         train(K, y, pre)
     with pytest.raises(ValueError, match="square"):
@@ -113,3 +115,51 @@ def test_precomputed_loud_rejections(gram_problem):
     est = OurSVC(C=10.0, kernel="precomputed").fit(K, y)
     with pytest.raises(ValueError, match="columns"):
         est.decision_function(K[:, :300])
+
+
+def test_precomputed_mesh_block_matches_dense(blobs_small):
+    """kernel='precomputed' on the 8-device mesh block engine: feeding
+    K(x, x) as the Gram matrix must reproduce the dense-RBF mesh solve
+    (Gram symmetry makes the fold local — parallel/dist_block.py)."""
+    import numpy as np
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    kp = KernelParams("rbf", 0.2)
+    K = np.asarray(kernel_matrix(x, x, kp), np.float32)
+    cfg = SVMConfig(c=5.0, gamma=0.2, epsilon=1e-3, engine="block",
+                    working_set_size=32, cache_lines=0)
+    r_dense = solve_mesh(x, y, cfg, num_devices=8)
+    r_gram = solve_mesh(K, y, cfg.replace(kernel="precomputed"),
+                        num_devices=8)
+    assert r_gram.converged
+    # Same Gram values -> same optimum (fp paths differ: dense computes
+    # rows on the fly, precomputed reads them).
+    assert abs(r_gram.b - r_dense.b) < 2 * cfg.epsilon
+
+    def obj(r):
+        return float(np.sum(r.alpha)
+                     - 0.5 * np.sum(r.alpha * y * (r.stats["f"] + y)))
+
+    assert abs(obj(r_gram) - obj(r_dense)) <= 1e-3 * abs(obj(r_dense))
+    assert abs(r_gram.n_sv - r_dense.n_sv) <= max(2, 0.02 * r_dense.n_sv)
+    # Uneven rows: padding covers both axes of the Gram.
+    n = len(y) - 3
+    r_odd = solve_mesh(K[:n, :n], y[:n], cfg.replace(kernel="precomputed"),
+                       num_devices=8)
+    assert r_odd.converged and r_odd.alpha.shape == (n,)
+
+
+def test_precomputed_mesh_rejects_per_pair(blobs_small):
+    import pytest
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    with pytest.raises(ValueError, match="engine='block'"):
+        solve_mesh(x, y, SVMConfig(kernel="precomputed", engine="xla",
+                                   cache_lines=0), num_devices=2)
