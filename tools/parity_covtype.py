@@ -76,9 +76,6 @@ def main() -> int:
         return 0
 
     from dpsvm_tpu.config import SVMConfig
-    from dpsvm_tpu.models.svm_model import SVMModel
-    from dpsvm_tpu.ops.kernels import KernelParams
-    from dpsvm_tpu.predict import decision_function
     from dpsvm_tpu.solver.smo import solve
 
     with open(opath + ".json") as fh:
@@ -127,7 +124,10 @@ def main() -> int:
             alpha_i = zs["alpha"].astype(np.float32)
             total_pairs, total_secs = int(zs["pairs"]), float(zs["secs"])
             if "leg_pairs" in zs:
-                leg_pairs0 = int(zs["leg_pairs"])
+                # Floor the resumed budget: a fully-shrunk saved budget
+                # would end the loop before a (re)tightened inner eps
+                # gets a chance to close the last 1e-4.
+                leg_pairs0 = max(int(zs["leg_pairs"]), 500_000)
             f64 = reconstruct_f64(alpha_i)
             f_i = f64.astype(np.float32)
             b_hi_t, b_lo_t = extrema_np(f64, alpha_i, y, (C, C))
@@ -147,12 +147,20 @@ def main() -> int:
         # progress at finer resolution.
         leg_pairs = leg_pairs0
         for leg in range(60):
-            if gap <= 2 * (TOL / 2) or leg_pairs < 250_000:
+            if gap <= TOL or leg_pairs < 62_500:
                 break
-            cfg = SVMConfig(c=C, gamma=GAMMA, epsilon=TOL / 2,
+            # The solver's own (carried-gap) stop aims BELOW the true
+            # target: per-leg fp32 drift adds ~1-2e-4 to the
+            # reconstructed gap, so carried-converging at exactly the
+            # target stalls the true gap just above it (measured
+            # 0.0011-0.0012 vs 0.0010).
+            cfg = SVMConfig(c=C, gamma=GAMMA, epsilon=0.35 * TOL,
                             max_iter=leg_pairs, engine=engine,
                             selection=sel, dtype="float32",
                             chunk_iters=250_000)
+            alpha_prev, f_prev = alpha_i, f_i
+            recon_prev = ((f64, b_hi_t, b_lo_t)
+                          if np.isfinite(gap) else None)
             try:
                 # The heartbeat keeps the solve OBSERVED: without it the
                 # whole leg runs as one ~45 s dispatch, which the
@@ -180,7 +188,30 @@ def main() -> int:
             print(f"  [leg {leg}] budget={leg_pairs} "
                   f"carried={float(res.b_lo - res.b_hi):.4f} "
                   f"TRUE gap={gap:.4f} pairs={total_pairs}", flush=True)
-            if gap > 0.7 * prev:
+            if gap > prev and np.isfinite(prev):
+                # REJECT a regressed leg: its drift did more harm than
+                # its optimization did good (measured at mid-phase gaps:
+                # a 2M-pair leg moved the true gap 2.2 -> 2.5). Revert
+                # to the pre-leg state and retry at half the budget —
+                # the true gap descends monotonically by construction.
+                print(f"  [leg {leg}] REJECTED (prev {prev:.4f}); "
+                      f"halving to {leg_pairs // 2}", flush=True)
+                alpha_i, f_i, gap = alpha_prev, f_prev, prev
+                if recon_prev is not None:
+                    # The post-loop b/decision evaluation must see the
+                    # KEPT state's reconstruction, not the rejected one.
+                    f64, b_hi_t, b_lo_t = recon_prev
+                leg_pairs //= 2
+                # Persist the halving: a fault before the next good leg
+                # must not make the resume re-run a budget already
+                # proven regressing.
+                tmp = state_p + ".tmp.npz"
+                np.savez(tmp, alpha=alpha_i, pairs=total_pairs,
+                         secs=total_secs, leg_pairs=leg_pairs)
+                os.replace(tmp, state_p)
+                continue
+            if gap > 0.85 * prev:
+                # Near the drift floor: finer legs resolve further.
                 leg_pairs //= 2
             # Atomic write (tmp + os.replace, like utils/checkpoint.py):
             # a mid-write kill must never leave a truncated state file
@@ -192,14 +223,19 @@ def main() -> int:
                      secs=total_secs, leg_pairs=leg_pairs)
             os.replace(tmp, state_p)
             f_i = f64.astype(np.float32)
-        converged = gap <= 2 * (TOL / 2)
+        converged = gap <= TOL
         b = float((b_lo_t + b_hi_t) / 2.0)
         np.savez(os.path.join(outdir,
                               f"parity_covtype{args.n}_{engine}_{sel}.npz"),
                  alpha=alpha_i, b=b, gap=gap)
-        model = SVMModel.from_dense(x, y, alpha_i, b,
-                                    KernelParams("rbf", GAMMA))
-        dec = decision_function(model, x)
+        # Decision values in FLOAT64, directly from the reconstructed
+        # gradient: dec_i = sum_j a_j y_j K_ij - b = f64_i + y_i - b.
+        # At this C the fp32 batched predictor's accumulation noise
+        # (23k terms of magnitude ~1500 summing to ~1) swamps the signs
+        # — measured 59% agreement from an alpha whose merged SV count
+        # matches the oracle to 0.05%; the oracle's own decision values
+        # are float64 (sklearn). Apples to apples means f64 vs f64.
+        dec = f64 + y - b
         msv = merged_sv(x, y, alpha_i)
         sv_dev = abs(msv - oracle["merged_sv"]) / oracle["merged_sv"]
         agree = float(np.mean(np.sign(dec) == np.sign(z["dec"])))
@@ -238,7 +274,14 @@ def main() -> int:
         lines.append(f"| {label} | {n_sv} | {msv} | {sv_dev * 100:.2f}% | "
                      f"{agree * 100:.2f}% | {acc:.4f} | {iters} | {secs} | "
                      f"{'OK' if ok else '**FAIL**'} |")
-    lines.append("")
+    lines += ["",
+              "Status is the STRICT conjunction: reconstructed gap <= "
+              "1e-3 AND merged-SV delta <= 1% AND sign agreement >= "
+              "99.8%. A row can fail ONLY the gap test and still match "
+              "the oracle on every parity criterion — the leg scheme's "
+              "reachable gap is floored by per-leg fp32 drift at its "
+              "final leg size, and the harness stops rather than "
+              "claiming tighter convergence than it can verify.", ""]
 
     path = os.path.join(REPO, "PARITY.md")
     replace_section(path, SECTION, lines)
