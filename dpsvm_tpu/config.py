@@ -109,6 +109,41 @@ class SVMConfig:
     active_set_size: int = 0
     reconcile_rounds: int = 8
 
+    # Extreme-C numerics (no reference equivalent; the reference's fp32
+    # incremental gradient silently drifts the same way ours would,
+    # svmTrain.cu:98-137 — measured at its covtype stress config c=2048:
+    # carried gap 0.005 vs true 1.1 after one 8M-pair leg).
+    #
+    # compensated: carry the gradient with a Kahan residual (solver/smo.py
+    # kahan_add) so each update's fp32 rounding is deferred instead of
+    # accumulated — the carried gap then stays honest through tens of
+    # millions of pair updates. Costs 3 elementwise vector ops per
+    # update/fold (noise on the latency-bound chain). Supported by the
+    # xla and block engines, single-chip and mesh.
+    #
+    # reconstruct_every: > 0 runs the solve in legs of at most this many
+    # pair updates; between legs the gradient is recomputed EXACTLY in
+    # float64 on the host (solver/reconstruct.py), a regressed leg is
+    # rejected and retried at half budget, and convergence is judged on
+    # the RECONSTRUCTED gap — the LibSVM gradient-reconstruction move,
+    # productized from the round-3 external harness. Use both together
+    # for one-call convergence at extreme C (PARITY.md covtype section).
+    compensated: bool = False
+    reconstruct_every: int = 0
+
+    # MXU matmul precision for every solver matmul (dot rows, Gram
+    # blocks, folds, x_sq). TPU f32 matmuls default to ONE bfloat16 MXU
+    # pass (~1e-3 relative error in the dot values) — measured on the
+    # extreme-C stress problem this, not accumulation rounding, is the
+    # dominant gradient drift: 6000 pair updates drift the carried f by
+    # 0.37 at default vs 1.3e-3 at "highest" (6-pass, ~f32-exact).
+    #   None      -- auto: "highest" when compensated or reconstruct_every
+    #                request accuracy mode, else the platform default
+    #   "default" -- force the platform default (fastest, bf16 passes)
+    #   "high"    -- 3-pass bf16 (~tf32 quality)
+    #   "highest" -- 6-pass bf16 (~f32 quality)
+    matmul_precision: Optional[str] = None
+
     # Benchmark budget mode (no reference equivalent — but it mirrors how
     # the reference's published numbers were produced: max_iter-capped
     # runs, reference Makefile:74,77). When True the solver IGNORES the
@@ -199,6 +234,34 @@ class SVMConfig:
                 "(use engine='block')")
         if self.reconcile_rounds < 1:
             raise ValueError("reconcile_rounds must be >= 1")
+        if self.reconstruct_every < 0:
+            raise ValueError("reconstruct_every must be >= 0 (0 = off)")
+        if self.reconstruct_every and self.budget_mode:
+            raise ValueError(
+                "budget_mode runs exactly max_iter pairs in one dispatch "
+                "sequence; reconstruction legs re-judge convergence and "
+                "would break the pinned budget — use one or the other")
+        if self.compensated and self.engine == "pallas":
+            raise ValueError(
+                "compensated gradient carry is implemented for the xla and "
+                "block engines (the fused pallas per-pair engine bakes its "
+                "f update into the on-chip pass); use engine='xla' or "
+                "'block'")
+        if self.matmul_precision not in (None, "default", "high", "highest"):
+            raise ValueError(
+                "matmul_precision must be None (auto), 'default', 'high' "
+                "or 'highest'")
+
+    def resolve_precision(self) -> Optional[str]:
+        """The jax.default_matmul_precision value the solvers apply, or
+        None for the platform default. Auto (None) escalates to 'highest'
+        whenever accuracy mode is requested (compensated gradients or
+        reconstruction legs): running certification legs over ~1e-3-
+        relative bf16 dot products would waste them."""
+        if self.matmul_precision is None:
+            return ("highest" if (self.compensated or self.reconstruct_every)
+                    else None)
+        return None if self.matmul_precision == "default" else self.matmul_precision
 
     def replace(self, **kw) -> "SVMConfig":
         return dataclasses.replace(self, **kw)

@@ -134,7 +134,12 @@ def extrema_np(f, alpha, y, c, rule: str = "mvp"):
     import numpy as np
 
     cp, cn = split_c(c)
-    f = np.asarray(f, np.float32)
+    # Preserve a float64 f: the reconstruction path (solver/reconstruct.py)
+    # judges convergence on these extrema and must not have its exact
+    # gradient rounded back to f32 on the way in.
+    f = np.asarray(f)
+    if f.dtype != np.float64:
+        f = f.astype(np.float32)
     alpha = np.asarray(alpha)
     y = np.asarray(y)
     up = up_mask(alpha, y, cp, cn, xp=np)

@@ -45,6 +45,7 @@ from dpsvm_tpu.parallel.mesh import DATA_AXIS
 from dpsvm_tpu.solver.block import (BlockState, _round_core,
                                     _solve_subproblem, _top_h,
                                     combine_halves)
+from dpsvm_tpu.solver.smo import eff_f, maybe_kahan
 
 
 def _global_top(scores, gids_loc, h: int):
@@ -143,9 +144,12 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                             tau: float, q: int, inner_iters: int,
                             rounds_per_chunk: int, inner_impl: str = "xla",
                             interpret: bool = False,
-                            selection: str = "mvp"):
+                            selection: str = "mvp",
+                            compensated: bool = False):
     """Build the jitted shard_mapped block-round chunk executor.
-    selection: "mvp" | "second_order" | "nu" (solver/block.py rules)."""
+    selection: "mvp" | "second_order" | "nu" (solver/block.py rules).
+    compensated: carry a shard-local Kahan residual of f so the fold's
+    fp32 rounding is deferred (solver/smo.py kahan_add)."""
 
     def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
                    state: BlockState, max_iter):
@@ -162,11 +166,12 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             # solver/block.py run_chunk_block for the one-fold-behind
             # convergence semantics; the final round runs gated to 0
             # pair updates).
+            f_cur = eff_f(st)
             w, slot_ok, b_hi, b_lo = _select_block_mesh(
-                st.f, st.alpha, y_loc, valid_loc, c, q, rule=selection)
+                f_cur, st.alpha, y_loc, valid_loc, c, q, rule=selection)
             gap_open = b_lo > b_hi + 2.0 * eps
             scal_loc = jnp.stack(
-                [x_sq_loc, k_diag_loc, st.alpha, y_loc, st.f], axis=1)
+                [x_sq_loc, k_diag_loc, st.alpha, y_loc, f_cur], axis=1)
             if kp.kind == "precomputed":
                 # x_loc holds this shard's ROWS of the (symmetric) Gram
                 # matrix. Symmetry makes everything local or tiny:
@@ -219,7 +224,7 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             else:
                 k_rows_loc = kernel_rows(
                     x_loc, x_sq_loc, qx.astype(x_loc.dtype), qsq, kp)
-            f = st.f + coef @ k_rows_loc
+            f, f_err = maybe_kahan(st.f, st.f_err, coef @ k_rows_loc)
 
             # Scatter owned alpha slots into the shard. The inert index
             # must be OUT OF RANGE (n_loc), not -1: mode="drop" only drops
@@ -229,14 +234,15 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             alpha = st.alpha.at[l_scatter].set(
                 jnp.where(own, alpha_w, 0.0), mode="drop")
             return BlockState(alpha, f, b_hi, b_lo,
-                              st.pairs + t, st.rounds + 1)
+                              st.pairs + t, st.rounds + 1, f_err)
 
         return lax.while_loop(cond, body, state)
 
     shard = P(DATA_AXIS)
     rep = P()
     state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
-                             pairs=rep, rounds=rep)
+                             pairs=rep, rounds=rep,
+                             f_err=shard if compensated else None)
     mapped = jax.shard_map(
         chunk_body,
         mesh=mesh,
@@ -253,7 +259,8 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                    m: int, k_rounds: int,
                                    inner_impl: str = "xla",
                                    interpret: bool = False,
-                                   selection: str = "mvp"):
+                                   selection: str = "mvp",
+                                   compensated: bool = False):
     """Active-set ("shrinking") variant of make_block_chunk_runner — the
     mesh port of solver/block.py run_chunk_block_active (the layer the
     reference scales with MPI ranks, svmTrainMain.cpp:244). One CYCLE:
@@ -295,11 +302,12 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                     & (st.b_lo > st.b_hi + 2.0 * eps))
 
         def cycle(st: BlockState):
+            f_cur = eff_f(st)
             act_ids, act_ok, b_hi, b_lo = _select_block_mesh(
-                st.f, st.alpha, y_loc, valid_loc, c, m, rule=selection)
+                f_cur, st.alpha, y_loc, valid_loc, c, m, rule=selection)
             gap_open = b_lo > b_hi + 2.0 * eps
             scal_loc = jnp.stack(
-                [x_sq_loc, k_diag_loc, st.alpha, y_loc, st.f], axis=1)
+                [x_sq_loc, k_diag_loc, st.alpha, y_loc, f_cur], axis=1)
             x_act, scal, l_act, own_act = _gather_ws(
                 x_loc, scal_loc, act_ids, act_ok, n_loc)
             sq_act, kd_act, a_act0, y_act, f_act0 = (
@@ -342,14 +350,17 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
 
             # Reconciliation: one LOCAL batched fold of the cycle's deltas
             # into the shard's gradient (dead slots carry coef 0).
-            def do_fold(f):
+            def do_fold(carry):
+                f, err = carry
                 wf = pend_w.reshape(-1)
                 cf = pend_c.reshape(-1)
                 xw = jnp.take(x_act, wf, axis=0)  # (k_rounds*q, d)
                 sqw = jnp.take(sq_act, wf)
-                return f + cf @ kernel_rows(x_loc, x_sq_loc, xw, sqw, kp)
+                delta = cf @ kernel_rows(x_loc, x_sq_loc, xw, sqw, kp)
+                return maybe_kahan(f, err, delta)
 
-            f = lax.cond(t_tot > 0, do_fold, lambda f: f, st.f)
+            f, f_err = lax.cond(t_tot > 0, do_fold, lambda c: c,
+                                (st.f, st.f_err))
             # Scatter back the active rows THIS shard owns: the
             # incrementally-maintained replicated values overwrite the
             # fold's regrouped results so all views agree exactly (see
@@ -357,17 +368,22 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
             l_scatter = jnp.where(own_act, l_act, jnp.int32(n_loc))
             f = f.at[l_scatter].set(
                 jnp.where(own_act, f_act, 0.0), mode="drop")
+            if f_err is not None:
+                # Scattered entries were reset directly; their residual
+                # no longer describes them (see run_chunk_block_active).
+                f_err = f_err.at[l_scatter].set(0.0, mode="drop")
             alpha = st.alpha.at[l_scatter].set(
                 jnp.where(own_act, a_act, 0.0), mode="drop")
             return BlockState(alpha, f, b_hi, b_lo,
-                              st.pairs + t_tot, st.rounds + k_done)
+                              st.pairs + t_tot, st.rounds + k_done, f_err)
 
         return lax.while_loop(cond, cycle, state)
 
     shard = P(DATA_AXIS)
     rep = P()
     state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
-                             pairs=rep, rounds=rep)
+                             pairs=rep, rounds=rep,
+                             f_err=shard if compensated else None)
     mapped = jax.shard_map(
         chunk_body,
         mesh=mesh,

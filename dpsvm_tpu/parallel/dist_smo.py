@@ -44,7 +44,8 @@ from dpsvm_tpu.ops.kernels import KernelParams, kernel_diag, kernel_from_dots
 from dpsvm_tpu.ops.select import c_of, low_mask, split_c, up_mask
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
-from dpsvm_tpu.solver.smo import SMOState, assert_finite_state
+from dpsvm_tpu.solver.smo import (SMOState, assert_finite_state, eff_f,
+                                  kahan_add)
 from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh, pad_rows
 
 _I32_MAX = jnp.iinfo(jnp.int32).max
@@ -144,7 +145,9 @@ def _pair_update_local(state, y_loc, own_hi, own_lo, b_hi_pair, b_lo_pair,
                        k_hi, k_lo, eta, c, gate=None):
     """Shared distributed tail: replicated alpha-pair algebra + local
     scatter + local rank-2 f update. `c` is (c_pos, c_neg). `gate=False`
-    forces an exact no-op (see solver/smo.py _apply_pair_update)."""
+    forces an exact no-op (see solver/smo.py _apply_pair_update).
+    Returns (alpha, f, f_err); the Kahan residual is carried shard-local
+    exactly like f itself (config.compensated)."""
     from dpsvm_tpu.solver.smo import pair_alpha_update
 
     cp, cn = split_c(c)
@@ -158,9 +161,16 @@ def _pair_update_local(state, y_loc, own_hi, own_lo, b_hi_pair, b_lo_pair,
     # lo writes first, hi wins on i_hi == i_lo (matches seq.cpp:248-251).
     alpha = jnp.where(own_lo, a_lo_new, state.alpha)
     alpha = jnp.where(own_hi, a_hi_new, alpha)
-    f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
-                + (a_lo_new - a_lo_old) * y_lo * k_lo
-    return alpha, f
+    if state.f_err is None:
+        # Association kept bit-identical to the pre-compensation engine
+        # (mesh/single-chip trajectory parity is calibrated against it).
+        f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
+                    + (a_lo_new - a_lo_old) * y_lo * k_lo
+        return alpha, f, None
+    delta = (a_hi_new - a_hi_old) * y_hi * k_hi \
+        + (a_lo_new - a_lo_old) * y_lo * k_lo
+    f, err = kahan_add(state.f, state.f_err, delta)
+    return alpha, f, err
 
 
 def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
@@ -173,10 +183,11 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
     n_loc = x_loc.shape[0]
     gids = _global_ids(n_loc)
     cp, cn = split_c(c)
+    f_cur = eff_f(state)
     up = up_mask(state.alpha, y_loc, cp, cn) & valid_loc
     low = low_mask(state.alpha, y_loc, cp, cn) & valid_loc
-    f_up = jnp.where(up, state.f, jnp.inf)
-    f_low = jnp.where(low, state.f, -jnp.inf)
+    f_up = jnp.where(up, f_cur, jnp.inf)
+    f_low = jnp.where(low, f_cur, -jnp.inf)
     l_hi = jnp.argmin(f_up).astype(jnp.int32)
 
     # Round 1: global i (min f over I_up) + global b_lo (convergence gap).
@@ -206,7 +217,7 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
     # from q_hi) so the reduction is bit-identical to the single-chip
     # path's k_diag[i_hi] and trajectories stay aligned across backends.
     k_hh = _gather_scalar(k_diag_loc, own_hi)
-    diff = state.f - b_hi
+    diff = f_cur - b_hi
     eta_j = jnp.maximum(k_hh + k_diag_loc - 2.0 * k_hi, tau)
     gain = jnp.where(low & (diff > 0), diff * diff / eta_j, -jnp.inf)
     l_lo = jnp.argmax(gain).astype(jnp.int32)
@@ -218,7 +229,7 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
                      jnp.min(jnp.where(g_gain == best, g_jidx, _I32_MAX)),
                      i_hi).astype(jnp.int32)
     own_lo = gids == i_lo
-    b_lo_pair = _gather_scalar(state.f, own_lo)
+    b_lo_pair = _gather_scalar(f_cur, own_lo)
 
     q_lo = _gather_row(x_loc, own_lo)
     q_lo_sq = _gather_scalar(x_sq_loc, own_lo)  # see _iteration: bit-parity
@@ -237,9 +248,11 @@ def _iteration_wss2(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
     k_hl = _gather_scalar(k_hi, own_lo)
     eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
     n_hits = hit_hi.astype(jnp.int32) + hit_lo.astype(jnp.int32)
-    alpha, f = _pair_update_local(state, y_loc, own_hi, own_lo, b_hi,
-                                  b_lo_pair, k_hi, k_lo, eta, c, gate=any_elig)
-    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
+    alpha, f, f_err = _pair_update_local(state, y_loc, own_hi, own_lo, b_hi,
+                                         b_lo_pair, k_hi, k_lo, eta, c,
+                                         gate=any_elig)
+    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache,
+                    state.hits + n_hits, f_err)
 
 
 def _iteration(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state: SMOState,
@@ -250,7 +263,7 @@ def _iteration(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state: SMOState,
     per-class variant (see solver/smo.py)."""
     n_loc = x_loc.shape[0]
     i_hi, b_hi, i_lo, b_lo = select_fn(
-        state.f, state.alpha, y_loc, c, valid_loc)
+        eff_f(state), state.alpha, y_loc, c, valid_loc)
 
     gids = _global_ids(n_loc)
     own_hi = gids == i_hi
@@ -284,9 +297,10 @@ def _iteration(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc, state: SMOState,
     k_hl = _gather_scalar(k_hi, own_lo)
     eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
 
-    alpha, f = _pair_update_local(state, y_loc, own_hi, own_lo, b_hi, b_lo,
-                                  k_hi, k_lo, eta, c)
-    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
+    alpha, f, f_err = _pair_update_local(state, y_loc, own_hi, own_lo,
+                                         b_hi, b_lo, k_hi, k_lo, eta, c)
+    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache,
+                    state.hits + n_hits, f_err)
 
 
 _ITERATION_FNS = {
@@ -298,7 +312,7 @@ _ITERATION_FNS = {
 
 def _make_chunk_runner(mesh: Mesh, kp: KernelParams, c: float, eps: float,
                        tau: float, chunk: int, use_cache: bool,
-                       selection: str = "mvp"):
+                       selection: str = "mvp", compensated: bool = False):
     """Build the jitted shard_mapped chunk executor."""
     step = _ITERATION_FNS[selection]
 
@@ -320,6 +334,7 @@ def _make_chunk_runner(mesh: Mesh, kp: KernelParams, c: float, eps: float,
         alpha=shard, f=shard, b_hi=rep, b_lo=rep, it=rep,
         cache=CacheState(data=P(None, DATA_AXIS), keys=rep, ticks=rep),
         hits=rep,
+        f_err=shard if compensated else None,
     )
     mapped = jax.shard_map(
         chunk_body,
@@ -371,6 +386,29 @@ def solve_mesh(
         raise ValueError(
             "selection='nu' is internal to the nu duals — call "
             "train_nusvc/train_nusvr (models/nusvm.py) instead")
+    if config.reconstruct_every:
+        # f64 reconstruction legs around the mesh solve — same scheme as
+        # the single-chip delegation (solver/reconstruct.py).
+        from functools import partial as _partial
+
+        from dpsvm_tpu.solver.reconstruct import solve_in_legs
+
+        return solve_in_legs(
+            _partial(solve_mesh, num_devices=num_devices, mesh=mesh),
+            x, y, config, callback=callback,
+            checkpoint_path=checkpoint_path, resume=resume,
+            alpha_init=alpha_init, f_init=f_init)
+
+    from dpsvm_tpu.solver.smo import _precision_ctx
+
+    with _precision_ctx(config):
+        return _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
+                                checkpoint_path, resume, alpha_init, f_init)
+
+
+def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
+                     checkpoint_path, resume, alpha_init,
+                     f_init) -> SolveResult:
     use_block = config.engine == "block"
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)
@@ -472,6 +510,9 @@ def solve_mesh(
                 b_hi=jax.device_put(jnp.float32(bh0), rep),
                 b_lo=jax.device_put(jnp.float32(bl0), rep),
                 it=jax.device_put(jnp.int32(it0), rep))
+    if config.compensated:
+        state = state._replace(
+            f_err=jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard))
     max_iter = jnp.int32(config.max_iter)
     start_iter = int(state.it)
     ckpt = PeriodicCheckpointer(checkpoint_path, config, start_iter)
@@ -518,20 +559,24 @@ def solve_mesh(
                 mesh, kp, config.c_bounds(), eps_run,
                 float(config.tau), q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds), inner_impl,
-                selection=config.selection)
+                selection=config.selection,
+                compensated=config.compensated)
         else:
             run_chunk = make_block_chunk_runner(
                 mesh, kp, config.c_bounds(), eps_run,
                 float(config.tau), q, inner, rounds_per_chunk, inner_impl,
-                selection=config.selection)
+                selection=config.selection,
+                compensated=config.compensated)
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
-                           rounds=jax.device_put(jnp.int32(0), rep))
+                           rounds=jax.device_put(jnp.int32(0), rep),
+                           f_err=state.f_err)
     else:
         run_chunk = _make_chunk_runner(mesh, kp, config.c_bounds(),
                                        eps_run,
                                        float(config.tau), chunk_len,
-                                       use_cache, config.selection)
+                                       use_cache, config.selection,
+                                       compensated=config.compensated)
     if callback is not None and hasattr(callback, "on_start"):
         callback.on_start(start_iter)
 
@@ -559,7 +604,7 @@ def solve_mesh(
             # written); abort exits force the save — the state being
             # stopped at must not exist only in memory.
             ckpt.save(it, np.asarray(state.alpha)[:n],
-                      np.asarray(state.f)[:n], b_hi, b_lo, force=True)
+                      np.asarray(eff_f(state))[:n], b_hi, b_lo, force=True)
         if config.verbose:
             print(f"[smo-mesh p={n_dev}] iter={it} gap={b_lo - b_hi:.6f}")
         if converged or it >= config.max_iter:
@@ -570,11 +615,12 @@ def solve_mesh(
             break
 
     alpha = np.asarray(state.alpha)[:n]
+    f_final = np.asarray(eff_f(state))[:n]
     if (use_block or config.budget_mode) and not converged:
         from dpsvm_tpu.ops.select import refresh_extrema_host
 
         b_hi, b_lo, converged = refresh_extrema_host(
-            np.asarray(state.f)[:n], alpha, y_np, config.c_bounds(),
+            f_final, alpha, y_np, config.c_bounds(),
             config.epsilon, rule=config.selection)
     lookups = 2 * (it - start_iter) if use_cache else 0
     return SolveResult(
@@ -591,7 +637,7 @@ def solve_mesh(
             "cache_hits": int(state.hits),
             "cache_lookups": lookups,
             "cache_hit_rate": (int(state.hits) / lookups) if lookups else 0.0,
-            "f": np.asarray(state.f)[:n],
+            "f": f_final,
             **({"outer_rounds": int(state.rounds)} if use_block else {}),
         },
     )

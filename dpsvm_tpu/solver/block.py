@@ -33,7 +33,7 @@ proactively with static shapes, which is what XLA wants.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +42,7 @@ from jax import lax
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
 from dpsvm_tpu.ops.select import (c_of, low_mask, nu_stopping_pair,
                                   select_working_set_nu, split_c, up_mask)
-from dpsvm_tpu.solver.smo import pair_alpha_update
+from dpsvm_tpu.solver.smo import eff_f, maybe_kahan, pair_alpha_update
 
 
 class BlockState(NamedTuple):
@@ -54,6 +54,10 @@ class BlockState(NamedTuple):
     b_lo: jax.Array  # float32
     pairs: jax.Array  # int32: total pair updates (comparable to per-pair iters)
     rounds: jax.Array  # int32: outer rounds (block builds)
+    # Kahan residual of f (config.compensated; see solver/smo.py
+    # kahan_add): the fold's delta accumulates compensated so the carried
+    # gradient stays honest at extreme C. None = compensation off.
+    f_err: Optional[jax.Array] = None
 
     @property
     def hits(self):
@@ -336,8 +340,9 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
                 & (st.b_lo > st.b_hi + 2.0 * eps))
 
     def body(st: BlockState):
+        f_cur = eff_f(st)
         w, slot_ok, b_hi, b_lo, alpha_w, coef, t, qx, qsq = _round_core(
-            x, y, x_sq, k_diag, st.f, st.alpha, None, max_iter - st.pairs,
+            x, y, x_sq, k_diag, f_cur, st.alpha, None, max_iter - st.pairs,
             kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
             selection)
         # Fold the round's alpha deltas into the global state with one
@@ -345,14 +350,15 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
         # f += (dalpha * y)_W @ K(W, :), with K(W, :) from the same
         # kernel_rows machinery every other engine uses.
         k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n) fp32
-        f = st.f + coef @ k_rows
+        f, f_err = maybe_kahan(st.f, st.f_err, coef @ k_rows)
         # Dead slots must not scatter. The inert index must be OUT OF
         # RANGE (n), not -1: mode="drop" only drops beyond-range indices,
         # while -1 wraps to the LAST row and would erase its alpha.
         safe_w = jnp.where(slot_ok, w, jnp.int32(st.alpha.shape[0]))
         alpha = st.alpha.at[safe_w].set(
             jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
-        return BlockState(alpha, f, b_hi, b_lo, st.pairs + t, st.rounds + 1)
+        return BlockState(alpha, f, b_hi, b_lo, st.pairs + t, st.rounds + 1,
+                          f_err)
 
     return lax.while_loop(cond, body, state)
 
@@ -407,15 +413,16 @@ def run_chunk_block_active(x, y, x_sq, k_diag, state: BlockState, max_iter,
                 & (st.b_lo > st.b_hi + 2.0 * eps))
 
     def cycle(st: BlockState):
+        f_cur = eff_f(st)
         act_ids, act_ok, b_hi, b_lo = select_block(
-            st.f, st.alpha, y, c, m, rule=selection)
+            f_cur, st.alpha, y, c, m, rule=selection)
         gap_open = b_lo > b_hi + 2.0 * eps
         x_act = jnp.take(x, act_ids, axis=0)  # (m, d)
         sq_act = jnp.take(x_sq, act_ids)
         kd_act = jnp.take(k_diag, act_ids)
         y_act = jnp.take(y, act_ids)
         a_act0 = jnp.take(st.alpha, act_ids)
-        f_act0 = jnp.take(st.f, act_ids)
+        f_act0 = jnp.take(f_cur, act_ids)
         pend_w0 = jnp.zeros((k_rounds, q), jnp.int32)
         pend_c0 = jnp.zeros((k_rounds, q), jnp.float32)
 
@@ -454,14 +461,17 @@ def run_chunk_block_active(x, y, x_sq, k_diag, state: BlockState, max_iter,
         # the FULL gradient (skipped entirely on the terminal all-zero
         # cycle). XLA fuses the kernel evaluation into the contraction
         # exactly as in run_chunk_block's per-round fold.
-        def do_fold(f):
+        def do_fold(carry):
+            f, err = carry
             wf = pend_w.reshape(-1)
             cf = pend_c.reshape(-1)
             xw = jnp.take(x, wf, axis=0)  # (k_rounds*q, d)
             sqw = jnp.take(x_sq, wf)
-            return f + cf @ kernel_rows(x, x_sq, xw, sqw, kp)
+            delta = cf @ kernel_rows(x, x_sq, xw, sqw, kp)
+            return maybe_kahan(f, err, delta)
 
-        f = lax.cond(t_tot > 0, do_fold, lambda f: f, st.f)
+        f, f_err = lax.cond(t_tot > 0, do_fold, lambda c: c,
+                            (st.f, st.f_err))
         # Active slots hold the incrementally-maintained values the inner
         # selections actually saw — scatter them over the fold's
         # (numerically regrouped) results so the two views agree exactly.
@@ -469,9 +479,14 @@ def run_chunk_block_active(x, y, x_sq, k_diag, state: BlockState, max_iter,
         # copies of a live row's state).
         safe_ids = jnp.where(act_ok, act_ids, jnp.int32(n))
         f = f.at[safe_ids].set(jnp.where(act_ok, f_act, 0.0), mode="drop")
+        if f_err is not None:
+            # The scattered entries are reset to the incrementally-
+            # maintained values directly; their Kahan residual no longer
+            # describes them.
+            f_err = f_err.at[safe_ids].set(0.0, mode="drop")
         alpha = st.alpha.at[safe_ids].set(
             jnp.where(act_ok, a_act, 0.0), mode="drop")
         return BlockState(alpha, f, b_hi, b_lo,
-                          st.pairs + t_tot, st.rounds + k_done)
+                          st.pairs + t_tot, st.rounds + k_done, f_err)
 
     return lax.while_loop(cond, cycle, state)

@@ -48,6 +48,10 @@ class SMOState(NamedTuple):
     it: jax.Array  # int32
     cache: CacheState
     hits: jax.Array  # int32 cache-hit count (observability, SURVEY 5.5)
+    # Kahan residual of f (config.compensated): true f ~= f - f_err.
+    # None (an empty pytree leaf) when compensation is off, so existing
+    # constructors, shard_map specs and compiled carries are unchanged.
+    f_err: Optional[jax.Array] = None
 
 
 def init_state(n: int, y: jax.Array, cache_lines: int) -> SMOState:
@@ -60,6 +64,42 @@ def init_state(n: int, y: jax.Array, cache_lines: int) -> SMOState:
         cache=init_cache(cache_lines, n),
         hits=jnp.int32(0),
     )
+
+
+def eff_f(state):
+    """The solver's best estimate of the true gradient: f minus the Kahan
+    residual when compensation is on (SMOState/BlockState both carry the
+    trailing f_err leaf). Works on device arrays and host-pulled state."""
+    return state.f if state.f_err is None else state.f - state.f_err
+
+
+def kahan_add(f, err, delta):
+    """One compensated (Kahan) vector accumulation step: returns the new
+    (f, err) with the invariant true_sum ~= f - err.
+
+    Why it exists (config.compensated): at extreme C the rank-2 f updates
+    add terms of magnitude up to C*|K| ~ 2048 to values of order 1; each
+    fp32 add rounds by ~eps*|term| ~ 1e-4 and the solver's incremental
+    gradient random-walks away from the true one (measured: carried gap
+    0.005 vs true 1.1 after 8M pairs — PARITY.md covtype section). The
+    compensation defers each step's rounding into `err`, cutting the
+    accumulated drift to second order, so the carried gap stays honest
+    without the external reconstruction harness. Cost: 3 extra
+    elementwise vector ops per update — noise on a latency-bound chain.
+    No reference equivalent (the reference's fp32 gradient silently
+    drifts the same way, svmTrain.cu:98-137)."""
+    y_v = delta - err
+    t = f + y_v
+    return t, (t - f) - y_v
+
+
+def maybe_kahan(f, err, delta):
+    """Fold `delta` into (f, err): plain add when compensation is off
+    (err is None), Kahan-compensated otherwise. The single definition of
+    the conditional every engine's fold uses."""
+    if err is None:
+        return f + delta, None
+    return kahan_add(f, err, delta)
 
 
 def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
@@ -123,7 +163,10 @@ def pair_alpha_update(a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair,
 def _apply_pair_update(state: SMOState, y, i_hi, i_lo, b_hi_pair, b_lo_pair,
                        k_hi, k_lo, eta, c, gate=None) -> tuple:
     """Shared tail of an SMO iteration: alpha-pair algebra + rank-2 f
-    update (update_functor svmTrain.cu:98-137). `c` is (c_pos, c_neg)."""
+    update (update_functor svmTrain.cu:98-137). `c` is (c_pos, c_neg).
+    Returns (alpha, f, f_err) — f_err is None unless the state carries a
+    Kahan residual (config.compensated), in which case the rank-2 delta
+    is accumulated compensated (see kahan_add)."""
 
     cp, cn = split_c(c)
     y_hi = y[i_hi].astype(jnp.float32)
@@ -134,9 +177,17 @@ def _apply_pair_update(state: SMOState, y, i_hi, i_lo, b_hi_pair, b_lo_pair,
         a_hi_old, a_lo_old, y_hi, y_lo, b_hi_pair, b_lo_pair, eta,
         c_of(y_hi, cp, cn), c_of(y_lo, cp, cn), gate)
     alpha = state.alpha.at[i_lo].set(a_lo_new).at[i_hi].set(a_hi_new)
-    f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
-                + (a_lo_new - a_lo_old) * y_lo * k_lo
-    return alpha, f
+    if state.f_err is None:
+        # Left-to-right association kept bit-identical to the
+        # pre-compensation engine (tolerances in the parity artifacts are
+        # calibrated against this exact rounding sequence).
+        f = state.f + (a_hi_new - a_hi_old) * y_hi * k_hi \
+                    + (a_lo_new - a_lo_old) * y_lo * k_lo
+        return alpha, f, None
+    delta = (a_hi_new - a_hi_old) * y_hi * k_hi \
+        + (a_lo_new - a_lo_old) * y_lo * k_lo
+    f, err = kahan_add(state.f, state.f_err, delta)
+    return alpha, f, err
 
 
 def _smo_iteration(x, y, x_sq, k_diag, valid, state: SMOState, kp: KernelParams,
@@ -149,7 +200,7 @@ def _smo_iteration(x, y, x_sq, k_diag, valid, state: SMOState, kp: KernelParams,
     (the nu duals' two-equality-constraint variant) — everything after
     selection (kernel rows, pair algebra, f update) is identical.
     """
-    i_hi, b_hi, i_lo, b_lo = select_fn(state.f, state.alpha, y, c, valid)
+    i_hi, b_hi, i_lo, b_lo = select_fn(eff_f(state), state.alpha, y, c, valid)
 
     q_hi = lax.dynamic_index_in_dim(x, i_hi, 0, keepdims=False)
     q_lo = lax.dynamic_index_in_dim(x, i_lo, 0, keepdims=False)
@@ -174,9 +225,10 @@ def _smo_iteration(x, y, x_sq, k_diag, valid, state: SMOState, kp: KernelParams,
     # reference divides unguarded at svmTrainMain.cpp:290).
     eta = jnp.maximum(k_hi[i_hi] + k_lo[i_lo] - 2.0 * k_hi[i_lo], tau)
 
-    alpha, f = _apply_pair_update(state, y, i_hi, i_lo, b_hi, b_lo,
-                                  k_hi, k_lo, eta, c)
-    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
+    alpha, f, f_err = _apply_pair_update(state, y, i_hi, i_lo, b_hi, b_lo,
+                                         k_hi, k_lo, eta, c)
+    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache,
+                    state.hits + n_hits, f_err)
 
 
 def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
@@ -191,13 +243,14 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
     O(n) pass for typically several-fold fewer iterations.
     """
     cp, cn = split_c(c)
+    f_cur = eff_f(state)
     up = up_mask(state.alpha, y, cp, cn)
     low = low_mask(state.alpha, y, cp, cn)
     if valid is not None:
         up = up & valid
         low = low & valid
-    f_up = jnp.where(up, state.f, jnp.inf)
-    f_low = jnp.where(low, state.f, -jnp.inf)
+    f_up = jnp.where(up, f_cur, jnp.inf)
+    f_low = jnp.where(low, f_cur, -jnp.inf)
     i_hi = jnp.argmin(f_up).astype(jnp.int32)
     b_hi = f_up[i_hi]
     b_lo = jnp.max(f_low)  # convergence gap still uses the max violator
@@ -214,14 +267,14 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
         d_hi, cache, hit_hi = row_dots(x, q_hi), state.cache, jnp.bool_(False)
         k_hi = kernel_from_dots(d_hi, x_sq, x_sq[i_hi], kp)
 
-    diff = state.f - b_hi  # f_j - f_i
+    diff = f_cur - b_hi  # f_j - f_i
     eta_j = jnp.maximum(k_diag[i_hi] + k_diag - 2.0 * k_hi, tau)
     gain = jnp.where(low & (diff > 0), diff * diff / eta_j, -jnp.inf)
     any_elig = jnp.any(gain > -jnp.inf)
     # No eligible j <=> b_lo <= b_hi <=> converged; make the update a no-op
     # by degenerating to i_lo = i_hi (deltas become exactly 0).
     i_lo = jnp.where(any_elig, jnp.argmax(gain), i_hi).astype(jnp.int32)
-    b_lo_pair = state.f[i_lo]
+    b_lo_pair = f_cur[i_lo]
 
     q_lo = lax.dynamic_index_in_dim(x, i_lo, 0, keepdims=False)
     if kp.kind == "precomputed":
@@ -235,9 +288,11 @@ def _smo_iteration_wss2(x, y, x_sq, k_diag, valid, state: SMOState,
 
     eta = jnp.maximum(k_diag[i_hi] + k_diag[i_lo] - 2.0 * k_hi[i_lo], tau)
     n_hits = hit_hi.astype(jnp.int32) + hit_lo.astype(jnp.int32)
-    alpha, f = _apply_pair_update(state, y, i_hi, i_lo, b_hi, b_lo_pair,
-                                  k_hi, k_lo, eta, c, gate=any_elig)
-    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache, state.hits + n_hits)
+    alpha, f, f_err = _apply_pair_update(state, y, i_hi, i_lo, b_hi,
+                                         b_lo_pair, k_hi, k_lo, eta, c,
+                                         gate=any_elig)
+    return SMOState(alpha, f, b_hi, b_lo, state.it + 1, cache,
+                    state.hits + n_hits, f_err)
 
 
 _ITERATION_FNS = {
@@ -404,6 +459,16 @@ def assert_finite_state(state: SMOState, it: int, backend: str) -> None:
             "input features for inf/NaN and gamma/C scaling")
 
 
+def _precision_ctx(config: SVMConfig):
+    """Scoped matmul-precision override for everything a solve traces and
+    dispatches (config.matmul_precision; jax keys its jit caches on this
+    context, so configs at different precisions compile separately)."""
+    from contextlib import nullcontext
+
+    p = config.resolve_precision()
+    return jax.default_matmul_precision(p) if p else nullcontext()
+
+
 def solve(
     x,
     y,
@@ -444,6 +509,27 @@ def solve(
         raise ValueError(
             "selection='nu' is internal to the nu duals — call "
             "train_nusvc/train_nusvr (models/nusvm.py) instead")
+    if config.reconstruct_every:
+        # Exact-f64 reconstruction legs around the device solve: the
+        # productized form of the extreme-C recipe (solver/reconstruct.py;
+        # convergence is judged on the RECONSTRUCTED gap, matching the
+        # reference's in-tool stopping rule svmTrainMain.cpp:310 at
+        # hyperparameters where fp32 carried gradients cannot be trusted).
+        from dpsvm_tpu.solver.reconstruct import solve_in_legs
+
+        return solve_in_legs(solve, x, y, config, callback=callback,
+                             checkpoint_path=checkpoint_path, resume=resume,
+                             alpha_init=alpha_init, f_init=f_init,
+                             device=device)
+
+    with _precision_ctx(config):
+        return _solve_impl(x, y, config, callback, device, checkpoint_path,
+                           resume, alpha_init, f_init)
+
+
+def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
+                alpha_init, f_init) -> SolveResult:
+    import numpy as np
 
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)
@@ -542,6 +628,8 @@ def solve(
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
                            rounds=jnp.int32(0))
+    if config.compensated:
+        state = state._replace(f_err=jnp.zeros_like(state.f))
 
     state = jax.device_put(state, device)
     max_iter = jnp.int32(config.max_iter)
@@ -630,7 +718,7 @@ def solve(
             # written); abort exits force the save — the state being
             # stopped at must not exist only in memory.
             ckpt.save(it, np.asarray(state.alpha)[:n],
-                      np.asarray(state.f)[:n], b_hi, b_lo, force=True)
+                      np.asarray(eff_f(state))[:n], b_hi, b_lo, force=True)
         if config.verbose:
             gap = b_lo - b_hi
             print(f"[smo] iter={it} b_lo-b_hi={gap:.6f} "
@@ -647,12 +735,13 @@ def solve(
             break
 
     alpha = np.asarray(state.alpha)[:n]
+    f_final = np.asarray(eff_f(state))[:n]
     if (use_block or config.budget_mode) and not converged:
         # Budget exits report the honest stopping rule at the REAL
         # epsilon on the final state (budget_mode runs the loop itself
         # with _BUDGET_EPS, which never closes).
         b_hi, b_lo, converged = refresh_extrema_host(
-            np.asarray(state.f)[:n], alpha, y_np, config.c_bounds(),
+            f_final, alpha, y_np, config.c_bounds(),
             config.epsilon, rule=config.selection)
     # Hit-rate denominator covers only THIS run's lookups (post-resume).
     total_lookups = 2 * (it - start_iter) if use_cache else 0
@@ -668,7 +757,7 @@ def solve(
             "cache_hits": int(state.hits),
             "cache_lookups": total_lookups,
             "cache_hit_rate": (int(state.hits) / total_lookups) if total_lookups else 0.0,
-            "f": np.asarray(state.f)[:n],
+            "f": f_final,
             **({"outer_rounds": int(state.rounds)} if use_block else {}),
         },
     )
