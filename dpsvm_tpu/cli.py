@@ -60,8 +60,15 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                    help="kernel-row cache lines per device (default 0 = off; "
                         "on the MXU a fresh kernel-row matvec is cheaper than "
                         "the cache bookkeeping — see SVMConfig.cache_lines)")
-    p.add_argument("--kernel", choices=["rbf", "linear", "poly", "sigmoid"],
-                   default="rbf")
+    p.add_argument("--kernel",
+                   choices=["rbf", "linear", "poly", "sigmoid",
+                            "precomputed"],
+                   default="rbf",
+                   help="kernel family (precomputed = LibSVM -t 4: the "
+                        "training file's feature columns ARE the square "
+                        "(n, n) Gram matrix; the model saves SV indices "
+                        "as .npz, and the test file must hold "
+                        "K(test, train) rows)")
     p.add_argument("--selection", choices=["mvp", "second_order"], default="mvp",
                    help="working-set rule: mvp = reference-parity maximal "
                         "violating pair; second_order = LibSVM-style WSS2")
@@ -78,7 +85,8 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--active-set-size", type=int, default=0,
                    help="block engine: shrink per-round work to the m "
                         "most-violating rows, reconciling the full "
-                        "gradient in batches (0 = off; single-chip only)")
+                        "gradient in batches (0 = off; single-chip and "
+                        "mesh)")
     p.add_argument("--reconcile-rounds", type=int, default=8,
                    help="block engine shrinking: rounds between full-"
                         "gradient reconciliations (default 8)")
@@ -144,8 +152,11 @@ def _build_test_parser(sub) -> argparse.ArgumentParser:
                    help="1 = report calibrated-probability metrics "
                         "(model must have been trained with -b 1)")
     p.add_argument("-o", "--output", default=None,
-                   help="write per-row predictions here (with -b 1: "
-                        "'label p(+1)' per line, LibSVM svm-predict style)")
+                   help="write per-row predictions here, one per line "
+                        "(labels for classifiers/one-class/precomputed, "
+                        "values for SVR; with -b 1: 'label p(+1)' with "
+                        "the label from p >= 0.5, LibSVM svm-predict "
+                        "-b 1 style)")
     return p
 
 
@@ -237,6 +248,22 @@ def _cmd_train(args) -> int:
               f"only, not {args.svm_type}", file=sys.stderr)
         return 2
 
+    if args.kernel == "precomputed":
+        # LibSVM -t 4: the training file's features ARE the Gram matrix.
+        if args.svm_type != "c-svc":
+            print("error: --kernel precomputed supports c-svc only (the "
+                  "other duals would need transformed Gram sub-matrices)",
+                  file=sys.stderr)
+            return 2
+        if args.probability:
+            print("error: -b 1 is not supported with --kernel precomputed",
+                  file=sys.stderr)
+            return 2
+        if args.backend in ("reference", "native"):
+            print("error: --kernel precomputed needs the single or mesh "
+                  "backend", file=sys.stderr)
+            return 2
+
     t0 = time.perf_counter()
     regression = args.svm_type in ("eps-svr", "nu-svr")
     try:
@@ -254,17 +281,25 @@ def _cmd_train(args) -> int:
         print(f"loaded {x.shape[0]} examples x {x.shape[1]} features "
               f"in {time.perf_counter() - t0:.2f}s")
 
-    config = SVMConfig(
-        c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
-        max_iter=args.max_iter, cache_lines=args.cache_size,
-        kernel=args.kernel, degree=args.degree, coef0=args.coef0,
-        weight_pos=args.weight_pos, weight_neg=args.weight_neg,
-        selection=args.selection, engine=args.engine,
-        working_set_size=args.working_set_size, inner_iters=args.inner_iters,
-        active_set_size=args.active_set_size,
-        reconcile_rounds=args.reconcile_rounds,
-        dtype=args.dtype, chunk_iters=args.chunk_iters,
-        checkpoint_every=args.checkpoint_every, verbose=not args.quiet)
+    try:
+        config = SVMConfig(
+            c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
+            max_iter=args.max_iter, cache_lines=args.cache_size,
+            kernel=args.kernel, degree=args.degree, coef0=args.coef0,
+            weight_pos=args.weight_pos, weight_neg=args.weight_neg,
+            selection=args.selection, engine=args.engine,
+            working_set_size=args.working_set_size,
+            inner_iters=args.inner_iters,
+            active_set_size=args.active_set_size,
+            reconcile_rounds=args.reconcile_rounds,
+            dtype=args.dtype, chunk_iters=args.chunk_iters,
+            checkpoint_every=args.checkpoint_every, verbose=not args.quiet)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.kernel == "precomputed":
+        return _train_precomputed(args, x, y, config)
 
     logger = MetricsLogger(
         sink=None if args.quiet else sys.stderr,
@@ -365,6 +400,67 @@ def _cmd_train(args) -> int:
     return 0
 
 
+def _train_precomputed(args, x, y, config) -> int:
+    """Train on a user-supplied Gram matrix (LibSVM -t 4). The model
+    carries SV indices (models/precomputed.py), so it saves as .npz."""
+    import jax
+
+    from dpsvm_tpu.models.precomputed import PrecomputedSVCModel
+    from dpsvm_tpu.utils.metrics import MetricsLogger
+
+    n = x.shape[0]
+    if x.shape[1] != n:
+        print(f"error: --kernel precomputed needs the square (n, n) Gram "
+              f"matrix as features; {args.file_path} is {x.shape[0]} x "
+              f"{x.shape[1]}", file=sys.stderr)
+        return 2
+    backend = args.backend
+    if backend == "auto":
+        multi = (args.num_devices or len(jax.devices())) > 1
+        # The mesh precomputed path exists for the block engine only
+        # (Gram symmetry makes its fold local; dist_block.py).
+        backend = "mesh" if (multi and config.engine == "block") else "single"
+    logger = MetricsLogger(
+        sink=None if args.quiet else sys.stderr, jsonl_path=args.metrics_jsonl,
+        lookups_per_iter=0)
+    try:
+        if backend == "single":
+            from dpsvm_tpu.solver.smo import solve
+            result = solve(x, y, config, callback=logger,
+                           checkpoint_path=args.checkpoint,
+                           resume=args.resume)
+        else:
+            from dpsvm_tpu.parallel.dist_smo import solve_mesh
+            result = solve_mesh(x, y, config, num_devices=args.num_devices,
+                                callback=logger,
+                                checkpoint_path=args.checkpoint,
+                                resume=args.resume)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        logger.close()
+
+    model = PrecomputedSVCModel.from_solution(y, result.alpha, result.b)
+    if result.converged:
+        print(f"converged at iteration {result.iterations}")
+    else:
+        print(f"stopped at max-iter {result.iterations} without converging")
+    print(f"training took {result.train_seconds:.2f}s")
+    print(f"b: {result.b:.6f}")
+    print(f"support vectors: {model.n_sv}")
+    # Train accuracy: the training Gram's rows ARE K(train, train).
+    acc = float(np.mean(model.predict(x) == y))
+    print(f"train accuracy: {acc:.4f}")
+    if not args.model.endswith(".npz"):
+        args.model += ".npz"
+        print("note: precomputed-kernel models use the .npz format "
+              "(they store SV indices, not feature rows)")
+    model.save(args.model)
+    print(f"model saved to {args.model}")
+    return 0
+
+
 def _load_eval_data(args, model_width: int, float_labels: bool = False):
     """Load the test file at its OWN inferred width, then reconcile with
     the model's width. Silent truncation of a wider file is the failure
@@ -423,6 +519,17 @@ def _load_eval_data(args, model_width: int, float_labels: bool = False):
     return x, y
 
 
+def _write_predictions(args, values, fmt: str = "%d") -> None:
+    """Shared -o writer for the non-classifier branches: one prediction
+    per line (labels for one-class/precomputed, regression values for
+    SVR)."""
+    if not args.output:
+        return
+    with open(args.output, "w") as fh:
+        fh.writelines((fmt % v) + "\n" for v in values)
+    print(f"predictions written to {args.output}")
+
+
 def _cmd_test(args) -> int:
     from dpsvm_tpu.models.svm_model import SVMModel
     from dpsvm_tpu.ops.kernels import KernelParams
@@ -432,8 +539,16 @@ def _cmd_test(args) -> int:
     model_type = "classifier"
     if args.model.endswith(".npz"):
         z = np.load(args.model, allow_pickle=False)
-        model_type = {"svr": "svr", "oneclass": "oneclass"}.get(
+        model_type = {"svr": "svr", "oneclass": "oneclass",
+                      "precomputed_svc": "precomputed_svc"}.get(
             str(z.get("model_type", "")), "classifier")
+
+    if model_type != "classifier" and args.probability:
+        # -b 1 needs Platt calibration, which only classifier models
+        # carry; failing loudly beats silently ignoring the flag.
+        print(f"error: -b 1 is not applicable to a {model_type} model",
+              file=sys.stderr)
+        return 2
 
     if model_type == "svr":
         from dpsvm_tpu.models.svr import SVRModel
@@ -449,6 +564,7 @@ def _cmd_test(args) -> int:
         r2 = 1.0 - float(np.sum((pred - z_true) ** 2)) / ss_tot if ss_tot else 0.0
         print(f"loaded SVR model: {model.n_sv} SVs, gamma={model.kernel.gamma}")
         print(f"test RMSE: {rmse:.6f}  R2: {r2:.4f} ({x.shape[0]} examples)")
+        _write_predictions(args, pred, fmt="%.9g")
         return 0
     if model_type == "oneclass":
         from dpsvm_tpu.models.oneclass import OneClassModel
@@ -463,6 +579,23 @@ def _cmd_test(args) -> int:
               f"({x.shape[0]} examples)")
         if set(np.unique(y).tolist()) <= {-1, 1}:
             print(f"test accuracy vs +-1 labels: {float(np.mean(pred == y)):.4f}")
+        _write_predictions(args, pred)
+        return 0
+    if model_type == "precomputed_svc":
+        from dpsvm_tpu.models.precomputed import PrecomputedSVCModel
+        model = PrecomputedSVCModel.load(args.model)
+        # The test file's feature columns must be K(test, train) rows —
+        # width n_train, exactly like LibSVM's precomputed svm-predict.
+        loaded = _load_eval_data(args, model.n_train)
+        if loaded is None:
+            return 2
+        x, y = loaded
+        pred = model.predict(x)
+        acc = float(np.mean(pred == y))
+        print(f"loaded precomputed-kernel model: {model.n_sv} SVs over "
+              f"{model.n_train} training points, b={model.b:.6f}")
+        print(f"test accuracy: {acc:.4f} ({x.shape[0]} examples)")
+        _write_predictions(args, pred)
         return 0
 
     model = SVMModel.load(args.model)
@@ -476,12 +609,6 @@ def _cmd_test(args) -> int:
     from dpsvm_tpu.predict import decision_function
 
     dec = np.asarray(decision_function(model, x))
-    pred = np.where(dec >= 0, 1, -1)
-    acc = float(np.mean(pred == y))
-    print(f"loaded model: {model.n_sv} SVs, gamma={model.kernel.gamma}, "
-          f"b={model.b:.6f}"
-          + (", platt-calibrated" if model.has_probability else ""))
-    print(f"test accuracy: {acc:.4f} ({x.shape[0]} examples)")
     proba = None
     if args.probability:
         if not model.has_probability:
@@ -491,6 +618,19 @@ def _cmd_test(args) -> int:
         from dpsvm_tpu.models.platt import platt_probability
 
         proba = platt_probability(dec, model.prob_a, model.prob_b)
+    # LibSVM's svm-predict scores sign(dec) plain and the max-probability
+    # label under -b 1 (Platt's B can shift the p=0.5 threshold off
+    # dec=0) — the printed accuracy, the -o labels and LibSVM all agree.
+    pred = (np.where(proba >= 0.5, 1, -1) if proba is not None
+            else np.where(dec >= 0, 1, -1))
+    acc = float(np.mean(pred == y))
+    print(f"loaded model: {model.n_sv} SVs, gamma={model.kernel.gamma}, "
+          f"b={model.b:.6f}"
+          + (", platt-calibrated" if model.has_probability else ""))
+    print(f"test accuracy: {acc:.4f} ({x.shape[0]} examples)"
+          + (" [labels by max probability, svm-predict -b 1 style]"
+             if proba is not None else ""))
+    if proba is not None:
         p = np.clip(proba, 1e-15, 1 - 1e-15)
         t = (y > 0).astype(np.float64)
         ll = float(-np.mean(t * np.log(p) + (1 - t) * np.log(1 - p)))

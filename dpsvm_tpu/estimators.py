@@ -231,9 +231,12 @@ class SVC(ClassifierMixin, BaseEstimator):
     def _fit_platt_cv(self, X, y_pm, cfg):
         from dpsvm_tpu.models.platt import fit_platt_cv
 
+        # random_state passes through unchanged: None keeps sklearn's
+        # fresh-entropy-per-fit semantics (default_rng(None)), and 0 is a
+        # distinct deterministic seed rather than an alias of None.
         return fit_platt_cv(X, y_pm, cfg, backend=self.backend,
                             k=self.probability_cv,
-                            seed=self.random_state or 0)
+                            seed=self.random_state)
 
     def predict_proba(self, X):
         """Class-probability matrix (n, k), classes in ``classes_`` order."""

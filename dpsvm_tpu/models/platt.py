@@ -80,13 +80,15 @@ def platt_probability(decision: np.ndarray, a: float, b: float) -> np.ndarray:
 
 def fit_platt_cv(x, y_pm, config, backend: str = "auto",
                  num_devices=None, k: int = 5,
-                 seed: int = 0, train_fn=None) -> tuple[float, float]:
+                 seed=0, train_fn=None) -> tuple[float, float]:
     """(A, B) from decision values on held-out folds, LibSVM-style: k-fold
     refits so the calibration never sees its own training residuals
     (in-sample |f| is biased toward the margin — measured on the CLI drive
     fixture: in-sample fit gives train log-loss 0.006 vs test 0.43; the
     CV fit's train and test losses agree). Shared by estimators.SVC and
-    the CLI -b 1 flag."""
+    the CLI -b 1 flag. `seed` may be None for fresh-entropy fold shuffles
+    (sklearn random_state=None semantics); the default 0 keeps the CLI
+    deterministic."""
     from dpsvm_tpu.predict import decision_function
     from dpsvm_tpu.train import train
 

@@ -1,0 +1,73 @@
+"""Model container for precomputed-kernel C-SVC (LibSVM -t 4).
+
+A precomputed-kernel model cannot carry SV feature rows (there are none;
+the trainer consumed the user's Gram matrix directly — the reference CLI
+heritage's -t 4 role, svmTrainMain.cpp:46-58 via LibSVM). It carries the
+SUPPORT INDICES into the training set instead; prediction consumes rows
+of K(test, train) and gathers the support columns, exactly how LibSVM's
+svm-predict treats precomputed test files.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PrecomputedSVCModel:
+    """Binary C-SVC trained on a user-supplied Gram matrix.
+
+    Attributes:
+      sv_idx:  (n_sv,) int32 — indices of the support vectors into the
+               TRAINING set (= the Gram column ids prediction gathers)
+      coef:    (n_sv,) float32 — alpha_i * y_i at the support indices
+      b:       float bias (decision = K[:, sv_idx] @ coef - b)
+      n_train: training-set size (the width prediction inputs must have)
+    """
+
+    def __init__(self, sv_idx, coef, b: float, n_train: int):
+        self.sv_idx = np.asarray(sv_idx, np.int32)
+        self.coef = np.asarray(coef, np.float32)
+        self.b = float(b)
+        self.n_train = int(n_train)
+
+    @classmethod
+    def from_solution(cls, y, alpha, b: float) -> "PrecomputedSVCModel":
+        y = np.asarray(y, np.float32)
+        alpha = np.asarray(alpha, np.float32)
+        idx = np.nonzero(alpha > 0)[0]
+        return cls(idx, alpha[idx] * y[idx], b, len(y))
+
+    @property
+    def n_sv(self) -> int:
+        return int(self.sv_idx.size)
+
+    def decision_function(self, k_rows) -> np.ndarray:
+        """Decision values from K(query, train) rows: (m, n_train) ->
+        (m,). Only the support columns are read."""
+        k_rows = np.asarray(k_rows, np.float32)
+        if k_rows.ndim != 2 or k_rows.shape[1] != self.n_train:
+            raise ValueError(
+                f"precomputed prediction needs K(query, train) rows of "
+                f"width {self.n_train}, got {k_rows.shape}")
+        return k_rows[:, self.sv_idx].astype(np.float64) @ \
+            self.coef.astype(np.float64) - self.b
+
+    def predict(self, k_rows) -> np.ndarray:
+        return np.where(self.decision_function(k_rows) >= 0, 1, -1) \
+            .astype(np.int32)
+
+    def save(self, path: str) -> None:
+        if not path.endswith(".npz"):
+            raise ValueError(
+                "precomputed models use the .npz format (the reference "
+                "text format stores SV feature rows, which do not exist)")
+        np.savez(path, model_type="precomputed_svc",
+                 sv_idx=self.sv_idx, coef=self.coef,
+                 b=np.float32(self.b), n_train=np.int32(self.n_train))
+
+    @classmethod
+    def load(cls, path: str) -> "PrecomputedSVCModel":
+        z = np.load(path, allow_pickle=False)
+        if str(z.get("model_type", "")) != "precomputed_svc":
+            raise ValueError(f"{path} is not a precomputed-kernel model")
+        return cls(z["sv_idx"], z["coef"], float(z["b"]), int(z["n_train"]))

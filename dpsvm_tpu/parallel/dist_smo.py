@@ -362,6 +362,8 @@ def solve_mesh(
 
     `alpha_init` / `f_init` override the standard start point exactly as in
     solver.smo.solve — the hook the SVR / one-class reductions use.
+    `callback` follows solve()'s contract, including abort-on-truthy-return
+    at chunk boundaries (see solver/smo.py solve docstring).
     """
     if config.engine not in ("xla", "block"):
         raise ValueError(

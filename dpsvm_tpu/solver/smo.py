@@ -484,7 +484,10 @@ def solve(
 
     `callback(iter, b_hi, b_lo, state)`, when given, fires once per chunk —
     the structured-progress hook the reference lacks (its per-iteration
-    print is commented out, svmTrainMain.cpp:237-239).
+    print is commented out, svmTrainMain.cpp:237-239). ABORT CONTRACT: a
+    truthy return value stops the solve cleanly at that chunk boundary
+    (state is kept, a due checkpoint is forced); return None/False/0 —
+    not, say, the gap — from callbacks that only observe.
 
     With `checkpoint_path` and config.checkpoint_every > 0, solver state
     (alpha, f, iteration) is persisted periodically; `resume=True` restarts

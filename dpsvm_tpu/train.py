@@ -33,6 +33,9 @@ def train(
       auto picks "mesh" when >1 device is visible, else "single".
       "reference" is the NumPy oracle; "native" the C++ sequential engine
       (native/seqsmo.cpp) — both host-only, MVP selection.
+    callback fires once per solver chunk; a TRUTHY return aborts the
+      training cleanly at that chunk boundary (solver/smo.py solve
+      docstring) — observation-only callbacks must return None.
     Labels must be in {-1, +1} (reference convention, parse.cpp label stoi).
     """
     import jax

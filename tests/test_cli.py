@@ -354,3 +354,101 @@ def test_probability_nusvc(csvs, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "test log-loss" in out
+
+
+def test_precomputed_kernel_roundtrip(capsys, tmp_path):
+    """LibSVM -t 4 through the CLI: train on a square Gram CSV, test on
+    K(test, train) rows, predictions written with -o. Accuracy must match
+    the feature-space rbf run that generated the Gram."""
+    from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
+
+    x, y = make_blobs_binary(n=260, d=8, seed=33, sep=2.0)
+    xtr, ytr, xte, yte = x[:200], y[:200], x[200:], y[200:]
+    kp = KernelParams("rbf", 0.2)
+    k_tr = np.asarray(kernel_matrix(xtr, xtr, kp))
+    k_te = np.asarray(kernel_matrix(xte, xtr, kp))
+    gram_p = str(tmp_path / "gram.csv")
+    test_p = str(tmp_path / "gramtest.csv")
+    model_p = str(tmp_path / "pc.npz")
+    out_p = str(tmp_path / "pred.txt")
+    save_csv(gram_p, k_tr, ytr)
+    save_csv(test_p, k_te, yte)
+
+    rc = main(["train", "-f", gram_p, "-m", model_p, "--kernel",
+               "precomputed", "-c", "5", "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "model saved" in out
+    n_sv = int(out.split("support vectors: ")[1].split()[0])
+
+    rc = main(["test", "-f", test_p, "-m", model_p, "-o", out_p])
+    assert rc == 0
+    out = capsys.readouterr().out
+    acc = float(out.split("test accuracy: ")[1].split()[0])
+    preds = np.loadtxt(out_p)
+    assert preds.shape == (60,)
+    assert acc == pytest.approx(float(np.mean(preds == yte)), abs=1e-4)
+
+    # Oracle: the same problem in feature space.
+    from dpsvm_tpu.cli import main as _m
+    fmodel = str(tmp_path / "feat.txt")
+    ftr, fte = str(tmp_path / "ftr.csv"), str(tmp_path / "fte.csv")
+    save_csv(ftr, xtr, ytr)
+    save_csv(fte, xte, yte)
+    rc = _m(["train", "-f", ftr, "-m", fmodel, "--kernel", "rbf",
+             "-g", "0.2", "-c", "5", "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    n_sv_f = int(out.split("support vectors: ")[1].split()[0])
+    rc = _m(["test", "-f", fte, "-m", fmodel])
+    assert rc == 0
+    acc_f = float(capsys.readouterr().out.split("test accuracy: ")[1].split()[0])
+    assert abs(n_sv - n_sv_f) <= max(2, 0.02 * n_sv_f)
+    assert acc == pytest.approx(acc_f, abs=0.02)
+
+
+def test_precomputed_kernel_cli_rejections(capsys, tmp_path):
+    x, y = make_blobs_binary(n=40, d=6, seed=3, sep=2.0)
+    p = str(tmp_path / "notsquare.csv")
+    save_csv(p, x, y)
+    rc = main(["train", "-f", p, "-m", str(tmp_path / "m.npz"),
+               "--kernel", "precomputed", "-q"])
+    assert rc == 2  # not a square Gram
+    err = capsys.readouterr().err
+    assert "square" in err
+    rc = main(["train", "-f", p, "-m", str(tmp_path / "m.npz"),
+               "--kernel", "precomputed", "-t", "eps-svr", "-q"])
+    assert rc == 2
+    rc = main(["train", "-f", p, "-m", str(tmp_path / "m.npz"),
+               "--kernel", "precomputed", "-b", "1", "-q"])
+    assert rc == 2
+    rc = main(["train", "-f", p, "-m", str(tmp_path / "m.npz"),
+               "--kernel", "precomputed", "--engine", "pallas", "-q"])
+    assert rc == 2  # config rejection surfaces as a clean error
+    assert "error:" in capsys.readouterr().err
+
+
+def test_svr_oneclass_output_flags(capsys, tmp_path):
+    """ADVICE r3: -o must write predictions for SVR and one-class models
+    too, and -b must fail loudly on them."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(150, 5)).astype(np.float32)
+    z = (x[:, 0] * 2.0).astype(np.float32)
+    svr_train = str(tmp_path / "svr.csv")
+    save_csv(svr_train, x, z)
+    svr_model = str(tmp_path / "svr.npz")
+    rc = main(["train", "-f", svr_train, "-m", svr_model, "-t", "eps-svr",
+               "-c", "10", "-g", "0.3", "--backend", "single", "-q"])
+    assert rc == 0
+    capsys.readouterr()
+    out_p = str(tmp_path / "svrpred.txt")
+    rc = main(["test", "-f", svr_train, "-m", svr_model, "-o", out_p])
+    assert rc == 0
+    assert "predictions written" in capsys.readouterr().out
+    preds = np.loadtxt(out_p)
+    assert preds.shape == (150,)
+    assert np.corrcoef(preds, z)[0, 1] > 0.9
+    # -b 1 on a non-classifier model: loud error, not silence.
+    rc = main(["test", "-f", svr_train, "-m", svr_model, "-b", "1"])
+    assert rc == 2
+    assert "not applicable" in capsys.readouterr().err
