@@ -124,6 +124,11 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None, help="solver checkpoint path")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="iterations between checkpoints (0 = off)")
+    p.add_argument("--retry-faults", type=int, default=2,
+                   help="automatic retries on transient device faults, "
+                        "resuming from --checkpoint when set (default 2; "
+                        "use 0 on multi-host pods and relaunch with "
+                        "--resume instead)")
     p.add_argument("--resume", action="store_true",
                    help="resume from --checkpoint if it exists")
     p.add_argument("--metrics-jsonl", default=None,
@@ -293,7 +298,8 @@ def _cmd_train(args) -> int:
             active_set_size=args.active_set_size,
             reconcile_rounds=args.reconcile_rounds,
             dtype=args.dtype, chunk_iters=args.chunk_iters,
-            checkpoint_every=args.checkpoint_every, verbose=not args.quiet)
+            checkpoint_every=args.checkpoint_every,
+            retry_faults=args.retry_faults, verbose=not args.quiet)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -636,15 +642,15 @@ def _cmd_test(args) -> int:
         ll = float(-np.mean(t * np.log(p) + (1 - t) * np.log(1 - p)))
         print(f"test log-loss: {ll:.4f} (Platt A={model.prob_a:.6f} "
               f"B={model.prob_b:.6f})")
-    if args.output:
+    if args.output and proba is not None:
+        # Only the -b 1 'label p(+1)' format needs a custom writer.
         with open(args.output, "w") as fh:
-            if proba is not None:
-                fh.write("label p(+1)\n")
-                for pi, pr in zip(pred, proba):
-                    fh.write(f"{int(pi)} {pr:.6f}\n")
-            else:
-                fh.writelines(f"{int(pi)}\n" for pi in pred)
+            fh.write("label p(+1)\n")
+            for pi, pr in zip(pred, proba):
+                fh.write(f"{int(pi)} {pr:.6f}\n")
         print(f"predictions written to {args.output}")
+    else:
+        _write_predictions(args, pred)
     return 0
 
 

@@ -153,6 +153,19 @@ class SVMConfig:
     # honest stopping rule at `epsilon` on the final state.
     budget_mode: bool = False
 
+    # Automatic fault recovery (SURVEY.md 5.3 — the reference loses the
+    # whole run on a rank death): number of automatic retries when a
+    # solve's device dispatch dies with a TRANSIENT runtime fault
+    # (UNAVAILABLE / ABORTED / ... — solver/smo.py _TRANSIENT_MARKERS).
+    # Each retry clears the compiled-program caches, waits out the
+    # runtime's settle time, bumps chunk_iters (static-arg change =>
+    # genuinely fresh compile, dodging poisoned server-side compile
+    # caches), and resumes from the last checkpoint when checkpoint_path
+    # is set (else restarts the attempt). Non-transient errors always
+    # propagate immediately. Set 0 on multi-host pods (a single faulted
+    # process cannot re-sync its peers; relaunch with --resume instead).
+    retry_faults: int = 2
+
     # Numerics / runtime knobs (no reference equivalent).
     tau: float = 1e-12  # eta clamp (LibSVM-style guard, fixes bug B2)
     # Debug mode (SURVEY.md 5.2: the reference has no sanitizers at all):
@@ -251,6 +264,8 @@ class SVMConfig:
             raise ValueError(
                 "matmul_precision must be None (auto), 'default', 'high' "
                 "or 'highest'")
+        if self.retry_faults < 0:
+            raise ValueError("retry_faults must be >= 0 (0 = no retry)")
 
     def resolve_precision(self) -> Optional[str]:
         """The jax.default_matmul_precision value the solvers apply, or

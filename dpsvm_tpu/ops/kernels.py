@@ -167,6 +167,72 @@ def blocked_kernel_matvec(x, coef, params: KernelParams,
     return out
 
 
+def bf16_rbf_perturbation(x, gamma: float, sample: int = 2048,
+                          pairs: int = 4096, seed: int = 0) -> float:
+    """p90 of |K_exact - K_bf16-stored| over sampled pairs: how much
+    storing X in bfloat16 perturbs RBF kernel values for THIS data.
+
+    The footgun it quantifies (measured, BENCH_COVTYPE.md): at the
+    reference's covtype stress config (c=2048, gamma=0.03125) bf16
+    storage silently drops train accuracy from 0.97 to 0.59 — the box
+    bound C amplifies kernel perturbation into O(1) decision changes, so
+    the risk scale is C * p90|dK| (0.46 for the failing covtype config
+    vs <= 0.001 for the mnist-shaped headline and adult-shaped configs).
+    Host NumPy on a seeded sample; ~ms cost.
+    """
+    import ml_dtypes
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    rng = np.random.default_rng(seed)
+    n = x.shape[0]
+    idx = rng.choice(n, min(sample, n), replace=False)
+    s = x[idx].astype(np.float64)
+    sb = x[idx].astype(ml_dtypes.bfloat16).astype(np.float64)
+    i = rng.integers(0, len(s), pairs)
+    j = rng.integers(0, len(s), pairs)
+
+    def kvals(a):
+        nrm = (a ** 2).sum(1)
+        d2 = np.maximum(nrm[i] + nrm[j]
+                        - 2.0 * np.einsum("nd,nd->n", a[i], a[j]), 0.0)
+        return np.exp(-gamma * d2)
+
+    return float(np.percentile(np.abs(kvals(s) - kvals(sb)), 90))
+
+
+# C * p90|dK| above this warns (see bf16_rbf_perturbation): calibrated
+# between the measured-failing covtype-stress value (0.46) and the
+# passing headline/adult configs (<= 0.001).
+BF16_RISK_THRESHOLD = 0.1
+
+
+def warn_if_bf16_degrades(x, config) -> None:
+    """Loud warning when dtype='bfloat16' is configured in a regime where
+    storage rounding is likely to destroy solution quality (SURVEY 7.3
+    numerics-parity item 3). Called by both solver backends before any
+    device work; rbf only (the measured failure mode is the rbf
+    exponent's cancellation structure)."""
+    if config.dtype != "bfloat16" or config.kernel != "rbf":
+        return
+    import warnings
+
+    import numpy as np
+
+    gamma = config.resolve_gamma(np.asarray(x).shape[1])
+    risk = max(config.c_bounds()) * bf16_rbf_perturbation(x, gamma)
+    if risk > BF16_RISK_THRESHOLD:
+        warnings.warn(
+            f"dtype='bfloat16' is likely to destroy solution quality for "
+            f"this data: C * p90|dK| = {risk:.3f} > {BF16_RISK_THRESHOLD} "
+            f"(bf16 feature rounding perturbs RBF kernel values enough "
+            f"for the box bound C to amplify into O(1) decision changes; "
+            f"measured on the covtype stress config this costs 0.97 -> "
+            f"0.59 train accuracy, BENCH_COVTYPE.md). Use "
+            f"dtype='float32', or lower C / raise gamma.",
+            stacklevel=3)
+
+
 @partial(jax.jit, static_argnames=("params",))
 def kernel_matrix(
     a: jax.Array,

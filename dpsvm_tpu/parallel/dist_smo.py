@@ -401,11 +401,25 @@ def solve_mesh(
             checkpoint_path=checkpoint_path, resume=resume,
             alpha_init=alpha_init, f_init=f_init)
 
-    from dpsvm_tpu.solver.smo import _precision_ctx
+    from dpsvm_tpu.solver.smo import (_precision_ctx, _retry_callback,
+                                      run_with_fault_retry)
 
+    def attempt(cfg_k, res_k, k):
+        return _solve_mesh_impl(x, y, cfg_k, num_devices, mesh,
+                                _retry_callback(callback, cfg_k,
+                                                checkpoint_path, k),
+                                checkpoint_path, res_k, alpha_init, f_init)
+
+    # Single-controller retry only: on a multi-host pod a faulted process
+    # cannot re-sync its peers' collectives mid-job, so retries are
+    # forced OFF there automatically — recovery happens by relaunching
+    # the whole job with --resume (checkpoints are process-0-written and
+    # backend-portable).
+    retry_cfg = (config if jax.process_count() == 1
+                 else config.replace(retry_faults=0))
     with _precision_ctx(config):
-        return _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
-                                checkpoint_path, resume, alpha_init, f_init)
+        return run_with_fault_retry(retry_cfg, checkpoint_path, resume,
+                                    attempt)
 
 
 def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
@@ -418,6 +432,9 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     gamma = config.resolve_gamma(d)
     kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
     dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    if config.dtype == "bfloat16":
+        from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
+        warn_if_bf16_degrades(x, config)
 
     if mesh is None:
         mesh = make_data_mesh(num_devices)

@@ -469,6 +469,97 @@ def _precision_ctx(config: SVMConfig):
     return jax.default_matmul_precision(p) if p else nullcontext()
 
 
+# Error-text markers that identify a TRANSIENT device-runtime fault worth
+# retrying (tunneled/disaggregated TPU runtimes fault long dispatches with
+# UNAVAILABLE; preemptions surface as ABORTED/CANCELLED). Anything else —
+# e.g. INVALID_ARGUMENT from a real bug — propagates immediately.
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                      "CANCELLED", "INTERNAL", "connection", "socket")
+
+# Seconds to wait before re-dispatching after a fault (indexed by retry
+# number, clamped to the last entry). The dev tunnel needs ~90 s to settle
+# after killing a dispatch; tests monkeypatch this to () for speed.
+_RETRY_BACKOFF_S = (5.0, 30.0, 90.0)
+
+
+def _is_transient_fault(e: Exception) -> bool:
+    s = str(e)
+    sl = s.lower()
+    # grpc status codes are matched exactly (INVALID_ARGUMENT must never
+    # read as transient); the prose markers case-insensitively
+    # ("Connection reset by peer", "Socket closed").
+    return (any(m in s for m in _TRANSIENT_MARKERS[:5])
+            or "connection" in sl or "socket" in sl)
+
+
+def run_with_fault_retry(config: SVMConfig, checkpoint_path, resume,
+                         attempt_fn):
+    """Bounded automatic fault recovery around a whole solve attempt
+    (SURVEY.md section 5.3 — the reference loses everything on a rank
+    death; here a transient device-runtime fault costs at most the work
+    since the last checkpoint).
+
+    ``attempt_fn(cfg, resume, k)`` runs attempt ``k`` and returns a
+    SolveResult. On a transient JaxRuntimeError with retries left, the
+    compiled-program caches are cleared (a faulted dispatch can leave a
+    poisoned cached executable — re-dispatching it faults instantly), the
+    retry waits out the runtime's settle time, and the next attempt runs
+    with ``chunk_iters`` bumped by k — a static-arg change that forces a
+    genuinely fresh compile even through server-side compile caches — and
+    ``resume=True`` when a checkpoint path exists (else the attempt
+    restarts from the caller's initial state).
+    """
+    import os as _os
+    import sys as _sys
+
+    attempts = max(1, int(config.retry_faults) + 1)
+    # A retry may resume ONLY from a checkpoint THIS run wrote (or one
+    # the caller explicitly asked to resume from): a stale file from an
+    # earlier run with matching hyperparameters would otherwise silently
+    # replace the fresh training the caller asked for. Detected by mtime:
+    # unchanged since before attempt 0 => not ours.
+    def _mtime():
+        try:
+            return _os.path.getmtime(checkpoint_path) if checkpoint_path \
+                else None
+        except OSError:
+            return None
+
+    baseline_mtime = _mtime()
+
+    def _resume_now():
+        return resume or (bool(checkpoint_path)
+                          and _mtime() is not None
+                          and _mtime() != baseline_mtime)
+
+    for k in range(attempts):
+        # Retry attempts perturb tau by ~1e-6 relative: tau is a STATIC
+        # argument / closure constant in EVERY engine's compiled executor
+        # (per-pair, block, mesh), so this forces a genuinely fresh
+        # compile even through server-side compile caches — a faulted
+        # dispatch can leave a poisoned cached executable that refaults
+        # instantly on re-dispatch. Numerically inert (tau is the eta
+        # clamp floor, ~1e-12). chunk_iters+k additionally re-chunks the
+        # per-pair observed path.
+        cfg_k = config if k == 0 else config.replace(
+            chunk_iters=config.chunk_iters + k,
+            tau=config.tau * (1.0 + k * 1e-6))
+        res_k = resume if k == 0 else _resume_now()
+        try:
+            return attempt_fn(cfg_k, res_k, k)
+        except jax.errors.JaxRuntimeError as e:
+            if k == attempts - 1 or not _is_transient_fault(e):
+                raise
+            nxt = "from checkpoint" if _resume_now() else "from scratch"
+            print(f"[fault-retry] transient device fault "
+                  f"({str(e)[:160]!r}); retry {k + 1}/{attempts - 1} {nxt}",
+                  file=_sys.stderr, flush=True)
+            jax.clear_caches()
+            if _RETRY_BACKOFF_S:
+                time.sleep(_RETRY_BACKOFF_S[min(k, len(_RETRY_BACKOFF_S) - 1)])
+    raise AssertionError("unreachable")
+
+
 def solve(
     x,
     y,
@@ -525,9 +616,36 @@ def solve(
                              alpha_init=alpha_init, f_init=f_init,
                              device=device)
 
+    def attempt(cfg_k, res_k, k):
+        return _solve_impl(x, y, cfg_k,
+                           _retry_callback(callback, cfg_k,
+                                           checkpoint_path, k),
+                           device, checkpoint_path, res_k,
+                           alpha_init, f_init)
+
     with _precision_ctx(config):
-        return _solve_impl(x, y, config, callback, device, checkpoint_path,
-                           resume, alpha_init, f_init)
+        return run_with_fault_retry(config, checkpoint_path, resume, attempt)
+
+
+def _noop_callback(it, b_hi, b_lo, state):
+    """Observation-forcing callback used by fault retries (chunked
+    dispatches instead of one long one). Returns None: never aborts."""
+    return None
+
+
+def _retry_callback(callback, cfg_k, checkpoint_path, k):
+    """The callback a retry attempt should run with: unchanged on attempt
+    0 or when anything already observes chunk boundaries; otherwise the
+    no-op observer, so retries dispatch in chunks instead of re-running
+    the single long dispatch the degraded runtime just killed. The
+    condition mirrors _solve_impl's `observe` predicate (a checkpoint
+    cadence without a path observes nothing). Shared by solve() and
+    solve_mesh()."""
+    if k > 0 and callback is None and not cfg_k.verbose \
+            and not cfg_k.check_numerics \
+            and not (cfg_k.checkpoint_every and checkpoint_path):
+        return _noop_callback
+    return callback
 
 
 def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
@@ -540,6 +658,9 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     gamma = config.resolve_gamma(d)
     kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
     dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    if config.dtype == "bfloat16":
+        from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
+        warn_if_bf16_degrades(x, config)
 
     use_pallas = config.engine == "pallas"
     use_block = config.engine == "block"

@@ -1,0 +1,69 @@
+"""bf16 small-gamma/extreme-C footgun guard (VERDICT r3 weak item 4).
+
+Measured failure it protects against (BENCH_COVTYPE.md): bfloat16 X
+storage at the covtype stress config (c=2048, gamma=0.03125) silently
+drops train accuracy 0.97 -> 0.59. The guard warns when
+C * p90|K_exact - K_bf16| exceeds the calibrated threshold.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import (BF16_RISK_THRESHOLD,
+                                   bf16_rbf_perturbation)
+from dpsvm_tpu.solver.smo import solve
+
+
+def _covtype_shaped(n=4096):
+    rng = np.random.default_rng(0)
+    return (rng.normal(size=(n, 54)) * 0.3).astype(np.float32), \
+        np.where(rng.normal(size=n) > 0, 1, -1).astype(np.int32)
+
+
+def test_warns_on_covtype_stress_config():
+    x, y = _covtype_shaped()
+    cfg = SVMConfig(c=2048.0, gamma=0.03125, dtype="bfloat16",
+                    max_iter=8, engine="block")
+    with pytest.warns(UserWarning, match="bfloat16.*destroy|destroy.*quality"):
+        solve(x, y, cfg)
+
+
+def test_silent_on_mnist_shaped_config():
+    from dpsvm_tpu.data.synth import make_mnist_like
+
+    x, y = make_mnist_like(n=3000, d=784, seed=7, noise=0.1)
+    cfg = SVMConfig(c=10.0, gamma=0.125, dtype="bfloat16", max_iter=8,
+                    engine="block")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        solve(x, y, cfg)
+
+
+def test_silent_on_float32():
+    x, y = _covtype_shaped(1024)
+    cfg = SVMConfig(c=2048.0, gamma=0.03125, dtype="float32", max_iter=8)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        solve(x, y, cfg)
+
+
+def test_mesh_warns_too():
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = _covtype_shaped(2048)
+    cfg = SVMConfig(c=2048.0, gamma=0.03125, dtype="bfloat16", max_iter=8)
+    with pytest.warns(UserWarning, match="bfloat16"):
+        solve_mesh(x, y, cfg, num_devices=8)
+
+
+def test_risk_metric_separates_calibration_cases():
+    x, _ = _covtype_shaped()
+    risk_fail = 2048.0 * bf16_rbf_perturbation(x, 0.03125)
+    assert risk_fail > BF16_RISK_THRESHOLD
+    from dpsvm_tpu.data.synth import make_mnist_like
+    xm, _ = make_mnist_like(n=3000, d=784, seed=7, noise=0.1)
+    risk_pass = 10.0 * bf16_rbf_perturbation(xm, 0.125)
+    assert risk_pass < BF16_RISK_THRESHOLD / 10

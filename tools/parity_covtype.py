@@ -117,12 +117,16 @@ def main() -> int:
         if os.path.exists(sc):
             with open(sc) as fh:
                 prior.update(json.load(fh))
+        # retry_faults=0: this tool has its own exit-fast/rerun recovery
+        # protocol, and an IN-process retry would silently swallow the
+        # faulted attempt's device seconds that the sidecar accounting
+        # exists to flag.
         cfg = SVMConfig(c=C, gamma=GAMMA, epsilon=TOL / 2,
                         max_iter=args.max_pairs, engine=engine,
                         selection=sel, dtype="float32",
                         compensated=True, reconstruct_every=args.leg,
                         chunk_iters=250_000, checkpoint_every=1,
-                        verbose=True)
+                        retry_faults=0, verbose=True)
         last = [0.0]
 
         def heartbeat(it, bh, bl, st):
@@ -209,7 +213,14 @@ def main() -> int:
               "Status is the STRICT conjunction: reconstructed gap <= "
               "1e-3 (the solver's `converged`, judged on the float64 "
               "reconstruction) AND merged-SV delta <= 1% AND sign "
-              "agreement >= 99.8%.", ""]
+              "agreement >= 99.8%."]
+    if unrecorded_wall > 0:
+        lines.append(
+            f"Timing caveat: ~{unrecorded_wall:.0f} wall-seconds of "
+            f"faulted-attempt work are NOT in the device-s column (their "
+            f"pairs resumed from checkpoints) — treat device seconds as "
+            f"a lower bound for those rows.")
+    lines.append("")
 
     path = os.path.join(REPO, "PARITY.md")
     replace_section(path, SECTION, lines)
