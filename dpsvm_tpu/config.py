@@ -98,6 +98,16 @@ class SVMConfig:
     working_set_size: int = 128
     inner_iters: int = 0
 
+    # Fused fold+select for the block engine (ops/pallas_fold_select.py):
+    # the round's gradient fold and the NEXT round's working-set
+    # selection run as ONE Pallas pass over f, removing the separate
+    # full-n mask+top-k stage from the latency-bound round chain
+    # (PROFILE.md). None = auto (on for real TPUs); True forces it (CPU
+    # tests run the kernel in interpret mode); False forces the plain
+    # two-pass round. Applies to selection in {mvp, second_order} with
+    # feature kernels; nu / active-set / precomputed use the plain path.
+    fused_fold: Optional[bool] = None
+
     # Active-set shrinking for the block engine (0 = off). When > 0, the
     # solver runs cycles of `reconcile_rounds` block rounds whose
     # selection and fold touch only the `active_set_size` most-violating
@@ -156,7 +166,8 @@ class SVMConfig:
     # Automatic fault recovery (SURVEY.md 5.3 — the reference loses the
     # whole run on a rank death): number of automatic retries when a
     # solve's device dispatch dies with a TRANSIENT runtime fault
-    # (UNAVAILABLE / ABORTED / ... — solver/smo.py _TRANSIENT_MARKERS).
+    # (UNAVAILABLE / ABORTED / ... — solver/smo.py _GRPC_TRANSIENT and
+    # _PROSE_TRANSIENT).
     # Each retry clears the compiled-program caches, waits out the
     # runtime's settle time, bumps chunk_iters (static-arg change =>
     # genuinely fresh compile, dodging poisoned server-side compile
@@ -266,6 +277,8 @@ class SVMConfig:
                 "or 'highest'")
         if self.retry_faults < 0:
             raise ValueError("retry_faults must be >= 0 (0 = no retry)")
+        if self.chunk_iters < 1:
+            raise ValueError("chunk_iters must be >= 1")
 
     def resolve_precision(self) -> Optional[str]:
         """The jax.default_matmul_precision value the solvers apply, or

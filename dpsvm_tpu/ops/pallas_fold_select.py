@@ -1,0 +1,177 @@
+"""Fused Pallas TPU kernel: block-engine fold + next working-set
+candidate selection in ONE pass over HBM.
+
+The block round's fixed cost is a latency-bound serial stage sequence
+(PROFILE.md: 0.20-0.74 ms/round of selection -> gathers -> Gram ->
+subproblem -> fold), and its two largest non-matmul stages are
+back-to-back full-n passes separated by kernel boundaries: the fold
+writes f, and the next round's selection (mask building + approx_max_k)
+immediately re-reads it. This kernel extends the ops/pallas_fused.py
+pattern (the per-pair engine's fused update+select — itself the TPU
+counterpart of the reference fusing classify+reduce, svmTrain.cu:469-476)
+to the block engine:
+
+    per (rows, 128) grid block:
+      f'   = f + delta            (compensated: Kahan with the err carry)
+      up/low masks from the ALREADY-SCATTERED alpha
+      per-128-lane-row (min f' over I_up, max f' over I_low) + flat argext
+
+emitting ONE candidate per side per 128-element row — (n/128,) value and
+index arrays. A tiny epilogue takes top-h over those (exact lax.top_k on
+n/128 elements) to assemble the next working set. Selection invariants
+match solver/block.py select_block: each row's true extremum is always
+retained, so the globally most-violating pair is always in W and the
+emitted extrema are exact; only the mid-rank recall pattern differs
+(<=1 candidate per 128-row vs approx_max_k's bins), which swaps
+interchangeable mid-rank violators exactly as the approx path already
+does.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dpsvm_tpu.ops.select import split_c
+
+LANES = 128
+_BIG = float("inf")
+_IMAX = 2 ** 31 - 1
+
+
+def _fold_select_kernel(*refs, c, rows_per_block: int, compensated: bool):
+    """One grid step: fold a (rows, 128) block of delta into f and emit
+    per-row selection candidates."""
+    if compensated:
+        (f_ref, err_ref, alpha_ref, y_ref, valid_ref, delta_ref,
+         f_out_ref, err_out_ref, upv_ref, upi_ref, lov_ref, loi_ref) = refs
+    else:
+        (f_ref, alpha_ref, y_ref, valid_ref, delta_ref,
+         f_out_ref, upv_ref, upi_ref, lov_ref, loi_ref) = refs
+
+    delta = delta_ref[:]
+    f = f_ref[:]
+    if compensated:
+        # The canonical Kahan step (true ~= f - err), shared with every
+        # other engine's fold.
+        from dpsvm_tpu.solver.smo import kahan_add
+
+        f_new, err_new = kahan_add(f, err_ref[:], delta)
+        err_out_ref[:] = err_new
+        f_sel = f_new - err_new
+    else:
+        f_new = f + delta
+        f_sel = f_new
+    f_out_ref[:] = f_new
+
+    # Set membership is the up_mask/low_mask algebra of ops/select.py,
+    # re-expressed as pure i1 logic: those helpers build on jnp.where
+    # over booleans, which Mosaic materializes at i8 and cannot truncate
+    # back to i1 (same constraint, ops/pallas_fused.py) — keep the two
+    # in sync.
+    alpha = alpha_ref[:]
+    y = y_ref[:]
+    valid = valid_ref[:] > 0.0  # float mask: see ops/pallas_fused.py
+    cp, cn = split_c(c)
+    pos = y > 0
+    neg = ~pos
+    if cp == cn:
+        lt_cp = lt_cn = alpha < cp
+    else:
+        lt_cp = alpha < cp
+        lt_cn = alpha < cn
+    gt_0 = alpha > 0
+    up = ((pos & lt_cp) | (neg & gt_0)) & valid
+    low = ((pos & gt_0) | (neg & lt_cn)) & valid
+
+    rows = rows_per_block
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+    base = pl.program_id(0) * (rows * LANES)
+    flat_ids = base + row_ids * LANES + col_ids
+
+    f_up = jnp.where(up, f_sel, _BIG)
+    f_low = jnp.where(low, f_sel, -_BIG)
+    # Per-ROW extremum + lowest-flat-id argext (SURVEY 7.3 item 4
+    # tie-break), keepdims so the lane reduction stays 2D for Mosaic.
+    upv = jnp.min(f_up, axis=1, keepdims=True)  # (rows, 1)
+    upi = jnp.min(jnp.where(f_up == upv, flat_ids, _IMAX),
+                  axis=1, keepdims=True)
+    lov = jnp.max(f_low, axis=1, keepdims=True)
+    loi = jnp.min(jnp.where(f_low == lov, flat_ids, _IMAX),
+                  axis=1, keepdims=True)
+    upv_ref[:] = upv
+    upi_ref[:] = upi
+    lov_ref[:] = lov
+    loi_ref[:] = loi
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("c", "block_rows", "compensated",
+                                    "interpret"))
+def fold_select(f2d, err2d, alpha2d, y2d, valid2d, delta2d, c,
+                block_rows: int = 8, compensated: bool = False,
+                interpret: bool = False):
+    """Fold delta into f (optionally Kahan-compensated) and emit per-row
+    working-set candidates.
+
+    All arrays are (R, 128) float32, R % block_rows == 0; err2d is None
+    unless compensated. Returns (f_new2d, err_new2d_or_None, up_vals,
+    up_ids, low_vals, low_ids) with (R,) candidate arrays — one per
+    128-element row.
+    """
+    rows = f2d.shape[0]
+    assert rows % block_rows == 0, (rows, block_rows)
+    nblocks = rows // block_rows
+
+    block = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    cand = pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    kern = functools.partial(_fold_select_kernel, c=c,
+                             rows_per_block=block_rows,
+                             compensated=compensated)
+    full = jax.ShapeDtypeStruct((rows, LANES), jnp.float32)
+    cval = jax.ShapeDtypeStruct((rows, 1), jnp.float32)
+    cidx = jax.ShapeDtypeStruct((rows, 1), jnp.int32)
+
+    if compensated:
+        ins = (f2d, err2d, alpha2d, y2d, valid2d, delta2d)
+        out_specs = [block, block, cand, cand, cand, cand]
+        out_shape = [full, full, cval, cidx, cval, cidx]
+    else:
+        ins = (f2d, alpha2d, y2d, valid2d, delta2d)
+        out_specs = [block, cand, cand, cand, cand]
+        out_shape = [full, cval, cidx, cval, cidx]
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(nblocks,),
+        in_specs=[block] * len(ins),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*ins)
+    if compensated:
+        f_new, err_new, upv, upi, lov, loi = outs
+    else:
+        f_new, upv, upi, lov, loi = outs
+        err_new = None
+    return (f_new, err_new, upv[:, 0], upi[:, 0], lov[:, 0], loi[:, 0])
+
+
+def assemble_working_set(upv, upi, lov, loi, h: int):
+    """Epilogue: the next round's (w, slot_ok, b_hi, b_lo) from the
+    per-row candidates — exact top-h over n/128 elements (tiny), then the
+    shared cross-half dedup (solver/block.py combine_halves)."""
+    from dpsvm_tpu.solver.block import combine_halves
+
+    vals, idx = jax.lax.top_k(jnp.stack([-upv, lov]), h)  # (2, h)
+    ids = jnp.take_along_axis(jnp.stack([upi, loi]), idx, axis=1)
+    w, slot_ok = combine_halves(ids[0], jnp.isfinite(vals[0]),
+                                ids[1], jnp.isfinite(vals[1]))
+    return w, slot_ok, -vals[0, 0], vals[1, 0]

@@ -262,7 +262,7 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
 def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
                 kp: KernelParams, c, eps: float, tau: float,
                 q: int, inner_iters: int, inner_impl: str,
-                interpret: bool, selection: str):
+                interpret: bool, selection: str, cand=None):
     """The shared block-round step: ONE selection pass (whose top-k values
     also carry the stopping extrema of the CURRENT f), working-set
     gathers, the (q, q) Gram block, the subproblem dispatch, and the fold
@@ -276,9 +276,16 @@ def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
     inert fold), and budget exits are refreshed host-side
     (ops/select.py refresh_extrema_host).
 
+    `cand`, when given, is a precomputed (w, slot_ok, b_hi, b_lo) and the
+    selection pass is skipped entirely — the fused-fold path
+    (run_chunk_block_fused) selects as part of the PREVIOUS round's fold.
+
     Returns (w, slot_ok, b_hi, b_lo, a_w, coef, t, qx, qsq)."""
-    w, slot_ok, b_hi, b_lo = select_block(f, alpha, y, c, q,
-                                          valid=valid, rule=selection)
+    if cand is not None:
+        w, slot_ok, b_hi, b_lo = cand
+    else:
+        w, slot_ok, b_hi, b_lo = select_block(f, alpha, y, c, q,
+                                              valid=valid, rule=selection)
     gap_open = b_lo > b_hi + 2.0 * eps
     qx = jnp.take(x, w, axis=0)  # (q, d)
     qsq = jnp.take(x_sq, w)
@@ -361,6 +368,85 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
                           f_err)
 
     return lax.while_loop(cond, body, state)
+
+
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
+                                  "inner_iters", "rounds_per_chunk",
+                                  "inner_impl", "interpret", "selection"))
+def run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
+                          max_iter, kp: KernelParams, c, eps: float,
+                          tau: float, q: int, inner_iters: int,
+                          rounds_per_chunk: int,
+                          inner_impl: str = "pallas",
+                          interpret: bool = False,
+                          selection: str = "mvp") -> BlockState:
+    """Fused-fold variant of run_chunk_block: the round's fold and the
+    NEXT round's selection run as ONE Pallas pass over f
+    (ops/pallas_fold_select.py), eliminating the separate full-n
+    mask-building + approx_max_k stage from the latency-bound serial
+    round chain (PROFILE.md reading 4).
+
+    The working set rides the loop carry as per-fold candidates; one
+    plain select_block seeds it per chunk (amortized over
+    rounds_per_chunk rounds). Because each round's stopping extrema are
+    computed from the POST-fold gradient, the carried (b_hi, b_lo) are
+    exact rather than one fold behind.
+
+    Requires: n padded to a multiple of 1024 with `valid` marking real
+    rows (solver/smo.py pads); selection in {"mvp", "second_order"} (the
+    nu rule's per-class quarters use the plain path); q/2 <= n_pad/128
+    (one candidate per 128-row per side).
+    """
+    n_pad = y.shape[0]
+    rows = n_pad // 128
+    shp = (rows, 128)
+    h = q // 2
+    y2d = y.reshape(shp)
+    valid2d = valid.astype(jnp.float32).reshape(shp)
+    end = state.rounds + rounds_per_chunk
+    compensated = state.f_err is not None
+
+    from dpsvm_tpu.ops.pallas_fold_select import (assemble_working_set,
+                                                  fold_select)
+
+    w0, ok0, bhi0, blo0 = select_block(eff_f(state), state.alpha, y, c, q,
+                                       valid=valid, rule=selection)
+    st0 = state._replace(b_hi=bhi0, b_lo=blo0)
+
+    def cond(carry):
+        st, w, ok = carry
+        return ((st.rounds < end) & (st.pairs < max_iter)
+                & (st.b_lo > st.b_hi + 2.0 * eps))
+
+    def body(carry):
+        st, w, slot_ok = carry
+        _, _, b_hi, b_lo, alpha_w, coef, t, qx, qsq = _round_core(
+            x, y, x_sq, k_diag, eff_f(st), st.alpha, valid,
+            max_iter - st.pairs, kp, c, eps, tau, q, inner_iters,
+            inner_impl, interpret, selection,
+            cand=(w, slot_ok, st.b_hi, st.b_lo))
+        k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n_pad) fp32
+        delta2d = (coef @ k_rows).reshape(shp)
+        # Scatter alpha BEFORE the fused pass: its selection masks must
+        # see the updated box membership (same contract as
+        # ops/pallas_fused.py).
+        safe_w = jnp.where(slot_ok, w, jnp.int32(n_pad))
+        alpha = st.alpha.at[safe_w].set(
+            jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
+        err2d = st.f_err.reshape(shp) if compensated else None
+        f2d, err_new2d, upv, upi, lov, loi = fold_select(
+            st.f.reshape(shp), err2d, alpha.reshape(shp), y2d, valid2d,
+            delta2d, c, compensated=compensated, interpret=interpret)
+        w_n, ok_n, b_hi_n, b_lo_n = assemble_working_set(upv, upi, lov,
+                                                         loi, h)
+        new_st = BlockState(
+            alpha, f2d.reshape(n_pad), b_hi_n, b_lo_n, st.pairs + t,
+            st.rounds + 1,
+            err_new2d.reshape(n_pad) if compensated else None)
+        return new_st, w_n, ok_n
+
+    final, _, _ = lax.while_loop(cond, body, (st0, w0, ok0))
+    return final
 
 
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",

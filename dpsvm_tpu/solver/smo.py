@@ -469,12 +469,15 @@ def _precision_ctx(config: SVMConfig):
     return jax.default_matmul_precision(p) if p else nullcontext()
 
 
-# Error-text markers that identify a TRANSIENT device-runtime fault worth
-# retrying (tunneled/disaggregated TPU runtimes fault long dispatches with
+# Markers that identify a TRANSIENT device-runtime fault worth retrying
+# (tunneled/disaggregated TPU runtimes fault long dispatches with
 # UNAVAILABLE; preemptions surface as ABORTED/CANCELLED). Anything else —
-# e.g. INVALID_ARGUMENT from a real bug — propagates immediately.
-_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
-                      "CANCELLED", "INTERNAL", "connection", "socket")
+# e.g. INVALID_ARGUMENT from a real bug — propagates immediately. grpc
+# status codes match case-sensitively; the prose markers are checked
+# lowercase against the lowercased message.
+_GRPC_TRANSIENT = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                   "CANCELLED", "INTERNAL")
+_PROSE_TRANSIENT = ("connection", "socket")
 
 # Seconds to wait before re-dispatching after a fault (indexed by retry
 # number, clamped to the last entry). The dev tunnel needs ~90 s to settle
@@ -485,11 +488,8 @@ _RETRY_BACKOFF_S = (5.0, 30.0, 90.0)
 def _is_transient_fault(e: Exception) -> bool:
     s = str(e)
     sl = s.lower()
-    # grpc status codes are matched exactly (INVALID_ARGUMENT must never
-    # read as transient); the prose markers case-insensitively
-    # ("Connection reset by peer", "Socket closed").
-    return (any(m in s for m in _TRANSIENT_MARKERS[:5])
-            or "connection" in sl or "socket" in sl)
+    return (any(m in s for m in _GRPC_TRANSIENT)
+            or any(m in sl for m in _PROSE_TRANSIENT))
 
 
 def run_with_fault_retry(config: SVMConfig, checkpoint_path, resume,
@@ -662,13 +662,31 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
         warn_if_bf16_degrades(x, config)
 
+    if device is None:
+        device = jax.devices()[0]
     use_pallas = config.engine == "pallas"
     use_block = config.engine == "block"
+    # Fused fold+select (ops/pallas_fold_select.py): auto on real TPUs
+    # for the 2-sided selection rules; needs >= q/2 128-element rows so
+    # every working-set slot can find a candidate.
+    # The fused path's hard constraint is on the PADDED row count (the
+    # top-h runs over n_pad/128 per-row candidates): q/2 <= n_pad/128.
+    n_pad_fused = -(-n // 1024) * 1024
+    use_fused = (use_block and config.selection != "nu"
+                 and not config.active_set_size
+                 and kp.kind != "precomputed"
+                 and min(config.working_set_size, n_pad_fused)
+                 <= n_pad_fused // 64
+                 and (config.fused_fold if config.fused_fold is not None
+                      else device.platform == "tpu"))
     block_rows = 64
     if use_pallas:
         # Pad rows to a whole number of (block_rows, 128) kernel blocks;
         # padding is masked out of selection via `valid`.
         blk = block_rows * 128
+        n_pad = -(-n // blk) * blk
+    elif use_fused:
+        blk = 8 * 128  # fold_select's (block_rows=8, 128) grid blocks
         n_pad = -(-n // blk) * blk
     else:
         n_pad = n
@@ -683,8 +701,6 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     valid_np = np.zeros((n_pad,), bool)
     valid_np[:n] = True
 
-    if device is None:
-        device = jax.devices()[0]
     if kp.kind == "precomputed" and x.shape[0] != x.shape[1]:
         # Checked before any device transfer or compute is spent.
         raise ValueError(
@@ -692,7 +708,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
             f"matrix as x; got {x.shape}")
     x_dev = jax.device_put(jnp.asarray(x_p, dtype), device)
     y_dev = jax.device_put(jnp.asarray(y_p, jnp.float32), device)
-    valid_dev = jax.device_put(jnp.asarray(valid_np), device) if use_pallas else None
+    valid_dev = (jax.device_put(jnp.asarray(valid_np), device)
+                 if (use_pallas or use_fused) else None)
     if kp.kind == "precomputed":
         # x IS the Gram matrix: its diagonal is the kernel diag, and the
         # squared-norm pass (an O(n^2) read no precomputed branch ever
@@ -806,6 +823,16 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds),
                 inner_impl="pallas" if not interpret else "xla",
+                selection=config.selection)
+        elif use_block and use_fused:
+            from dpsvm_tpu.solver.block import run_chunk_block_fused
+
+            state = run_chunk_block_fused(
+                x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
+                kp, config.c_bounds(), eps_run, float(config.tau),
+                q, inner, rounds_per_chunk,
+                inner_impl="pallas" if not interpret else "xla",
+                interpret=interpret,
                 selection=config.selection)
         elif use_block:
             state = run_chunk_block(

@@ -102,6 +102,24 @@ def main() -> int:
     failures += status == "FAIL"
     print(f"block-engine nu-svc max|ddec|={dd:.4f} {status}")
 
+    # Fused fold+select block rounds (ops/pallas_fold_select.py): real
+    # Mosaic lowering of the fold kernel + per-row candidate assembly,
+    # plain and Kahan-compensated. Needs n >= 64*q so every slot can
+    # find a per-128-row candidate (smaller n auto-falls-back).
+    xf, yf = make_blobs_binary(n=4096, d=24, seed=5, sep=1.2)
+    rf_ref = solve(xf, yf, cfg.replace(engine="block",
+                                       working_set_size=32,
+                                       fused_fold=False))
+    for comp in (False, True):
+        rf = solve(xf, yf, cfg.replace(engine="block", working_set_size=32,
+                                       fused_fold=True, compensated=comp,
+                                       matmul_precision="default"))
+        db = abs(rf.b - rf_ref.b)
+        status = "OK" if (rf.converged and db < 5e-2) else "FAIL"
+        failures += status == "FAIL"
+        print(f"fused fold+select compensated={comp} pairs={rf.iterations} "
+              f"|b-b_ref|={db:.4f} {status}")
+
     # Fused per-pair Pallas engine.
     r_pl = solve(x, y, cfg.replace(engine="pallas"))
     db = abs(r_pl.b - r_ref.b)
