@@ -75,18 +75,31 @@ class SVMConfig:
     selection: str = "mvp"
 
     # Compute engine for the single-chip solver:
-    #   "xla"    -- pure XLA ops (reference-parity iteration structure);
-    #   "pallas" -- fused Pallas TPU kernel doing the rank-2 f update and
-    #               the next selection in one HBM pass, with the loop
-    #               software-pipelined around it. Same optimum; iteration
-    #               count may differ by one (the fused path skips the
-    #               reference's final degenerate update).
+    #   "xla"    -- pure XLA ops (reference-parity iteration structure).
+    #               The per-pair engine of choice: extreme-C convergence
+    #               runs use it (PARITY.md covtype section).
     #   "block"  -- blockwise working-set decomposition (solver/block.py):
     #               one batched MXU pass builds kernel rows for the
     #               `working_set_size` most-violating points, then up to
     #               `inner_iters` pair updates run inside that block.
     #               Same optimum and stopping rule; drastically less HBM
-    #               traffic per pair than the per-pair engines.
+    #               traffic per pair than the per-pair engines — THE
+    #               throughput path (the headline bench's engine).
+    #   "pallas" -- SUPERSEDED, kept as a working design study: fused
+    #               Pallas kernel doing the rank-2 f update and the next
+    #               selection in one HBM pass, software-pipelined. Same
+    #               optimum as "xla" (iteration count may differ by one —
+    #               it skips the reference's final degenerate update),
+    #               but MEASURED SLOWER than plain "xla" on real v5e at
+    #               the PARITY shapes (2.46 vs 1.33 device-s at 10k,
+    #               5.04 vs 3.17 at 32k — the per-iteration pallas_call
+    #               launch plus the pipelined seed selection cost more
+    #               than the one HBM pass it saves; n ~ 60k is where it
+    #               reaches parity). Its fused-pass idea is what pays off
+    #               at block granularity instead: ops/pallas_fold_select
+    #               (fused_fold) applies it per ROUND, where one pass
+    #               amortizes over `inner_iters` pair updates. Prefer
+    #               "block" for speed, "xla" for per-pair runs.
     engine: str = "xla"
 
     # Block-engine shape knobs (ignored by other engines). working_set_size

@@ -671,6 +671,11 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     # every working-set slot can find a candidate.
     # The fused path's hard constraint is on the PADDED row count (the
     # top-h runs over n_pad/128 per-row candidates): q/2 <= n_pad/128.
+    # Auto mode additionally requires large n: the fuse removes the
+    # full-n mask+approx_max_k stage but adds a pallas launch + delta
+    # round-trip + candidate top-k, measured net -11% fixed round cost
+    # at n=500k (0.617 vs 0.690 ms) and net LOSS at n=60k (headline
+    # bench 0.184 vs 0.164 s) — see PROFILE.md round-4 section.
     n_pad_fused = -(-n // 1024) * 1024
     use_fused = (use_block and config.selection != "nu"
                  and not config.active_set_size
@@ -678,7 +683,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                  and min(config.working_set_size, n_pad_fused)
                  <= n_pad_fused // 64
                  and (config.fused_fold if config.fused_fold is not None
-                      else device.platform == "tpu"))
+                      else (device.platform == "tpu"
+                            and n_pad_fused >= 200_000)))
     block_rows = 64
     if use_pallas:
         # Pad rows to a whole number of (block_rows, 128) kernel blocks;

@@ -1,0 +1,134 @@
+"""Full-n covtype quality trajectory (VERDICT r3 item 3).
+
+Runs the reference's covtype stress config (c=2048, gamma=0.03125, eps
+0.001 — reference Makefile:77) at the FULL n=500k with a real
+optimization budget (default 300M pairs vs the reference's 3M-pair cap),
+recording a train-accuracy + gap trajectory, and appends it to
+BENCH_COVTYPE.md. This turns round 3's "0.97 achievable (shown at
+n=20k)" extrapolation into a measured full-scale curve.
+
+Operating point: block engine (fused fold+select on TPU), fp32 X,
+Kahan-compensated gradient carry (the carried f then stays accurate
+enough to read train accuracy directly off it: dec_i = f_i + y_i - b,
+zero extra compute), default matmul precision (r3 measured 0.97+
+accuracy at this precision on the n=20k anchor; the 1e-3-gap
+certification story lives in PARITY.md, not here). Dispatches are kept
+to a few seconds via chunked observation; solver-level checkpointing +
+automatic fault retry ride along, so a tunnel fault costs at most one
+chunk.
+
+Run: `python tools/covtype_fullscale.py [--pairs 300000000]`
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.parity_common import replace_section
+
+SECTION = "## full-n quality trajectory (n=500k, measured)"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pairs", type=int, default=300_000_000)
+    ap.add_argument("--q", type=int, default=512)
+    ap.add_argument("--inner", type=int, default=1024)
+    ap.add_argument("--chunk", type=int, default=8_000_000)
+    ap.add_argument("--acc-every", type=int, default=20_000_000,
+                    help="pairs between accuracy reads (each pulls f, "
+                         "~2 MB device->host)")
+    args = ap.parse_args()
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.solver.smo import solve
+    from tools.bench_covtype import make_data
+
+    x, y = make_data()
+    n = len(y)
+    cfg = SVMConfig(c=2048.0, gamma=0.03125, epsilon=1e-3,
+                    max_iter=args.pairs, engine="block",
+                    working_set_size=args.q, inner_iters=args.inner,
+                    compensated=True, matmul_precision="default",
+                    dtype="float32", chunk_iters=args.chunk,
+                    checkpoint_every=args.chunk)
+    ck = os.path.join(REPO, "artifacts", "covtype_fullscale_ck.npz")
+
+    traj = []  # (pairs, gap, acc or None)
+    t_state = {"acc_pairs": -args.acc_every}
+
+    def acc_from_f(f, bh, bl):
+        b = (bh + bl) / 2.0
+        dec = np.asarray(f, np.float64) + y - b
+        return float(np.mean(np.where(dec >= 0, 1, -1) == y))
+
+    def cb(it, bh, bl, st):
+        from dpsvm_tpu.solver.smo import eff_f
+
+        gap = bl - bh
+        acc = None
+        if it - t_state["acc_pairs"] >= args.acc_every:
+            t_state["acc_pairs"] = it
+            acc = acc_from_f(np.asarray(eff_f(st))[:n], bh, bl)
+        traj.append((int(it), float(gap), acc))
+        print(f"  pairs={it:>11,} gap={gap:9.5f}"
+              + (f" train_acc={acc:.4f}" if acc is not None else ""),
+              flush=True)
+
+    t0 = time.perf_counter()
+    res = solve(x, y, cfg, callback=cb, checkpoint_path=ck, resume=True)
+    wall = time.perf_counter() - t0
+    final_acc = acc_from_f(res.stats["f"], res.b_hi, res.b_lo)
+    pps = res.iterations / max(res.train_seconds, 1e-9)
+    print(f"done: pairs={res.iterations:,} device_s={res.train_seconds:.1f} "
+          f"wall_s={wall:.1f} pairs/s={pps:,.0f} "
+          f"gap={res.b_lo - res.b_hi:.5f} train_acc={final_acc:.4f}",
+          flush=True)
+
+    # Thin the trajectory for the table: keep accuracy rows + endpoints.
+    rows = [t for t in traj if t[2] is not None]
+    if traj and (not rows or rows[-1][0] != traj[-1][0]):
+        rows.append(traj[-1])
+
+    lines = [
+        SECTION, "",
+        f"The reference caps its covtype run at 3M pair updates "
+        f"(Makefile:77) and reports no accuracy; this run gives the SAME "
+        f"config (c=2048, gamma=0.03125, n=500k, d=54, fp32) a real "
+        f"optimization budget on one v5e chip — block engine "
+        f"(fused fold+select), q={args.q}, inner={args.inner}, "
+        f"Kahan-compensated gradient carry (train accuracy is read "
+        f"directly off the carried gradient: dec = f + y - b). "
+        f"**{res.iterations:,} pair updates in "
+        f"{res.train_seconds:.1f} device-seconds "
+        f"({pps:,.0f} pairs/s), final train accuracy "
+        f"{final_acc:.4f}**, stopping-rule gap "
+        f"{res.b_lo - res.b_hi:.4f}.", "",
+        "| pair updates | gap (b_lo - b_hi) | train accuracy |",
+        "|---|---|---|",
+    ]
+    for it, gap, acc in rows:
+        lines.append(f"| {it:,} | {gap:.5f} | "
+                     f"{'' if acc is None else f'{acc:.4f}'} |")
+    lines += [
+        "",
+        f"(final row re-read from the returned state: accuracy "
+        f"{final_acc:.4f} at {res.iterations:,} pairs; device time "
+        f"excludes the per-chunk host observation, solver/smo.py timing "
+        f"discipline)", ""]
+    path = os.path.join(REPO, "BENCH_COVTYPE.md")
+    replace_section(path, SECTION, lines)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

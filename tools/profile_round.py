@@ -84,7 +84,8 @@ def timed(fn, *args, reps: int) -> float:
     return max(t2 - t1, 0.0) / reps
 
 
-def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int):
+def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
+           fused: bool = False, valid=None):
     """Stage attribution from WHOLE-CHUNK ablation — the only timing
     method the tunnel cannot distort (one dispatch per probe, big-state
     output, salted fresh start each time). Runs `reps` rounds at
@@ -100,7 +101,8 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int):
     import jax
     import jax.numpy as jnp
 
-    from dpsvm_tpu.solver.block import BlockState, run_chunk_block
+    from dpsvm_tpu.solver.block import (BlockState, run_chunk_block,
+                                        run_chunk_block_fused)
     from dpsvm_tpu.solver.smo import _BUDGET_EPS
 
     base = BlockState(alpha=jnp.zeros_like(yd),
@@ -130,10 +132,16 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int):
         # making rounds/pairs differ across budgets and the slope
         # meaningless. Post-optimum rounds execute the identical
         # instruction stream, so the cost model is unaffected.
-        run = lambda st, n: run_chunk_block(
-            xd, yd, x_sq, k_diag, st, jnp.int32(10 ** 9), kp,
-            cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
-            n, inner_impl="pallas")
+        if fused:
+            run = lambda st, n: run_chunk_block_fused(
+                xd, yd, x_sq, k_diag, valid, st, jnp.int32(10 ** 9), kp,
+                cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
+                n, inner_impl="pallas")
+        else:
+            run = lambda st, n: run_chunk_block(
+                xd, yd, x_sq, k_diag, st, jnp.int32(10 ** 9), kp,
+                cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
+                n, inner_impl="pallas")
         jax.block_until_ready(run(base, reps))       # compile + warm
         jax.block_until_ready(run(base, 2 * reps))
         t1, r1, p1 = probe(run, reps)
@@ -171,6 +179,9 @@ def main() -> int:
     ap.add_argument("--n", type=int, default=None,
                     help="row-count override (docs/SCALING.md uses the "
                          "fixed-cost slope between two n's at equal d/q)")
+    ap.add_argument("--fused", action="store_true",
+                    help="ablate run_chunk_block_fused (fold+select as "
+                         "one Pallas pass; rows padded to 1024)")
     args = ap.parse_args()
 
     import jax
@@ -198,6 +209,24 @@ def main() -> int:
     q = args.q
     n, d = x.shape
     kp = KernelParams("rbf", cfg.resolve_gamma(d))
+    valid_dev = None
+    if args.fused:
+        # The fused runner's contract: rows padded to 1024 with a valid
+        # mask (solver/smo.py pads the same way).
+        n_pad = -(-n // 1024) * 1024
+        x_p = np.zeros((n_pad, d), np.float32)
+        x_p[:n] = x
+        y_p = np.ones((n_pad,), np.float32)
+        y_p[:n] = y
+        valid = np.zeros((n_pad,), bool)
+        valid[:n] = True
+        x, y = x_p, y_p
+        valid_dev = jnp.asarray(valid)
+        n = n_pad
+        if q // 2 > n_pad // 128:
+            ap.error(f"--fused needs q/2 <= n_pad/128 (one candidate per "
+                     f"128-row per side): q={q}, n_pad={n_pad} allows "
+                     f"q <= {2 * (n_pad // 128)}")
     xd = jnp.asarray(x, jnp.bfloat16)
     yd = jnp.asarray(y, jnp.float32)
     x_sq = jax.jit(squared_norms)(xd)
@@ -314,9 +343,11 @@ def main() -> int:
     # Whole-chunk ablation: the authoritative attribution (see ablate()).
     print("  whole-chunk ablation over inner budgets (authoritative):")
     rows, fixed_ms, marg_us = ablate(xd, yd, x_sq, k_diag, kp, cfg, q,
-                                     args.reps)
-    print(f"  => fixed round cost {fixed_ms:.3f} ms "
-          f"(select+gather+gram+fold+scatter), marginal "
+                                     args.reps, fused=args.fused,
+                                     valid=valid_dev)
+    stages = ("gather+gram+fused-fold/select+top-h+scatter" if args.fused
+              else "select+gather+gram+fold+scatter")
+    print(f"  => fixed round cost {fixed_ms:.3f} ms ({stages}), marginal "
           f"{marg_us:.2f} us/pair (serial subproblem chain)")
     return 0
 
