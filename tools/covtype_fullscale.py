@@ -61,6 +61,15 @@ def main() -> int:
                     dtype="float32", chunk_iters=args.chunk,
                     checkpoint_every=args.chunk)
     ck = os.path.join(REPO, "artifacts", "covtype_fullscale_ck.npz")
+    # Trajectory + device-seconds accumulate ACROSS invocations (the
+    # solve resumes from its checkpoint, so res.iterations is cumulative
+    # while train_seconds covers only this process).
+    sidecar = os.path.join(REPO, "artifacts", "covtype_fullscale_traj.json")
+    hist = {"rows": [], "device_s": 0.0, "pairs_done": 0}
+    if os.path.exists(sidecar):
+        import json
+        with open(sidecar) as fh:
+            hist.update(json.load(fh))
 
     traj = []  # (pairs, gap, acc or None)
     t_state = {"acc_pairs": -args.acc_every}
@@ -87,16 +96,28 @@ def main() -> int:
     res = solve(x, y, cfg, callback=cb, checkpoint_path=ck, resume=True)
     wall = time.perf_counter() - t0
     final_acc = acc_from_f(res.stats["f"], res.b_hi, res.b_lo)
-    pps = res.iterations / max(res.train_seconds, 1e-9)
-    print(f"done: pairs={res.iterations:,} device_s={res.train_seconds:.1f} "
-          f"wall_s={wall:.1f} pairs/s={pps:,.0f} "
-          f"gap={res.b_lo - res.b_hi:.5f} train_acc={final_acc:.4f}",
-          flush=True)
+    this_pairs = res.iterations - hist["pairs_done"]
+    pps = this_pairs / max(res.train_seconds, 1e-9)
+    print(f"done: pairs={res.iterations:,} (+{this_pairs:,}) "
+          f"device_s={res.train_seconds:.1f} wall_s={wall:.1f} "
+          f"pairs/s={pps:,.0f} gap={res.b_lo - res.b_hi:.5f} "
+          f"train_acc={final_acc:.4f}", flush=True)
 
     # Thin the trajectory for the table: keep accuracy rows + endpoints.
     rows = [t for t in traj if t[2] is not None]
     if traj and (not rows or rows[-1][0] != traj[-1][0]):
         rows.append(traj[-1])
+    import json
+    hist["rows"] = [r for r in hist["rows"] if r[0] < (rows[0][0] if rows
+                                                       else 10 ** 18)]
+    hist["rows"] += [list(r) for r in rows]
+    hist["device_s"] += res.train_seconds
+    hist["pairs_done"] = int(res.iterations)
+    with open(sidecar, "w") as fh:
+        json.dump(hist, fh)
+    rows = [tuple(r) for r in hist["rows"]]
+    device_s = hist["device_s"]
+    pps = res.iterations / max(device_s, 1e-9)
 
     lines = [
         SECTION, "",
@@ -108,7 +129,7 @@ def main() -> int:
         f"Kahan-compensated gradient carry (train accuracy is read "
         f"directly off the carried gradient: dec = f + y - b). "
         f"**{res.iterations:,} pair updates in "
-        f"{res.train_seconds:.1f} device-seconds "
+        f"{device_s:.1f} device-seconds "
         f"({pps:,.0f} pairs/s), final train accuracy "
         f"{final_acc:.4f}**, stopping-rule gap "
         f"{res.b_lo - res.b_hi:.4f}.", "",

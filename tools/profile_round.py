@@ -85,7 +85,7 @@ def timed(fn, *args, reps: int) -> float:
 
 
 def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
-           fused: bool = False, valid=None):
+           fused: bool = False, valid=None, budgets=None):
     """Stage attribution from WHOLE-CHUNK ablation — the only timing
     method the tunnel cannot distort (one dispatch per probe, big-state
     output, salted fresh start each time). Runs `reps` rounds at
@@ -125,7 +125,7 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
                 best = (t, int(out.rounds), int(out.pairs))
         return best
 
-    for inner in (1, max(2, q // 4), q, 2 * q):
+    for inner in (budgets or (1, max(2, q // 4), q, 2 * q)):
         # _BUDGET_EPS keeps the stopping test open so EVERY probe runs
         # its exact round budget with its full inner budget — from the
         # zero start the mnist shape otherwise converges mid-probe,
@@ -182,6 +182,12 @@ def main() -> int:
     ap.add_argument("--fused", action="store_true",
                     help="ablate run_chunk_block_fused (fold+select as "
                          "one Pallas pass; rows padded to 1024)")
+    ap.add_argument("--ablate-only", action="store_true",
+                    help="skip the indicative isolated-stage probes and "
+                         "run only the authoritative whole-chunk ablation")
+    ap.add_argument("--budgets", default=None,
+                    help="comma-separated inner budgets for the ablation "
+                         "(default: 1,q/4,q,2q)")
     args = ap.parse_args()
 
     import jax
@@ -238,6 +244,19 @@ def main() -> int:
     print(f"dataset={args.dataset} n={n} d={d} q={q} reps={args.reps}")
 
     c = cfg.c_bounds()
+
+    if args.ablate_only:
+        budgets = (tuple(int(v) for v in args.budgets.split(","))
+                   if args.budgets else None)
+        print("  whole-chunk ablation over inner budgets (authoritative):")
+        rows_a, fixed_ms, marg_us = ablate(
+            xd, yd, x_sq, k_diag, kp, cfg, q, args.reps,
+            fused=args.fused, valid=valid_dev, budgets=budgets)
+        stages = ("gather+gram+fused-fold/select+top-h+scatter"
+                  if args.fused else "select+gather+gram+fold+scatter")
+        print(f"  => fixed round cost {fixed_ms:.3f} ms ({stages}), "
+              f"marginal {marg_us:.2f} us/pair")
+        return 0
 
     # --- select
     def s_select(f, alpha):
