@@ -292,6 +292,11 @@ class SVMConfig:
             raise ValueError("retry_faults must be >= 0 (0 = no retry)")
         if self.chunk_iters < 1:
             raise ValueError("chunk_iters must be >= 1")
+        if self.max_iter > 2 ** 31 - 1:
+            raise ValueError(
+                "max_iter must fit int32 (the on-device pair counters "
+                "are int32); split larger budgets across resumed solves "
+                "(checkpoint_path + resume)")
 
     def resolve_precision(self) -> Optional[str]:
         """The jax.default_matmul_precision value the solvers apply, or
