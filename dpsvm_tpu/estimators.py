@@ -95,6 +95,12 @@ class SVC(ClassifierMixin, BaseEstimator):
     is reduced via one-vs-rest or one-vs-one (``strategy``). ``class_weight``
     ({label: w} or "balanced") is honored for binary problems, mirroring
     LibSVM ``-w``.
+
+    Numerics note: like sklearn, prediction evaluates in float32. For
+    extreme-C models, fp32 accumulation can swamp near-boundary decision
+    signs (predict.decision_risk estimates when); use the module-level
+    ``predict.decision_function(model, X, precision='float64')`` on the
+    fitted binary model for exact evaluation.
     """
 
     def __init__(self, C=1.0, kernel="rbf", degree=3, gamma="scale",

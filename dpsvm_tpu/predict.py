@@ -27,8 +27,23 @@ def _decision_batch(q, sv_x, dual_coef, b, kp: KernelParams):
     return k @ dual_coef - b
 
 
-def decision_function(model: SVMModel, q, block: int = 8192) -> np.ndarray:
-    """f(q_i) for a batch of query points, blocked to bound HBM use."""
+def decision_function(model: SVMModel, q, block: int = 8192,
+                      precision: str = "float32") -> np.ndarray:
+    """f(q_i) for a batch of query points, blocked to bound HBM use.
+
+    precision="float64" evaluates on the host in exact float64 instead —
+    REQUIRED for trustworthy signs from extreme-C models: fp32
+    accumulation noise over many large-|coef| terms swamps O(1) decision
+    values (measured at the covtype stress config: an alpha matching
+    LibSVM's SV count to 0.05% read 59% sign agreement under fp32
+    evaluation and 99.99% under f64 — PARITY.md). decision_risk() gives
+    a cheap a-priori estimate of when this matters.
+    """
+    if precision == "float64":
+        # No fp32 quantization of the queries on the exact path.
+        return _decision_f64(model, q, block)
+    if precision != "float32":
+        raise ValueError("precision must be 'float32' or 'float64'")
     q = np.asarray(q, np.float32)
     sv_x = jnp.asarray(model.sv_x)
     coef = jnp.asarray(model.dual_coef)
@@ -40,17 +55,47 @@ def decision_function(model: SVMModel, q, block: int = 8192) -> np.ndarray:
     return np.concatenate(out) if out else np.zeros((0,), np.float32)
 
 
-def predict(model: SVMModel, q, block: int = 8192) -> np.ndarray:
+def _decision_f64(model: SVMModel, q, block: int) -> np.ndarray:
+    """Host float64 decision evaluation — the single f64 kernel-algebra
+    definition (solver/reconstruct.py gram_matvec_f64) applied at the
+    query points."""
+    from dpsvm_tpu.solver.reconstruct import gram_matvec_f64
+
+    return gram_matvec_f64(
+        model.sv_x, model.dual_coef, model.kernel, block=block,
+        queries=np.asarray(q, np.float64)) - model.b
+
+
+def decision_risk(model: SVMModel) -> float:
+    """A-priori estimate of fp32 decision-evaluation noise: the random-
+    walk accumulation error sqrt(n_sv) * eps_f32 * rms|coef| (kernel
+    values <= O(1)). Compare to the decision margin that matters;
+    values approaching ~0.1+ mean fp32 signs near the boundary are
+    noise — use decision_function(..., precision='float64'). The
+    measured covtype-stress case reads ~4 (59% fp32 sign agreement);
+    moderate-C models read ~1e-4."""
+    coef = np.asarray(model.dual_coef, np.float64)
+    if coef.size == 0:
+        return 0.0
+    return float(np.sqrt(coef.size) * 2.0 ** -23
+                 * np.sqrt(np.mean(coef ** 2)))
+
+
+def predict(model: SVMModel, q, block: int = 8192,
+            precision: str = "float32") -> np.ndarray:
     """Class labels in {-1, +1}. sign(0) maps to +1 (matches the reference's
-    `dual >= 0` style checks, seq_test.cpp:199-203)."""
-    d = decision_function(model, q, block)
+    `dual >= 0` style checks, seq_test.cpp:199-203). precision='float64'
+    evaluates exactly on the host — required for trustworthy labels from
+    extreme-C models (see decision_function / decision_risk)."""
+    d = decision_function(model, q, block, precision=precision)
     return np.where(d >= 0, 1, -1).astype(np.int32)
 
 
-def accuracy(model: SVMModel, q, y, block: int = 8192) -> float:
+def accuracy(model: SVMModel, q, y, block: int = 8192,
+             precision: str = "float32") -> float:
     """Fraction correct — the get_test_accuracy equivalent
     (seq_test.cpp:187-210)."""
-    pred = predict(model, q, block)
+    pred = predict(model, q, block, precision=precision)
     return float(np.mean(pred == np.asarray(y)))
 
 

@@ -59,26 +59,35 @@ def _stored_x64(x, dtype: str) -> np.ndarray:
 
 
 def gram_matvec_f64(x, coef, kp: KernelParams, dtype: str = "float32",
-                    block: int = 4096) -> np.ndarray:
+                    block: int = 4096, queries=None) -> np.ndarray:
     """K(x, x_active) @ coef_active in float64 on the host, blocked so at
     most a (block, n_active) kernel tile is live. Only the nonzero-coef
-    columns are evaluated (n_sv << n near convergence). Returns (n,) f64.
+    columns are evaluated (n_sv << n near convergence). Returns
+    (len(queries) or n,) f64.
 
-    The float64 counterpart of ops/kernels.py blocked_kernel_matvec; the
-    kernel algebra mirrors kernel_from_dots exactly (including the RBF
-    squared-distance clamp at 0).
+    `queries=None` evaluates at x's own rows (gradient reconstruction);
+    a (m, d) query matrix evaluates at arbitrary points (the float64
+    prediction path, predict.decision_function precision='float64' —
+    ONE definition of the host f64 kernel algebra serves both). The
+    float64 counterpart of ops/kernels.py blocked_kernel_matvec; mirrors
+    kernel_from_dots exactly (including the RBF distance clamp at 0).
     """
     coef = np.asarray(coef, np.float64)
     n = x.shape[0]
     active = np.nonzero(coef != 0.0)[0]
-    if active.size == 0:
-        return np.zeros(n, np.float64)
     if kp.kind == "precomputed":
+        if queries is not None:
+            raise ValueError(
+                "precomputed kernels carry no feature vectors; gather "
+                "K(query, train) columns instead "
+                "(models/precomputed.py decision_function)")
         # x IS the (n, n) Gram matrix (cast blockwise THROUGH the stored
         # dtype — the device gathers bf16-rounded rows under
         # dtype='bfloat16', and certifying unrounded values would judge a
         # different problem; same rule as _stored_x64 — and only the
         # active columns: n_sv << n near convergence).
+        if active.size == 0:
+            return np.zeros(n, np.float64)
         ca = coef[active]
         out = np.empty(n, np.float64)
         if dtype == "bfloat16":
@@ -89,15 +98,20 @@ def gram_matvec_f64(x, coef, kp: KernelParams, dtype: str = "float32",
                 blk = blk.astype(ml_dtypes.bfloat16).astype(np.float32)
             out[s:s + block] = blk.astype(np.float64) @ ca
         return out
-    x64 = _stored_x64(x, dtype)
+    xq = (_stored_x64(x, dtype) if queries is None
+          else np.asarray(queries, np.float64))
+    m = xq.shape[0]
+    if active.size == 0:
+        return np.zeros(m, np.float64)
+    x64 = xq if queries is None else _stored_x64(x, dtype)
     xa = x64[active]
     ca = coef[active]
-    out = np.empty(n, np.float64)
+    out = np.empty(m, np.float64)
     if kp.kind == "rbf":
-        sq = np.einsum("nd,nd->n", x64, x64)
-        sqa = sq[active]
-    for s in range(0, n, block):
-        t = x64[s:s + block]
+        sq = np.einsum("nd,nd->n", xq, xq)
+        sqa = np.einsum("nd,nd->n", xa, xa)
+    for s in range(0, m, block):
+        t = xq[s:s + block]
         dots = t @ xa.T
         if kp.kind == "linear":
             k = dots

@@ -186,3 +186,37 @@ def test_config_validation():
         SVMConfig(compensated=True, engine="pallas")
     with pytest.raises(ValueError):
         SVMConfig(reconstruct_every=-1)
+
+
+def test_f64_prediction_fixes_extreme_c_signs():
+    """The fp32 prediction trap (PARITY.md): at extreme C, fp32 decision
+    accumulation loses signs that float64 evaluation recovers; the risk
+    estimator separates the regimes."""
+    from sklearn.svm import SVC
+
+    from dpsvm_tpu.models.svm_model import SVMModel
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.predict import decision_function, decision_risk
+
+    x, y = _stress(n=500)
+    cfg = STRESS.replace(selection="second_order", compensated=True,
+                         reconstruct_every=50_000)
+    res = solve(x, y, cfg)
+    kp = KernelParams("rbf", cfg.gamma)
+    model = SVMModel.from_dense(x, y, res.alpha, res.b, kp)
+    sk = SVC(C=cfg.c, kernel="rbf", gamma=cfg.gamma,
+             tol=2 * cfg.epsilon).fit(x, y)
+
+    d64 = decision_function(model, x, precision="float64")
+    agree64 = np.mean(np.sign(d64) == np.sign(sk.decision_function(x)))
+    assert agree64 >= 0.995
+    np.testing.assert_allclose(
+        decision_function(model, x), d64, atol=10 * decision_risk(model)
+        + 1e-4)
+    # Risk separates regimes: extreme C >> moderate C.
+    from dpsvm_tpu.train import train
+
+    m_easy, _ = train(x, y, SVMConfig(c=1.0, gamma=0.1), backend="single")
+    assert decision_risk(model) > 10 * decision_risk(m_easy)
+    with pytest.raises(ValueError):
+        decision_function(model, x, precision="float16")
