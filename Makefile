@@ -42,6 +42,15 @@ multihost_check:
 parity:
 	$(PY) tools/parity.py
 
+# Covtype-stress LibSVM parity (one solve() call per row via the
+# in-solver f64 reconstruction legs; oracle phase first on CPU) and the
+# full-n 500k quality trajectory -> BENCH_COVTYPE.md.
+parity_covtype:
+	$(PY) tools/parity_covtype.py
+
+covtype_fullscale:
+	$(PY) tools/covtype_fullscale.py
+
 parity_full:
 	$(PY) tools/parity.py --full
 

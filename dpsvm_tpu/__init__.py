@@ -28,7 +28,8 @@ from dpsvm_tpu.models.svr import SVRModel, train_svr
 from dpsvm_tpu.models.oneclass import OneClassModel, train_oneclass
 from dpsvm_tpu.models.nusvm import train_nusvc, train_nusvr
 from dpsvm_tpu.train import train
-from dpsvm_tpu.predict import decision_function, predict, accuracy
+from dpsvm_tpu.predict import (decision_function, decision_risk,
+                               predict, accuracy)
 from dpsvm_tpu import data
 
 
@@ -53,6 +54,7 @@ __all__ = [
     "train_nusvr",
     "train",
     "decision_function",
+    "decision_risk",
     "predict",
     "accuracy",
     "data",
