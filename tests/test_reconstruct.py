@@ -220,3 +220,33 @@ def test_f64_prediction_fixes_extreme_c_signs():
     assert decision_risk(model) > 10 * decision_risk(m_easy)
     with pytest.raises(ValueError):
         decision_function(model, x, precision="float16")
+
+
+@pytest.mark.parametrize("kind,degree,coef0", [
+    ("linear", 3, 0.0), ("poly", 2, 1.0), ("sigmoid", 3, 0.5),
+])
+def test_gram_matvec_f64_all_kernels(kind, degree, coef0):
+    """The f64 host algebra must match the device kernel definition for
+    every feature-kernel family (it certifies their convergence too)."""
+    from dpsvm_tpu.ops.kernels import kernel_matrix
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(96, 5)).astype(np.float32)
+    coef = rng.normal(size=96).astype(np.float64)
+    coef[rng.random(96) < 0.4] = 0.0
+    kp = KernelParams(kind, 0.3, degree, coef0)
+    got = gram_matvec_f64(x, coef, kp)
+    want = np.asarray(kernel_matrix(x, x, kp), np.float64) @ coef
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # And at arbitrary query points.
+    q = rng.normal(size=(17, 5)).astype(np.float32)
+    got_q = gram_matvec_f64(x, coef, kp, queries=q.astype(np.float64))
+    want_q = np.asarray(kernel_matrix(q, x, kp), np.float64) @ coef
+    np.testing.assert_allclose(got_q, want_q, rtol=1e-5, atol=1e-5)
+
+
+def test_gram_matvec_f64_precomputed_rejects_queries():
+    kp = KernelParams("precomputed")
+    K = np.eye(8, dtype=np.float32)
+    with pytest.raises(ValueError, match="precomputed"):
+        gram_matvec_f64(K, np.ones(8), kp, queries=np.ones((2, 8)))
