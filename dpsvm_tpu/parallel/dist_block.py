@@ -140,6 +140,68 @@ def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
     return qx, scal, l, own
 
 
+
+def _mesh_round_core(x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
+                     budget_left, kp, c, eps, tau, inner_iters: int,
+                     inner_impl: str, interpret: bool, selection: str):
+    """The shared mesh round step AFTER selection: working-set recovery
+    (masked psum, or the symmetric local path for a precomputed Gram),
+    the replicated (q, q) Gram block + subproblem solve (every device
+    computes the identical result — the reference's replicated-update
+    trick, svmTrainMain.cpp:285-299, lifted to q variables), the fold
+    coefficients, and the LOCAL fold rows K(W, shard). Used by the plain
+    and fused runners; the active runner works on replicated views via
+    solver/block.py _round_core instead.
+
+    `scal_loc` is the (n_loc, 5) stack [x_sq, k_diag, alpha, y, f_eff].
+    Returns (alpha_w, coef, t, l, own, k_rows_loc)."""
+    n_loc = x_loc.shape[0]
+    if kp.kind == "precomputed":
+        # x_loc holds this shard's ROWS of the (symmetric) Gram matrix.
+        # Symmetry makes everything local or tiny: K(W, W) = psum of
+        # each shard's owned rows' W-columns ((q, q) traffic — never the
+        # (q, n) row psum), and the fold's K(W, shard) is the transpose
+        # of the LOCAL column gather x_loc[:, W] (zero traffic).
+        l, own, l_safe = _ws_owners(w, slot_ok, n_loc)
+        scal = _psum_scal(scal_loc, own, l_safe)
+        rows_own = jnp.where(
+            own[:, None],
+            jnp.take(x_loc, l_safe, axis=0).astype(jnp.float32),
+            0.0)  # (q, n_pad) — local view of the owned W rows
+        kb_w = lax.psum(jnp.take(rows_own, w, axis=1), DATA_AXIS)
+        qx = qsq = None
+    else:
+        qx, scal, l, own = _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc)
+        qsq = scal[:, 0]
+    kd_w, alpha_w0, y_w, f_w0 = (
+        scal[:, 1], scal[:, 2], scal[:, 3], scal[:, 4])
+
+    if kp.kind != "precomputed":
+        dots_w = jnp.dot(qx, qx.T, preferred_element_type=jnp.float32)
+        kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)
+    limit = jnp.minimum(jnp.int32(inner_iters), budget_left)
+    limit = jnp.where(gap_open, limit, 0)
+    if inner_impl == "pallas":
+        from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+
+        alpha_w, t = solve_subproblem_pallas(
+            kb_w, alpha_w0, y_w, f_w0, kd_w,
+            slot_ok.astype(jnp.float32), limit, c, eps, tau,
+            rule=selection, interpret=interpret)
+    else:
+        alpha_w, _, t = _solve_subproblem(
+            kb_w, kd_w, slot_ok, alpha_w0, y_w, f_w0, c, eps, tau,
+            limit, rule=selection)
+
+    coef = jnp.where(slot_ok, (alpha_w - alpha_w0) * y_w, 0.0)
+    if kp.kind == "precomputed":
+        k_rows_loc = jnp.take(x_loc, w, axis=1).astype(jnp.float32).T
+    else:
+        k_rows_loc = kernel_rows(
+            x_loc, x_sq_loc, qx.astype(x_loc.dtype), qsq, kp)
+    return alpha_w, coef, t, l, own, k_rows_loc
+
+
 def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                             tau: float, q: int, inner_iters: int,
                             rounds_per_chunk: int, inner_impl: str = "xla",
@@ -172,58 +234,12 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             gap_open = b_lo > b_hi + 2.0 * eps
             scal_loc = jnp.stack(
                 [x_sq_loc, k_diag_loc, st.alpha, y_loc, f_cur], axis=1)
-            if kp.kind == "precomputed":
-                # x_loc holds this shard's ROWS of the (symmetric) Gram
-                # matrix. Symmetry makes everything local or tiny:
-                # K(W, W) = psum of each shard's owned rows' W-columns
-                # ((q, q) traffic — never the (q, n) row psum), and the
-                # fold's K(W, shard) is the transpose of the LOCAL
-                # column gather x_loc[:, W] (zero traffic).
-                l, own, l_safe = _ws_owners(w, slot_ok, n_loc)
-                scal = _psum_scal(scal_loc, own, l_safe)
-                rows_own = jnp.where(
-                    own[:, None],
-                    jnp.take(x_loc, l_safe, axis=0).astype(jnp.float32),
-                    0.0)  # (q, n_pad) — local view of the owned W rows
-                kb_w = lax.psum(jnp.take(rows_own, w, axis=1), DATA_AXIS)
-                qx = qsq = None
-            else:
-                qx, scal, l, own = _gather_ws(x_loc, scal_loc, w, slot_ok,
-                                              n_loc)
-                qsq = scal[:, 0]
-            kd_w, alpha_w0, y_w, f_w0 = (
-                scal[:, 1], scal[:, 2], scal[:, 3], scal[:, 4])
-
-            # Replicated (q, q) Gram block and subproblem solve — every
-            # device computes the identical result, like the reference's
-            # replicated alpha-pair update (svmTrainMain.cpp:285-299).
-            if kp.kind != "precomputed":
-                dots_w = jnp.dot(qx, qx.T,
-                                 preferred_element_type=jnp.float32)
-                kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)
-            limit = jnp.minimum(jnp.int32(inner_iters), max_iter - st.pairs)
-            limit = jnp.where(gap_open, limit, 0)
-            if inner_impl == "pallas":
-                from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
-
-                alpha_w, t = solve_subproblem_pallas(
-                    kb_w, alpha_w0, y_w, f_w0, kd_w,
-                    slot_ok.astype(jnp.float32), limit, c, eps, tau,
-                    rule=selection, interpret=interpret)
-            else:
-                alpha_w, _, t = _solve_subproblem(
-                    kb_w, kd_w, slot_ok, alpha_w0, y_w, f_w0, c, eps, tau,
-                    limit, rule=selection)
-
+            alpha_w, coef, t, l, own, k_rows_loc = _mesh_round_core(
+                x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
+                max_iter - st.pairs, kp, c, eps, tau, inner_iters,
+                inner_impl, interpret, selection)
             # Fold: purely LOCAL (q, n_loc) kernel-row matmul (or, for
             # a precomputed Gram, the symmetric local column gather).
-            coef = jnp.where(slot_ok, (alpha_w - alpha_w0) * y_w, 0.0)
-            if kp.kind == "precomputed":
-                k_rows_loc = jnp.take(x_loc, w, axis=1) \
-                                .astype(jnp.float32).T
-            else:
-                k_rows_loc = kernel_rows(
-                    x_loc, x_sq_loc, qx.astype(x_loc.dtype), qsq, kp)
             f, f_err = maybe_kahan(st.f, st.f_err, coef @ k_rows_loc)
 
             # Scatter owned alpha slots into the shard. The inert index
@@ -237,6 +253,126 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                               st.pairs + t, st.rounds + 1, f_err)
 
         return lax.while_loop(cond, body, state)
+
+    shard = P(DATA_AXIS)
+    rep = P()
+    state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
+                             pairs=rep, rounds=rep,
+                             f_err=shard if compensated else None)
+    mapped = jax.shard_map(
+        chunk_body,
+        mesh=mesh,
+        in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
+        out_specs=state_specs,
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def _global_top_from_rows(upv, upi, lov, loi, h: int):
+    """Replicated global working set from per-shard PER-ROW candidates
+    (the fused fold+select kernel's outputs, ids already globalized):
+    exact local top-h per side, one all_gather, exact global top-h,
+    shared cross-half dedup. The gathered union contains each shard's
+    true extremum, so the global MVP invariant and the (b_hi, b_lo)
+    extrema are exact — same argument as _global_top, with the fused
+    kernel replacing the masked-score approx_max_k stage."""
+    scores = jnp.stack([-upv, lov])  # (2, r)
+    ids = jnp.stack([upi, loi])
+    v, i = lax.top_k(scores, h)
+    g = jnp.take_along_axis(ids, i, axis=1)
+    av = lax.all_gather(v, DATA_AXIS)  # (P, 2, h)
+    ag = lax.all_gather(g, DATA_AXIS)
+    av = jnp.moveaxis(av, 0, 1).reshape(2, -1)
+    ag = jnp.moveaxis(ag, 0, 1).reshape(2, -1)
+    gv, gi = lax.top_k(av, h)
+    gids = jnp.take_along_axis(ag, gi, axis=1)
+    w, slot_ok = combine_halves(gids[0], jnp.isfinite(gv[0]),
+                                gids[1], jnp.isfinite(gv[1]))
+    return w, slot_ok, -gv[0, 0], gv[1, 0]
+
+
+def make_block_fused_chunk_runner(mesh: Mesh, kp: KernelParams, c,
+                                  eps: float, tau: float, q: int,
+                                  inner_iters: int, rounds_per_chunk: int,
+                                  inner_impl: str = "pallas",
+                                  interpret: bool = False,
+                                  selection: str = "mvp",
+                                  compensated: bool = False):
+    """Fused-fold mesh block runner: each shard's fold and per-row
+    candidate selection run as ONE Pallas pass over its f shard
+    (ops/pallas_fold_select.py — the mesh counterpart of solver/block.py
+    run_chunk_block_fused), then one all_gather assembles the exact
+    global working set. This removes the separate full-n_loc
+    mask+approx_max_k stage from every shard's round chain — the regime
+    where it pays is big n_loc (solver/smo.py measured the single-chip
+    crossover at ~200k rows), i.e. exactly the big-n·d pod story of
+    docs/SCALING.md.
+
+    Requires: n_loc padded to a multiple of 1024 (solve_mesh pads via
+    pad_rows(multiple=1024)), q/2 <= n_loc/128, selection in
+    {mvp, second_order}, feature kernels.
+    """
+    from dpsvm_tpu.ops.pallas_fold_select import fold_select
+
+    def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
+                   state: BlockState, max_iter):
+        n_loc = x_loc.shape[0]
+        rows = n_loc // 128
+        shp = (rows, 128)
+        h = q // 2
+        y2d = y_loc.reshape(shp)
+        valid2d = valid_loc.astype(jnp.float32).reshape(shp)
+        end = state.rounds + rounds_per_chunk
+        comp = state.f_err is not None
+        dev_off = lax.axis_index(DATA_AXIS).astype(jnp.int32) * n_loc
+
+        # Seed candidates once per chunk (amortized over the rounds).
+        w0, ok0, bhi0, blo0 = _select_block_mesh(
+            eff_f(state), state.alpha, y_loc, valid_loc, c, q,
+            rule=selection)
+        st0 = state._replace(b_hi=bhi0, b_lo=blo0)
+
+        def cond(carry):
+            st, w, ok = carry
+            return ((st.rounds < end) & (st.pairs < max_iter)
+                    & (st.b_lo > st.b_hi + 2.0 * eps))
+
+        def body(carry):
+            st, w, slot_ok = carry
+            f_cur = eff_f(st)
+            scal_loc = jnp.stack(
+                [x_sq_loc, k_diag_loc, st.alpha, y_loc, f_cur], axis=1)
+            alpha_w, coef, t, l, own, k_rows_loc = _mesh_round_core(
+                x_loc, x_sq_loc, scal_loc, w, slot_ok,
+                st.b_lo > st.b_hi + 2.0 * eps, max_iter - st.pairs,
+                kp, c, eps, tau, inner_iters, inner_impl, interpret,
+                selection)
+            delta2d = (coef @ k_rows_loc).reshape(shp)
+            # Scatter owned alpha BEFORE the fused pass (its masks must
+            # see updated box membership).
+            l_scatter = jnp.where(own, l, jnp.int32(n_loc))
+            alpha = st.alpha.at[l_scatter].set(
+                jnp.where(own, alpha_w, 0.0), mode="drop")
+            err2d = st.f_err.reshape(shp) if comp else None
+            f2d, err_new2d, upv, upi, lov, loi = fold_select(
+                st.f.reshape(shp), err2d, alpha.reshape(shp), y2d,
+                valid2d, delta2d, c, compensated=comp,
+                interpret=interpret)
+            # Candidate ids are shard-local flat ids; globalize. (Rows
+            # with empty candidate sets carry +-inf values and an
+            # arbitrary real local id — masked downstream by the
+            # isfinite check, so the offset add is always safe.)
+            w_n, ok_n, bhi_n, blo_n = _global_top_from_rows(
+                upv, upi + dev_off, lov, loi + dev_off, h)
+            new_st = BlockState(
+                alpha, f2d.reshape(n_loc), bhi_n, blo_n, st.pairs + t,
+                st.rounds + 1,
+                err_new2d.reshape(n_loc) if comp else None)
+            return new_st, w_n, ok_n
+
+        final, _, _ = lax.while_loop(cond, body, (st0, w0, ok0))
+        return final
 
     shard = P(DATA_AXIS)
     rep = P()

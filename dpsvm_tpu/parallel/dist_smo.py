@@ -440,7 +440,22 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
         mesh = make_data_mesh(num_devices)
     n_dev = mesh.devices.size
 
-    n_pad = pad_rows(n, n_dev)
+    # Fused fold+select (mesh counterpart of solver/block.py
+    # run_chunk_block_fused): each shard's fold + candidate selection is
+    # one Pallas pass; pays in the big-n_loc pod regime (single-chip
+    # crossover measured at ~200k rows, PROFILE.md round-4). Needs
+    # n_loc padded to 1024 and q/2 <= n_loc/128.
+    _platform = mesh.devices.flat[0].platform
+    _n_pad_f = pad_rows(n, n_dev, multiple=1024)
+    _n_loc_f = _n_pad_f // n_dev
+    use_fused = (use_block and config.selection != "nu"
+                 and not config.active_set_size
+                 and kp.kind != "precomputed"
+                 and min(config.working_set_size, _n_loc_f)
+                 <= _n_loc_f // 64
+                 and (config.fused_fold if config.fused_fold is not None
+                      else (_platform == "tpu" and _n_loc_f >= 200_000)))
+    n_pad = _n_pad_f if use_fused else pad_rows(n, n_dev)
     if kp.kind == "precomputed":
         if n != d:
             raise ValueError(
@@ -563,8 +578,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
         inner = config.inner_iters or 2 * q
         rounds_per_chunk = (max(1, chunk_len // inner)
                             if observe else _UNOBSERVED_CHUNK)
-        inner_impl = ("pallas" if mesh.devices.flat[0].platform == "tpu"
-                      else "xla")
+        inner_impl = "pallas" if _platform == "tpu" else "xla"
         if config.active_set_size:
             from dpsvm_tpu.parallel.dist_block import (
                 make_block_active_chunk_runner)
@@ -578,6 +592,16 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 mesh, kp, config.c_bounds(), eps_run,
                 float(config.tau), q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds), inner_impl,
+                selection=config.selection,
+                compensated=config.compensated)
+        elif use_fused:
+            from dpsvm_tpu.parallel.dist_block import (
+                make_block_fused_chunk_runner)
+
+            run_chunk = make_block_fused_chunk_runner(
+                mesh, kp, config.c_bounds(), eps_run,
+                float(config.tau), q, inner, rounds_per_chunk, inner_impl,
+                interpret=_platform != "tpu",
                 selection=config.selection,
                 compensated=config.compensated)
         else:

@@ -150,3 +150,40 @@ def test_fold_select_kernel_unit():
     # The global extrema's indices must be among the working set.
     assert int(np.argmin(f_up)) in np.asarray(w)[np.asarray(slot_ok)]
     assert int(np.argmax(f_low)) in np.asarray(w)[np.asarray(slot_ok)]
+
+
+def test_fused_mesh_matches_single_chip(blobs_medium):
+    """The mesh fused runner (per-shard fold+select pass + gathered exact
+    global top-h) must land on the single-chip optimum."""
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_medium
+    cfg = BASE.replace(working_set_size=16, fused_fold=True)
+    r1 = solve(x, y, cfg)
+    r8 = solve_mesh(x, y, cfg, num_devices=8)
+    assert r1.converged and r8.converged
+    np.testing.assert_allclose(r8.alpha, r1.alpha, atol=5e-2)
+    assert r8.b == pytest.approx(r1.b, abs=5e-3)
+
+
+def test_fused_mesh_compensated(blobs_small):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    cfg = BASE.replace(working_set_size=8, compensated=True,
+                       fused_fold=True)
+    rp = solve_mesh(x, y, cfg.replace(fused_fold=False), num_devices=4)
+    rf = solve_mesh(x, y, cfg, num_devices=4)
+    assert rp.converged and rf.converged
+    np.testing.assert_allclose(rf.alpha, rp.alpha, atol=5e-2)
+    assert rf.b == pytest.approx(rp.b, abs=5e-3)
+
+
+def test_fused_mesh_budget_mode(blobs_medium):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_medium
+    cfg = BASE.replace(budget_mode=True, max_iter=600, inner_iters=50,
+                       working_set_size=16, fused_fold=True)
+    r = solve_mesh(x, y, cfg, num_devices=8)
+    assert r.iterations == 600

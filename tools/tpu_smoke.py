@@ -120,6 +120,21 @@ def main() -> int:
         print(f"fused fold+select compensated={comp} pairs={rf.iterations} "
               f"|b-b_ref|={db:.4f} {status}")
 
+    # Mesh fused fold+select on the single real chip (1-device mesh:
+    # exercises the shard_mapped pallas_call lowering + gathered top-h).
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    rm = solve_mesh(xf, yf, cfg.replace(engine="block",
+                                        working_set_size=32,
+                                        fused_fold=True,
+                                        matmul_precision="default"),
+                    num_devices=1)
+    db = abs(rm.b - rf_ref.b)
+    status = "OK" if (rm.converged and db < 5e-2) else "FAIL"
+    failures += status == "FAIL"
+    print(f"mesh fused fold+select pairs={rm.iterations} "
+          f"|b-b_ref|={db:.4f} {status}")
+
     # Fused per-pair Pallas engine.
     r_pl = solve(x, y, cfg.replace(engine="pallas"))
     db = abs(r_pl.b - r_ref.b)
