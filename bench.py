@@ -73,7 +73,17 @@ def main() -> int:
         c=10.0, gamma=0.125, epsilon=0.01, max_iter=REF_BUDGET,
         cache_lines=0, engine="block", working_set_size=256,
         dtype="bfloat16")
-    budget_config = config.replace(budget_mode=True)
+    # Budget run: inner=2048 (not the convergence run's 2q=512). The
+    # dataset converges at ~7k pairs, so most of the 100k-pair budget
+    # executes at the optimum either way; a larger inner budget amortizes
+    # the ~0.2 ms fixed round cost over 4x the pairs and cuts the round
+    # count 4x. Swept on-chip 2026-07-31 (best of 3, q x inner grid):
+    # i=512 0.161 s / i=1024 0.154 / i=2048 0.135 / i=4096 0.133 — but
+    # i=4096's dual objective lands 1.5% from the fp32 optimum, too close
+    # to this file's 2% gate for run-to-run variance; i=2048 sits at
+    # 0.24% with the same 0.13x-second class. The honest-eps convergence
+    # run below keeps the measured-best 2q default.
+    budget_config = config.replace(budget_mode=True, inner_iters=2048)
 
     # Warm-up: compile BOTH chunk executors (budget_mode bakes a
     # different epsilon into the stopping test, so it is a different XLA

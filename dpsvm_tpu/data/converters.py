@@ -83,3 +83,40 @@ def mnist_to_odd_even_csv(src: str, dst: str) -> tuple[int, int]:
     x, y = mnist_to_odd_even(x * 1.0, digits, scale=255.0)
     save_csv(dst, x, y)
     return x.shape
+
+
+def main(argv=None) -> int:
+    """CLI, matching the reference's scripts being directly runnable
+    (scripts/convert_adult.py, scripts/convert_mnist_to_odd_even.py):
+
+        python -m dpsvm_tpu.data.converters adult in.libsvm out.csv
+        python -m dpsvm_tpu.data.converters mnist_even_odd in.csv out.csv
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="dpsvm_tpu.data.converters",
+                                 description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_a = sub.add_parser("adult",
+                         help="sparse LIBSVM -> dense reference CSV")
+    p_a.add_argument("src")
+    p_a.add_argument("dst")
+    p_a.add_argument("--num-features", type=int, default=None,
+                     help="pad/clip feature width (default: max index "
+                          "seen; the reference pins Adult to 123)")
+    p_m = sub.add_parser("mnist_even_odd",
+                         help="digit,pixels CSV -> +-1 even/odd CSV "
+                              "with pixels scaled /255")
+    p_m.add_argument("src")
+    p_m.add_argument("dst")
+    args = ap.parse_args(argv)
+    if args.cmd == "adult":
+        n, d = libsvm_to_csv(args.src, args.dst, args.num_features)
+    else:
+        n, d = mnist_to_odd_even_csv(args.src, args.dst)
+    print(f"wrote {args.dst}: {n} rows x {d} features")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,3 +41,25 @@ def test_mnist_odd_even_relabel():
     xs, y = mnist_to_odd_even(x, digits)
     np.testing.assert_array_equal(y, [1, -1, 1, -1, -1, 1])
     np.testing.assert_allclose(xs, 0.5)
+
+
+def test_converters_cli(tmp_path):
+    """The module is directly runnable, like the reference's prep
+    scripts (scripts/convert_adult.py, convert_mnist_to_odd_even.py)."""
+    from dpsvm_tpu.data.converters import main
+
+    src = tmp_path / "a.libsvm"
+    src.write_text("+1 1:0.5 3:1\n-1 2:2\n")
+    dst = tmp_path / "a.csv"
+    assert main(["adult", str(src), str(dst), "--num-features", "4"]) == 0
+    x, y = load_csv(str(dst))
+    assert x.shape == (2, 4)
+    np.testing.assert_array_equal(y, [1, -1])
+
+    msrc = tmp_path / "digits.csv"
+    msrc.write_text("0,127.5,0\n3,255,255\n")
+    mdst = tmp_path / "evenodd.csv"
+    assert main(["mnist_even_odd", str(msrc), str(mdst)]) == 0
+    x, y = load_csv(str(mdst))
+    np.testing.assert_array_equal(y, [1, -1])
+    np.testing.assert_allclose(x[0], [0.5, 0.0])

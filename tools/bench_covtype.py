@@ -181,8 +181,16 @@ def main() -> int:
     # measured to distort config comparisons; see sweep()). The
     # trajectory comes from a ladder of shorter unobserved runs — each
     # point an independent solve from the zero start, so its time is
-    # directly the device-seconds-to-that-many-pairs.
-    res = solve(x, y, base)
+    # directly the device-seconds-to-that-many-pairs. The headline value
+    # is best of three (bench.py discipline): the tunneled harness shows
+    # ~+-20% run-to-run/session drift (e.g. the same headline-bench
+    # config read 0.135 s and 0.165 s twenty minutes apart,
+    # PROFILE.md round-4 section). NOTE: do not "confirm" drift by
+    # comparing against budget_mode runs — those execute full
+    # inner-budget rounds (1.6M pairs/s at this shape) and measure a
+    # different thing than this honest-eps run (~945k), see PROFILE.md.
+    runs = [solve(x, y, base) for _ in range(3)]
+    res = min(runs, key=lambda r: r.train_seconds)
     traj_rows = []
     for b in (250_000, 500_000, 1_000_000, 1_500_000, 2_000_000,
               2_500_000):
@@ -283,7 +291,10 @@ def main() -> int:
         "",
         "Gap-vs-pairs trajectory (each row an independent unobserved "
         "run from the zero start to that pair budget; time is "
-        "device-seconds to reach it):",
+        "device-seconds to reach it; ladder rows are single runs, the "
+        "final full-budget row is the best of three — with the "
+        "tunnel's ~+-20% session drift the mixed estimators can read "
+        "non-monotonic near the top):",
         "",
         "| pair updates | KKT gap (b_lo - b_hi) | device s |",
         "|---|---|---|",
@@ -291,8 +302,21 @@ def main() -> int:
     md += [f"| {it} | {gap:.5f} | {t:.2f} |" for it, gap, t in traj_rows]
     md += ["", "```json", json.dumps(line), "```", ""]
     out = os.path.join(REPO, "BENCH_COVTYPE.md")
-    with open(out, "w") as fh:
+    # Preserve the full-n quality-trajectory section that
+    # tools/covtype_fullscale.py appends (a 47-min measured artifact —
+    # a header refresh must never clobber it; it did once, 2026-07-31).
+    keep = ""
+    if os.path.exists(out):
+        text = open(out).read()
+        idx = text.find("## full-n quality trajectory")
+        if idx >= 0:
+            keep = text[idx:]
+    tmp = out + ".tmp"
+    with open(tmp, "w") as fh:
         fh.write("\n".join(md))
+        if keep:
+            fh.write("\n" + keep)
+    os.replace(tmp, out)  # atomic: never leave the artifact truncated
     print(f"wrote {out}", file=sys.stderr)
     return 0
 

@@ -188,6 +188,11 @@ def main() -> int:
     ap.add_argument("--budgets", default=None,
                     help="comma-separated inner budgets for the ablation "
                          "(default: 1,q/4,q,2q)")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"],
+                    help="X storage dtype for the probes (bench_covtype "
+                         "pins float32 for quality; the fold reads X so "
+                         "its cost depends on this)")
     args = ap.parse_args()
 
     import jax
@@ -233,7 +238,8 @@ def main() -> int:
             ap.error(f"--fused needs q/2 <= n_pad/128 (one candidate per "
                      f"128-row per side): q={q}, n_pad={n_pad} allows "
                      f"q <= {2 * (n_pad // 128)}")
-    xd = jnp.asarray(x, jnp.bfloat16)
+    xd = jnp.asarray(x, jnp.bfloat16 if args.dtype == "bfloat16"
+                     else jnp.float32)
     yd = jnp.asarray(y, jnp.float32)
     x_sq = jax.jit(squared_norms)(xd)
     k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq, params=kp)
