@@ -82,6 +82,10 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--inner-iters", type=int, default=0,
                    help="block engine: pair updates per block "
                         "(default 0 = working-set-size)")
+    p.add_argument("--pair-batch", type=int, default=1, choices=[1, 2],
+                   help="block engine: pair updates per inner-loop trip "
+                        "(2 = batched disjoint second pair, mvp only — "
+                        "see SVMConfig.pair_batch)")
     p.add_argument("--active-set-size", type=int, default=0,
                    help="block engine: shrink per-round work to the m "
                         "most-violating rows, reconciling the full "
@@ -295,6 +299,7 @@ def _cmd_train(args) -> int:
             selection=args.selection, engine=args.engine,
             working_set_size=args.working_set_size,
             inner_iters=args.inner_iters,
+            pair_batch=args.pair_batch,
             active_set_size=args.active_set_size,
             reconcile_rounds=args.reconcile_rounds,
             dtype=args.dtype, chunk_iters=args.chunk_iters,

@@ -111,6 +111,20 @@ class SVMConfig:
     working_set_size: int = 128
     inner_iters: int = 0
 
+    # Block-engine subproblem pair batching (no reference equivalent).
+    # 2 = each inner-loop trip executes TWO coordinate-disjoint pair
+    # updates: the MVP pair plus the second-best pair selected from the
+    # SAME (stale) extrema reductions, with the second update computed
+    # exactly against the post-first-update gradient (see
+    # ops/pallas_subproblem.py). Halves the serial dependency chain per
+    # pair in the chain-bound regimes. Same optimum (every update is an
+    # exact descent step on a violating pair); the pair SEQUENCE differs
+    # from pair_batch=1, so trajectories and exact pair counts to
+    # convergence differ. mvp selection + block engine only (the nu
+    # trainers, which re-select to the per-class rule internally, fall
+    # back to single-pair rather than rejecting the config).
+    pair_batch: int = 1
+
     # Fused fold+select for the block engine (ops/pallas_fold_select.py):
     # the round's gradient fold and the NEXT round's working-set
     # selection run as ONE Pallas pass over f, removing the separate
@@ -264,6 +278,15 @@ class SVMConfig:
             raise ValueError("inner_iters must be >= 0 (0 = working_set_size)")
         if self.active_set_size < 0:
             raise ValueError("active_set_size must be >= 0 (0 = shrinking off)")
+        if self.pair_batch not in (1, 2):
+            raise ValueError("pair_batch must be 1 or 2")
+        if self.pair_batch == 2 and (self.engine != "block"
+                                     or self.selection != "mvp"):
+            raise ValueError(
+                "pair_batch=2 is a block-engine mvp-selection feature "
+                "(the per-pair engines update one global pair by "
+                "definition; second_order/nu pairings pick partners by "
+                "rules the batched second slot does not implement)")
         if self.active_set_size and self.engine != "block":
             raise ValueError(
                 "active_set_size (shrinking) is a block-engine knob; the "

@@ -138,8 +138,11 @@ def train_nusvc(
             "engine='pallas' does not implement the per-class nu "
             "selection; use engine='xla' (per-pair) or engine='block' "
             "(decomposition with per-class quarters)")
+    # pair_batch falls back to single-pair: the batched second slot is
+    # mvp-only (SVMConfig.pair_batch) and must not make a legal user
+    # config crash when this trainer switches the selection rule.
     cfg = config.replace(c=1.0, weight_pos=1.0, weight_neg=1.0,
-                         selection="nu")
+                         selection="nu", pair_batch=1)
 
     result = _solve(x, y, cfg, backend, num_devices, callback,
                     alpha0, f_init, checkpoint_path, resume)
@@ -222,7 +225,7 @@ def train_nusvr(
             "selection; use engine='xla' (per-pair) or engine='block' "
             "(decomposition with per-class quarters)")
     cfg = config.replace(c=C, weight_pos=1.0, weight_neg=1.0,
-                         selection="nu")
+                         selection="nu", pair_batch=1)  # see train_nusvc
     result = _solve(x2, y2, cfg, backend, num_devices, callback,
                     alpha0, f_init, checkpoint_path, resume)
 

@@ -46,7 +46,7 @@ def _pick1(sel, vec):
 def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
                        ok_ref, alpha_out_ref, t_ref,
                        *, rows: int, cp: float, cn: float, eps: float,
-                       tau: float, rule: str):
+                       tau: float, rule: str, pair_batch: int = 1):
     # All working-set state lives in (rows, 128) tiles: a (1, q) vector
     # occupies ceil(q/128) vregs with 7 of 8 sublanes idle, while the
     # (rows, 128) layout packs the same q values 8x denser — every
@@ -177,7 +177,58 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
         alpha = jnp.where(sel_j, a_j_new, alpha)
         f = f + (a_i_new - a_i_old) * y_i * row_i \
               + (a_j_new - a_j_old) * y_j * row_j
-        return alpha, f, t + jnp.int32(gap_open), gap_open
+        if pair_batch == 1:
+            return alpha, f, t + jnp.int32(gap_open), gap_open
+
+        # ---- pair_batch == 2 (rule == "mvp", validated upstream): a
+        # second, coordinate-disjoint pair per trip. SELECTION is stale
+        # (second-best extrema of the same pre-update f_up/f_low
+        # reductions, excluding pair 1's lanes — no extra full-tile
+        # reduction pass on the serial chain for the candidate values);
+        # the UPDATE is exact: its b_hi2/b_lo2 are re-picked from the
+        # post-pair-1 f tile and its alpha coords are untouched by
+        # pair 1 (disjointness), so this is a true SMO step on the
+        # updated state — monotone descent, conservation, box all hold.
+        # Counting matches the second_order precedent: an attempted slot
+        # counts even when gated to a no-op (deterministic budget math);
+        # the update itself is gated on the STALE sets being non-empty
+        # (empty-set sentinel index would alias lane 0 — a real, wrong
+        # update, not a no-op) and on the corrected pair still violating
+        # (b_lo2 <= b_hi2 after correction would be an ASCENT step).
+        excl = sel_i | sel_j
+        f_up2 = jnp.where(excl, _INF, f_up)
+        f_low2 = jnp.where(excl, -_INF, f_low)
+        bh2s = jnp.min(f_up2)
+        bl2s = jnp.max(f_low2)
+        i2 = jnp.min(jnp.where(f_up2 == bh2s, lanes, _IMAX))
+        j2 = jnp.min(jnp.where(f_low2 == bl2s, lanes, _IMAX))
+        sel_i2 = lanes == i2
+        sel_j2 = lanes == j2
+        row_i2 = jnp.reshape(kb_ref[pl.ds(i2, 1)], (rows, 128))
+        row_j2 = jnp.reshape(kb_ref[pl.ds(j2, 1)], (rows, 128))
+        b_hi2 = _pick1(sel_i2, f)  # corrected: post-pair-1 gradient
+        b_lo2 = _pick1(sel_j2, f)
+        y_i2 = _pick1(sel_i2, y)
+        y_j2 = _pick1(sel_j2, y)
+        eta2 = jnp.maximum(
+            _pick1(sel_i2, kd) + _pick1(sel_j2, kd)
+            - 2.0 * _pick1(sel_j2, row_i2), tau)
+        a_i2_old = _pick1(sel_i2, alpha)
+        a_j2_old = _pick1(sel_j2, alpha)
+        t1 = t + jnp.int32(gap_open)
+        cnt2 = gap_open & (t1 < limit)
+        upd2 = (cnt2 & (bh2s < _INF) & (bl2s > -_INF)
+                & (b_lo2 > b_hi2))
+        c_i2 = cp if cp == cn else jnp.where(y_i2 > 0, cp, cn)
+        c_j2 = cp if cp == cn else jnp.where(y_j2 > 0, cp, cn)
+        a_i2_new, a_j2_new = pair_alpha_update(
+            a_i2_old, a_j2_old, y_i2, y_j2, b_hi2, b_lo2, eta2,
+            c_i2, c_j2, gate=upd2)
+        alpha = jnp.where(sel_i2, a_i2_new, alpha)
+        alpha = jnp.where(sel_j2, a_j2_new, alpha)
+        f = f + (a_i2_new - a_i2_old) * y_i2 * row_i2 \
+              + (a_j2_new - a_j2_old) * y_j2 * row_j2
+        return alpha, f, t1 + jnp.int32(cnt2), gap_open
 
     def cond(carry):
         _, _, t, gap_open = carry
@@ -191,10 +242,11 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("c", "eps", "tau", "rule", "interpret"))
+                   static_argnames=("c", "eps", "tau", "rule", "interpret",
+                                    "pair_batch"))
 def solve_subproblem_pallas(kb_w, alpha_w, y_w, f_w, kd_w, slot_ok, limit,
                             c, eps: float, tau: float, rule: str = "mvp",
-                            interpret: bool = False):
+                            interpret: bool = False, pair_batch: int = 1):
     """Solve the q-variable subproblem on-core.
 
     kb_w: (q, q) float32 Gram block; the five vectors are (q,) float32
@@ -202,8 +254,15 @@ def solve_subproblem_pallas(kb_w, alpha_w, y_w, f_w, kd_w, slot_ok, limit,
     scalar — per-round inner_iters already clamped to the remaining
     max_iter budget). Returns (alpha_w_new (q,), n_pairs int32).
     `rule` is the pairing rule ("mvp" | "second_order" | "nu" — see
-    solver/block.py _solve_subproblem).
+    solver/block.py _solve_subproblem). pair_batch=2 (mvp only) executes
+    a second coordinate-disjoint pair per while-loop trip — stale-selected,
+    exactly-updated (see the kernel comment) — trading one trip's serial
+    dependency chain for two counted pairs.
     """
+    if pair_batch not in (1, 2):
+        raise ValueError("pair_batch must be 1 or 2")
+    if pair_batch == 2 and rule != "mvp":
+        raise ValueError("pair_batch=2 is implemented for rule='mvp' only")
     cp, cn = split_c(c)
     q = kb_w.shape[0]
     # Pad the working set up to whole 128-lane rows and hand the kernel
@@ -224,7 +283,7 @@ def solve_subproblem_pallas(kb_w, alpha_w, y_w, f_w, kd_w, slot_ok, limit,
     kb_p = kb_w if not pad else jnp.pad(kb_w, ((0, pad), (0, pad)))
     kern = functools.partial(
         _subproblem_kernel, rows=rows, cp=float(cp), cn=float(cn),
-        eps=float(eps), tau=float(tau), rule=rule)
+        eps=float(eps), tau=float(tau), rule=rule, pair_batch=pair_batch)
     vec = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     alpha_out, t = pl.pallas_call(

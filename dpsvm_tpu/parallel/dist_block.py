@@ -143,7 +143,8 @@ def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
 
 def _mesh_round_core(x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
                      budget_left, kp, c, eps, tau, inner_iters: int,
-                     inner_impl: str, interpret: bool, selection: str):
+                     inner_impl: str, interpret: bool, selection: str,
+                     pair_batch: int = 1):
     """The shared mesh round step AFTER selection: working-set recovery
     (masked psum, or the symmetric local path for a precomputed Gram),
     the replicated (q, q) Gram block + subproblem solve (every device
@@ -187,11 +188,11 @@ def _mesh_round_core(x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
         alpha_w, t = solve_subproblem_pallas(
             kb_w, alpha_w0, y_w, f_w0, kd_w,
             slot_ok.astype(jnp.float32), limit, c, eps, tau,
-            rule=selection, interpret=interpret)
+            rule=selection, interpret=interpret, pair_batch=pair_batch)
     else:
         alpha_w, _, t = _solve_subproblem(
             kb_w, kd_w, slot_ok, alpha_w0, y_w, f_w0, c, eps, tau,
-            limit, rule=selection)
+            limit, rule=selection, pair_batch=pair_batch)
 
     coef = jnp.where(slot_ok, (alpha_w - alpha_w0) * y_w, 0.0)
     if kp.kind == "precomputed":
@@ -207,7 +208,8 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                             rounds_per_chunk: int, inner_impl: str = "xla",
                             interpret: bool = False,
                             selection: str = "mvp",
-                            compensated: bool = False):
+                            compensated: bool = False,
+                            pair_batch: int = 1):
     """Build the jitted shard_mapped block-round chunk executor.
     selection: "mvp" | "second_order" | "nu" (solver/block.py rules).
     compensated: carry a shard-local Kahan residual of f so the fold's
@@ -237,7 +239,7 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             alpha_w, coef, t, l, own, k_rows_loc = _mesh_round_core(
                 x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
                 max_iter - st.pairs, kp, c, eps, tau, inner_iters,
-                inner_impl, interpret, selection)
+                inner_impl, interpret, selection, pair_batch=pair_batch)
             # Fold: purely LOCAL (q, n_loc) kernel-row matmul (or, for
             # a precomputed Gram, the symmetric local column gather).
             f, f_err = maybe_kahan(st.f, st.f_err, coef @ k_rows_loc)
@@ -298,7 +300,8 @@ def make_block_fused_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                   inner_impl: str = "pallas",
                                   interpret: bool = False,
                                   selection: str = "mvp",
-                                  compensated: bool = False):
+                                  compensated: bool = False,
+                                  pair_batch: int = 1):
     """Fused-fold mesh block runner: each shard's fold and per-row
     candidate selection run as ONE Pallas pass over its f shard
     (ops/pallas_fold_select.py — the mesh counterpart of solver/block.py
@@ -347,7 +350,7 @@ def make_block_fused_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                 x_loc, x_sq_loc, scal_loc, w, slot_ok,
                 st.b_lo > st.b_hi + 2.0 * eps, max_iter - st.pairs,
                 kp, c, eps, tau, inner_iters, inner_impl, interpret,
-                selection)
+                selection, pair_batch=pair_batch)
             delta2d = (coef @ k_rows_loc).reshape(shp)
             # Scatter owned alpha BEFORE the fused pass (its masks must
             # see updated box membership).
@@ -396,7 +399,8 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                    inner_impl: str = "xla",
                                    interpret: bool = False,
                                    selection: str = "mvp",
-                                   compensated: bool = False):
+                                   compensated: bool = False,
+                                   pair_batch: int = 1):
     """Active-set ("shrinking") variant of make_block_chunk_runner — the
     mesh port of solver/block.py run_chunk_block_active (the layer the
     reference scales with MPI ranks, svmTrainMain.cpp:244). One CYCLE:
@@ -465,7 +469,7 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                     x_act, y_act, sq_act, kd_act, f_act, a_act, act_ok,
                     max_iter - st.pairs - t_tot,
                     kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
-                    selection)
+                    selection, pair_batch=pair_batch)
                 open_a = bl_a > bh_a + 2.0 * eps
                 k_rows_act = kernel_rows(x_act, sq_act, qx, qsq, kp)
                 f_act = f_act + coef @ k_rows_act

@@ -593,7 +593,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 float(config.tau), q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds), inner_impl,
                 selection=config.selection,
-                compensated=config.compensated)
+                compensated=config.compensated,
+                pair_batch=int(config.pair_batch))
         elif use_fused:
             from dpsvm_tpu.parallel.dist_block import (
                 make_block_fused_chunk_runner)
@@ -603,13 +604,15 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 float(config.tau), q, inner, rounds_per_chunk, inner_impl,
                 interpret=_platform != "tpu",
                 selection=config.selection,
-                compensated=config.compensated)
+                compensated=config.compensated,
+                pair_batch=int(config.pair_batch))
         else:
             run_chunk = make_block_chunk_runner(
                 mesh, kp, config.c_bounds(), eps_run,
                 float(config.tau), q, inner, rounds_per_chunk, inner_impl,
                 selection=config.selection,
-                compensated=config.compensated)
+                compensated=config.compensated,
+                pair_batch=int(config.pair_batch))
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
                            rounds=jax.device_put(jnp.int32(0), rep),

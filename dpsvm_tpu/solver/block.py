@@ -162,7 +162,8 @@ def combine_halves(up_idx, up_ok, low_idx, low_ok):
 
 
 def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
-                      eps: float, tau: float, limit, rule: str = "mvp"):
+                      eps: float, tau: float, limit, rule: str = "mvp",
+                      pair_batch: int = 1):
     """Exact SMO on the q-variable subproblem. All state is q-sized.
 
     kb_w: (q, q) Gram block K(w_i, w_j); kd_w: (q,) its diagonal. `limit`
@@ -181,6 +182,8 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
       "nu"           — per-class MVP (both pair members share a class;
                        the nu duals' two-equality-constraint rule).
     """
+    if pair_batch == 2 and rule != "mvp":
+        raise ValueError("pair_batch=2 is implemented for rule='mvp' only")
     cp, cn = split_c(c)
 
     def cond(carry):
@@ -252,7 +255,44 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
         alpha_w = jnp.where(lanes == j, a_j_new, alpha_w)
         f_w = f_w + (a_i_new - a_i_old) * y_i * row_i \
                   + (a_j_new - a_j_old) * y_j * row_j
-        return alpha_w, f_w, t + jnp.int32(gap_open), gap_open
+        if pair_batch == 1:
+            return alpha_w, f_w, t + jnp.int32(gap_open), gap_open
+
+        # pair_batch == 2 (mvp only): second coordinate-disjoint pair per
+        # trip — stale second-best SELECTION, exact UPDATE on the
+        # post-pair-1 state. Identical semantics to the Pallas kernel
+        # (ops/pallas_subproblem.py): attempted slots count even when the
+        # update gates to a no-op; the update gates on non-empty stale
+        # sets (the empty-set argmin aliases slot 0 — a wrong update, not
+        # a no-op) and on the corrected pair still violating.
+        excl = (lanes == i) | (lanes == j)
+        f_up2 = jnp.where(excl, jnp.inf, f_up)
+        f_low2 = jnp.where(excl, -jnp.inf, f_low)
+        i2 = jnp.argmin(f_up2).astype(jnp.int32)
+        j2 = jnp.argmax(f_low2).astype(jnp.int32)
+        bh2s = f_up2[i2]
+        bl2s = f_low2[j2]
+        row_i2 = lax.dynamic_index_in_dim(kb_w, i2, 0, keepdims=False)
+        row_j2 = lax.dynamic_index_in_dim(kb_w, j2, 0, keepdims=False)
+        b_hi2 = f_w[i2]  # corrected: post-pair-1 gradient
+        b_lo2 = f_w[j2]
+        y_i2 = y_w[i2]
+        y_j2 = y_w[j2]
+        eta2 = jnp.maximum(kd_w[i2] + kd_w[j2] - 2.0 * row_i2[j2], tau)
+        t1 = t + jnp.int32(gap_open)
+        cnt2 = gap_open & (t1 < limit)
+        upd2 = (cnt2 & (bh2s < jnp.inf) & (bl2s > -jnp.inf)
+                & (b_lo2 > b_hi2))
+        a_i2_old = alpha_w[i2]
+        a_j2_old = alpha_w[j2]
+        a_i2_new, a_j2_new = pair_alpha_update(
+            a_i2_old, a_j2_old, y_i2, y_j2, b_hi2, b_lo2, eta2,
+            c_of(y_i2, cp, cn), c_of(y_j2, cp, cn), gate=upd2)
+        alpha_w = jnp.where(lanes == i2, a_i2_new, alpha_w)
+        alpha_w = jnp.where(lanes == j2, a_j2_new, alpha_w)
+        f_w = f_w + (a_i2_new - a_i2_old) * y_i2 * row_i2 \
+                  + (a_j2_new - a_j2_old) * y_j2 * row_j2
+        return alpha_w, f_w, t1 + jnp.int32(cnt2), gap_open
 
     alpha_w, f_w, t, _ = lax.while_loop(
         cond, body, (alpha_w, f_w, jnp.int32(0), jnp.bool_(True)))
@@ -262,7 +302,8 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
 def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
                 kp: KernelParams, c, eps: float, tau: float,
                 q: int, inner_iters: int, inner_impl: str,
-                interpret: bool, selection: str, cand=None):
+                interpret: bool, selection: str, cand=None,
+                pair_batch: int = 1):
     """The shared block-round step: ONE selection pass (whose top-k values
     also carry the stopping extrema of the CURRENT f), working-set
     gathers, the (q, q) Gram block, the subproblem dispatch, and the fold
@@ -312,24 +353,27 @@ def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
 
         a_w, t = solve_subproblem_pallas(
             kb_w, a_w0, y_w, f_w0, kd_w, slot_ok.astype(jnp.float32),
-            limit, c, eps, tau, rule=selection, interpret=interpret)
+            limit, c, eps, tau, rule=selection, interpret=interpret,
+            pair_batch=pair_batch)
     else:
         a_w, _, t = _solve_subproblem(
             kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
-            limit, rule=selection)
+            limit, rule=selection, pair_batch=pair_batch)
     coef = jnp.where(slot_ok, (a_w - a_w0) * y_w, 0.0)  # (q,)
     return w, slot_ok, b_hi, b_lo, a_w, coef, t, qx, qsq
 
 
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
                                   "inner_iters", "rounds_per_chunk",
-                                  "inner_impl", "interpret", "selection"))
+                                  "inner_impl", "interpret", "selection",
+                                  "pair_batch"))
 def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
                     kp: KernelParams, c, eps: float, tau: float,
                     q: int, inner_iters: int, rounds_per_chunk: int,
                     inner_impl: str = "xla",
                     interpret: bool = False,
-                    selection: str = "mvp") -> BlockState:
+                    selection: str = "mvp",
+                    pair_batch: int = 1) -> BlockState:
     """Run up to `rounds_per_chunk` outer rounds fully on device.
 
     inner_impl: "xla" runs the subproblem as a lax.while_loop of XLA ops
@@ -351,7 +395,7 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
         w, slot_ok, b_hi, b_lo, alpha_w, coef, t, qx, qsq = _round_core(
             x, y, x_sq, k_diag, f_cur, st.alpha, None, max_iter - st.pairs,
             kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
-            selection)
+            selection, pair_batch=pair_batch)
         # Fold the round's alpha deltas into the global state with one
         # fused matmul chain over X (the single O(n d q) pass per round):
         # f += (dalpha * y)_W @ K(W, :), with K(W, :) from the same
@@ -372,14 +416,16 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
 
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
                                   "inner_iters", "rounds_per_chunk",
-                                  "inner_impl", "interpret", "selection"))
+                                  "inner_impl", "interpret", "selection",
+                                  "pair_batch"))
 def run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
                           max_iter, kp: KernelParams, c, eps: float,
                           tau: float, q: int, inner_iters: int,
                           rounds_per_chunk: int,
                           inner_impl: str = "pallas",
                           interpret: bool = False,
-                          selection: str = "mvp") -> BlockState:
+                          selection: str = "mvp",
+                          pair_batch: int = 1) -> BlockState:
     """Fused-fold variant of run_chunk_block: the round's fold and the
     NEXT round's selection run as ONE Pallas pass over f
     (ops/pallas_fold_select.py), eliminating the separate full-n
@@ -424,7 +470,7 @@ def run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
             x, y, x_sq, k_diag, eff_f(st), st.alpha, valid,
             max_iter - st.pairs, kp, c, eps, tau, q, inner_iters,
             inner_impl, interpret, selection,
-            cand=(w, slot_ok, st.b_hi, st.b_lo))
+            cand=(w, slot_ok, st.b_hi, st.b_lo), pair_batch=pair_batch)
         k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n_pad) fp32
         delta2d = (coef @ k_rows).reshape(shp)
         # Scatter alpha BEFORE the fused pass: its selection masks must
@@ -452,14 +498,16 @@ def run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
                                   "inner_iters", "rounds_per_chunk",
                                   "m", "k_rounds",
-                                  "inner_impl", "interpret", "selection"))
+                                  "inner_impl", "interpret", "selection",
+                                  "pair_batch"))
 def run_chunk_block_active(x, y, x_sq, k_diag, state: BlockState, max_iter,
                            kp: KernelParams, c, eps: float, tau: float,
                            q: int, inner_iters: int, rounds_per_chunk: int,
                            m: int, k_rounds: int,
                            inner_impl: str = "xla",
                            interpret: bool = False,
-                           selection: str = "mvp") -> BlockState:
+                           selection: str = "mvp",
+                           pair_batch: int = 1) -> BlockState:
     """Active-set ("shrinking") variant of run_chunk_block.
 
     LibSVM shrinks by dropping bound-saturated rows from its scans and
@@ -525,7 +573,7 @@ def run_chunk_block_active(x, y, x_sq, k_diag, state: BlockState, max_iter,
                 x_act, y_act, sq_act, kd_act, f_act, a_act, act_ok,
                 max_iter - st.pairs - t_tot,
                 kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
-                selection)
+                selection, pair_batch=pair_batch)
             open_a = bl_a > bh_a + 2.0 * eps
             k_rows_act = kernel_rows(x_act, sq_act, qx, qsq, kp)  # (q, m)
             f_act = f_act + coef @ k_rows_act

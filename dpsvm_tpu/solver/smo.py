@@ -829,7 +829,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds),
                 inner_impl="pallas" if not interpret else "xla",
-                selection=config.selection)
+                selection=config.selection,
+                pair_batch=int(config.pair_batch))
         elif use_block and use_fused:
             from dpsvm_tpu.solver.block import run_chunk_block_fused
 
@@ -839,14 +840,16 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 q, inner, rounds_per_chunk,
                 inner_impl="pallas" if not interpret else "xla",
                 interpret=interpret,
-                selection=config.selection)
+                selection=config.selection,
+                pair_batch=int(config.pair_batch))
         elif use_block:
             state = run_chunk_block(
                 x_dev, y_dev, x_sq, k_diag, state, max_iter,
                 kp, config.c_bounds(), eps_run, float(config.tau),
                 q, inner, rounds_per_chunk,
                 inner_impl="pallas" if not interpret else "xla",
-                selection=config.selection)
+                selection=config.selection,
+                pair_batch=int(config.pair_batch))
         else:
             state = _run_chunk(x_dev, y_dev, x_sq, k_diag, None, state, max_iter,
                                kp, config.c_bounds(), eps_run,

@@ -1,0 +1,146 @@
+"""pair_batch=2: batched disjoint-pair subproblem steps.
+
+Contracts (see SVMConfig.pair_batch and ops/pallas_subproblem.py): same
+fixed point as pair_batch=1 (every batched slot is an exact descent step
+on a violating pair, so the standard decomposition convergence argument
+is unchanged), exact dual feasibility, deterministic budget accounting
+(attempted second slots count even when gated to no-ops — the
+second_order counted-no-op precedent), and Pallas/XLA implementation
+parity. Trajectories are NOT comparable to pair_batch=1 (the pair
+sequence differs by construction).
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
+from dpsvm_tpu.solver.smo import solve
+
+CFG = SVMConfig(c=10.0, gamma=0.15, epsilon=1e-3, max_iter=200_000,
+                engine="block", working_set_size=64, pair_batch=2)
+
+
+def dual_objective(x, y, alpha, kp):
+    K = np.asarray(kernel_matrix(x, x, kp))
+    ay = alpha * y
+    return alpha.sum() - 0.5 * ay @ K @ ay
+
+
+def test_same_optimum_as_single_pair(blobs_medium):
+    x, y = blobs_medium
+    kp = KernelParams("rbf", CFG.gamma)
+    r1 = solve(x, y, CFG.replace(pair_batch=1))
+    r2 = solve(x, y, CFG)
+    assert r2.converged
+    obj1 = dual_objective(x, y, r1.alpha, kp)
+    obj2 = dual_objective(x, y, r2.alpha, kp)
+    assert obj2 == pytest.approx(obj1, rel=1e-4)
+    assert r2.b == pytest.approx(r1.b, abs=5e-3)
+    assert abs(r2.n_sv - r1.n_sv) <= max(3, 0.02 * r1.n_sv)
+
+
+def test_feasibility_and_conservation(blobs_medium):
+    x, y = blobs_medium
+    cfg = CFG.replace(weight_pos=1.5, weight_neg=0.75)
+    r = solve(x, y, cfg)
+    assert r.converged
+    a = np.asarray(r.alpha)
+    c_i = np.where(y > 0, cfg.c * cfg.weight_pos, cfg.c * cfg.weight_neg)
+    assert a.min() >= 0.0
+    assert np.all(a <= c_i + 1e-5)
+    # The pair algebra conserves sum alpha_i y_i exactly per update.
+    assert abs(float(np.dot(a, y))) < 1e-3
+
+
+@pytest.mark.parametrize("budget", [999, 12344, 12345])
+def test_budget_mode_exact_pair_count(blobs_small, budget):
+    """Odd budgets exercise the second-slot (t1 < limit) gate: the batch
+    must stop at exactly the budget, never one past it."""
+    x, y = blobs_small
+    r = solve(x, y, CFG.replace(budget_mode=True, max_iter=budget))
+    assert int(r.iterations) == budget
+
+
+def test_pallas_xla_subproblem_parity():
+    """The interpret-mode Pallas kernel and the XLA while_loop implement
+    the SAME batched semantics: identical pair counts and alphas on a
+    random subproblem driven to its local optimum."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+    from dpsvm_tpu.solver.block import _solve_subproblem
+
+    rng = np.random.default_rng(0)
+    q, c = 64, 4.0
+    g = rng.normal(size=(q, 18)).astype(np.float32)
+    kb = np.exp(-0.1 * ((g[:, None] - g[None, :]) ** 2).sum(-1))
+    kd = np.ones(q, np.float32)
+    y = np.where(rng.random(q) > 0.5, 1.0, -1.0).astype(np.float32)
+    alpha = np.clip(rng.normal(1.0, 1.0, q), 0, c).astype(np.float32)
+    K = kb * 1.0
+    f = ((alpha * y) @ K - y).astype(np.float32)
+    ok = np.ones(q, np.float32)
+    ok[-5:] = 0.0  # dead filler slots must stay untouched
+    args = (jnp.asarray(kb, jnp.float32), jnp.asarray(alpha),
+            jnp.asarray(y), jnp.asarray(f), jnp.asarray(kd),
+            jnp.asarray(ok), jnp.int32(5000))
+    a_p, t_p = solve_subproblem_pallas(*args, c, 1e-3, 1e-12, rule="mvp",
+                                       interpret=True, pair_batch=2)
+    a_x, _, t_x = _solve_subproblem(
+        args[0], args[4], args[5] > 0, args[1], args[2], args[3], c,
+        1e-3, 1e-12, args[6], rule="mvp", pair_batch=2)
+    assert int(t_p) == int(t_x)
+    np.testing.assert_allclose(np.asarray(a_p), np.asarray(a_x),
+                               rtol=1e-5, atol=1e-6)
+    # Dead slots: exact no-touch.
+    np.testing.assert_array_equal(np.asarray(a_p)[-5:], alpha[-5:])
+
+
+def test_second_slot_progress(blobs_small):
+    """The batch must actually converge in fewer inner trips than it
+    counts pairs: with pair_batch=2 a converged solve's pair count stays
+    within ~2x of the single-pair count (it would blow past it if the
+    second slot did junk updates that undo progress)."""
+    x, y = blobs_small
+    r1 = solve(x, y, CFG.replace(pair_batch=1))
+    r2 = solve(x, y, CFG)
+    assert r2.converged
+    assert int(r2.iterations) <= 2.5 * int(r1.iterations)
+
+
+def test_mesh_pair_batch(blobs_small):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    kp = KernelParams("rbf", CFG.gamma)
+    r1 = solve(x, y, CFG)
+    rm = solve_mesh(x, y, CFG, num_devices=8)
+    assert rm.converged
+    obj1 = dual_objective(x, y, r1.alpha, kp)
+    objm = dual_objective(x, y, rm.alpha, kp)
+    assert objm == pytest.approx(obj1, rel=1e-4)
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        SVMConfig(pair_batch=3)
+    with pytest.raises(ValueError):
+        SVMConfig(engine="xla", pair_batch=2)
+    with pytest.raises(ValueError):
+        SVMConfig(engine="block", selection="second_order", pair_batch=2)
+    # fused-fold + active-set compositions stay legal (pair_batch lives
+    # inside the shared subproblem, below both).
+    SVMConfig(engine="block", pair_batch=2, active_set_size=256)
+    SVMConfig(engine="block", pair_batch=2, fused_fold=True)
+
+
+def test_active_set_pair_batch(blobs_medium):
+    x, y = blobs_medium
+    kp = KernelParams("rbf", CFG.gamma)
+    r1 = solve(x, y, CFG.replace(pair_batch=1))
+    ra = solve(x, y, CFG.replace(active_set_size=256))
+    assert ra.converged
+    obj1 = dual_objective(x, y, r1.alpha, kp)
+    obja = dual_objective(x, y, ra.alpha, kp)
+    assert obja == pytest.approx(obj1, rel=1e-4)

@@ -64,19 +64,21 @@ def main() -> int:
             a_w = jnp.asarray(alpha[w_np])
             y_w = jnp.asarray(y[w_np].astype(np.float32))
             f_w = jnp.asarray(f[w_np])
-            a_x, _, t_x = _solve_subproblem(
-                kb_w, kd_w, ok, a_w, y_w, f_w, cfg.c, cfg.epsilon,
-                cfg.tau, jnp.int32(64), rule=rule)
-            a_p, t_p = solve_subproblem_pallas(
-                kb_w, a_w, y_w, f_w, kd_w, ok.astype(jnp.float32),
-                jnp.int32(64), cfg.c, cfg.epsilon, cfg.tau, rule=rule)
-            same_t = int(t_x) == int(t_p)
-            close = np.allclose(np.asarray(a_x), np.asarray(a_p),
-                                rtol=1e-5, atol=1e-6)
-            status = "OK" if (same_t and close) else "FAIL"
-            failures += status == "FAIL"
-            print(f"subproblem rule={rule:13s} q={q:4d} pairs={int(t_p):3d} "
-                  f"{status}")
+            for pb in ((1, 2) if rule == "mvp" else (1,)):
+                a_x, _, t_x = _solve_subproblem(
+                    kb_w, kd_w, ok, a_w, y_w, f_w, cfg.c, cfg.epsilon,
+                    cfg.tau, jnp.int32(64), rule=rule, pair_batch=pb)
+                a_p, t_p = solve_subproblem_pallas(
+                    kb_w, a_w, y_w, f_w, kd_w, ok.astype(jnp.float32),
+                    jnp.int32(64), cfg.c, cfg.epsilon, cfg.tau, rule=rule,
+                    pair_batch=pb)
+                same_t = int(t_x) == int(t_p)
+                close = np.allclose(np.asarray(a_x), np.asarray(a_p),
+                                    rtol=1e-5, atol=1e-6)
+                status = "OK" if (same_t and close) else "FAIL"
+                failures += status == "FAIL"
+                print(f"subproblem rule={rule:13s} q={q:4d} pb={pb} "
+                      f"pairs={int(t_p):3d} {status}")
 
     # End-to-end block solves on device (inner_impl='pallas' path).
     r_ref = solve(x, y, cfg)
@@ -88,6 +90,13 @@ def main() -> int:
         failures += status == "FAIL"
         print(f"block-engine selection={rule:13s} pairs={r.iterations} "
               f"|b-b_ref|={db:.4f} {status}")
+    r2 = solve(x, y, cfg.replace(engine="block", working_set_size=40,
+                                 pair_batch=2))
+    db2 = abs(r2.b - r_ref.b)
+    status = "OK" if (r2.converged and db2 < 5e-2) else "FAIL"
+    failures += status == "FAIL"
+    print(f"block-engine pair_batch=2    pairs={r2.iterations} "
+          f"|b-b_ref|={db2:.4f} {status}")
     from dpsvm_tpu.models.nusvm import train_nusvc
 
     m1, _ = train_nusvc(x, y, nu=0.3, config=cfg)
