@@ -68,21 +68,32 @@ def sweep(x, y, base, budget: int):
 
     grid = []
     for sel in ("mvp", "second_order"):
+        # pair_batch is mvp-only; second_order rows run single-pair. An
+        # explicit mvp/pb1 row keeps the batching win visible in the
+        # ranking instead of baked invisibly into every mvp row.
+        pb = base.pair_batch if sel == "mvp" else 1
         for q, inner in ((512, 2048), (512, 4096), (512, 16384),
                          (1024, 4096), (1024, 8192)):
             grid.append(base.replace(selection=sel, working_set_size=q,
-                                     inner_iters=inner))
+                                     inner_iters=inner, pair_batch=pb))
         # Shrinking rows (PROFILE.md: the fixed cost is the bottleneck;
         # shrinking divides its O(n) terms by n/m for k_rounds per cycle).
         grid.append(base.replace(selection=sel, working_set_size=512,
                                  inner_iters=2048, active_set_size=65536,
-                                 reconcile_rounds=8))
+                                 reconcile_rounds=8, pair_batch=pb))
+    if base.pair_batch != 1:
+        # Explicit single-pair control row so the batching win stays
+        # visible in the ranking (skipped if base already runs pb1,
+        # which would duplicate a loop row above).
+        grid.append(base.replace(selection="mvp", working_set_size=512,
+                                 inner_iters=16384, pair_batch=1))
     ladder = [budget // 5, 2 * budget // 5, budget]
     results = []  # (label, cfg, points=[(pairs, gap, dev_s), ...])
     for cfg in grid:
         label = (f"{cfg.selection}/q{cfg.working_set_size}"
                  f"/i{cfg.inner_iters}"
-                 + (f"/m{cfg.active_set_size}" if cfg.active_set_size else ""))
+                 + (f"/m{cfg.active_set_size}" if cfg.active_set_size else "")
+                 + f"/pb{cfg.pair_batch}")
         solve(x, y, cfg.replace(max_iter=64))  # compile (same executor)
         points = []
         for b in ladder:
@@ -142,12 +153,14 @@ def main() -> int:
 
     x, y = make_data()
 
-    # Operating point from the --sweep ranking (2026-07-30): mvp with a
-    # large inner budget amortizes the fixed ~0.74 ms round cost
-    # (PROFILE.md) over every pair the working set can absorb (i8192 and
-    # i16384 measure identically — the subproblem exits when the local
-    # gap closes, ~4-8k useful pairs per q=512 set — so the budget is a
-    # ceiling, not a forcing). WSS2 measured SLOWER at equal quality on
+    # Operating point from the --sweep ranking (2026-07-30, re-ranked
+    # 2026-07-31 with pair_batch): mvp with a large inner budget
+    # amortizes the fixed round cost (PROFILE.md) over every pair the
+    # working set can absorb; the subproblem exits when the local gap
+    # closes (~1.3k useful pairs per q=512 set at this extreme-C shape,
+    # PROFILE.md round-4 section), so the budget is a ceiling, not a
+    # forcing, and i2048-i16384 rank within drift of each other.
+    # WSS2 measured SLOWER at equal quality on
     # both this shape and adult-shape (the block engine's pair
     # redundancy comes from working-set restriction, not partner choice
     # within W; see BENCH_COVTYPE_SWEEP.md) — defaults stay mvp.

@@ -65,12 +65,15 @@ DATASETS = {
                           max_iter=2_000_000), None),
 }
 CASES = [
-    # (engine, backend, platform-child)
+    # (engine, backend, platform-child); "-pb2" suffix = pair_batch=2
+    # (the batched disjoint-pair subproblem steps, SVMConfig.pair_batch)
     ("xla", "single", "tpu"),
     ("pallas", "single", "tpu"),
     ("block", "single", "tpu"),
+    ("block-pb2", "single", "tpu"),
     ("xla", "mesh8", "cpu"),
     ("block", "mesh8", "cpu"),
+    ("block-pb2", "mesh8", "cpu"),
 ]
 
 
@@ -100,7 +103,10 @@ def child_main(args) -> int:
 
     for case in args.cases.split(","):
         engine, backend = case.split("/")
-        cfg = SVMConfig(engine=engine, **cfg_kw)
+        pb = 1
+        if engine.endswith("-pb2"):
+            engine, pb = engine[:-4], 2
+        cfg = SVMConfig(engine=engine, pair_batch=pb, **cfg_kw)
         t0 = time.perf_counter()
         if backend == "mesh8":
             res = solve_mesh(x, y, cfg, num_devices=8)
@@ -110,8 +116,10 @@ def child_main(args) -> int:
         kp = KernelParams("rbf", cfg.resolve_gamma(x.shape[1]))
         model = SVMModel.from_dense(x, y, res.alpha, res.b, kp)
         dec = decision_function(model, x)
+        # Filename keyed by the CASE label, not the stripped engine —
+        # block and block-pb2 must not overwrite each other's artifacts.
         out = os.path.join(args.outdir,
-                           f"{args.name}_{engine}_{backend}.npz")
+                           f"{args.name}_{case.replace('/', '_')}.npz")
         np.savez(out, dec=dec, alpha=res.alpha)
         print(json.dumps({
             "case": case, "dataset": args.name,
