@@ -315,8 +315,19 @@ def _write_md(path: str, rows: list, quick: bool, full: bool = False) -> None:
                     f"{r['iterations']} | {r['device_seconds']} | "
                     f"{'OK' if r['ok'] else '**FAIL**'} |")
             lines.append("")
-    with open(path, "w") as fh:
+    # Preserve the sections other harnesses maintain surgically
+    # (tools/parity60k.py's full-scale section, tools/parity_covtype.py's
+    # covtype section — both use parity_common.replace_section): a
+    # mid-scale refresh must never clobber their measured artifacts.
+    from tools.parity_common import preserved_tail
+
+    keep = preserved_tail(open(path).read()) if os.path.exists(path) else ""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         fh.write("\n".join(lines))
+        if keep:
+            fh.write("\n" + keep)
+    os.replace(tmp, path)
 
 
 if __name__ == "__main__":

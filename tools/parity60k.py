@@ -31,12 +31,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tools.parity_common import merged_sv as merged_sv_xy
-from tools.parity_common import replace_section
+from tools.parity_common import SECTION_60K, replace_section
 
 SV_TOL = 0.01
 SIGN_TOL = 0.998
-SECTION = ("## mnist-shaped / full-scale "
-           "(n=60000, achieved KKT gap 1e-3; SV parity asserted)")
+SECTION = SECTION_60K
 # epsilon is HALF the oracle's tol: LibSVM stops when its KKT gap drops
 # below tol, while this framework inherits the reference's stopping rule
 # b_lo > b_hi + 2*eps (svmTrainMain.cpp:310), which stops at gap <= 2*eps.

@@ -32,3 +32,23 @@ def replace_section(path: str, section: str, lines: list) -> None:
         text = head.rstrip("\n") + ("\n\n## " + tail[1].lstrip("\n")
                                      if len(tail) > 1 else "")
     open(path, "w").write(text.rstrip("\n") + "\n\n" + "\n".join(lines))
+
+# The section headings of the surgically-maintained PARITY.md sections
+# (tools/parity60k.py, tools/parity_covtype.py import these; the
+# mid-scale rewriter tools/parity.py preserves everything from the
+# earliest of them). ONE source of truth: a rename here keeps writer and
+# preserver in sync — a drifted hardcoded copy would let a mid-scale
+# refresh silently delete the measured full-scale/covtype artifacts.
+SECTION_60K = ("## mnist-shaped / full-scale "
+               "(n=60000, achieved KKT gap 1e-3; SV parity asserted)")
+SECTION_COVTYPE = ("## covtype-shaped / subsampled "
+                   "(achieved KKT gap 1e-3; SV parity asserted)")
+
+
+def preserved_tail(text: str) -> str:
+    """The trailing part of PARITY.md owned by the surgical writers
+    (everything from the earliest preserved heading), or ""."""
+    cuts = [i for i in (text.find(SECTION_60K.split(" (")[0]),
+                        text.find(SECTION_COVTYPE.split(" (")[0]))
+            if i >= 0]
+    return text[min(cuts):] if cuts else ""

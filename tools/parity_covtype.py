@@ -37,13 +37,13 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tools.parity_common import merged_sv, replace_section
+from tools.parity_common import (SECTION_COVTYPE, merged_sv,
+                                 replace_section)
 
 SV_TOL = 0.01
 SIGN_TOL = 0.998
 C, GAMMA, TOL = 2048.0, 0.03125, 1e-3
-SECTION = ("## covtype-shaped / subsampled "
-           "(achieved KKT gap 1e-3; SV parity asserted)")
+SECTION = SECTION_COVTYPE
 
 
 def make_data(n: int):
