@@ -48,7 +48,12 @@ SECTION_COVTYPE = ("## covtype-shaped / subsampled "
 def preserved_tail(text: str) -> str:
     """The trailing part of PARITY.md owned by the surgical writers
     (everything from the earliest preserved heading), or ""."""
-    cuts = [i for i in (text.find(SECTION_60K.split(" (")[0]),
-                        text.find(SECTION_COVTYPE.split(" (")[0]))
-            if i >= 0]
+    cuts = []
+    for sec in (SECTION_60K, SECTION_COVTYPE):
+        prefix = sec.split(" (")[0]
+        if text.startswith(prefix):
+            cuts.append(0)
+        i = text.find("\n" + prefix)  # line-anchored: a prose mention of
+        if i >= 0:                     # the heading must not become a cut
+            cuts.append(i + 1)
     return text[min(cuts):] if cuts else ""
