@@ -49,12 +49,19 @@ def _random_config(rng):
     if rng.random() < 0.3:
         kw["weight_pos"] = float(10.0 ** rng.uniform(-0.5, 0.5))
         kw["weight_neg"] = float(10.0 ** rng.uniform(-0.5, 0.5))
-    mode = rng.integers(3)
+    mode = rng.integers(4)
     if mode == 1:
         kw["engine"] = "block"
         kw["working_set_size"] = int(rng.choice([8, 16, 64]))
     elif mode == 2:
         kw["selection"] = "second_order"
+    elif mode == 3:
+        # Batched disjoint-pair subproblem steps (SVMConfig.pair_batch):
+        # the same contracts must hold when two exact pair updates
+        # retire per inner trip.
+        kw["engine"] = "block"
+        kw["working_set_size"] = int(rng.choice([8, 16, 64]))
+        kw["pair_batch"] = 2
     if rng.random() < 0.3:
         kw["cache_lines"] = int(rng.integers(4, 64))
     return SVMConfig(**kw)
