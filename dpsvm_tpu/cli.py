@@ -139,6 +139,12 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                    help="write per-chunk metrics records to this JSONL file")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace into this directory")
+    p.add_argument("-v", "--cross-validate", type=int, default=0,
+                   metavar="N",
+                   help="LibSVM svm-train -v: N-fold cross-validation "
+                        "(N >= 2) — prints held-out accuracy "
+                        "(classifiers) or MSE + squared correlation "
+                        "(SVR) and writes NO model file")
     p.add_argument("-q", "--quiet", action="store_true")
     return p
 
@@ -309,6 +315,9 @@ def _cmd_train(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    if args.cross_validate:
+        return _cross_validate(args, x, y, config)
+
     if args.kernel == "precomputed":
         return _train_precomputed(args, x, y, config)
 
@@ -408,6 +417,147 @@ def _cmd_train(args) -> int:
         print(f"note: {args.svm_type} models use the .npz format")
     model.save(args.model)
     print(f"model saved to {args.model}")
+    return 0
+
+
+def _fold_fit_factory(args, config):
+    """One fold-refit closure per svm_type — the family dispatch shared
+    by -v cross-validation (and mirroring the -b Platt refit shim).
+    Folds deliberately run without callbacks/checkpoints: a fold is a
+    throwaway refit, not a resumable training run."""
+    from dpsvm_tpu.train import train
+
+    if args.svm_type == "c-svc":
+        def fit(xf, yf):
+            return train(xf, yf, config, backend=args.backend,
+                         num_devices=args.num_devices)[0]
+    elif args.svm_type == "nu-svc":
+        from dpsvm_tpu.models.nusvm import train_nusvc
+
+        def fit(xf, yf):
+            return train_nusvc(xf, yf, nu=args.nu, config=config,
+                               backend=args.backend,
+                               num_devices=args.num_devices)[0]
+    elif args.svm_type == "eps-svr":
+        from dpsvm_tpu.models.svr import train_svr
+
+        def fit(xf, yf):
+            return train_svr(xf, yf, config,
+                             svr_epsilon=args.svr_epsilon,
+                             backend=args.backend,
+                             num_devices=args.num_devices)[0]
+    else:  # nu-svr
+        from dpsvm_tpu.models.nusvm import train_nusvr
+
+        def fit(xf, yf):
+            return train_nusvr(xf, yf, nu=args.nu, config=config,
+                               backend=args.backend,
+                               num_devices=args.num_devices)[0]
+    return fit
+
+
+def _fold_split(y, k: int, seed: int = 0, stratify: bool = False):
+    """Deterministic k-fold index split; stratify=True spreads each class
+    proportionally across folds (svm-train stratifies its -v folds for
+    classification — unstratified folds on imbalanced data can lose a
+    class from a training complement and are not comparable to LibSVM's
+    numbers)."""
+    rng = np.random.default_rng(seed)
+    if not stratify:
+        return np.array_split(rng.permutation(len(y)), k)
+    parts = [[] for _ in range(k)]
+    for cls in np.unique(y):
+        idx = rng.permutation(np.nonzero(y == cls)[0])
+        for i, p in enumerate(np.array_split(idx, k)):
+            if p.size:
+                parts[i].append(p)
+    return [rng.permutation(np.concatenate(p)) if p
+            else np.empty(0, np.int64) for p in parts]
+
+
+def _cross_validate(args, x, y, config) -> int:
+    """LibSVM svm-train -v: N-fold cross-validation. Each fold refits the
+    requested model family on the other folds and scores the held fold;
+    prints LibSVM's own output lines (Cross Validation Accuracy for
+    classifiers, Mean squared error + Squared correlation coefficient
+    for SVR) and writes NO model file. Classification folds are
+    STRATIFIED, like svm-train's. Deterministic folds (seed 0, like the
+    -b Platt calibration refits).
+    """
+    k = args.cross_validate
+    if k < 2:
+        print("error: -v requires N >= 2 folds", file=sys.stderr)
+        return 2
+    if args.svm_type == "one-class":
+        print("error: -v cross-validation is not defined for one-class "
+              "(no held-out labels to score)", file=sys.stderr)
+        return 2
+    if args.kernel == "precomputed":
+        print("error: -v does not compose with --kernel precomputed "
+              "(folds would need per-fold Gram sub-matrices; precompute "
+              "per-fold Grams and run them separately)", file=sys.stderr)
+        return 2
+    if len(y) < k:
+        print(f"error: -v {k} needs at least {k} rows", file=sys.stderr)
+        return 2
+    # Flags that -v cannot honor must fail loudly, never be silently
+    # dropped (this file's -b/-o convention): -v trains throwaway fold
+    # models, so probability calibration, checkpointing and per-chunk
+    # metrics have nothing durable to attach to.
+    ignored = [flag for flag, val in (
+        ("-b 1", args.probability), ("--checkpoint", args.checkpoint),
+        ("--resume", args.resume),
+        ("--metrics-jsonl", args.metrics_jsonl),
+        ("--profile-dir", args.profile_dir)) if val]
+    if ignored:
+        print(f"error: -v does not compose with {', '.join(ignored)} "
+              "(fold refits are throwaway models; run a plain train for "
+              "those)", file=sys.stderr)
+        return 2
+
+    fit = _fold_fit_factory(args, config)
+    classify = args.svm_type in ("c-svc", "nu-svc")
+    folds = _fold_split(y, k, seed=0, stratify=classify)
+    # Validate EVERY training complement up front — no wall-clock spent
+    # before a doomed fold is discovered (possible only when a class has
+    # a single member, given the stratified split).
+    if classify:
+        for i, held in enumerate(folds):
+            tr_mask = np.ones(len(y), bool)
+            tr_mask[held] = False
+            if len(np.unique(y[tr_mask])) < 2:
+                print(f"error: fold {i} would lose a class (a class has "
+                      "too few members); lower -v or provide more data",
+                      file=sys.stderr)
+                return 2
+    pred = np.empty(len(y), np.float64)
+    t0 = time.perf_counter()
+    for i, held in enumerate(folds):
+        tr = np.concatenate([f for j, f in enumerate(folds) if j != i])
+        model = fit(x[tr], y[tr])
+        if classify:
+            from dpsvm_tpu.predict import predict as predict_cls
+            pred[held] = np.asarray(predict_cls(model, x[held]), np.float64)
+        else:
+            pred[held] = np.asarray(model.predict(x[held]), np.float64)
+        if not args.quiet:
+            print(f"  fold {i + 1}/{k}: trained on {len(tr)}, "
+                  f"scored {len(held)}", file=sys.stderr)
+    wall = time.perf_counter() - t0
+    if classify:
+        acc = float(np.mean(pred == y))
+        print(f"Cross Validation Accuracy = {100.0 * acc:g}%")
+    else:
+        z = np.asarray(y, np.float64)
+        mse = float(np.mean((pred - z) ** 2))
+        vp, vz = pred - pred.mean(), z - z.mean()
+        denom = float(np.sum(vp ** 2) * np.sum(vz ** 2))
+        r2 = float(np.sum(vp * vz) ** 2 / denom) if denom > 0 else 0.0
+        print(f"Cross Validation Mean squared error = {mse:g}")
+        print(f"Cross Validation Squared correlation coefficient = {r2:g}")
+    if not args.quiet:
+        print(f"({k}-fold over {len(y)} rows in {wall:.2f}s; no model "
+              "file written — LibSVM -v contract)", file=sys.stderr)
     return 0
 
 

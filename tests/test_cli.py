@@ -452,3 +452,75 @@ def test_svr_oneclass_output_flags(capsys, tmp_path):
     rc = main(["test", "-f", svr_train, "-m", svr_model, "-b", "1"])
     assert rc == 2
     assert "not applicable" in capsys.readouterr().err
+
+
+def test_cross_validation_classifier(csvs, capsys):
+    """LibSVM svm-train -v: held-out accuracy line, no model written."""
+    train_p, _, d = csvs
+    model_p = d + "/cv_model.txt"
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g",
+               "0.1", "--backend", "single", "-q", "-v", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Cross Validation Accuracy = " in out
+    acc = float(out.split("Cross Validation Accuracy = ")[1].split("%")[0])
+    assert acc > 85.0
+    import os
+    assert not os.path.exists(model_p)  # -v writes no model (LibSVM)
+
+
+def test_cross_validation_svr(tmp_path, capsys):
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(240, 6)).astype(np.float32)
+    z = (x @ rng.normal(size=6) + 0.05 * rng.normal(size=240)).astype(
+        np.float32)
+    train_p = str(tmp_path / "svr.csv")
+    save_csv(train_p, x, z)
+    rc = main(["train", "-f", train_p, "-m", str(tmp_path / "m.npz"),
+               "-t", "eps-svr", "-c", "10", "--kernel", "linear",
+               "--backend", "single", "-q", "-v", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Cross Validation Mean squared error = " in out
+    assert "Cross Validation Squared correlation coefficient = " in out
+    r2 = float(out.split("coefficient = ")[1].split()[0])
+    assert r2 > 0.9
+
+
+def test_cross_validation_errors(csvs, capsys):
+    train_p, _, d = csvs
+    assert main(["train", "-f", train_p, "-m", d + "/x.txt", "-q",
+                 "-v", "1"]) == 2
+    assert main(["train", "-f", train_p, "-m", d + "/x.npz", "-q",
+                 "-t", "one-class", "-v", "3"]) == 2
+
+
+def test_cross_validation_stratified_imbalanced(tmp_path, capsys):
+    """svm-train stratifies -v folds: a 12-positive/288-negative set must
+    complete 5-fold CV (unstratified random folds could drop all
+    positives from a training complement)."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(300, 8)).astype(np.float32)
+    y = np.full(300, -1, np.int32)
+    y[:12] = 1
+    x[y > 0] += 3.0
+    p = str(tmp_path / "imb.csv")
+    save_csv(p, x, y)
+    rc = main(["train", "-f", p, "-m", str(tmp_path / "m.txt"), "-c", "5",
+               "-g", "0.2", "--backend", "single", "-q", "-v", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    acc = float(out.split("Cross Validation Accuracy = ")[1].split("%")[0])
+    assert acc > 90.0
+
+
+def test_cross_validation_conflicting_flags(csvs, capsys):
+    """-v must fail loudly on flags it cannot honor, never drop them."""
+    train_p, _, d = csvs
+    rc = main(["train", "-f", train_p, "-m", d + "/x.npz", "-q",
+               "-v", "3", "-b", "1"])
+    assert rc == 2
+    assert "does not compose" in capsys.readouterr().err
+    rc = main(["train", "-f", train_p, "-m", d + "/x.txt", "-q",
+               "-v", "3", "--checkpoint", d + "/ck.npz", "--resume"])
+    assert rc == 2
