@@ -102,6 +102,12 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "training decision values after training (LibSVM "
                         "-b; c-svc/nu-svc only; the model saves as .npz "
                         "— the reference text format cannot carry it)")
+    p.add_argument("--multiclass", choices=["ovr", "ovo"], default="ovr",
+                   help="reduction for >2-class (or non-±1-labelled) "
+                        "training files: one-vs-rest (k models) or "
+                        "LibSVM-style one-vs-one pairwise voting "
+                        "(k(k-1)/2 models); c-svc only, model saves as "
+                        ".npz")
     p.add_argument("-w1", "--weight-pos", type=float, default=1.0,
                    help="C multiplier for the +1 class (LibSVM -w1)")
     p.add_argument("-w-1", "--weight-neg", type=float, default=1.0,
@@ -315,6 +321,19 @@ def _cmd_train(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    # Non-±1 classification labels route through the OvR/OvO reduction
+    # (LibSVM's svm-train trains arbitrary-labelled multiclass files the
+    # same transparent way; the reference is binary-only). Two arbitrary
+    # labels also route here: the model must predict the ORIGINAL labels.
+    if args.svm_type in ("c-svc", "nu-svc") and not regression:
+        classes = np.unique(y)
+        if len(classes) < 2:
+            print("error: training data holds a single class",
+                  file=sys.stderr)
+            return 2
+        if not set(classes.tolist()) <= {-1, 1}:
+            return _train_multiclass_cli(args, x, y, config)
+
     if args.cross_validate:
         return _cross_validate(args, x, y, config)
 
@@ -415,6 +434,64 @@ def _cmd_train(args) -> int:
             and not args.model.endswith(".npz"):
         args.model += ".npz"
         print(f"note: {args.svm_type} models use the .npz format")
+    model.save(args.model)
+    print(f"model saved to {args.model}")
+    return 0
+
+
+def _train_multiclass_cli(args, x, y, config) -> int:
+    """Train a >2-class (or non-±1-labelled) file via the OvR/OvO
+    reduction (models/multiclass.py) and save the .npz bundle the test
+    command dispatches on. LibSVM's svm-train handles such files the
+    same transparent way (one-vs-one); the reference is binary-only."""
+    classes = np.unique(y)
+    blockers = [
+        ("-t nu-svc", args.svm_type != "c-svc"),
+        ("-b 1", bool(args.probability)),
+        ("-v", bool(args.cross_validate)),
+        ("--kernel precomputed", args.kernel == "precomputed"),
+        ("--checkpoint/--resume", bool(args.checkpoint or args.resume)),
+        ("--metrics-jsonl", bool(args.metrics_jsonl)),
+        ("--profile-dir", bool(args.profile_dir)),
+        # -w1/-w-1 would apply to a DIFFERENT original class in every
+        # OvR/OvO submodel (the +-1 remapping rotates) — scrambled
+        # semantics, so refuse rather than silently mis-weight.
+        ("-w1/-w-1", args.weight_pos != 1.0 or args.weight_neg != 1.0),
+    ]
+    bad = [f for f, hit in blockers if hit]
+    if bad:
+        print(f"error: multiclass training ({len(classes)} labels "
+              f"{classes.tolist()[:6]}{'...' if len(classes) > 6 else ''}) "
+              f"does not compose with {', '.join(bad)}; it trains plain "
+              "binary C-SVC submodels", file=sys.stderr)
+        return 2
+    from dpsvm_tpu.models.multiclass import train_multiclass
+
+    if not args.quiet:
+        k = len(classes)
+        if k == 2:
+            # train_multiclass collapses 2 classes to the single ovo
+            # pair regardless of the requested strategy.
+            plan = "1 binary submodel (2 non-±1 labels)"
+        else:
+            n_models = k if args.multiclass == "ovr" else k * (k - 1) // 2
+            plan = f"{n_models} {args.multiclass} binary submodels"
+        print(f"multiclass: {k} classes -> {plan}")
+    t0 = time.perf_counter()
+    model, results = train_multiclass(
+        x, y, config, strategy=args.multiclass, backend=args.backend,
+        num_devices=args.num_devices, verbose=not args.quiet)
+    wall = time.perf_counter() - t0
+    dev_s = sum(r.train_seconds for r in results)
+    conv = sum(r.converged for r in results)
+    print(f"training took {wall:.2f}s ({dev_s:.2f}s device; "
+          f"{conv}/{len(results)} submodels converged)")
+    from dpsvm_tpu.models.multiclass import accuracy_multiclass
+    print(f"train accuracy: {accuracy_multiclass(model, x, y):.4f}")
+    if not args.model.endswith(".npz"):
+        args.model += ".npz"
+        print("note: multiclass models use the .npz format (the "
+              "reference text format is binary-only)")
     model.save(args.model)
     print(f"model saved to {args.model}")
     return 0
@@ -701,8 +778,15 @@ def _cmd_test(args) -> int:
     if args.model.endswith(".npz"):
         z = np.load(args.model, allow_pickle=False)
         model_type = {"svr": "svr", "oneclass": "oneclass",
-                      "precomputed_svc": "precomputed_svc"}.get(
+                      "precomputed_svc": "precomputed_svc",
+                      "multiclass": "multiclass"}.get(
             str(z.get("model_type", "")), "classifier")
+        if model_type == "classifier" and "n_models" in z \
+                and "strategy" in z:
+            # Multiclass bundles saved before the model_type tag existed
+            # have everything MulticlassSVM.load needs — dispatch on
+            # their structural keys instead of crashing in SVMModel.load.
+            model_type = "multiclass"
 
     if model_type != "classifier" and args.probability:
         # -b 1 needs Platt calibration, which only classifier models
@@ -711,6 +795,22 @@ def _cmd_test(args) -> int:
               file=sys.stderr)
         return 2
 
+    if model_type == "multiclass":
+        from dpsvm_tpu.models.multiclass import (MulticlassSVM,
+                                                 predict_multiclass)
+        model = MulticlassSVM.load(args.model)
+        loaded = _load_eval_data(args, model.models[0].sv_x.shape[1])
+        if loaded is None:
+            return 2
+        x, y = loaded
+        pred = predict_multiclass(model, x)
+        acc = float(np.mean(pred == y))
+        print(f"loaded multiclass model: {len(model.classes)} classes, "
+              f"{model.strategy}, {len(model.models)} submodels, "
+              f"{sum(m.n_sv for m in model.models)} total SVs")
+        print(f"test accuracy: {acc:.4f} ({x.shape[0]} examples)")
+        _write_predictions(args, pred)
+        return 0
     if model_type == "svr":
         from dpsvm_tpu.models.svr import SVRModel
         model = SVRModel.load(args.model)
@@ -767,6 +867,15 @@ def _cmd_test(args) -> int:
     if loaded is None:
         return 2
     x, y = loaded
+    if not set(np.unique(y).tolist()) <= {-1, 1}:
+        # A binary model scored against other labels would print a
+        # plausible but meaningless accuracy (only the +1 rows could
+        # ever match); fail loudly instead.
+        print(f"error: {args.model} is a binary +-1 model but the test "
+              f"file's labels are {np.unique(y).tolist()[:6]}; relabel "
+              "the test data (or test against the multiclass .npz "
+              "model trained from the original labels)", file=sys.stderr)
+        return 2
     from dpsvm_tpu.predict import decision_function
 
     dec = np.asarray(decision_function(model, x))

@@ -17,7 +17,8 @@ import numpy as np
 def parse_libsvm(path: str, num_features: int | None = None,
                  num_rows: int | None = None):
     """Parse sparse LIBSVM lines ``label idx:val idx:val ...`` (1-based
-    indices) into dense arrays (x float32 (n,d), y int32 +-1). Reading
+    indices) into dense arrays (x float32 (n,d), y int32 — integer
+    class labels, +-1 in the common binary case). Reading
     stops after `num_rows` examples when given (matching load_csv's
     bounded read of the reference parser, parse.cpp:25)."""
     rows: list[dict[int, float]] = []
@@ -36,15 +37,19 @@ def parse_libsvm(path: str, num_features: int | None = None,
                 raise ValueError(
                     f"{path}:{lineno}: label token {parts[0]!r} is not "
                     "numeric (comment/header lines are not supported)") from None
-            if lab_val == 1:
-                labels.append(1)
-            elif lab_val == -1:
-                labels.append(-1)
+            if lab_val.is_integer() and abs(lab_val) < 2 ** 31:
+                # Arbitrary integer labels load (multiclass files train
+                # through the CLI's OvR/OvO routing, LibSVM-style; the
+                # +-1 convention is just the common binary case).
+                # is_integer() is False for inf/nan, and the int32 bound
+                # keeps np.asarray(labels, np.int32) exact — both would
+                # otherwise escape as OverflowError tracebacks.
+                labels.append(int(lab_val))
             else:
                 raise ValueError(
-                    f"{path}:{lineno}: label {parts[0]!r} is not +-1; this "
-                    "converter handles binary LIBSVM files only (relabel "
-                    "multiclass/0-1 data first)")
+                    f"{path}:{lineno}: label {parts[0]!r} is not an "
+                    "int32 class label (LIBSVM-format regression "
+                    "targets are not supported; convert to CSV)")
             feats = {}
             for tok in parts[1:]:
                 idx_s, val_s = tok.split(":")

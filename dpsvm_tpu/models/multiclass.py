@@ -31,6 +31,7 @@ class MulticlassSVM:
             raise ValueError("multiclass models are saved as .npz")
         payload = {
             "format_version": 1,
+            "model_type": "multiclass",  # cli test dispatches on this
             "strategy": self.strategy,
             "classes": self.classes,
             "n_models": len(self.models),
@@ -90,7 +91,10 @@ def train_multiclass(
     if classes.shape[0] < 2:
         raise ValueError("need at least 2 classes")
     if classes.shape[0] == 2:
-        strategy = "ovr"  # degenerate: a single binary model either way
+        # Degenerate case: the OvO reduction IS a single binary model
+        # (one a<b pair); the OvR loop would train two mirror-image
+        # submodels and pay double at fit and predict time.
+        strategy = "ovo"
 
     models: list[SVMModel] = []
     results = []

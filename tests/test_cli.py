@@ -524,3 +524,116 @@ def test_cross_validation_conflicting_flags(csvs, capsys):
     rc = main(["train", "-f", train_p, "-m", d + "/x.txt", "-q",
                "-v", "3", "--checkpoint", d + "/ck.npz", "--resume"])
     assert rc == 2
+
+
+@pytest.fixture(scope="module")
+def multi_csvs(tmp_path_factory):
+    """3-class blobs with labels {0, 1, 2} (not ±1)."""
+    d = tmp_path_factory.mktemp("cli_multi")
+    rng = np.random.default_rng(3)
+    centers = np.array([[0.0] * 8, [4.0] * 8, [-4.0] * 8], np.float32)
+    y = rng.integers(0, 3, 360).astype(np.int32)
+    x = centers[y] + rng.normal(size=(360, 8)).astype(np.float32)
+    train_p, test_p = str(d / "tr.csv"), str(d / "te.csv")
+    save_csv(train_p, x[:300], y[:300])
+    save_csv(test_p, x[300:], y[300:])
+    return train_p, test_p, str(d)
+
+
+@pytest.mark.parametrize("strategy", ["ovr", "ovo"])
+def test_multiclass_cli_roundtrip(multi_csvs, capsys, strategy):
+    """LibSVM's svm-train trains arbitrary-labelled multiclass files
+    transparently; so does the CLI (OvR/OvO reduction, .npz model)."""
+    train_p, test_p, d = multi_csvs
+    model_p = d + f"/m_{strategy}.npz"
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g",
+               "0.1", "--backend", "single", "-q",
+               "--multiclass", strategy])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "model saved" in out
+    preds_p = d + f"/preds_{strategy}.txt"
+    rc = main(["test", "-f", test_p, "-m", model_p, "-o", preds_p])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert f"{strategy}" in out
+    acc = float(out.split("test accuracy: ")[1].split()[0])
+    assert acc > 0.95
+    labels = {int(v) for v in open(preds_p).read().split()}
+    assert labels <= {0, 1, 2}
+
+
+def test_multiclass_cli_guards(multi_csvs, capsys):
+    train_p, _, d = multi_csvs
+    rc = main(["train", "-f", train_p, "-m", d + "/x.npz", "-q",
+               "-b", "1"])
+    assert rc == 2
+    assert "does not compose" in capsys.readouterr().err
+    rc = main(["train", "-f", train_p, "-m", d + "/x.npz", "-q",
+               "-t", "nu-svc"])
+    assert rc == 2
+
+
+def test_multiclass_libsvm_format_and_binary_01(tmp_path, capsys):
+    """Arbitrary integer labels load from BOTH file formats (LibSVM's
+    svm-train consumes sparse files); a 2-label non-±1 file trains a
+    SINGLE binary submodel (the ovo pair), not two OvR mirrors."""
+    rng = np.random.default_rng(1)
+    y3 = rng.integers(0, 3, 180)
+    c3 = np.array([[0.0] * 4, [4.0] * 4, [-4.0] * 4], np.float32)
+    x3 = c3[y3] + rng.normal(size=(180, 4)).astype(np.float32)
+    p = tmp_path / "mc.libsvm"
+    p.write_text("\n".join(
+        f"{y3[i]} " + " ".join(f"{j + 1}:{v:.4f}"
+                               for j, v in enumerate(x3[i]))
+        for i in range(180)) + "\n")
+    rc = main(["train", "-f", str(p), "-m", str(tmp_path / "m.npz"),
+               "-c", "5", "-g", "0.3", "--backend", "single"])
+    assert rc == 0
+    assert "3 classes" in capsys.readouterr().out
+
+    y2 = rng.integers(0, 2, 150)
+    x2 = (np.where(y2[:, None] > 0, 2.5, -2.5)
+          + rng.normal(size=(150, 5))).astype(np.float32)
+    p2 = str(tmp_path / "b01.csv")
+    save_csv(p2, x2, y2)
+    rc = main(["train", "-f", p2, "-m", str(tmp_path / "b.npz"),
+               "-c", "5", "-g", "0.2", "--backend", "single"])
+    assert rc == 0
+    assert "1 binary submodel" in capsys.readouterr().out
+    rc = main(["test", "-f", p2, "-m", str(tmp_path / "b.npz")])
+    assert rc == 0
+    acc = float(capsys.readouterr().out.split("test accuracy: ")[1].split()[0])
+    assert acc > 0.97
+    # -w1/-w-1 would rotate per submodel: refused loudly.
+    rc = main(["train", "-f", p2, "-m", str(tmp_path / "w.npz"),
+               "-w1", "2.0", "--backend", "single"])
+    assert rc == 2
+
+
+def test_binary_model_rejects_mismatched_test_labels(csvs, tmp_path, capsys):
+    """A binary ±1 model scored against 0/1-labelled data would print a
+    meaningless accuracy; the test command must refuse instead."""
+    train_p, _, d = csvs
+    model_p = d + "/guard_model.txt"
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    capsys.readouterr()
+    from dpsvm_tpu.data.loader import load_csv
+    x, y = load_csv(train_p)
+    bad_p = str(tmp_path / "bad01.csv")
+    save_csv(bad_p, x, (y > 0).astype(np.int32))  # {0, 1} labels
+    assert main(["test", "-f", bad_p, "-m", model_p]) == 2
+    assert "binary +-1 model" in capsys.readouterr().err
+
+
+def test_libsvm_inf_label_clean_error(tmp_path):
+    from dpsvm_tpu.data.converters import parse_libsvm
+
+    p = tmp_path / "bad.libsvm"
+    p.write_text("inf 1:0.5\n")
+    with pytest.raises(ValueError, match="int32 class label"):
+        parse_libsvm(str(p))
+    p.write_text("9999999999999 1:0.5\n")
+    with pytest.raises(ValueError, match="int32 class label"):
+        parse_libsvm(str(p))
