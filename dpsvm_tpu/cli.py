@@ -798,11 +798,30 @@ def _cmd_test(args) -> int:
     if model_type == "multiclass":
         from dpsvm_tpu.models.multiclass import (MulticlassSVM,
                                                  predict_multiclass)
+        if args.gamma is not None:
+            # The binary branch honors -g by rebuilding one kernel;
+            # silently evaluating k submodels at their TRAINED gammas
+            # while the user believes the override applied is worse
+            # than refusing.
+            print("error: -g does not apply to a multiclass bundle "
+                  "(its submodels carry their trained kernels); retrain "
+                  "with the desired gamma", file=sys.stderr)
+            return 2
         model = MulticlassSVM.load(args.model)
         loaded = _load_eval_data(args, model.models[0].sv_x.shape[1])
         if loaded is None:
             return 2
         x, y = loaded
+        extra = sorted(set(np.unique(y).tolist())
+                       - set(model.classes.tolist()))
+        if extra:
+            # Same footgun the binary branch guards: scoring against
+            # labels the model cannot predict prints a plausible but
+            # meaningless accuracy.
+            print(f"error: test labels {extra[:6]} are not among the "
+                  f"model's classes {model.classes.tolist()[:6]}",
+                  file=sys.stderr)
+            return 2
         pred = predict_multiclass(model, x)
         acc = float(np.mean(pred == y))
         print(f"loaded multiclass model: {len(model.classes)} classes, "

@@ -54,6 +54,14 @@ def parse_libsvm(path: str, num_features: int | None = None,
             for tok in parts[1:]:
                 idx_s, val_s = tok.split(":")
                 idx = int(idx_s)
+                if idx < 1:
+                    # LIBSVM indices are 1-based; accepting idx=0 here
+                    # would write x[i, -1] below (negative indexing) and
+                    # silently scramble the last feature column.
+                    raise ValueError(
+                        f"{path}:{lineno}: feature index {idx} — LIBSVM "
+                        "format is 1-based; re-index 0-based files "
+                        "before loading")
                 feats[idx] = float(val_s)
                 max_idx = max(max_idx, idx)
             rows.append(feats)

@@ -58,8 +58,9 @@ def load_data(
     if float_labels:
         raise ValueError(
             "LIBSVM-format regression targets are not supported; convert "
-            "to CSV (data/converters.py libsvm_to_csv handles +-1 "
-            "classification files only)")
+            "to CSV first (data/converters.py libsvm_to_csv converts any "
+            "integer-labelled file; non-integer regression targets need "
+            "an external conversion)")
     from dpsvm_tpu.data.converters import parse_libsvm
 
     x, y = parse_libsvm(path, num_features, num_rows=num_rows)

@@ -64,6 +64,7 @@ def _base_config(est, gamma: float) -> SVMConfig:
         selection=getattr(est, "selection", "mvp"),
         engine=getattr(est, "engine", "xla"),
         working_set_size=getattr(est, "working_set_size", 128),
+        pair_batch=getattr(est, "pair_batch", 1),
         cache_lines=est.cache_lines,
         dtype=est.dtype,
     )
@@ -106,7 +107,7 @@ class SVC(ClassifierMixin, BaseEstimator):
     def __init__(self, C=1.0, kernel="rbf", degree=3, gamma="scale",
                  coef0=0.0, tol=1e-3, max_iter=-1, class_weight=None,
                  strategy="ovr", backend="auto", selection="mvp",
-                 engine="xla", working_set_size=128,
+                 engine="xla", working_set_size=128, pair_batch=1,
                  cache_lines=0, dtype="float32", probability=False,
                  probability_cv=3, random_state=0):
         self.C = C
@@ -122,6 +123,7 @@ class SVC(ClassifierMixin, BaseEstimator):
         self.selection = selection
         self.engine = engine
         self.working_set_size = working_set_size
+        self.pair_batch = pair_batch
         self.cache_lines = cache_lines
         self.dtype = dtype
         self.probability = probability
@@ -300,7 +302,7 @@ class SVR(RegressorMixin, BaseEstimator):
     def __init__(self, C=1.0, kernel="rbf", degree=3, gamma="scale",
                  coef0=0.0, tol=1e-3, epsilon=0.1, max_iter=-1,
                  backend="auto", selection="mvp", engine="xla",
-                 working_set_size=128, cache_lines=0,
+                 working_set_size=128, pair_batch=1, cache_lines=0,
                  dtype="float32"):
         self.C = C
         self.kernel = kernel
@@ -314,6 +316,7 @@ class SVR(RegressorMixin, BaseEstimator):
         self.selection = selection
         self.engine = engine
         self.working_set_size = working_set_size
+        self.pair_batch = pair_batch
         self.cache_lines = cache_lines
         self.dtype = dtype
 

@@ -637,3 +637,29 @@ def test_libsvm_inf_label_clean_error(tmp_path):
     p.write_text("9999999999999 1:0.5\n")
     with pytest.raises(ValueError, match="int32 class label"):
         parse_libsvm(str(p))
+
+
+def test_multiclass_test_guards(multi_csvs, tmp_path, capsys):
+    """Multiclass test path refuses -g and out-of-vocabulary labels."""
+    train_p, test_p, d = multi_csvs
+    model_p = d + "/guard_mc.npz"
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    capsys.readouterr()
+    assert main(["test", "-f", test_p, "-m", model_p, "-g", "0.5"]) == 2
+    assert "-g does not apply" in capsys.readouterr().err
+    from dpsvm_tpu.data.loader import load_csv
+    x, y = load_csv(test_p)
+    bad_p = str(tmp_path / "shifted.csv")
+    save_csv(bad_p, x, y + 1)  # labels {1,2,3} vs model's {0,1,2}
+    assert main(["test", "-f", bad_p, "-m", model_p]) == 2
+    assert "not among the model's classes" in capsys.readouterr().err
+
+
+def test_libsvm_zero_based_index_rejected(tmp_path):
+    from dpsvm_tpu.data.converters import parse_libsvm
+
+    p = tmp_path / "zb.libsvm"
+    p.write_text("1 0:1.5 1:0.3\n")
+    with pytest.raises(ValueError, match="1-based"):
+        parse_libsvm(str(p))

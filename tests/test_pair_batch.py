@@ -144,3 +144,20 @@ def test_active_set_pair_batch(blobs_medium):
     obj1 = dual_objective(x, y, r1.alpha, kp)
     obja = dual_objective(x, y, ra.alpha, kp)
     assert obja == pytest.approx(obj1, rel=1e-4)
+
+
+def test_estimators_expose_pair_batch(blobs_small):
+    """sklearn-facade estimators accept and clone the pair_batch knob."""
+    from dpsvm_tpu.estimators import SVC
+
+    x, y = blobs_small
+    est = SVC(C=5.0, gamma=0.2, engine="block", working_set_size=32,
+              pair_batch=2)
+    try:
+        from sklearn.base import clone
+        est = clone(est)
+        assert est.pair_batch == 2
+    except ImportError:
+        pass
+    est.fit(x, y)
+    assert est.score(x, y) > 0.8
