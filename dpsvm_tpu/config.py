@@ -168,6 +168,23 @@ class SVMConfig:
     compensated: bool = False
     reconstruct_every: int = 0
 
+    # Resident-Gram acceleration for the per-pair engine (no reference
+    # equivalent — it is the 100%-hit-rate limit of the reference's LRU
+    # row cache, cache.cu). When on, the solver materializes the full
+    # (n, n) float32 kernel matrix ON DEVICE once (ops/kernels.py
+    # resident_gram) and runs the solve through the precomputed-kernel
+    # path: each per-pair iteration's two kernel rows become row GATHERS
+    # instead of two full MXU passes over X. This is what makes
+    # extreme-C tail convergence affordable — at the accuracy mode's
+    # 6-pass matmul precision the per-iteration matvecs dominate
+    # (PARITY.md covtype rows). None = auto: on for engine='xla' with a
+    # feature kernel when n >= 8192 and the Gram fits ~70% of the
+    # device's memory budget (so it never triggers where it cannot fit,
+    # e.g. the 60k x 784 headline shape at 14.4 GB). True forces it
+    # (any engine but 'pallas'); False disables. The certification /
+    # prediction paths still see the original features.
+    gram_resident: Optional[bool] = None
+
     # MXU matmul precision for every solver matmul (dot rows, Gram
     # blocks, folds, x_sq). TPU f32 matmuls default to ONE bfloat16 MXU
     # pass (~1e-3 relative error in the dot values) — measured on the
@@ -307,6 +324,23 @@ class SVMConfig:
                 "block engines (the fused pallas per-pair engine bakes its "
                 "f update into the on-chip pass); use engine='xla' or "
                 "'block'")
+        if self.gram_resident:
+            if self.engine == "pallas":
+                raise ValueError(
+                    "gram_resident is not implemented for the fused pallas "
+                    "per-pair engine (its kernel evaluation is baked into "
+                    "the on-chip pass); use engine='xla' or 'block'")
+            if self.kernel == "precomputed":
+                raise ValueError(
+                    "kernel='precomputed' already IS a resident Gram; "
+                    "leave gram_resident unset")
+            if self.active_set_size:
+                raise ValueError(
+                    "gram_resident does not compose with active-set "
+                    "shrinking (same constraint as kernel='precomputed': "
+                    "the active view re-indexes rows but the Gram block "
+                    "gather needs global column ids); set "
+                    "active_set_size=0")
         if self.matmul_precision not in (None, "default", "high", "highest"):
             raise ValueError(
                 "matmul_precision must be None (auto), 'default', 'high' "

@@ -20,6 +20,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 @dataclasses.dataclass(frozen=True)
@@ -231,6 +232,37 @@ def warn_if_bf16_degrades(x, config) -> None:
             f"0.59 train accuracy, BENCH_COVTYPE.md). Use "
             f"dtype='float32', or lower C / raise gamma.",
             stacklevel=3)
+
+
+@partial(jax.jit, static_argnames=("params", "tile"))
+def resident_gram(x, x_sq, params: KernelParams, tile: int = 2048):
+    """The full (n, n) float32 Gram matrix, built ON DEVICE in row tiles.
+
+    Backs the solver's resident-Gram acceleration (config.gram_resident):
+    when the (n, n) matrix fits HBM, the per-pair engine's two kernel
+    rows per iteration become ROW GATHERS of this matrix instead of two
+    full passes of X through the MXU — at the extreme-C accuracy mode
+    (matmul_precision='highest', 6-pass bf16) that removes the dominant
+    per-iteration cost entirely. The reference's LRU cache (cache.cu)
+    chases the same reuse reactively, one row at a time; a resident Gram
+    is the 100%-hit-rate limit of that idea, affordable on a 16 GB-HBM
+    TPU for n up to ~60k.
+
+    Tiled so peak temp memory beyond the (n, n) output is one (tile, n)
+    row block: the last partial tile re-computes a few overlapping rows
+    into the same slot rather than tracing a dynamic shape.
+    """
+    n, d = x.shape
+    t = min(tile, n)
+
+    def body(i, g):
+        s = jnp.minimum(i * t, n - t)
+        qx = lax.dynamic_slice(x, (s, 0), (t, d))
+        qsq = lax.dynamic_slice(x_sq, (s,), (t,))
+        rows = kernel_rows(x, x_sq, qx, qsq, params)  # (t, n) f32
+        return lax.dynamic_update_slice(g, rows, (s, 0))
+
+    return lax.fori_loop(0, -(-n // t), body, jnp.zeros((n, n), jnp.float32))
 
 
 @partial(jax.jit, static_argnames=("params",))

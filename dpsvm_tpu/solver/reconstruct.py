@@ -43,6 +43,20 @@ from dpsvm_tpu.solver.result import SolveResult
 _LEG_FLOOR = 2048
 _MAX_LEGS = 1000  # runaway guard; real runs end on gap/budget/floor
 
+# Hybrid tail engine (engine='block' runs only): a full block leg that
+# fails to cut the TRUE gap below this fraction of the previous one — or
+# regresses it outright — is declared stalled, and every remaining leg
+# runs the per-pair engine instead. The block engine's restricted working
+# sets are measured to cycle at extreme-C tails (gap ~3 after 460M
+# subproblem pairs at the covtype stress config, BENCH_COVTYPE.md
+# engine-semantics note) while per-pair global selection closes them; the
+# per-pair legs ride the resident-Gram path (solver/smo.py _resolve_gram)
+# where the (n, n) kernel matrix fits HBM, so the tail costs gathers, not
+# matvecs. The ratio is deliberately permissive (block legs halving the
+# gap keep the throughput engine); per-pair tail legs near convergence
+# legitimately progress slower than this and are never re-judged.
+_BLOCK_STALL_RATIO = 0.5
+
 
 def _stored_x64(x, dtype: str) -> np.ndarray:
     """The float64 view of X as the SOLVER sees it: under bfloat16
@@ -226,6 +240,21 @@ def solve_in_legs(base_solve, x, y, config: SVMConfig, callback=None,
     device_s = recon_s = 0.0
     recons = legs = 0
     converged = False
+    hybrid = config.engine == "block"
+    switch_pairs = None  # cumulative pair count at the block->xla switch
+
+    def switch_to_per_pair():
+        # The per-pair engine takes over for the remaining legs: same
+        # selection rule, block-only knobs reset (they would fail
+        # validation on engine='xla').
+        nonlocal inner, switch_pairs
+        inner = inner.replace(engine="xla", pair_batch=1,
+                              active_set_size=0, fused_fold=None)
+        switch_pairs = pairs_done
+        if config.verbose:
+            print(f"[reconstruct] block legs stalled at true gap "
+                  f"{gap:.6f} after {pairs_done} pairs; switching "
+                  f"remaining legs to the per-pair engine", flush=True)
 
     def reconstruct(alpha):
         f64 = gram_matvec_f64(
@@ -266,8 +295,15 @@ def solve_in_legs(base_solve, x, y, config: SVMConfig, callback=None,
                   f"carried_gap={float(res.b_lo - res.b_hi):.6f} "
                   f"true_gap={new_gap:.6f}", flush=True)
         if np.isfinite(gap) and new_gap > gap:
-            # REJECT: revert to the kept state, halve the budget. The
-            # true gap descends monotonically by construction.
+            # REJECT: revert to the kept state. A regressed BLOCK leg in
+            # hybrid mode is the cycling signature — switch engines at
+            # the full budget; otherwise halve (drift floor semantics:
+            # the true gap descends monotonically by construction).
+            if hybrid and inner.engine == "block":
+                switch_to_per_pair()
+                if aborted[0]:
+                    break
+                continue
             leg_budget //= 2
             if leg_budget < floor or aborted[0]:
                 break
@@ -283,6 +319,13 @@ def solve_in_legs(base_solve, x, y, config: SVMConfig, callback=None,
             break
         if aborted[0]:
             break
+        if (hybrid and inner.engine == "block" and np.isfinite(prev_gap)
+                and gap > _BLOCK_STALL_RATIO * prev_gap):
+            # Accepted but stalled block leg: hand the tail to the
+            # per-pair engine (supersedes the drift-floor halving — the
+            # slow progress is the engine, not the leg length).
+            switch_to_per_pair()
+            continue
         if np.isfinite(prev_gap) and gap > 0.85 * prev_gap:
             # Near the per-leg drift floor: finer legs resolve further.
             leg_budget //= 2
@@ -317,5 +360,8 @@ def solve_in_legs(base_solve, x, y, config: SVMConfig, callback=None,
             "reconstructions": recons,
             "reconstruct_seconds": recon_s,
             "final_leg_budget": leg_budget,
+            # Cumulative pair count at which hybrid mode handed the tail
+            # to the per-pair engine (None: never switched / not block).
+            "hybrid_switch_pairs": switch_pairs,
         },
     )

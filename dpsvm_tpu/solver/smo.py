@@ -560,6 +560,75 @@ def run_with_fault_retry(config: SVMConfig, checkpoint_path, resume,
     raise AssertionError("unreachable")
 
 
+# Auto resident-Gram gating (config.gram_resident=None): fraction of the
+# device's reported memory budget the (n, n) float32 Gram may occupy, and
+# the n below which the build/compile overhead is not worth switching
+# paths for.
+_GRAM_BUDGET_FRACTION = 0.70
+_GRAM_MIN_N = 8192
+
+# Size-1 memo: (key) -> (weakref-to-host-x, device Gram). Reconstruction
+# legs (solver/reconstruct.py) call solve() once per leg with the SAME
+# host array; rebuilding a ~10 GB Gram every leg would cost ~12 s of HBM
+# writes each. Keyed by object identity (guarded by the weakref so a
+# recycled id can never alias) plus everything that changes the values.
+_GRAM_MEMO: dict = {}
+
+
+def _gram_budget_bytes(device) -> int:
+    try:
+        stats = device.memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return int(_GRAM_BUDGET_FRACTION * limit)
+    except Exception:
+        pass
+    return 0  # unknown budget (e.g. CPU backends): auto stays off
+
+
+def _resolve_gram(config: SVMConfig, kp: KernelParams, n: int,
+                  device) -> bool:
+    """Whether this solve runs in resident-Gram mode (see config)."""
+    if kp.kind == "precomputed" or config.engine == "pallas":
+        return False
+    if config.gram_resident is not None:
+        return bool(config.gram_resident)
+    return (config.engine == "xla" and n >= _GRAM_MIN_N
+            and 4 * n * n <= _gram_budget_bytes(device))
+
+
+def _resident_gram_cached(x_host, x_p, dtype, kp: KernelParams,
+                          config: SVMConfig, device):
+    """(gram, k_diag) for resident-Gram mode, memoized across legs.
+
+    Owns the whole build so a memo HIT costs nothing: no feature
+    re-upload, no squared-norm/diag recompute. A weakref finalizer
+    evicts the entry the moment the host array dies — a multi-GB device
+    Gram must never outlive the data it was built from (it would pin up
+    to ~70% of HBM against later unrelated work)."""
+    import weakref
+
+    from dpsvm_tpu.ops.kernels import resident_gram
+
+    key = (kp, x_host.shape, config.dtype, getattr(device, "id", None),
+           config.resolve_precision())
+    ent = _GRAM_MEMO.get(key)
+    if ent is not None and ent[0]() is x_host:
+        return ent[1], ent[2]
+    x_feat = jax.device_put(jnp.asarray(x_p, dtype), device)
+    x_sq_f = jax.jit(squared_norms)(x_feat)
+    k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq_f,
+                                                            params=kp)
+    g = resident_gram(x_feat, x_sq_f, kp)
+    _GRAM_MEMO.clear()  # size-1: never hold two multi-GB grams
+    try:
+        ref = weakref.ref(x_host, lambda _r: _GRAM_MEMO.pop(key, None))
+        _GRAM_MEMO[key] = (ref, g, k_diag)
+    except TypeError:
+        pass  # non-weakrefable host container: just skip the memo
+    return g, k_diag
+
+
 def solve(
     x,
     y,
@@ -666,6 +735,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         device = jax.devices()[0]
     use_pallas = config.engine == "pallas"
     use_block = config.engine == "block"
+    use_gram = _resolve_gram(config, kp, n, device)
     # Fused fold+select (ops/pallas_fold_select.py): auto on real TPUs
     # for the 2-sided selection rules; needs >= q/2 128-element rows so
     # every working-set slot can find a candidate.
@@ -679,7 +749,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     n_pad_fused = -(-n // 1024) * 1024
     use_fused = (use_block and config.selection != "nu"
                  and not config.active_set_size
-                 and kp.kind != "precomputed"
+                 and kp.kind != "precomputed" and not use_gram
                  and min(config.working_set_size, n_pad_fused)
                  <= n_pad_fused // 64
                  and (config.fused_fold if config.fused_fold is not None
@@ -712,26 +782,42 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         raise ValueError(
             f"kernel='precomputed' needs the square (n, n) Gram "
             f"matrix as x; got {x.shape}")
-    x_dev = jax.device_put(jnp.asarray(x_p, dtype), device)
     y_dev = jax.device_put(jnp.asarray(y_p, jnp.float32), device)
     valid_dev = (jax.device_put(jnp.asarray(valid_np), device)
                  if (use_pallas or use_fused) else None)
-    if kp.kind == "precomputed":
-        # x IS the Gram matrix: its diagonal is the kernel diag, and the
-        # squared-norm pass (an O(n^2) read no precomputed branch ever
-        # consumes) is replaced by a zero placeholder.
+    if use_gram:
+        # Resident-Gram mode (config.gram_resident): materialize the
+        # (n, n) kernel matrix on device once and run the solve through
+        # the precomputed-kernel branches — per-pair kernel rows become
+        # row gathers. n_pad == n here (the gram engines never pad), the
+        # kernel diag comes from the FEATURE side (exact: rbf diag is
+        # exactly 1, no Gram round-trip), and the original host x stays
+        # the memo key so reconstruction legs reuse one build.
+        x_dev, k_diag = _resident_gram_cached(x, x_p, dtype, kp, config,
+                                              device)
+        kp = KernelParams("precomputed")
         x_sq = jnp.zeros((n_pad,), jnp.float32)
-        k_diag = jnp.diagonal(x_dev).astype(jnp.float32)
     else:
-        x_sq = jax.jit(squared_norms)(x_dev)
-        k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq, params=kp)
+        x_dev = jax.device_put(jnp.asarray(x_p, dtype), device)
+        if kp.kind == "precomputed":
+            # x IS the Gram matrix: its diagonal is the kernel diag, and
+            # the squared-norm pass (an O(n^2) read no precomputed branch
+            # ever consumes) is replaced by a zero placeholder.
+            x_sq = jnp.zeros((n_pad,), jnp.float32)
+            k_diag = jnp.diagonal(x_dev).astype(jnp.float32)
+        else:
+            x_sq = jax.jit(squared_norms)(x_dev)
+            k_diag = jax.jit(kernel_diag,
+                             static_argnames="params")(x_sq, params=kp)
 
     from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer, resume_solver_state
 
     cache_lines = min(config.cache_lines, n_pad)
     # The block engine has no LRU cache (its working-set block is the
     # reuse mechanism) — don't allocate one or report cache stats for it.
-    use_cache = cache_lines > 0 and not use_block
+    # Resident-Gram mode supersedes the cache entirely (every row is
+    # already resident), so a configured cache is silently idle there.
+    use_cache = cache_lines > 0 and not use_block and not use_gram
     state = init_state(n_pad, y_dev, cache_lines if use_cache else 1)
     if alpha_init is not None:
         a_p = np.zeros((n_pad,), np.float32)
