@@ -10,12 +10,17 @@ DATA ?= data
 .PHONY: test test_all bench bench_predict smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
-# full suite the CI/driver runs.
+# full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
+# SHELL level (belt-and-braces with tests/conftest.py's in-process
+# override): with the axon TPU tunnel attached, per-test device->host
+# latency (~80 ms/transfer and worse under load) blows the suite past
+# any CI budget — the suite is designed for the 8-virtual-device CPU
+# platform; tools/tpu_smoke.py is the real-TPU gate.
 test:
-	$(PY) -m pytest tests/ -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
 
 test_all:
-	$(PY) -m pytest tests/ -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
 
 bench:
 	$(PY) bench.py

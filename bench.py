@@ -115,6 +115,18 @@ def main() -> int:
     res = min(conv_runs, key=lambda r: r.train_seconds)
     conv_seconds = res.train_seconds
 
+    # HARD convergence regime (VERDICT round-4 item 9): the pinned
+    # noise=0.1 dataset converges in ~7k pairs, which says more about
+    # the generator's separability than the solver. A second pinned
+    # dataset with 10% label flips is genuinely non-separable (every
+    # flipped point becomes a bound SV), exercising the solver's soft-
+    # margin tail. Same engine config, same oracle-quality gate below.
+    xh, yh = make_mnist_like(n=N, d=D, seed=7, noise=0.1, label_flip=0.10)
+    solve(xh, yh, config.replace(max_iter=64))  # warm the executor
+    hard_runs = [solve(xh, yh, config) for _ in range(3)]
+    hres = min(hard_runs, key=lambda r: r.train_seconds)
+    hard_seconds = hres.train_seconds
+
     # Solution-quality gate: the timed bf16/block run must reach the same
     # optimum as an fp32 per-pair-parity solve — the speedup must come
     # from the engine, never from silently converging somewhere looser.
@@ -131,6 +143,20 @@ def main() -> int:
     assert abs(obj_t - obj_r) <= 0.005 * abs(obj_r), (obj_t, obj_r)
     assert abs(res.n_sv - ref.n_sv) <= 0.10 * ref.n_sv, (res.n_sv, ref.n_sv)
 
+    # Hard-regime gate: same fp32 per-pair oracle discipline (dual_obj
+    # closes over the EASY labels, so compute against yh inline).
+    def dual_obj_h(r):
+        import numpy as np
+        a, f = r.alpha, r.stats["f"]
+        return float(a.sum() - 0.5 * np.sum(a * yh * (f + yh)))
+
+    refh = solve(xh, yh, config.replace(engine="xla", dtype="float32"))
+    assert hres.converged, "hard convergence run did not converge"
+    obj_th, obj_rh = dual_obj_h(hres), dual_obj_h(refh)
+    assert abs(obj_th - obj_rh) <= 0.005 * abs(obj_rh), (obj_th, obj_rh)
+    assert abs(hres.n_sv - refh.n_sv) <= 0.10 * refh.n_sv, \
+        (hres.n_sv, refh.n_sv)
+
     # The PRIMARY (budget) run gets its own gate: its forced post-optimum
     # steps oscillate around the optimum, so demand dual feasibility
     # (box + equality constraint — a drift here means corrupted updates)
@@ -146,7 +172,9 @@ def main() -> int:
         f"[bench] device={jax.devices()[0]} budget: {bres.iterations} pairs "
         f"in {budget_seconds:.3f}s ({pairs_per_second:.0f}/s); convergence: "
         f"{res.iterations} pairs in {conv_seconds:.3f}s "
-        f"(converged={res.converged} n_sv={res.n_sv})",
+        f"(converged={res.converged} n_sv={res.n_sv}); hard (10% label "
+        f"flip): {hres.iterations} pairs in {hard_seconds:.3f}s "
+        f"(n_sv={hres.n_sv})",
         file=sys.stderr)
 
     # Honesty notes, embedded in the output rather than buried here:
@@ -172,7 +200,13 @@ def main() -> int:
         "pairs_per_second": round(pairs_per_second),
         "seconds_to_convergence": round(conv_seconds, 3),
         "pairs_to_convergence": int(res.iterations),
+        "seconds_to_convergence_hard": round(hard_seconds, 3),
+        "pairs_to_convergence_hard": int(hres.iterations),
+        "n_sv_hard": int(hres.n_sv),
         "dataset": "synthetic make_mnist_like(n=60000, d=784, seed=7, noise=0.1)",
+        "dataset_hard": ("synthetic make_mnist_like(n=60000, d=784, "
+                         "seed=7, noise=0.1, label_flip=0.10) — "
+                         "non-separable soft-margin regime"),
     }))
     return 0
 

@@ -448,7 +448,6 @@ def _train_multiclass_cli(args, x, y, config) -> int:
     blockers = [
         ("-t nu-svc", args.svm_type != "c-svc"),
         ("-b 1", bool(args.probability)),
-        ("-v", bool(args.cross_validate)),
         ("--kernel precomputed", args.kernel == "precomputed"),
         ("--checkpoint/--resume", bool(args.checkpoint or args.resume)),
         ("--metrics-jsonl", bool(args.metrics_jsonl)),
@@ -465,6 +464,12 @@ def _train_multiclass_cli(args, x, y, config) -> int:
               f"does not compose with {', '.join(bad)}; it trains plain "
               "binary C-SVC submodels", file=sys.stderr)
         return 2
+    if args.cross_validate:
+        # LibSVM's svm-train -v supports multiclass files (stratified CV
+        # over the reduction); refusing here was a parity gap (ADVICE
+        # round-4). Same contract as the binary path: throwaway fold
+        # refits, LibSVM's output line, no model file.
+        return _cross_validate_multiclass(args, x, y, config)
     from dpsvm_tpu.models.multiclass import train_multiclass
 
     if not args.quiet:
@@ -543,11 +548,15 @@ def _fold_split(y, k: int, seed: int = 0, stratify: bool = False):
     if not stratify:
         return np.array_split(rng.permutation(len(y)), k)
     parts = [[] for _ in range(k)]
-    for cls in np.unique(y):
+    for ci, cls in enumerate(np.unique(y)):
         idx = rng.permutation(np.nonzero(y == cls)[0])
+        # np.array_split hands every remainder member to the LOWEST
+        # part indices; rotating the assignment by the class counter
+        # spreads remainders across folds instead of systematically
+        # making fold 0 the largest (ADVICE round-4).
         for i, p in enumerate(np.array_split(idx, k)):
             if p.size:
-                parts[i].append(p)
+                parts[(i + ci) % k].append(p)
     return [rng.permutation(np.concatenate(p)) if p
             else np.empty(0, np.int64) for p in parts]
 
@@ -635,6 +644,52 @@ def _cross_validate(args, x, y, config) -> int:
     if not args.quiet:
         print(f"({k}-fold over {len(y)} rows in {wall:.2f}s; no model "
               "file written — LibSVM -v contract)", file=sys.stderr)
+    return 0
+
+
+def _cross_validate_multiclass(args, x, y, config) -> int:
+    """svm-train -v for a multiclass file: stratified k-fold over the
+    OvR/OvO reduction (models/multiclass.py), printing LibSVM's Cross
+    Validation Accuracy line and writing no model file. The composition
+    blockers (-b, --checkpoint, precomputed, weights, ...) were already
+    enforced by _train_multiclass_cli's shared list."""
+    from dpsvm_tpu.models.multiclass import (predict_multiclass,
+                                             train_multiclass)
+
+    k = args.cross_validate
+    if k < 2:
+        print("error: -v requires N >= 2 folds", file=sys.stderr)
+        return 2
+    if len(y) < k:
+        print(f"error: -v {k} needs at least {k} rows", file=sys.stderr)
+        return 2
+    folds = _fold_split(y, k, seed=0, stratify=True)
+    for i, held in enumerate(folds):
+        tr_mask = np.ones(len(y), bool)
+        tr_mask[held] = False
+        if len(np.unique(y[tr_mask])) < 2:
+            print(f"error: fold {i} would lose all but one class; lower "
+                  "-v or provide more data", file=sys.stderr)
+            return 2
+    pred = np.empty(len(y), np.float64)
+    t0 = time.perf_counter()
+    for i, held in enumerate(folds):
+        tr = np.concatenate([f for j, f in enumerate(folds) if j != i])
+        model, _ = train_multiclass(x[tr], y[tr], config,
+                                    strategy=args.multiclass,
+                                    backend=args.backend,
+                                    num_devices=args.num_devices)
+        pred[held] = np.asarray(predict_multiclass(model, x[held]),
+                                np.float64)
+        if not args.quiet:
+            print(f"  fold {i + 1}/{k}: trained on {len(tr)}, "
+                  f"scored {len(held)}", file=sys.stderr)
+    acc = float(np.mean(pred == np.asarray(y, np.float64)))
+    print(f"Cross Validation Accuracy = {100.0 * acc:g}%")
+    if not args.quiet:
+        print(f"({k}-fold over {len(y)} rows in "
+              f"{time.perf_counter() - t0:.2f}s; no model file written — "
+              "LibSVM -v contract)", file=sys.stderr)
     return 0
 
 

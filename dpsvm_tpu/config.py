@@ -111,18 +111,27 @@ class SVMConfig:
     working_set_size: int = 128
     inner_iters: int = 0
 
-    # Block-engine subproblem pair batching (no reference equivalent).
-    # 2 = each inner-loop trip executes TWO coordinate-disjoint pair
-    # updates: the MVP pair plus the second-best pair selected from the
-    # SAME (stale) extrema reductions, with the second update computed
-    # exactly against the post-first-update gradient (see
-    # ops/pallas_subproblem.py). Halves the serial dependency chain per
-    # pair in the chain-bound regimes. Same optimum (every update is an
-    # exact descent step on a violating pair); the pair SEQUENCE differs
-    # from pair_batch=1, so trajectories and exact pair counts to
-    # convergence differ. mvp selection + block engine only (the nu
-    # trainers, which re-select to the per-class rule internally, fall
-    # back to single-pair rather than rejecting the config).
+    # Pair batching (no reference equivalent): execute several
+    # coordinate-disjoint pair updates per serial loop trip, selected
+    # from the SAME (stale) extrema reductions with every update's
+    # (b_hi, b_lo) corrected to the post-previous-updates gradient —
+    # exact descent steps on then-violating pairs, so the optimum is
+    # unchanged while the pair SEQUENCE (and exact counts to
+    # convergence) differ from pair_batch=1.
+    #   engine='block': 2/4 = the subproblem's inner trip runs the MVP
+    #     pair plus 1/3 further stale-ranked disjoint pairs
+    #     (ops/pallas_subproblem.py). Cuts the serial dependency chain
+    #     per pair in the chain-bound regimes (measured at 2; 4 is the
+    #     round-5 extension — measure before adopting).
+    #   engine='xla':   2/4/8 = the micro-batched per-pair executor
+    #     (solver/smo.py _run_chunk_micro): one selection pass + one
+    #     batched kernel-row pass + k unrolled scalar pair updates + one
+    #     rank-2k fold per trip, amortizing the latency-bound loop
+    #     body's fixed cost over k pairs. The extreme-C tail engine
+    #     (PARITY.md covtype rows), usually with the resident Gram.
+    # mvp selection only (the nu trainers, which re-select to the
+    # per-class rule internally, fall back to single-pair rather than
+    # rejecting the config).
     pair_batch: int = 1
 
     # Fused fold+select for the block engine (ops/pallas_fold_select.py):
@@ -295,15 +304,24 @@ class SVMConfig:
             raise ValueError("inner_iters must be >= 0 (0 = working_set_size)")
         if self.active_set_size < 0:
             raise ValueError("active_set_size must be >= 0 (0 = shrinking off)")
-        if self.pair_batch not in (1, 2):
-            raise ValueError("pair_batch must be 1 or 2")
-        if self.pair_batch == 2 and (self.engine != "block"
-                                     or self.selection != "mvp"):
-            raise ValueError(
-                "pair_batch=2 is a block-engine mvp-selection feature "
-                "(the per-pair engines update one global pair by "
-                "definition; second_order/nu pairings pick partners by "
-                "rules the batched second slot does not implement)")
+        if self.pair_batch not in (1, 2, 4, 8):
+            raise ValueError("pair_batch must be 1, 2, 4 or 8")
+        if self.pair_batch > 1:
+            if self.selection != "mvp":
+                raise ValueError(
+                    "pair_batch > 1 is an mvp-selection feature "
+                    "(second_order/nu pairings pick partners by rules "
+                    "the batched extra slots do not implement)")
+            if self.engine == "pallas":
+                raise ValueError(
+                    "pair_batch > 1 is not implemented for the fused "
+                    "pallas per-pair engine (use engine='xla' or 'block')")
+            if self.engine == "block" and self.pair_batch > 4:
+                raise ValueError(
+                    "the block subproblem implements pair_batch up to 4 "
+                    "(ops/pallas_subproblem.py); pair_batch=8 is the "
+                    "per-pair micro-batch executor only (engine='xla', "
+                    "solver/smo.py _run_chunk_micro)")
         if self.active_set_size and self.engine != "block":
             raise ValueError(
                 "active_set_size (shrinking) is a block-engine knob; the "

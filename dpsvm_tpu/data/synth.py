@@ -33,6 +33,7 @@ def make_mnist_like(
     seed: int = 7,
     n_prototypes: int = 20,
     noise: float = 0.1,
+    label_flip: float = 0.0,
 ) -> tuple[np.ndarray, np.ndarray]:
     """An MNIST-even-odd-shaped stand-in: n x d in [0, 1], +-1 labels.
 
@@ -49,6 +50,26 @@ def make_mnist_like(
     which makes every point a support vector and the benchmark
     meaningless. Benchmark callers should pin `noise` explicitly.
     """
+    rng, x, proto_ids = _mnist_features(n, d, seed, n_prototypes, noise)
+    y = np.where(proto_ids % 2 == 0, 1, -1).astype(np.int32)
+    if label_flip > 0.0:
+        # Label noise is how this generator gets HARDER without touching
+        # the feature geometry: raising pixel `noise` at d=784 collapses
+        # all RBF values toward 0 (see above), while flipping a seeded
+        # fraction of labels makes the problem genuinely non-separable —
+        # every flipped point becomes a bound SV and the solver must
+        # carve a soft margin around it (bench.py's hard convergence
+        # regime).
+        flips = rng.random(n) < label_flip
+        y = np.where(flips, -y, y).astype(np.int32)
+    return x.astype(np.float32), y
+
+
+def _mnist_features(n, d, seed, n_prototypes, noise):
+    """THE mnist-shaped feature geometry, shared by make_mnist_like and
+    make_mnist_multiclass so the binary and multiclass benchmarks can
+    never drift apart. Returns (rng, x, proto_ids) — rng is handed back
+    so callers' extra draws (label flips) stay in the same stream."""
     rng = np.random.default_rng(seed)
     protos = rng.random((n_prototypes, d)).astype(np.float32)
     # Smooth the prototypes a little so nearby "pixels" correlate.
@@ -57,10 +78,28 @@ def make_mnist_like(
     for p in range(n_prototypes):
         protos[p] = np.convolve(protos[p], kernel, mode="same")
     proto_ids = rng.integers(0, n_prototypes, size=n)
-    y = np.where(proto_ids % 2 == 0, 1, -1).astype(np.int32)
     x = protos[proto_ids] + noise * rng.standard_normal((n, d)).astype(np.float32)
     np.clip(x, 0.0, 1.0, out=x)
-    return x.astype(np.float32), y
+    return rng, x.astype(np.float32), proto_ids
+
+
+def make_mnist_multiclass(
+    n: int = 60_000,
+    d: int = 784,
+    seed: int = 7,
+    n_prototypes: int = 20,
+    noise: float = 0.1,
+    n_classes: int = 10,
+) -> tuple[np.ndarray, np.ndarray]:
+    """The make_mnist_like generator BEFORE the even/odd collapse: the
+    same smoothed prototypes and pixel noise (shared _mnist_features),
+    labelled by prototype id modulo `n_classes` — a 10-class
+    MNIST-shaped stand-in for the multiclass benchmark (the reference
+    pre-reduced real MNIST to even/odd offline,
+    scripts/convert_mnist_to_odd_even.py; multiclass is THIS
+    framework's capability extension)."""
+    _, x, proto_ids = _mnist_features(n, d, seed, n_prototypes, noise)
+    return x, (proto_ids % n_classes).astype(np.int32)
 
 
 def make_adult_like(

@@ -65,6 +65,9 @@ def _base_config(est, gamma: float) -> SVMConfig:
         engine=getattr(est, "engine", "xla"),
         working_set_size=getattr(est, "working_set_size", 128),
         pair_batch=getattr(est, "pair_batch", 1),
+        # None = auto (on when the per-pair engine's (n, n) Gram fits
+        # device memory); estimators expose it for the extreme-C tails.
+        gram_resident=getattr(est, "gram_resident", None),
         cache_lines=est.cache_lines,
         dtype=est.dtype,
     )
@@ -108,8 +111,9 @@ class SVC(ClassifierMixin, BaseEstimator):
                  coef0=0.0, tol=1e-3, max_iter=-1, class_weight=None,
                  strategy="ovr", backend="auto", selection="mvp",
                  engine="xla", working_set_size=128, pair_batch=1,
-                 cache_lines=0, dtype="float32", probability=False,
-                 probability_cv=3, random_state=0):
+                 gram_resident=None, cache_lines=0, dtype="float32",
+                 probability=False, probability_cv=3, random_state=0):
+        self.gram_resident = gram_resident
         self.C = C
         self.kernel = kernel
         self.degree = degree
@@ -302,8 +306,9 @@ class SVR(RegressorMixin, BaseEstimator):
     def __init__(self, C=1.0, kernel="rbf", degree=3, gamma="scale",
                  coef0=0.0, tol=1e-3, epsilon=0.1, max_iter=-1,
                  backend="auto", selection="mvp", engine="xla",
-                 working_set_size=128, pair_batch=1, cache_lines=0,
-                 dtype="float32"):
+                 working_set_size=128, pair_batch=1, gram_resident=None,
+                 cache_lines=0, dtype="float32"):
+        self.gram_resident = gram_resident
         self.C = C
         self.kernel = kernel
         self.degree = degree

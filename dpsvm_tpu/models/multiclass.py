@@ -114,8 +114,15 @@ def train_multiclass(
                 mask = (y == classes[a]) | (y == classes[b])
                 xa = x[mask]
                 ya = np.where(y[mask] == classes[a], 1, -1).astype(np.int32)
+                # Shape bucketing: the k(k-1)/2 subsets all have slightly
+                # different row counts, and XLA executors are shape-keyed
+                # — without bucketing every pair pays a fresh compile.
+                # Rounding up to the next power of two collapses them to
+                # ~1-2 buckets (padding is masked out of selection;
+                # solver/smo.py solve pad_to).
+                bucket = 1 << (len(xa) - 1).bit_length()
                 model, res = train(xa, ya, config, backend=backend,
-                                   num_devices=num_devices)
+                                   num_devices=num_devices, pad_to=bucket)
                 if verbose:
                     print(f"[ovo {classes[a]} vs {classes[b]}] "
                           f"iters={res.iterations} n_sv={res.n_sv}")

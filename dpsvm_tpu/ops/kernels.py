@@ -234,7 +234,30 @@ def warn_if_bf16_degrades(x, config) -> None:
             stacklevel=3)
 
 
-@partial(jax.jit, static_argnames=("params", "tile"))
+def _gram_tile_body(g, x, x_sq, s, params: KernelParams, tile: int):
+    d = x.shape[1]
+    qx = lax.dynamic_slice(x, (s, 0), (tile, d))
+    qsq = lax.dynamic_slice(x_sq, (s,), (tile,))
+    rows = kernel_rows(x, x_sq, qx, qsq, params)  # (tile, n) f32
+    return lax.dynamic_update_slice(g, rows, (s, 0))
+
+
+# The Gram buffer is DONATED through each tile write so the build's peak
+# footprint is exactly one (n, n) buffer plus one (tile, n) block. The
+# obvious fori_loop formulation is a memory trap on TPU runtimes: the
+# compiled while-loop executable keeps an O(n^2) scoped temp reservation
+# for as long as it stays in the jit cache, which OOMs the SOLVE
+# executor dispatched right after it (measured at n=50k on a 16 GiB
+# v5e: build succeeds, the first executor dispatch ResourceExhausts,
+# and jax.clear_caches() — unloading the build executable — cures it).
+# CPU backends don't implement donation (they'd warn and copy), so the
+# undonated variant serves them; their allocator has no such reservation.
+_gram_tile_donated = partial(jax.jit, donate_argnums=(0,),
+                             static_argnames=("params", "tile"))(_gram_tile_body)
+_gram_tile_plain = partial(jax.jit,
+                           static_argnames=("params", "tile"))(_gram_tile_body)
+
+
 def resident_gram(x, x_sq, params: KernelParams, tile: int = 2048):
     """The full (n, n) float32 Gram matrix, built ON DEVICE in row tiles.
 
@@ -248,21 +271,22 @@ def resident_gram(x, x_sq, params: KernelParams, tile: int = 2048):
     is the 100%-hit-rate limit of that idea, affordable on a 16 GB-HBM
     TPU for n up to ~60k.
 
-    Tiled so peak temp memory beyond the (n, n) output is one (tile, n)
-    row block: the last partial tile re-computes a few overlapping rows
+    Host-driven tile loop (~n/tile dispatches) with a donated output
+    buffer — see the note above _gram_tile_donated for why this is NOT a
+    fori_loop. The last partial tile re-computes a few overlapping rows
     into the same slot rather than tracing a dynamic shape.
     """
-    n, d = x.shape
+    n = x.shape[0]
     t = min(tile, n)
-
-    def body(i, g):
-        s = jnp.minimum(i * t, n - t)
-        qx = lax.dynamic_slice(x, (s, 0), (t, d))
-        qsq = lax.dynamic_slice(x_sq, (s,), (t,))
-        rows = kernel_rows(x, x_sq, qx, qsq, params)  # (t, n) f32
-        return lax.dynamic_update_slice(g, rows, (s, 0))
-
-    return lax.fori_loop(0, -(-n // t), body, jnp.zeros((n, n), jnp.float32))
+    dev = x.devices().pop()
+    step = (_gram_tile_donated
+            if getattr(dev, "platform", "cpu") == "tpu"
+            else _gram_tile_plain)
+    g = jnp.zeros((n, n), jnp.float32, device=dev)
+    for i in range(-(-n // t)):
+        s = jnp.int32(min(i * t, n - t))
+        g = step(g, x, x_sq, s, params=params, tile=t)
+    return g
 
 
 @partial(jax.jit, static_argnames=("params",))

@@ -180,55 +180,61 @@ def _subproblem_kernel(limit_ref, kb_ref, alpha_ref, y_ref, f_ref, kd_ref,
         if pair_batch == 1:
             return alpha, f, t + jnp.int32(gap_open), gap_open
 
-        # ---- pair_batch == 2 (rule == "mvp", validated upstream): a
-        # second, coordinate-disjoint pair per trip. SELECTION is stale
-        # (second-best extrema of the same pre-update f_up/f_low
-        # reductions, excluding pair 1's lanes — no extra full-tile
-        # reduction pass on the serial chain for the candidate values);
-        # the UPDATE is exact: its b_hi2/b_lo2 are re-picked from the
-        # post-pair-1 f tile and its alpha coords are untouched by
-        # pair 1 (disjointness), so this is a true SMO step on the
-        # updated state — monotone descent, conservation, box all hold.
-        # Counting matches the second_order precedent: an attempted slot
-        # counts even when gated to a no-op (deterministic budget math);
-        # the update itself is gated on the STALE sets being non-empty
-        # (empty-set sentinel index would alias lane 0 — a real, wrong
-        # update, not a no-op) and on the corrected pair still violating
-        # (b_lo2 <= b_hi2 after correction would be an ASCENT step).
+        # ---- pair_batch >= 2 (rule == "mvp", validated upstream):
+        # pair_batch-1 further coordinate-disjoint pairs per trip.
+        # SELECTION is stale (rank-s extrema of the same pre-update
+        # f_up/f_low reductions, excluding all earlier pairs' lanes — no
+        # extra full-tile reduction pass on the serial chain for the
+        # candidate values); each UPDATE is exact: its b_hi/b_lo are
+        # re-picked from the CURRENT f tile and its alpha coords are
+        # untouched by the earlier pairs (disjointness), so every
+        # applied step is a true SMO step on the updated state —
+        # monotone descent, conservation, box all hold. Counting matches
+        # the second_order precedent: an attempted slot counts even when
+        # gated to a no-op (deterministic budget math); the update
+        # itself is gated on the STALE sets being non-empty (empty-set
+        # sentinel index would alias lane 0 — a real, wrong update, not
+        # a no-op) and on the corrected pair still violating (deliberate
+        # margin-free b_lo > b_hi gate — the pinned pair_batch=2
+        # semantics; see the counting note in solver/block.py).
         excl = sel_i | sel_j
-        f_up2 = jnp.where(excl, _INF, f_up)
-        f_low2 = jnp.where(excl, -_INF, f_low)
-        bh2s = jnp.min(f_up2)
-        bl2s = jnp.max(f_low2)
-        i2 = jnp.min(jnp.where(f_up2 == bh2s, lanes, _IMAX))
-        j2 = jnp.min(jnp.where(f_low2 == bl2s, lanes, _IMAX))
-        sel_i2 = lanes == i2
-        sel_j2 = lanes == j2
-        row_i2 = jnp.reshape(kb_ref[pl.ds(i2, 1)], (rows, 128))
-        row_j2 = jnp.reshape(kb_ref[pl.ds(j2, 1)], (rows, 128))
-        b_hi2 = _pick1(sel_i2, f)  # corrected: post-pair-1 gradient
-        b_lo2 = _pick1(sel_j2, f)
-        y_i2 = _pick1(sel_i2, y)
-        y_j2 = _pick1(sel_j2, y)
-        eta2 = jnp.maximum(
-            _pick1(sel_i2, kd) + _pick1(sel_j2, kd)
-            - 2.0 * _pick1(sel_j2, row_i2), tau)
-        a_i2_old = _pick1(sel_i2, alpha)
-        a_j2_old = _pick1(sel_j2, alpha)
-        t1 = t + jnp.int32(gap_open)
-        cnt2 = gap_open & (t1 < limit)
-        upd2 = (cnt2 & (bh2s < _INF) & (bl2s > -_INF)
-                & (b_lo2 > b_hi2))
-        c_i2 = cp if cp == cn else jnp.where(y_i2 > 0, cp, cn)
-        c_j2 = cp if cp == cn else jnp.where(y_j2 > 0, cp, cn)
-        a_i2_new, a_j2_new = pair_alpha_update(
-            a_i2_old, a_j2_old, y_i2, y_j2, b_hi2, b_lo2, eta2,
-            c_i2, c_j2, gate=upd2)
-        alpha = jnp.where(sel_i2, a_i2_new, alpha)
-        alpha = jnp.where(sel_j2, a_j2_new, alpha)
-        f = f + (a_i2_new - a_i2_old) * y_i2 * row_i2 \
-              + (a_j2_new - a_j2_old) * y_j2 * row_j2
-        return alpha, f, t1 + jnp.int32(cnt2), gap_open
+        f_up_s, f_low_s = f_up, f_low
+        t_cur = t + jnp.int32(gap_open)
+        for _s in range(pair_batch - 1):
+            f_up_s = jnp.where(excl, _INF, f_up_s)
+            f_low_s = jnp.where(excl, -_INF, f_low_s)
+            bh_s = jnp.min(f_up_s)
+            bl_s = jnp.max(f_low_s)
+            i2 = jnp.min(jnp.where(f_up_s == bh_s, lanes, _IMAX))
+            j2 = jnp.min(jnp.where(f_low_s == bl_s, lanes, _IMAX))
+            sel_i2 = lanes == i2
+            sel_j2 = lanes == j2
+            row_i2 = jnp.reshape(kb_ref[pl.ds(i2, 1)], (rows, 128))
+            row_j2 = jnp.reshape(kb_ref[pl.ds(j2, 1)], (rows, 128))
+            b_hi2 = _pick1(sel_i2, f)  # corrected: current gradient
+            b_lo2 = _pick1(sel_j2, f)
+            y_i2 = _pick1(sel_i2, y)
+            y_j2 = _pick1(sel_j2, y)
+            eta2 = jnp.maximum(
+                _pick1(sel_i2, kd) + _pick1(sel_j2, kd)
+                - 2.0 * _pick1(sel_j2, row_i2), tau)
+            a_i2_old = _pick1(sel_i2, alpha)
+            a_j2_old = _pick1(sel_j2, alpha)
+            cnt2 = gap_open & (t_cur < limit)
+            upd2 = (cnt2 & (bh_s < _INF) & (bl_s > -_INF)
+                    & (b_lo2 > b_hi2))
+            c_i2 = cp if cp == cn else jnp.where(y_i2 > 0, cp, cn)
+            c_j2 = cp if cp == cn else jnp.where(y_j2 > 0, cp, cn)
+            a_i2_new, a_j2_new = pair_alpha_update(
+                a_i2_old, a_j2_old, y_i2, y_j2, b_hi2, b_lo2, eta2,
+                c_i2, c_j2, gate=upd2)
+            alpha = jnp.where(sel_i2, a_i2_new, alpha)
+            alpha = jnp.where(sel_j2, a_j2_new, alpha)
+            f = f + (a_i2_new - a_i2_old) * y_i2 * row_i2 \
+                  + (a_j2_new - a_j2_old) * y_j2 * row_j2
+            t_cur = t_cur + jnp.int32(cnt2)
+            excl = excl | sel_i2 | sel_j2
+        return alpha, f, t_cur, gap_open
 
     def cond(carry):
         _, _, t, gap_open = carry
@@ -259,10 +265,10 @@ def solve_subproblem_pallas(kb_w, alpha_w, y_w, f_w, kd_w, slot_ok, limit,
     exactly-updated (see the kernel comment) — trading one trip's serial
     dependency chain for two counted pairs.
     """
-    if pair_batch not in (1, 2):
-        raise ValueError("pair_batch must be 1 or 2")
-    if pair_batch == 2 and rule != "mvp":
-        raise ValueError("pair_batch=2 is implemented for rule='mvp' only")
+    if pair_batch not in (1, 2, 4):
+        raise ValueError("pair_batch must be 1, 2 or 4")
+    if pair_batch > 1 and rule != "mvp":
+        raise ValueError("pair_batch>1 is implemented for rule='mvp' only")
     cp, cn = split_c(c)
     q = kb_w.shape[0]
     # Pad the working set up to whole 128-lane rows and hand the kernel

@@ -182,8 +182,8 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
       "nu"           — per-class MVP (both pair members share a class;
                        the nu duals' two-equality-constraint rule).
     """
-    if pair_batch == 2 and rule != "mvp":
-        raise ValueError("pair_batch=2 is implemented for rule='mvp' only")
+    if pair_batch > 1 and rule != "mvp":
+        raise ValueError("pair_batch>1 is implemented for rule='mvp' only")
     cp, cn = split_c(c)
 
     def cond(carry):
@@ -258,41 +258,57 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
         if pair_batch == 1:
             return alpha_w, f_w, t + jnp.int32(gap_open), gap_open
 
-        # pair_batch == 2 (mvp only): second coordinate-disjoint pair per
-        # trip — stale second-best SELECTION, exact UPDATE on the
-        # post-pair-1 state. Identical semantics to the Pallas kernel
+        # pair_batch >= 2 (mvp only): further coordinate-disjoint pairs
+        # per trip — stale rank-s SELECTION, exact UPDATE on the current
+        # state. Identical semantics to the Pallas kernel
         # (ops/pallas_subproblem.py): attempted slots count even when the
         # update gates to a no-op; the update gates on non-empty stale
         # sets (the empty-set argmin aliases slot 0 — a wrong update, not
         # a no-op) and on the corrected pair still violating.
+        # Two DELIBERATE counting/tolerance quirks (ADVICE round-4),
+        # kept because the round-4 artifacts' trajectories are pinned to
+        # them: (1) `iterations` counts attempted slots, so pairs/s under
+        # pair_batch>1 includes gated no-op slots and is not directly
+        # comparable to pair_batch=1 runs (PROFILE.md documents this
+        # wherever the two are compared); (2) the extra slots gate on
+        # the MARGIN-FREE b_lo2 > b_hi2, so sub-tolerance slot updates
+        # the eps-gated first slot would never take DO apply — still
+        # exact descent, slightly different stopping-tolerance
+        # semantics. The per-pair micro-batch executor (solver/smo.py
+        # _run_chunk_micro) gates its extra slots on the full 2*eps
+        # margin instead.
         excl = (lanes == i) | (lanes == j)
-        f_up2 = jnp.where(excl, jnp.inf, f_up)
-        f_low2 = jnp.where(excl, -jnp.inf, f_low)
-        i2 = jnp.argmin(f_up2).astype(jnp.int32)
-        j2 = jnp.argmax(f_low2).astype(jnp.int32)
-        bh2s = f_up2[i2]
-        bl2s = f_low2[j2]
-        row_i2 = lax.dynamic_index_in_dim(kb_w, i2, 0, keepdims=False)
-        row_j2 = lax.dynamic_index_in_dim(kb_w, j2, 0, keepdims=False)
-        b_hi2 = f_w[i2]  # corrected: post-pair-1 gradient
-        b_lo2 = f_w[j2]
-        y_i2 = y_w[i2]
-        y_j2 = y_w[j2]
-        eta2 = jnp.maximum(kd_w[i2] + kd_w[j2] - 2.0 * row_i2[j2], tau)
-        t1 = t + jnp.int32(gap_open)
-        cnt2 = gap_open & (t1 < limit)
-        upd2 = (cnt2 & (bh2s < jnp.inf) & (bl2s > -jnp.inf)
-                & (b_lo2 > b_hi2))
-        a_i2_old = alpha_w[i2]
-        a_j2_old = alpha_w[j2]
-        a_i2_new, a_j2_new = pair_alpha_update(
-            a_i2_old, a_j2_old, y_i2, y_j2, b_hi2, b_lo2, eta2,
-            c_of(y_i2, cp, cn), c_of(y_j2, cp, cn), gate=upd2)
-        alpha_w = jnp.where(lanes == i2, a_i2_new, alpha_w)
-        alpha_w = jnp.where(lanes == j2, a_j2_new, alpha_w)
-        f_w = f_w + (a_i2_new - a_i2_old) * y_i2 * row_i2 \
-                  + (a_j2_new - a_j2_old) * y_j2 * row_j2
-        return alpha_w, f_w, t1 + jnp.int32(cnt2), gap_open
+        f_up_s, f_low_s = f_up, f_low
+        t_cur = t + jnp.int32(gap_open)
+        for _s in range(pair_batch - 1):
+            f_up_s = jnp.where(excl, jnp.inf, f_up_s)
+            f_low_s = jnp.where(excl, -jnp.inf, f_low_s)
+            i2 = jnp.argmin(f_up_s).astype(jnp.int32)
+            j2 = jnp.argmax(f_low_s).astype(jnp.int32)
+            bh2s = f_up_s[i2]
+            bl2s = f_low_s[j2]
+            row_i2 = lax.dynamic_index_in_dim(kb_w, i2, 0, keepdims=False)
+            row_j2 = lax.dynamic_index_in_dim(kb_w, j2, 0, keepdims=False)
+            b_hi2 = f_w[i2]  # corrected: current gradient
+            b_lo2 = f_w[j2]
+            y_i2 = y_w[i2]
+            y_j2 = y_w[j2]
+            eta2 = jnp.maximum(kd_w[i2] + kd_w[j2] - 2.0 * row_i2[j2], tau)
+            cnt2 = gap_open & (t_cur < limit)
+            upd2 = (cnt2 & (bh2s < jnp.inf) & (bl2s > -jnp.inf)
+                    & (b_lo2 > b_hi2))
+            a_i2_old = alpha_w[i2]
+            a_j2_old = alpha_w[j2]
+            a_i2_new, a_j2_new = pair_alpha_update(
+                a_i2_old, a_j2_old, y_i2, y_j2, b_hi2, b_lo2, eta2,
+                c_of(y_i2, cp, cn), c_of(y_j2, cp, cn), gate=upd2)
+            alpha_w = jnp.where(lanes == i2, a_i2_new, alpha_w)
+            alpha_w = jnp.where(lanes == j2, a_j2_new, alpha_w)
+            f_w = f_w + (a_i2_new - a_i2_old) * y_i2 * row_i2 \
+                      + (a_j2_new - a_j2_old) * y_j2 * row_j2
+            t_cur = t_cur + jnp.int32(cnt2)
+            excl = excl | (lanes == i2) | (lanes == j2)
+        return alpha_w, f_w, t_cur, gap_open
 
     alpha_w, f_w, t, _ = lax.while_loop(
         cond, body, (alpha_w, f_w, jnp.int32(0), jnp.bool_(True)))
@@ -367,7 +383,7 @@ def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
                                   "inner_iters", "rounds_per_chunk",
                                   "inner_impl", "interpret", "selection",
                                   "pair_batch"))
-def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
+def run_chunk_block(x, y, x_sq, k_diag, valid, state: BlockState, max_iter,
                     kp: KernelParams, c, eps: float, tau: float,
                     q: int, inner_iters: int, rounds_per_chunk: int,
                     inner_impl: str = "xla",
@@ -393,7 +409,8 @@ def run_chunk_block(x, y, x_sq, k_diag, state: BlockState, max_iter,
     def body(st: BlockState):
         f_cur = eff_f(st)
         w, slot_ok, b_hi, b_lo, alpha_w, coef, t, qx, qsq = _round_core(
-            x, y, x_sq, k_diag, f_cur, st.alpha, None, max_iter - st.pairs,
+            x, y, x_sq, k_diag, f_cur, st.alpha, valid,
+            max_iter - st.pairs,
             kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
             selection, pair_batch=pair_batch)
         # Fold the round's alpha deltas into the global state with one
@@ -500,7 +517,8 @@ def run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
                                   "m", "k_rounds",
                                   "inner_impl", "interpret", "selection",
                                   "pair_batch"))
-def run_chunk_block_active(x, y, x_sq, k_diag, state: BlockState, max_iter,
+def run_chunk_block_active(x, y, x_sq, k_diag, valid, state: BlockState,
+                           max_iter,
                            kp: KernelParams, c, eps: float, tau: float,
                            q: int, inner_iters: int, rounds_per_chunk: int,
                            m: int, k_rounds: int,
@@ -549,7 +567,7 @@ def run_chunk_block_active(x, y, x_sq, k_diag, state: BlockState, max_iter,
     def cycle(st: BlockState):
         f_cur = eff_f(st)
         act_ids, act_ok, b_hi, b_lo = select_block(
-            f_cur, st.alpha, y, c, m, rule=selection)
+            f_cur, st.alpha, y, c, m, valid=valid, rule=selection)
         gap_open = b_lo > b_hi + 2.0 * eps
         x_act = jnp.take(x, act_ids, axis=0)  # (m, d)
         sq_act = jnp.take(x_sq, act_ids)

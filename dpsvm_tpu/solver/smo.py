@@ -26,6 +26,7 @@ from dpsvm_tpu.ops.kernels import (
     KernelParams,
     kernel_diag,
     kernel_from_dots,
+    kernel_rows,
     row_dots,
     squared_norms,
 )
@@ -427,6 +428,169 @@ def _run_chunk_pallas(x, y, x_sq, valid, state: SMOState, max_iter,
     return final
 
 
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "chunk", "k"))
+def _run_chunk_micro(x, y, x_sq, k_diag, valid, state: SMOState, max_iter,
+                     kp: KernelParams, c, eps: float, tau: float,
+                     chunk: int, k: int) -> SMOState:
+    """Micro-batched per-pair chunk executor (config.pair_batch > 1 on
+    engine='xla', mvp selection).
+
+    The plain per-pair loop is LATENCY-bound on TPU: its body is ~10
+    serialized small kernels and costs ~22 us/pair even when the kernel
+    rows are resident-Gram gathers (measured n=50k v5e, PROFILE.md
+    round-5). Each trip here amortizes that fixed cost over k pairs:
+
+      1. ONE selection pass picks the k most-violating disjoint pairs —
+         top-k of I_up by smallest f paired rank-for-rank with top-k of
+         I_low by largest f (pair 1 is exactly the reference's maximal
+         violating pair; pairs 2..k are the pair_batch=2 scheme of
+         solver/block.py generalized);
+      2. ONE batched pass produces all 2k kernel rows (a (2k, d) x
+         (d, n) MXU matvec — or a 2k-row gather in resident-Gram mode);
+      3. the k pair updates run as UNROLLED scalar algebra against the
+         (2k, 2k) cross-Gram block: selection is stale (rank j), but
+         every update's (b_hi, b_lo) are CORRECTED to the
+         post-previous-updates gradient, so each applied step is an
+         exact descent step on a then-violating pair — same optimum,
+         different pair sequence (the pair_batch=2 contract);
+      4. ONE rank-2k fold applies the accumulated coefficients to f.
+
+    Pairs j >= 2 gate on fe_lo > fe_hi + 2*eps — the SAME margin as the
+    stopping rule (unlike block pair_batch=2's margin-free second slot,
+    ADVICE round-4): a sub-tolerance slot is a counted no-op (attempted
+    slots count, the block subproblem's pinned budget semantics). A
+    free point can top BOTH lists; collisions are resolved by rank
+    order (a pair colliding with an earlier APPLIED pair is a counted
+    no-op and its stale slots never scatter) so the rank-0 maximal pair
+    always executes.
+    """
+    cp, cn = split_c(c)
+    end = jnp.minimum(state.it + chunk, max_iter)
+
+    def top_pairs(scores):
+        """(vals, idx) of the top k per row of the stacked (2, n) scores,
+        SORTED descending. One stacked reduction per trip; on TPU the
+        exact lax.top_k is ~4x the cost of approx_max_k here (155.8 vs
+        41.1 us/trip measured at n=20k, k=8), and approx's bin-max always
+        retains each row's true maximum — so after the (trivial, 2k-
+        element) sort, slot 0 is the EXACT maximal violating pair and
+        the approximation only reshuffles the interchangeable ranks
+        2..k (solver/block.py _top_h rationale)."""
+        if jax.default_backend() == "tpu":
+            v, i = lax.approx_max_k(scores, k)
+            order = jnp.argsort(-v, axis=1)
+            return jnp.take_along_axis(v, order, axis=1), \
+                jnp.take_along_axis(i, order, axis=1)
+        return lax.top_k(scores, k)
+
+    def cond(st: SMOState):
+        return (st.it < end) & (st.b_lo > st.b_hi + 2.0 * eps)
+
+    def body(st: SMOState):
+        f_cur = eff_f(st)
+        up = up_mask(st.alpha, y, cp, cn)
+        low = low_mask(st.alpha, y, cp, cn)
+        if valid is not None:
+            up = up & valid
+            low = low & valid
+        scores = jnp.stack([jnp.where(up, -f_cur, -jnp.inf),
+                            jnp.where(low, f_cur, -jnp.inf)])
+        vals, ids = top_pairs(scores)
+        up_v, up_i = vals[0], ids[0]  # ascending f: rank 0 = b_hi
+        low_v, low_i = vals[1], ids[1]  # descending f: rank 0 = b_lo
+        b_hi = -up_v[0]
+        b_lo = low_v[0]
+        up_ok = jnp.isfinite(up_v)
+        low_ok = jnp.isfinite(low_v)
+        # A free point can appear in BOTH top lists (it is in I_up and
+        # I_low at once). Collisions are resolved by RANK ORDER inside
+        # the unrolled update loop below — a pair whose member was
+        # already touched by an EARLIER applied pair this trip is gated
+        # off. A global "drop the low copy" dedup here would be wrong:
+        # it can gate off rank 0 — the maximal violating pair and the
+        # only slot guaranteed to execute — and livelock the loop into
+        # counted no-op trips (review finding, round 5).
+        collide = low_i[:, None] == up_i[None, :]  # [low_rank, up_rank]
+        idx = jnp.concatenate([up_i, low_i]).astype(jnp.int32)  # (2k,)
+        # Row/column extraction via UNROLLED dynamic slices, never
+        # jnp.take: XLA lowers a general row gather from a large operand
+        # (the resident Gram is (n, n)) to a one-hot MATMUL on TPU —
+        # O(k n^2) per trip, measured 606 us/pair at n=20k. 2k dynamic
+        # slices are plain DMAs.
+        qx = jnp.stack([lax.dynamic_index_in_dim(x, idx[s], 0,
+                                                 keepdims=False)
+                        for s in range(2 * k)])
+        rows = kernel_rows(x, x_sq, qx, jnp.take(x_sq, idx), kp)  # (2k, n)
+        m = jnp.stack([lax.dynamic_index_in_dim(rows, idx[s], 1,
+                                                keepdims=False)
+                       for s in range(2 * k)], axis=1)  # (2k, 2k)
+        kd = jnp.take(k_diag, idx)
+        a = jnp.take(st.alpha, idx)
+        fv = jnp.take(f_cur, idx)
+        yv = jnp.take(y, idx)
+        coef = jnp.zeros((2 * k,), jnp.float32)
+        t = st.it
+        applied = []  # per-pair applied gates, for collision tracking
+        for j in range(k):  # unrolled: all indices below are static
+            i_s, l_s = j, k + j
+            ok = up_ok[j] & low_ok[j]
+            # Gate on cross-list coordinate collisions with THIS pair
+            # (a point on both sides would self-pair) or with any
+            # EARLIER APPLIED pair (its alpha scalar here is stale).
+            # Rank 0 has no earlier pairs, so the maximal violating
+            # pair always executes — the livelock guard (a global
+            # drop-the-low-copy dedup could gate it off and spin the
+            # loop in counted no-op trips; review finding, round 5).
+            bad = collide[j, j]
+            for p in range(j):
+                bad |= (collide[p, j] | collide[j, p]) & applied[p]
+            ok = ok & ~bad
+            fe_i = fv[i_s] + coef @ m[:, i_s]  # corrected gradient
+            fe_l = fv[l_s] + coef @ m[:, l_s]
+            if j == 0:
+                # Reference semantics: the selected maximal pair always
+                # executes (a closed-gap trip is the do-while loop's
+                # final degenerate update) and always counts.
+                gate = ok
+                cnt = jnp.int32(1)
+            else:
+                gate = ok & (t < end) & (fe_l > fe_i + 2.0 * eps)
+                # ATTEMPTED slots count even when the update gates to a
+                # no-op — the block subproblem's pinned pair_batch
+                # counting semantics (solver/block.py), and what keeps
+                # budget math deterministic.
+                cnt = (t < end).astype(jnp.int32)
+            eta = jnp.maximum(kd[i_s] + kd[l_s] - 2.0 * m[i_s, l_s], tau)
+            na_i, na_l = pair_alpha_update(
+                a[i_s], a[l_s], yv[i_s], yv[l_s], fe_i, fe_l, eta,
+                c_of(yv[i_s], cp, cn), c_of(yv[l_s], cp, cn), gate=gate)
+            coef = coef.at[i_s].add((na_i - a[i_s]) * yv[i_s])
+            coef = coef.at[l_s].add((na_l - a[l_s]) * yv[l_s])
+            a = a.at[i_s].set(na_i).at[l_s].set(na_l)
+            applied.append(gate)
+            t = t + cnt
+        f, f_err = maybe_kahan(st.f, st.f_err, coef @ rows)
+        # Scatter mask. Dead top-k filler never scatters. For a global
+        # index that appears in TWO pairs (cross-list collision), at
+        # most one of those pairs applied (an applied pair gates every
+        # later collider); the UNAPPLIED pair's slots hold stale copies
+        # and must not race the applied pair's scatter — drop both its
+        # slots (their values are unchanged, so nothing is lost). Two
+        # unapplied colliding pairs scatter identical unchanged values,
+        # which is benign.
+        applied_v = jnp.stack(applied)  # (k,)
+        share = collide | collide.T  # pairs p,q share a coordinate
+        conflict = ~applied_v & jnp.any(share & applied_v[None, :], axis=1)
+        pair_scatter = jnp.tile(~conflict, 2)
+        slot_ok = jnp.concatenate([up_ok, low_ok]) & pair_scatter
+        safe = jnp.where(slot_ok, idx, jnp.int32(y.shape[0]))
+        alpha = st.alpha.at[safe].set(jnp.where(slot_ok, a, 0.0),
+                                      mode="drop")
+        return SMOState(alpha, f, b_hi, b_lo, t, st.cache, st.hits, f_err)
+
+    return lax.while_loop(cond, body, state)
+
+
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "chunk",
                                    "use_cache", "selection"))
 def _run_chunk(x, y, x_sq, k_diag, valid, state: SMOState, max_iter,
@@ -574,6 +738,44 @@ _GRAM_MIN_N = 8192
 # recycled id can never alias) plus everything that changes the values.
 _GRAM_MEMO: dict = {}
 
+# Size-1 memo for the (x_dev, x_sq) device pair, same identity+weakref
+# discipline as _GRAM_MEMO. One-vs-rest multiclass training calls
+# solve() once per class on the SAME host X (188 MB at the MNIST shape);
+# without this every class re-pays the host->device transfer and the
+# squared-norm pass (VERDICT round-4 item 2). Reconstruction legs hit it
+# too. k_diag is NOT memoized (it depends on kp and costs one tiny
+# elementwise dispatch).
+_XDEV_MEMO: dict = {}
+
+
+def _device_x_cached(x_host, build_x_p, n_pad, dtype, device):
+    """(x_dev, x_sq) for feature-kernel solves. `build_x_p` is called
+    only on a miss (it materializes the padded host copy)."""
+    import weakref
+
+    d = x_host.shape[1]
+    key = ((n_pad, d), str(dtype), getattr(device, "id", None))
+    ent = _XDEV_MEMO.get(key)
+    if ent is not None and ent[0]() is x_host:
+        return ent[1], ent[2]
+    x_dev = jax.device_put(jnp.asarray(build_x_p(), dtype), device)
+    x_sq = jax.jit(squared_norms)(x_dev)
+    _XDEV_MEMO.clear()
+    try:
+        ref = weakref.ref(x_host, lambda _r: _XDEV_MEMO.pop(key, None))
+        _XDEV_MEMO[key] = (ref, x_dev, x_sq)
+    except TypeError:
+        pass
+    return x_dev, x_sq
+
+
+# HBM per chip by TPU generation, for backends that do not report
+# bytes_limit (the tunneled axon runtime returns None). Matched against
+# device_kind substrings; unknown TPU kinds fall back to 16 GiB (every
+# generation since v3).
+_TPU_HBM_GIB = (("v5 lite", 16), ("v5e", 16), ("v5p", 95), ("v4", 32),
+                ("v6", 32), ("v3", 16), ("v2", 8))
+
 
 def _gram_budget_bytes(device) -> int:
     try:
@@ -583,6 +785,10 @@ def _gram_budget_bytes(device) -> int:
             return int(_GRAM_BUDGET_FRACTION * limit)
     except Exception:
         pass
+    if getattr(device, "platform", None) == "tpu":
+        kind = getattr(device, "device_kind", "").lower()
+        gib = next((g for k, g in _TPU_HBM_GIB if k in kind), 16)
+        return int(_GRAM_BUDGET_FRACTION * gib * (1 << 30))
     return 0  # unknown budget (e.g. CPU backends): auto stays off
 
 
@@ -610,7 +816,9 @@ def _resident_gram_cached(x_host, x_p, dtype, kp: KernelParams,
 
     from dpsvm_tpu.ops.kernels import resident_gram
 
-    key = (kp, x_host.shape, config.dtype, getattr(device, "id", None),
+    # Keyed on the PADDED build shape, not the host shape: the same host
+    # X solved at two pad_to buckets needs two distinct Grams.
+    key = (kp, x_p.shape, config.dtype, getattr(device, "id", None),
            config.resolve_precision())
     ent = _GRAM_MEMO.get(key)
     if ent is not None and ent[0]() is x_host:
@@ -620,6 +828,12 @@ def _resident_gram_cached(x_host, x_p, dtype, kp: KernelParams,
     k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq_f,
                                                             params=kp)
     g = resident_gram(x_feat, x_sq_f, kp)
+    # Synchronize BEFORE the caller dispatches the solve executor: the
+    # build transiently holds a second O(n^2) working buffer, and letting
+    # the executor's allocations overlap it OOMs exactly at the largest
+    # shapes this mode exists for (measured: n=50k fails async, passes
+    # synced, on a 16 GiB v5e).
+    jax.block_until_ready(g)
     _GRAM_MEMO.clear()  # size-1: never hold two multi-GB grams
     try:
         ref = weakref.ref(x_host, lambda _r: _GRAM_MEMO.pop(key, None))
@@ -639,8 +853,17 @@ def solve(
     resume: bool = False,
     alpha_init=None,
     f_init=None,
+    pad_to: Optional[int] = None,
 ) -> SolveResult:
     """Train binary C-SVC on one chip. Returns SolveResult.
+
+    `pad_to` (shape bucketing): pad the row dimension to at least this
+    many rows, masking the padding out of every selection. Callers with
+    MANY distinct problem sizes (one-vs-one multiclass trains k(k-1)/2
+    subset shapes) round sizes up to a few buckets so each bucket
+    compiles ONCE — XLA executors are shape-keyed, and a fresh compile
+    per shape costs more than the padded rows' dead lanes. Results
+    (alpha, f, SV counts) cover only the real rows.
 
     `callback(iter, b_hi, b_lo, state)`, when given, fires once per chunk —
     the structured-progress hook the reference lacks (its per-iteration
@@ -690,7 +913,7 @@ def solve(
                            _retry_callback(callback, cfg_k,
                                            checkpoint_path, k),
                            device, checkpoint_path, res_k,
-                           alpha_init, f_init)
+                           alpha_init, f_init, pad_to)
 
     with _precision_ctx(config):
         return run_with_fault_retry(config, checkpoint_path, resume, attempt)
@@ -718,7 +941,7 @@ def _retry_callback(callback, cfg_k, checkpoint_path, k):
 
 
 def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
-                alpha_init, f_init) -> SolveResult:
+                alpha_init, f_init, pad_to=None) -> SolveResult:
     import numpy as np
 
     x = np.asarray(x, np.float32)
@@ -735,7 +958,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         device = jax.devices()[0]
     use_pallas = config.engine == "pallas"
     use_block = config.engine == "block"
-    use_gram = _resolve_gram(config, kp, n, device)
+    # The Gram is built at the PADDED size — budget-gate on that.
+    use_gram = _resolve_gram(config, kp, max(n, int(pad_to or 0)), device)
     # Fused fold+select (ops/pallas_fold_select.py): auto on real TPUs
     # for the 2-sided selection rules; needs >= q/2 128-element rows so
     # every working-set slot can find a candidate.
@@ -756,35 +980,49 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                       else (device.platform == "tpu"
                             and n_pad_fused >= 200_000)))
     block_rows = 64
+    # Engine row-granularity, then the caller's shape bucket (`pad_to`,
+    # see solve()): padded rows are masked out of every selection.
+    n_min = max(n, min(pad_to, 2 ** 31) if pad_to else n)
     if use_pallas:
         # Pad rows to a whole number of (block_rows, 128) kernel blocks;
         # padding is masked out of selection via `valid`.
         blk = block_rows * 128
-        n_pad = -(-n // blk) * blk
+        n_pad = -(-n_min // blk) * blk
     elif use_fused:
         blk = 8 * 128  # fold_select's (block_rows=8, 128) grid blocks
-        n_pad = -(-n // blk) * blk
+        n_pad = -(-n_min // blk) * blk
     else:
-        n_pad = n
-    if n_pad == n:
-        x_p = x
-        y_p = y_np.astype(np.float32)
-    else:
-        x_p = np.zeros((n_pad, d), np.float32)
-        x_p[:n] = x
-        y_p = np.ones((n_pad,), np.float32)
-        y_p[:n] = y_np
-    valid_np = np.zeros((n_pad,), bool)
-    valid_np[:n] = True
+        n_pad = n_min
 
     if kp.kind == "precomputed" and x.shape[0] != x.shape[1]:
         # Checked before any device transfer or compute is spent.
         raise ValueError(
             f"kernel='precomputed' needs the square (n, n) Gram "
             f"matrix as x; got {x.shape}")
+    if kp.kind == "precomputed" and n_pad != n:
+        raise ValueError(
+            "pad_to does not compose with kernel='precomputed' (the "
+            "padded Gram rows/columns would need kernel values)")
+
+    def build_x_p():
+        if n_pad == n:
+            return x
+        xp = np.zeros((n_pad, d), np.float32)
+        xp[:n] = x
+        return xp
+
+    if n_pad == n:
+        y_p = y_np.astype(np.float32)
+    else:
+        y_p = np.ones((n_pad,), np.float32)
+        y_p[:n] = y_np
     y_dev = jax.device_put(jnp.asarray(y_p, jnp.float32), device)
-    valid_dev = (jax.device_put(jnp.asarray(valid_np), device)
-                 if (use_pallas or use_fused) else None)
+    if n_pad == n and not (use_pallas or use_fused):
+        valid_dev = None
+    else:
+        valid_np = np.zeros((n_pad,), bool)
+        valid_np[:n] = True
+        valid_dev = jax.device_put(jnp.asarray(valid_np), device)
     if use_gram:
         # Resident-Gram mode (config.gram_resident): materialize the
         # (n, n) kernel matrix on device once and run the solve through
@@ -793,22 +1031,24 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         # kernel diag comes from the FEATURE side (exact: rbf diag is
         # exactly 1, no Gram round-trip), and the original host x stays
         # the memo key so reconstruction legs reuse one build.
-        x_dev, k_diag = _resident_gram_cached(x, x_p, dtype, kp, config,
-                                              device)
+        x_dev, k_diag = _resident_gram_cached(x, build_x_p(), dtype, kp,
+                                              config, device)
         kp = KernelParams("precomputed")
         x_sq = jnp.zeros((n_pad,), jnp.float32)
+    elif kp.kind == "precomputed":
+        # x IS the Gram matrix: its diagonal is the kernel diag, and
+        # the squared-norm pass (an O(n^2) read no precomputed branch
+        # ever consumes) is replaced by a zero placeholder.
+        x_dev = jax.device_put(jnp.asarray(build_x_p(), dtype), device)
+        x_sq = jnp.zeros((n_pad,), jnp.float32)
+        k_diag = jnp.diagonal(x_dev).astype(jnp.float32)
     else:
-        x_dev = jax.device_put(jnp.asarray(x_p, dtype), device)
-        if kp.kind == "precomputed":
-            # x IS the Gram matrix: its diagonal is the kernel diag, and
-            # the squared-norm pass (an O(n^2) read no precomputed branch
-            # ever consumes) is replaced by a zero placeholder.
-            x_sq = jnp.zeros((n_pad,), jnp.float32)
-            k_diag = jnp.diagonal(x_dev).astype(jnp.float32)
-        else:
-            x_sq = jax.jit(squared_norms)(x_dev)
-            k_diag = jax.jit(kernel_diag,
-                             static_argnames="params")(x_sq, params=kp)
+        # Identity-memoized: repeated solves on the same host X (OvR
+        # multiclass, reconstruction legs) pay the transfer and the
+        # squared-norm pass once.
+        x_dev, x_sq = _device_x_cached(x, build_x_p, n_pad, dtype, device)
+        k_diag = jax.jit(kernel_diag,
+                         static_argnames="params")(x_sq, params=kp)
 
     from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer, resume_solver_state
 
@@ -817,7 +1057,9 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     # reuse mechanism) — don't allocate one or report cache stats for it.
     # Resident-Gram mode supersedes the cache entirely (every row is
     # already resident), so a configured cache is silently idle there.
-    use_cache = cache_lines > 0 and not use_block and not use_gram
+    use_micro = (config.engine == "xla" and config.pair_batch > 1)
+    use_cache = (cache_lines > 0 and not use_block and not use_gram
+                 and not use_micro)
     state = init_state(n_pad, y_dev, cache_lines if use_cache else 1)
     if alpha_init is not None:
         a_p = np.zeros((n_pad,), np.float32)
@@ -910,7 +1152,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
             from dpsvm_tpu.solver.block import run_chunk_block_active
 
             state = run_chunk_block_active(
-                x_dev, y_dev, x_sq, k_diag, state, max_iter,
+                x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
                 kp, config.c_bounds(), eps_run, float(config.tau),
                 q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds),
@@ -930,15 +1172,20 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 pair_batch=int(config.pair_batch))
         elif use_block:
             state = run_chunk_block(
-                x_dev, y_dev, x_sq, k_diag, state, max_iter,
+                x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
                 kp, config.c_bounds(), eps_run, float(config.tau),
                 q, inner, rounds_per_chunk,
                 inner_impl="pallas" if not interpret else "xla",
                 selection=config.selection,
                 pair_batch=int(config.pair_batch))
+        elif use_micro:
+            state = _run_chunk_micro(x_dev, y_dev, x_sq, k_diag, valid_dev,
+                                     state, max_iter, kp, config.c_bounds(),
+                                     eps_run, float(config.tau), chunk_len,
+                                     int(config.pair_batch))
         else:
-            state = _run_chunk(x_dev, y_dev, x_sq, k_diag, None, state, max_iter,
-                               kp, config.c_bounds(), eps_run,
+            state = _run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state,
+                               max_iter, kp, config.c_bounds(), eps_run,
                                float(config.tau), chunk_len, use_cache,
                                config.selection)
         jax.block_until_ready(state)

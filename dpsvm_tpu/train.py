@@ -26,6 +26,7 @@ def train(
     callback=None,
     checkpoint_path: Optional[str] = None,
     resume: bool = False,
+    pad_to: Optional[int] = None,
 ) -> tuple[SVMModel, SolveResult]:
     """Train binary C-SVC with modified SMO.
 
@@ -37,6 +38,11 @@ def train(
       training cleanly at that chunk boundary (solver/smo.py solve
       docstring) — observation-only callbacks must return None.
     Labels must be in {-1, +1} (reference convention, parse.cpp label stoi).
+    pad_to: shape-bucketing HINT (solver/smo.py solve) — OvO multiclass
+      rounds its k(k-1)/2 subset sizes up to shared buckets so XLA
+      compiles one executor per bucket, not per subset shape. Honored
+      by the single-chip backend; the mesh/host backends manage their
+      own shapes and ignore it (it never changes results).
     """
     import jax
 
@@ -80,7 +86,8 @@ def train(
     if backend == "single":
         from dpsvm_tpu.solver.smo import solve
         result = solve(x, y, config, callback=callback,
-                       checkpoint_path=checkpoint_path, resume=resume)
+                       checkpoint_path=checkpoint_path, resume=resume,
+                       pad_to=pad_to)
     elif backend == "mesh":
         from dpsvm_tpu.parallel.dist_smo import solve_mesh
         result = solve_mesh(x, y, config, num_devices=num_devices,

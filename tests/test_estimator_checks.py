@@ -1,0 +1,55 @@
+"""sklearn estimator-contract conformance (VERDICT round-4 item 8).
+
+``sklearn.utils.estimator_checks.parametrize_with_checks`` runs the
+library's own battery (get_params/set_params/clone round trips, fit
+idempotency, input validation, attribute contracts, ...) over every
+facade estimator. The documented skip list below marks contracts the
+facade deliberately does not implement; everything else must pass — the
+facade is a first-class surface (README sells GridSearchCV/Pipeline
+composition).
+
+Marked slow: the battery refits each estimator dozens of times at
+varied tiny shapes, which costs minutes of XLA compiles on the CPU
+platform (the quick `make test` loop deselects it; `make test_all`
+runs it).
+"""
+
+import pytest
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.utils.estimator_checks import parametrize_with_checks
+
+from dpsvm_tpu.estimators import SVC, SVR, NuSVC, NuSVR, OneClassSVM
+
+# Contracts the facade deliberately does not implement, with reasons.
+# Keyed by substring of the check name; applied to every estimator.
+_SKIPS = {
+    "check_sample_weights": "fit() has no sample_weight (the solver's "
+        "per-class weights cover LibSVM -w; per-row weights are not in "
+        "the reference's problem class)",
+    "check_estimator_sparse": "dense-only: the TPU solver's kernel rows "
+        "are MXU matmuls over dense X; callers densify first",
+}
+
+
+def _expected_failures(estimator):
+    return {name: reason for name, reason in _SKIPS.items()}
+
+
+# Small max_iter keeps each refit cheap; the checks assert contracts,
+# not solution quality. tol is left at default (checks never inspect
+# convergence).
+ESTIMATORS = [
+    SVC(max_iter=20_000),
+    NuSVC(max_iter=20_000),
+    SVR(max_iter=20_000),
+    NuSVR(max_iter=20_000),
+    OneClassSVM(max_iter=20_000),
+]
+
+
+@pytest.mark.slow
+@parametrize_with_checks(ESTIMATORS,
+                         expected_failed_checks=_expected_failures)
+def test_sklearn_estimator_contract(estimator, check):
+    check(estimator)

@@ -1,0 +1,102 @@
+"""Micro-batched per-pair executor (engine='xla', pair_batch in {2,4,8};
+solver/smo.py _run_chunk_micro).
+
+Semantics contract (the pair_batch=2 precedent of solver/block.py,
+generalized): stale rank-j selection, exact corrected-gradient updates,
+same optimum as the single-pair engine, different pair sequence. These
+tests pin the model-level equivalence, the budget-exact counting, and
+the composition with the extreme-C accuracy stack.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.solver.smo import solve
+
+
+def _blobs(n=600, d=8, seed=5, sep=1.0):
+    from dpsvm_tpu.data.synth import make_blobs_binary
+
+    return make_blobs_binary(n=n, d=d, seed=seed, sep=sep)
+
+
+BASE = SVMConfig(c=10.0, gamma=0.1, epsilon=1e-3, max_iter=400_000)
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_micro_matches_single_pair_optimum(k):
+    x, y = _blobs()
+    ref = solve(x, y, BASE)
+    got = solve(x, y, BASE.replace(pair_batch=k))
+    assert got.converged
+    assert abs(got.b - ref.b) < 5e-3
+    dec_r = ref.stats["f"] + y - ref.b
+    dec_g = got.stats["f"] + y - got.b
+    assert np.mean(np.sign(dec_r) == np.sign(dec_g)) > 0.995
+    # The batch amortizes trips: convergence must not need (many) more
+    # pair updates than single-pair (stale ranks are near-optimal pairs).
+    assert got.iterations < 3 * ref.iterations
+
+
+@pytest.mark.parametrize("k", [4, 8])
+def test_budget_mode_lands_exactly_on_max_iter(k):
+    """Slot gating keeps the pair counter budget-exact even when the
+    budget is not a multiple of the batch."""
+    x, y = _blobs(sep=0.6)
+    budget = 10_001
+    res = solve(x, y, BASE.replace(pair_batch=k, budget_mode=True,
+                                   max_iter=budget))
+    assert res.iterations == budget
+
+
+def test_micro_with_gram_compensated_and_legs():
+    """The full extreme-C tail stack in one call: resident Gram +
+    micro-batch + Kahan carry + f64 reconstruction legs."""
+    x, y = _blobs(sep=0.6)
+    cfg = BASE.replace(c=2000.0, pair_batch=4, gram_resident=True,
+                       compensated=True, reconstruct_every=50_000)
+    res = solve(x, y, cfg)
+    assert res.converged
+    assert res.stats["true_gap"] <= 2 * cfg.epsilon
+
+
+def test_micro_respects_class_weights():
+    """The batched slots use per-class box bounds like every engine."""
+    x, y = _blobs(sep=0.7)
+    cfg = BASE.replace(weight_pos=2.0, weight_neg=0.5)
+    ref = solve(x, y, cfg)
+    got = solve(x, y, cfg.replace(pair_batch=4))
+    assert got.converged
+    cp, cn = cfg.c_bounds()
+    assert got.alpha[y > 0].max() <= cp + 1e-5
+    assert got.alpha[y < 0].max() <= cn + 1e-5
+    assert abs(got.b - ref.b) < 1e-2
+
+
+def test_validation_matrix():
+    with pytest.raises(ValueError, match="1, 2, 4 or 8"):
+        SVMConfig(pair_batch=3)
+    with pytest.raises(ValueError, match="mvp"):
+        SVMConfig(pair_batch=4, selection="second_order")
+    with pytest.raises(ValueError, match="pallas"):
+        SVMConfig(pair_batch=2, engine="pallas")
+    with pytest.raises(ValueError, match="block subproblem"):
+        SVMConfig(pair_batch=8, engine="block")
+    # Legal: the block subproblem batches up to 4 slots.
+    SVMConfig(pair_batch=2, engine="block")
+    SVMConfig(pair_batch=4, engine="block")
+
+
+def test_micro_checkpoint_resume(tmp_path):
+    """Chunked observation + checkpoint/resume work through the micro
+    executor (iteration counting survives the round trip)."""
+    x, y = _blobs(sep=0.6)
+    ck = str(tmp_path / "micro.npz")
+    cfg = BASE.replace(c=100.0, pair_batch=4, checkpoint_every=500,
+                       chunk_iters=500, max_iter=1500, budget_mode=True)
+    r1 = solve(x, y, cfg, checkpoint_path=ck)
+    assert r1.iterations == 1500
+    cfg2 = cfg.replace(max_iter=3000)
+    r2 = solve(x, y, cfg2, checkpoint_path=ck, resume=True)
+    assert r2.iterations == 3000

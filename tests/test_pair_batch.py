@@ -97,6 +97,57 @@ def test_pallas_xla_subproblem_parity():
     np.testing.assert_array_equal(np.asarray(a_p)[-5:], alpha[-5:])
 
 
+@pytest.mark.parametrize("pb", [4])
+def test_pair_batch4_block(blobs_medium, pb):
+    """Round-5 extension: the subproblem batches up to 4 stale-ranked
+    disjoint pairs per trip — same fixed point, exact feasibility,
+    budget-exact counting (the generalized slot loop in
+    ops/pallas_subproblem.py / solver/block.py)."""
+    x, y = blobs_medium
+    kp = KernelParams("rbf", CFG.gamma)
+    r1 = solve(x, y, CFG.replace(pair_batch=1))
+    r4 = solve(x, y, CFG.replace(pair_batch=pb))
+    assert r4.converged
+    obj1 = dual_objective(x, y, r1.alpha, kp)
+    obj4 = dual_objective(x, y, r4.alpha, kp)
+    assert obj4 == pytest.approx(obj1, rel=1e-4)
+    a = np.asarray(r4.alpha)
+    assert a.min() >= 0.0 and a.max() <= CFG.c + 1e-5
+    assert abs(float(a @ y)) < 1e-2
+    rb = solve(x, y, CFG.replace(pair_batch=pb, budget_mode=True,
+                                 max_iter=4001))
+    assert int(rb.iterations) == 4001
+
+
+def test_pallas_xla_subproblem_parity_pb4():
+    """Pallas/XLA parity for the 4-slot batch (interpret mode)."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+    from dpsvm_tpu.solver.block import _solve_subproblem
+
+    rng = np.random.default_rng(1)
+    q, c = 128, 4.0
+    g = rng.normal(size=(q, 12)).astype(np.float32)
+    kb = np.exp(-0.1 * ((g[:, None] - g[None, :]) ** 2).sum(-1))
+    kd = np.ones(q, np.float32)
+    y = np.where(rng.random(q) > 0.5, 1.0, -1.0).astype(np.float32)
+    alpha = np.clip(rng.normal(1.0, 1.0, q), 0, c).astype(np.float32)
+    f = ((alpha * y) @ kb - y).astype(np.float32)
+    ok = np.ones(q, np.float32)
+    args = (jnp.asarray(kb, jnp.float32), jnp.asarray(alpha),
+            jnp.asarray(y), jnp.asarray(f), jnp.asarray(kd),
+            jnp.asarray(ok), jnp.int32(5000))
+    a_p, t_p = solve_subproblem_pallas(*args, c, 1e-3, 1e-12, rule="mvp",
+                                       interpret=True, pair_batch=4)
+    a_x, _, t_x = _solve_subproblem(
+        args[0], args[4], args[5] > 0, args[1], args[2], args[3], c,
+        1e-3, 1e-12, args[6], rule="mvp", pair_batch=4)
+    assert int(t_p) == int(t_x)
+    np.testing.assert_allclose(np.asarray(a_p), np.asarray(a_x),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_second_slot_progress(blobs_small):
     """The batch must actually converge in fewer inner trips than it
     counts pairs: with pair_batch=2 a converged solve's pair count stays
@@ -125,8 +176,9 @@ def test_mesh_pair_batch(blobs_small):
 def test_validation():
     with pytest.raises(ValueError):
         SVMConfig(pair_batch=3)
-    with pytest.raises(ValueError):
-        SVMConfig(engine="xla", pair_batch=2)
+    # Round 5: engine='xla' pair_batch>1 is the micro-batch executor
+    # (tests/test_micro_batch.py), no longer rejected.
+    SVMConfig(engine="xla", pair_batch=2)
     with pytest.raises(ValueError):
         SVMConfig(engine="block", selection="second_order", pair_batch=2)
     # fused-fold + active-set compositions stay legal (pair_batch lives

@@ -1,0 +1,36 @@
+"""Synthetic-generator contracts the benchmarks pin against
+(bench.py's hard regime, tools/bench_multiclass.py's 10-class data)."""
+
+import numpy as np
+
+from dpsvm_tpu.data.synth import make_mnist_like, make_mnist_multiclass
+
+
+def test_label_flip_is_seeded_and_proportional():
+    x0, y0 = make_mnist_like(n=4000, d=64, seed=7, noise=0.1)
+    x1, y1 = make_mnist_like(n=4000, d=64, seed=7, noise=0.1,
+                             label_flip=0.10)
+    np.testing.assert_array_equal(x0, x1)  # features untouched
+    flipped = float(np.mean(y0 != y1))
+    assert 0.07 < flipped < 0.13
+    _, y2 = make_mnist_like(n=4000, d=64, seed=7, noise=0.1,
+                            label_flip=0.10)
+    np.testing.assert_array_equal(y1, y2)  # deterministic
+
+
+def test_flip_zero_is_identity():
+    _, y0 = make_mnist_like(n=1000, d=32, seed=3)
+    _, y1 = make_mnist_like(n=1000, d=32, seed=3, label_flip=0.0)
+    np.testing.assert_array_equal(y0, y1)
+
+
+def test_multiclass_generator_matches_binary_geometry():
+    """make_mnist_multiclass is make_mnist_like BEFORE the even/odd
+    collapse: identical features, labels = prototype id mod n_classes
+    (so even/odd of the 10-class label reproduces the binary label)."""
+    xb, yb = make_mnist_like(n=3000, d=64, seed=7, noise=0.1)
+    xm, ym = make_mnist_multiclass(n=3000, d=64, seed=7, noise=0.1)
+    np.testing.assert_array_equal(xb, xm)
+    assert set(np.unique(ym)) <= set(range(10))
+    assert len(np.unique(ym)) == 10
+    np.testing.assert_array_equal(np.where(ym % 2 == 0, 1, -1), yb)

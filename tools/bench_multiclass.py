@@ -1,0 +1,164 @@
+"""Multiclass training benchmark -> BENCH_MULTICLASS.md (VERDICT
+round-4 item 2's measured artifact).
+
+10-class MNIST-shaped data (dpsvm_tpu.data.synth.make_mnist_multiclass
+— the make_mnist_like generator before its even/odd collapse), at the
+reference's MNIST hyperparameters (c=10, gamma=0.125, eps=0.01,
+reference Makefile:74). The reference itself cannot train this at all:
+it pre-reduced MNIST to even/odd offline
+(scripts/convert_mnist_to_odd_even.py).
+
+What the table must show (the round-4 verdict's 'done' bar): end-to-end
+wall ~= the sum of the per-submodel device solve times — i.e. the OvR
+X re-upload per class is gone (solver/smo.py _XDEV_MEMO) and the OvO
+per-pair recompiles are gone (power-of-two shape buckets, solve
+pad_to). A second, executor-warm run separates one-time XLA compiles
+from the steady-state cost.
+
+Two phases so the slow CPU oracle can run while the TPU works:
+  python tools/bench_multiclass.py --oracle   (sklearn OvO at the 10k
+                                               anchor, writes artifacts/)
+  python tools/bench_multiclass.py            (TPU runs + the artifact)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+C, GAMMA, EPS = 10.0, 0.125, 0.01
+N_FULL, N_ANCHOR, D = 60_000, 10_000, 784
+
+
+def make_data(n):
+    from dpsvm_tpu.data.synth import make_mnist_multiclass
+
+    x, y = make_mnist_multiclass(n=N_FULL, d=D, seed=7, noise=0.1)
+    return x[:n], y[:n]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--oracle", action="store_true")
+    args = ap.parse_args()
+    outdir = os.path.join(REPO, "artifacts")
+    os.makedirs(outdir, exist_ok=True)
+    opath = os.path.join(outdir, "oracle_multiclass10k.json")
+
+    if args.oracle:
+        from sklearn.svm import SVC
+
+        x, y = make_data(N_ANCHOR)
+        t0 = time.perf_counter()
+        sk = SVC(C=C, gamma=GAMMA, tol=EPS, cache_size=4000).fit(x, y)
+        secs = time.perf_counter() - t0
+        summary = dict(n=N_ANCHOR, n_sv=int(sk.n_support_.sum()),
+                       acc=float(sk.score(x, y)), seconds=round(secs, 1))
+        with open(opath, "w") as fh:
+            json.dump(summary, fh)
+        print(f"[oracle] {json.dumps(summary)}", flush=True)
+        return 0
+
+    import jax
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.models.multiclass import (accuracy_multiclass,
+                                             train_multiclass)
+
+    with open(opath) as fh:
+        oracle = json.load(fh)
+
+    cfg = SVMConfig(c=C, gamma=GAMMA, epsilon=EPS, engine="block",
+                    working_set_size=256, cache_lines=0)
+
+    def run(n, strategy):
+        x, y = make_data(n)
+        # Cold pass: includes every XLA compile + the one X upload.
+        t0 = time.perf_counter()
+        m, results = train_multiclass(x, y, cfg, strategy=strategy,
+                                      backend="single")
+        cold = time.perf_counter() - t0
+        # Warm pass: executors cached -> end-to-end is transfers +
+        # dispatches + host glue. THIS is the number the 'e2e ~= sum of
+        # solve times' bar judges.
+        t0 = time.perf_counter()
+        m, results = train_multiclass(x, y, cfg, strategy=strategy,
+                                      backend="single")
+        warm = time.perf_counter() - t0
+        dev = sum(r.train_seconds for r in results)
+        t0 = time.perf_counter()
+        acc = accuracy_multiclass(m, x, y)
+        pred_s = time.perf_counter() - t0
+        conv = sum(r.converged for r in results)
+        row = dict(n=n, strategy=strategy, models=len(results),
+                   converged=conv, device_s=round(dev, 3),
+                   warm_e2e_s=round(warm, 2), cold_e2e_s=round(cold, 2),
+                   train_acc=round(float(acc), 4),
+                   predict_s=round(pred_s, 2))
+        print(json.dumps(row), flush=True)
+        return row
+
+    rows = [run(N_ANCHOR, "ovr"), run(N_ANCHOR, "ovo"),
+            run(N_FULL, "ovr"), run(N_FULL, "ovo")]
+
+    dev = str(jax.devices()[0])
+    lines = [
+        "# BENCH_MULTICLASS — 10-class MNIST-shaped training",
+        "",
+        "Command: `python tools/bench_multiclass.py` (real TPU; "
+        "generator `make_mnist_multiclass(n=60000, d=784, seed=7, "
+        "noise=0.1)`, hyperparameters from the reference's MNIST run, "
+        "reference Makefile:74). The reference cannot train multiclass "
+        "at all — it pre-reduced MNIST to even/odd offline "
+        "(scripts/convert_mnist_to_odd_even.py); this artifact measures "
+        "the capability extension at the reference's own scale.",
+        "",
+        f"* device: {dev}",
+        f"* sklearn oracle (n={oracle['n']} anchor, same generator/"
+        f"hyperparameters): train accuracy {oracle['acc']:.4f}, "
+        f"{oracle['n_sv']} SVs, fit in {oracle['seconds']:.0f} s "
+        "(single-core LibSVM OvO)",
+        "",
+        "| n | strategy | submodels | converged | device solve s (sum) |"
+        " warm e2e s | cold e2e s | train acc | predict s |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['n']} | {r['strategy']} | {r['models']} | "
+            f"{r['converged']}/{r['models']} | {r['device_s']} | "
+            f"{r['warm_e2e_s']} | {r['cold_e2e_s']} | {r['train_acc']} | "
+            f"{r['predict_s']} |")
+    a_ovr, a_ovo = rows[0], rows[1]
+    lines += [
+        "",
+        f"Accuracy parity at the oracle-tractable anchor: ovr "
+        f"{a_ovr['train_acc']} / ovo {a_ovo['train_acc']} vs sklearn "
+        f"{oracle['acc']:.4f}.",
+        "",
+        "Reading the e2e columns: warm e2e minus the device column is "
+        "host glue (label remaps, subset copies, result assembly) plus "
+        "transfers — OvR uploads X ONCE (solver/smo.py _XDEV_MEMO) and "
+        "OvO compiles per power-of-two bucket, not per subset shape "
+        "(solve pad_to), which is what keeps warm e2e in the same "
+        "magnitude as the summed device time instead of 10x it. The "
+        "cold column carries the one-time XLA compiles.",
+        "",
+    ]
+    path = os.path.join(REPO, "BENCH_MULTICLASS.md")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -139,7 +139,7 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
                 n, inner_impl="pallas")
         else:
             run = lambda st, n: run_chunk_block(
-                xd, yd, x_sq, k_diag, st, jnp.int32(10 ** 9), kp,
+                xd, yd, x_sq, k_diag, None, st, jnp.int32(10 ** 9), kp,
                 cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
                 n, inner_impl="pallas")
         jax.block_until_ready(run(base, reps))       # compile + warm
@@ -338,7 +338,7 @@ def main() -> int:
                     b_lo=jnp.float32(1e9), pairs=jnp.int32(0),
                     rounds=jnp.int32(0))
     runner = lambda st: run_chunk_block(
-        xd, yd, x_sq, k_diag, st, jnp.int32(10**9), kp, c,
+        xd, yd, x_sq, k_diag, None, st, jnp.int32(10**9), kp, c,
         float(cfg.epsilon), float(cfg.tau), q, q, args.reps,
         inner_impl="pallas")
     out = runner(st)  # compile + warm
