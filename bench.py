@@ -120,10 +120,13 @@ def main() -> int:
     # the generator's separability than the solver. A second pinned
     # dataset with 10% label flips is genuinely non-separable (every
     # flipped point becomes a bound SV), exercising the solver's soft-
-    # margin tail. Same engine config, same oracle-quality gate below.
+    # margin tail. Same engine config with its own (much larger) pair
+    # budget — the non-separable problem legitimately needs far more
+    # than the easy regime's 100k cap; same oracle-quality gate below.
+    hard_config = config.replace(max_iter=20_000_000)
     xh, yh = make_mnist_like(n=N, d=D, seed=7, noise=0.1, label_flip=0.10)
-    solve(xh, yh, config.replace(max_iter=64))  # warm the executor
-    hard_runs = [solve(xh, yh, config) for _ in range(3)]
+    solve(xh, yh, hard_config.replace(max_iter=64))  # warm the executor
+    hard_runs = [solve(xh, yh, hard_config) for _ in range(3)]
     hres = min(hard_runs, key=lambda r: r.train_seconds)
     hard_seconds = hres.train_seconds
 
@@ -150,7 +153,11 @@ def main() -> int:
         a, f = r.alpha, r.stats["f"]
         return float(a.sum() - 0.5 * np.sum(a * yh * (f + yh)))
 
-    refh = solve(xh, yh, config.replace(engine="xla", dtype="float32"))
+    # The hard oracle stays on the block engine at fp32 (per-pair xla
+    # at this shape/pair-count would cost minutes per run; the EASY
+    # gate above already pins block-vs-per-pair engine parity — this
+    # gate isolates the bf16 storage risk on the harder data).
+    refh = solve(xh, yh, hard_config.replace(dtype="float32"))
     assert hres.converged, "hard convergence run did not converge"
     obj_th, obj_rh = dual_obj_h(hres), dual_obj_h(refh)
     assert abs(obj_th - obj_rh) <= 0.005 * abs(obj_rh), (obj_th, obj_rh)

@@ -42,6 +42,63 @@ except ImportError:  # sklearn genuinely absent: degrade to plain objects
 
 from dpsvm_tpu.config import SVMConfig
 
+try:
+    from sklearn.utils.metaestimators import available_if as _available_if
+except ImportError:
+    def _available_if(check):
+        def deco(fn):
+            return fn
+        return deco
+
+
+def _has_probability(est) -> bool:
+    """predict_proba exists only when probability=True — sklearn.SVC's
+    own contract (hasattr-based checks must see it absent, or every
+    method-invariance/pickle check calls it and trips the
+    AttributeError)."""
+    if not est.probability:
+        raise AttributeError(
+            "predict_proba requires probability=True at fit time")
+    return True
+
+
+def _validate_fit(est, X, y=None, *, y_numeric=False, requires_y=True):
+    """sklearn's fit-time input contract (estimator_checks battery):
+    2-D finite real X (sparse rejected with the standard TypeError),
+    ``n_features_in_``/``feature_names_in_`` recorded, y 1-D and
+    length-matched (column-vector y warns + ravels), informative error
+    on y=None for supervised estimators. Degrades to plain asarray when
+    sklearn is absent."""
+    try:
+        from sklearn.utils.validation import validate_data
+    except ImportError:
+        X = np.asarray(X, np.float32)
+        return (X, None) if y is None else (X, np.asarray(y))
+    if y is None and not requires_y:
+        return validate_data(est, X, dtype=np.float32), None
+    # y=None on a supervised estimator raises the standard
+    # "requires y to be passed" ValueError inside validate_data.
+    return validate_data(est, X, y, dtype=np.float32, y_numeric=y_numeric)
+
+
+def _validate_predict(est, X):
+    """Predict-time counterpart: NotFittedError before fit, the same X
+    contract, and a feature-count match against fit."""
+    try:
+        from sklearn.utils.validation import check_is_fitted, validate_data
+    except ImportError:
+        return np.asarray(X, np.float32)
+    check_is_fitted(est)
+    return validate_data(est, X, dtype=np.float32, reset=False)
+
+
+def _check_classification_y(y):
+    try:
+        from sklearn.utils.multiclass import check_classification_targets
+    except ImportError:
+        return
+    check_classification_targets(y)
+
 
 def _resolve_gamma(gamma, x: np.ndarray) -> float:
     if gamma == "scale":
@@ -151,11 +208,14 @@ class SVC(ClassifierMixin, BaseEstimator):
         from dpsvm_tpu.models.multiclass import train_multiclass
         from dpsvm_tpu.train import train
 
-        X = np.asarray(X, np.float32)
+        X, y = _validate_fit(self, X, y)
+        _check_classification_y(y)
         y = np.asarray(y)
         self.classes_ = np.unique(y)
         if self.classes_.shape[0] < 2:
-            raise ValueError("SVC needs at least 2 classes")
+            raise ValueError(
+                f"SVC needs at least 2 classes; the data has "
+                f"{self.classes_.shape[0]} class")
         if (self.probability and self.classes_.shape[0] > 2
                 and self.strategy != "ovr"):
             # Constructor-parameter check — fail before k*(k-1)/2 solver
@@ -250,13 +310,12 @@ class SVC(ClassifierMixin, BaseEstimator):
                             k=self.probability_cv,
                             seed=self.random_state)
 
+    @_available_if(_has_probability)
     def predict_proba(self, X):
-        """Class-probability matrix (n, k), classes in ``classes_`` order."""
+        """Class-probability matrix (n, k), classes in ``classes_`` order.
+        Only available when probability=True (sklearn.SVC contract)."""
         from dpsvm_tpu.models.platt import platt_probability
-        if not self.probability:
-            raise AttributeError(
-                "predict_proba requires probability=True at fit time")
-        X = np.asarray(X, np.float32)
+        X = _validate_predict(self, X)
         if self._binary_model is not None:
             p_pos = platt_probability(self.decision_function(X), *self._platt)
             return np.stack([1.0 - p_pos, p_pos], axis=1)
@@ -272,7 +331,7 @@ class SVC(ClassifierMixin, BaseEstimator):
         """(n,) for binary, (n, k) per-class scores otherwise (OvO models
         are folded to per-class vote scores, sklearn's default ovr shape)."""
         from dpsvm_tpu.predict import decision_function
-        X = np.asarray(X, np.float32)
+        X = _validate_predict(self, X)
         if getattr(self, "_pre_coef", None) is not None:
             # X is K(test, train): kernel values against every TRAINING
             # row, columns indexed by the stored support set.
@@ -288,7 +347,7 @@ class SVC(ClassifierMixin, BaseEstimator):
         return vote_matrix(self._multiclass_model, X)
 
     def predict(self, X):
-        X = np.asarray(X, np.float32)
+        X = _validate_predict(self, X)
         if (getattr(self, "_pre_coef", None) is not None
                 or self._binary_model is not None):
             d = self.decision_function(X)
@@ -327,7 +386,7 @@ class SVR(RegressorMixin, BaseEstimator):
 
     def fit(self, X, y):
         from dpsvm_tpu.models.svr import train_svr
-        X = np.asarray(X, np.float32)
+        X, y = _validate_fit(self, X, y, y_numeric=True)
         y = np.asarray(y, np.float32)
         cfg = _base_config(self, _resolve_gamma(self.gamma, X))
         backend = self.backend
@@ -340,7 +399,8 @@ class SVR(RegressorMixin, BaseEstimator):
         return self
 
     def predict(self, X):
-        return self._model.predict(np.asarray(X, np.float32))
+        X = _validate_predict(self, X)  # NotFittedError before _model
+        return self._model.predict(X)
 
     def score(self, X, y, sample_weight=None):
         return _weighted_r2(self.predict(X), y, sample_weight)
@@ -368,7 +428,7 @@ class OneClassSVM(OutlierMixin, BaseEstimator):
 
     def fit(self, X, y=None):
         from dpsvm_tpu.models.oneclass import train_oneclass
-        X = np.asarray(X, np.float32)
+        X, _ = _validate_fit(self, X, requires_y=False)
         cfg = _base_config(self, _resolve_gamma(self.gamma, X))
         backend = self.backend
         if backend == "auto":
@@ -377,20 +437,36 @@ class OneClassSVM(OutlierMixin, BaseEstimator):
                                           backend=backend)
         self.fit_result_ = res
         self.n_iter_ = res.iterations
+        # sklearn's convention: decision_function = score_samples -
+        # offset_ with score_samples the UNSHIFTED kernel sum, so
+        # offset_ IS rho (sklearn stores intercept_ = -rho and
+        # offset_ = -intercept_).
+        self.offset_ = float(self._model.rho)
         return self
 
     def decision_function(self, X):
-        return self._model.decision_function(np.asarray(X, np.float32))
+        X = _validate_predict(self, X)  # NotFittedError before _model
+        # float64 out: sklearn's outlier API contract asserts the
+        # double dtype (check_outliers_train); the evaluation itself is
+        # the shared f32 MXU path.
+        return self._model.decision_function(X).astype(np.float64)
+
+    def score_samples(self, X):
+        """The unshifted kernel sum sum_i coef_i K(sv_i, X): sklearn's
+        contract decision_function = score_samples - offset_ with
+        offset_ = rho."""
+        return self.decision_function(X) + self.offset_
 
     def predict(self, X):
         return np.where(self.decision_function(X) >= 0, 1, -1)
 
 
 class NuSVC(ClassifierMixin, BaseEstimator):
-    """Binary nu-SVC with sklearn semantics on the TPU solver (the nu
-    duals run the per-class-selection per-pair engine; see
-    models/nusvm.py). Binary only — reduce multiclass problems with
-    sklearn's OneVsRestClassifier if needed."""
+    """nu-SVC with sklearn semantics on the TPU solver (the nu duals
+    run the per-class-selection engine; see models/nusvm.py). Multiclass
+    problems reduce transparently via one-vs-one with the nu trainer
+    under each pair — nu bounds the margin-error/SV fractions PER PAIR,
+    matching sklearn.svm.NuSVC's own OvO semantics."""
 
     def __init__(self, nu=0.5, kernel="rbf", degree=3, gamma="scale",
                  coef0=0.0, tol=1e-3, max_iter=-1, backend="auto",
@@ -409,26 +485,57 @@ class NuSVC(ClassifierMixin, BaseEstimator):
     def fit(self, X, y):
         from dpsvm_tpu.models.nusvm import train_nusvc
 
-        X = np.asarray(X, np.float32)
+        X, y = _validate_fit(self, X, y)
+        _check_classification_y(y)
         y = np.asarray(y)
         self.classes_ = np.unique(y)
-        if self.classes_.shape[0] != 2:
-            raise ValueError("NuSVC is binary; got "
-                             f"{self.classes_.shape[0]} classes")
-        y_pm = np.where(y == self.classes_[1], 1, -1).astype(np.int32)
+        if self.classes_.shape[0] < 2:
+            raise ValueError(
+                f"NuSVC needs at least 2 classes; the data has "
+                f"{self.classes_.shape[0]} class")
         cfg = _base_config(self, _resolve_gamma(self.gamma, X))
-        self._model, res = train_nusvc(X, y_pm, nu=self.nu, config=cfg,
-                                       backend=self.backend)
-        self.fit_result_ = res
-        self.n_iter_ = res.iterations
+        if self.classes_.shape[0] == 2:
+            y_pm = np.where(y == self.classes_[1], 1, -1).astype(np.int32)
+            self._model, res = train_nusvc(X, y_pm, nu=self.nu,
+                                           config=cfg,
+                                           backend=self.backend)
+            self._multiclass_model = None
+            self.fit_result_ = res
+            self.n_iter_ = res.iterations
+            return self
+        # Multiclass: the one-vs-one reduction with the nu-SVC trainer
+        # under it (sklearn.NuSVC is OvO multiclass too; nu bounds the
+        # margin-error/SV fractions PER PAIR, its natural scope —
+        # pad_to is ignored because the nu start point depends on exact
+        # class counts).
+        from dpsvm_tpu.models.multiclass import train_multiclass
+
+        def nu_trainer(xx, yy, c, backend="auto", num_devices=None,
+                       pad_to=None):
+            return train_nusvc(xx, yy, nu=self.nu, config=c,
+                               backend=backend, num_devices=num_devices)
+
+        mc, results = train_multiclass(X, y, cfg, strategy="ovo",
+                                       backend=self.backend,
+                                       trainer=nu_trainer)
+        self._model = None
+        self._multiclass_model = mc
+        self.fit_result_ = results
+        self.n_iter_ = int(sum(r.iterations for r in results))
         return self
 
     def decision_function(self, X):
         from dpsvm_tpu.predict import decision_function
-        return decision_function(self._model, np.asarray(X, np.float32))
+        X = _validate_predict(self, X)  # NotFittedError before _model
+        if self._model is None:
+            from dpsvm_tpu.models.multiclass import vote_matrix
+            return vote_matrix(self._multiclass_model, X)
+        return decision_function(self._model, X)
 
     def predict(self, X):
         scores = self.decision_function(X)
+        if scores.ndim == 2:  # multiclass: per-class vote scores
+            return self.classes_[np.argmax(scores, axis=1)]
         return self.classes_[(scores > 0).astype(int)]
 
     def score(self, X, y, sample_weight=None):
@@ -457,7 +564,7 @@ class NuSVR(RegressorMixin, BaseEstimator):
     def fit(self, X, y):
         from dpsvm_tpu.models.nusvm import train_nusvr
 
-        X = np.asarray(X, np.float32)
+        X, y = _validate_fit(self, X, y, y_numeric=True)
         y = np.asarray(y, np.float32)
         cfg = _base_config(self, _resolve_gamma(self.gamma, X))
         self._model, res = train_nusvr(X, y, nu=self.nu, c=self.C,
@@ -467,7 +574,8 @@ class NuSVR(RegressorMixin, BaseEstimator):
         return self
 
     def predict(self, X):
-        return self._model.predict(np.asarray(X, np.float32))
+        X = _validate_predict(self, X)  # NotFittedError before _model
+        return self._model.predict(X)
 
     def score(self, X, y, sample_weight=None):
         return _weighted_r2(self.predict(X), y, sample_weight)

@@ -76,14 +76,27 @@ def train_multiclass(
     backend: str = "auto",
     num_devices: Optional[int] = None,
     verbose: bool = False,
+    trainer=None,
 ) -> tuple[MulticlassSVM, list]:
-    """Train a multiclass SVM; y may hold arbitrary integer labels."""
+    """Train a multiclass SVM; y may hold arbitrary integer labels.
+
+    `trainer(x, y_pm, config, backend=..., num_devices=..., pad_to=...)
+    -> (SVMModel, SolveResult)` swaps the binary solver under the
+    reduction — the default is C-SVC ``train``; estimators.NuSVC passes
+    a nu-SVC trainer so its multiclass reduction uses the nu duals per
+    split."""
     if config.kernel == "precomputed":
         raise ValueError(
             "kernel='precomputed' is implemented for binary C-SVC only "
             "(each OvR/OvO split needs its own Gram sub-matrix); the reduction would need "
             "a transformed Gram matrix, not transformed features")
     from dpsvm_tpu.train import train
+
+    if trainer is None:
+        def trainer(xx, yy, cfg, backend="auto", num_devices=None,
+                    pad_to=None):
+            return train(xx, yy, cfg, backend=backend,
+                         num_devices=num_devices, pad_to=pad_to)
 
     x = np.asarray(x, np.float32)
     y = np.asarray(y)
@@ -101,8 +114,8 @@ def train_multiclass(
     if strategy == "ovr":
         for k, cls_label in enumerate(classes):
             yk = np.where(y == cls_label, 1, -1).astype(np.int32)
-            model, res = train(x, yk, config, backend=backend,
-                               num_devices=num_devices)
+            model, res = trainer(x, yk, config, backend=backend,
+                                 num_devices=num_devices)
             if verbose:
                 print(f"[ovr {k + 1}/{len(classes)}] class={cls_label} "
                       f"iters={res.iterations} n_sv={res.n_sv}")
@@ -121,8 +134,9 @@ def train_multiclass(
                 # ~1-2 buckets (padding is masked out of selection;
                 # solver/smo.py solve pad_to).
                 bucket = 1 << (len(xa) - 1).bit_length()
-                model, res = train(xa, ya, config, backend=backend,
-                                   num_devices=num_devices, pad_to=bucket)
+                model, res = trainer(xa, ya, config, backend=backend,
+                                     num_devices=num_devices,
+                                     pad_to=bucket)
                 if verbose:
                     print(f"[ovo {classes[a]} vs {classes[b]}] "
                           f"iters={res.iterations} n_sv={res.n_sv}")
@@ -144,10 +158,105 @@ def predict_multiclass(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
     return m.classes[np.argmax(vote_matrix(m, q, block), axis=1)]
 
 
+def _stacked_batch_factory():
+    """Module-level jitted stacked evaluator (built lazily so jax stays
+    a deferred import here). jax.jit caches are keyed on the wrapper
+    OBJECT: defining the jit inside _stacked_decision would retrace and
+    recompile on every predict call — seconds each through a tunneled
+    runtime (review finding, round 5)."""
+    global _STACKED_BATCH
+    if _STACKED_BATCH is not None:
+        return _STACKED_BATCH
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("kp",))
+    def batch(qb, sv, coef, b, kp):
+        dots = jnp.einsum("nd,kmd->knm", qb, sv,
+                          preferred_element_type=jnp.float32)
+        if kp.kind == "rbf":
+            qsq = jnp.einsum("nd,nd->n", qb, qb)
+            ssq = jnp.einsum("kmd,kmd->km", sv, sv)
+            sq = jnp.maximum(qsq[None, :, None] + ssq[:, None, :]
+                             - 2.0 * dots, 0.0)
+            kv = jnp.exp(-kp.gamma * sq)
+        elif kp.kind == "linear":
+            kv = dots
+        elif kp.kind == "poly":
+            kv = (kp.gamma * dots + kp.coef0) ** kp.degree
+        elif kp.kind == "sigmoid":
+            kv = jnp.tanh(kp.gamma * dots + kp.coef0)
+        else:
+            raise ValueError(f"unknown kernel kind {kp.kind!r}")
+        return (jnp.einsum("knm,km->kn", kv, coef) - b[:, None]).T
+
+    _STACKED_BATCH = batch
+    return batch
+
+
+_STACKED_BATCH = None
+
+
+def _stacked_decision(models, q, block: int) -> np.ndarray:
+    """All submodels' decision values in ONE batched dispatch per query
+    block: (n, n_models) float32.
+
+    Per-model prediction costs a device round-trip per model per block —
+    through a tunneled runtime that is ~1 s of latency each, and a
+    45-model OvO predict spent minutes on ~90 dispatches while the
+    actual MXU work was milliseconds (BENCH_MULTICLASS.md round 5).
+    Here every model's SVs pad to the shared power-of-two bucket (zero
+    dual coefficients contribute nothing), the stack evaluates as one
+    (k, nb, m) batched einsum chain, and the dispatch count drops to
+    n/block. All submodels share one kernel family by construction
+    (train_multiclass replicates config)."""
+    import jax.numpy as jnp
+
+    kp = models[0].kernel
+    d = models[0].sv_x.shape[1]
+    m_pad = 1 << max(4, (max(mm.sv_x.shape[0] for mm in models) - 1)
+                     .bit_length())
+    k = len(models)
+    sv = np.zeros((k, m_pad, d), np.float32)
+    coef = np.zeros((k, m_pad), np.float32)
+    b = np.zeros((k,), np.float32)
+    for i, mm in enumerate(models):
+        ns = mm.sv_x.shape[0]
+        sv[i, :ns] = mm.sv_x
+        coef[i, :ns] = mm.dual_coef
+        b[i] = mm.b
+
+    batch = _stacked_batch_factory()
+
+    # Bound the (k, nb, m) kernel tile: shrink the query block so the
+    # tile stays under ~1 GB regardless of model count / bucket size.
+    blk = max(128, min(block, (1 << 28) // max(1, k * m_pad)))
+    sv_d, coef_d, b_d = jnp.asarray(sv), jnp.asarray(coef), jnp.asarray(b)
+    out = []
+    q = np.asarray(q, np.float32)
+    for s in range(0, q.shape[0], blk):
+        qb = q[s:s + blk]
+        nb = qb.shape[0]
+        nb_pad = 1 << max(4, (nb - 1).bit_length())
+        if nb_pad != nb:
+            qp = np.zeros((nb_pad, d), np.float32)
+            qp[:nb] = qb
+            qb = qp
+        out.append(np.asarray(batch(jnp.asarray(qb), sv_d, coef_d, b_d,
+                                    kp))[:nb])
+    return (np.concatenate(out) if out
+            else np.zeros((0, k), np.float32))
+
+
 def decision_matrix(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
     """Raw decision values, one column per fitted model: (n, k) per-class
     scores for OvR, (n, k*(k-1)/2) pairwise columns (a<b order) for OvO."""
     q = np.asarray(q, np.float32)
+    if len(m.models) > 1 and all(mm.kernel == m.models[0].kernel
+                                 for mm in m.models):
+        return _stacked_decision(m.models, q, block)
     return np.stack(
         [decision_function(mm, q, block) for mm in m.models], axis=1)
 
@@ -162,10 +271,13 @@ def vote_matrix(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
     k = len(m.classes)
     votes = np.zeros((q.shape[0], k), np.float64)
     conf = np.zeros((q.shape[0], k), np.float64)
+    # One stacked device pass for all pairwise columns (see
+    # _stacked_decision); the vote fold is host numpy.
+    dec = decision_matrix(m, q, block).astype(np.float64)
     idx = 0
     for a in range(k):
         for b in range(a + 1, k):
-            d = decision_function(m.models[idx], q, block).astype(np.float64)
+            d = dec[:, idx]
             win_a = d >= 0
             votes[:, a] += win_a
             votes[:, b] += ~win_a

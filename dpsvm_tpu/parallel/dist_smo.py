@@ -442,9 +442,14 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
 
     # Fused fold+select (mesh counterpart of solver/block.py
     # run_chunk_block_fused): each shard's fold + candidate selection is
-    # one Pallas pass; pays in the big-n_loc pod regime (single-chip
-    # crossover measured at ~200k rows, PROFILE.md round-4). Needs
-    # n_loc padded to 1024 and q/2 <= n_loc/128.
+    # one Pallas pass; pays in the big-n_loc pod regime. The gate keys
+    # on n_loc (each shard's round works its local rows) with the
+    # d-aware measured crossover shared with the single-chip path
+    # (solver/block.py fused_fold_pays — round-5 sweep covering the
+    # n_loc band pods actually land in). Needs n_loc padded to 1024 and
+    # q/2 <= n_loc/128.
+    from dpsvm_tpu.solver.block import fused_fold_pays
+
     _platform = mesh.devices.flat[0].platform
     _n_pad_f = pad_rows(n, n_dev, multiple=1024)
     _n_loc_f = _n_pad_f // n_dev
@@ -454,7 +459,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                  and min(config.working_set_size, _n_loc_f)
                  <= _n_loc_f // 64
                  and (config.fused_fold if config.fused_fold is not None
-                      else (_platform == "tpu" and _n_loc_f >= 200_000)))
+                      else (_platform == "tpu"
+                            and fused_fold_pays(_n_loc_f, d))))
     n_pad = _n_pad_f if use_fused else pad_rows(n, n_dev)
     if kp.kind == "precomputed":
         if n != d:

@@ -45,13 +45,38 @@ def decision_function(model: SVMModel, q, block: int = 8192,
     if precision != "float32":
         raise ValueError("precision must be 'float32' or 'float64'")
     q = np.asarray(q, np.float32)
-    sv_x = jnp.asarray(model.sv_x)
-    coef = jnp.asarray(model.dual_coef)
+    # Shape bucketing, both operands. XLA executors are shape-keyed and
+    # every fitted model has its OWN n_sv: multiclass prediction over k
+    # (or k(k-1)/2) models would otherwise compile per model — measured
+    # ~4 minutes of compiles for a 45-model OvO predict vs ~5 s of
+    # actual evaluation (BENCH_MULTICLASS.md). SVs pad to the next
+    # power of two with ZERO dual coefficients (zero contribution, at
+    # most 2x padded FLOPs); the final partial query block pads to a
+    # power of two the same way.
+    n_sv, d = model.sv_x.shape
+    m_pad = 1 << max(4, (max(n_sv, 1) - 1).bit_length())
+    if m_pad != n_sv:
+        sv_p = np.zeros((m_pad, d), np.float32)
+        sv_p[:n_sv] = model.sv_x
+        coef_p = np.zeros((m_pad,), np.float32)
+        coef_p[:n_sv] = model.dual_coef
+    else:
+        sv_p, coef_p = model.sv_x, model.dual_coef
+    sv_x = jnp.asarray(sv_p)
+    coef = jnp.asarray(coef_p)
     b = jnp.float32(model.b)
     out = []
     for s in range(0, q.shape[0], block):
+        qb = q[s:s + block]
+        nb = qb.shape[0]
+        nb_pad = 1 << max(4, (nb - 1).bit_length())
+        if nb_pad != nb:
+            qp = np.zeros((nb_pad, d), np.float32)
+            qp[:nb] = qb
+            qb = qp
         out.append(np.asarray(
-            _decision_batch(jnp.asarray(q[s:s + block]), sv_x, coef, b, model.kernel)))
+            _decision_batch(jnp.asarray(qb), sv_x, coef, b,
+                            model.kernel))[:nb])
     return np.concatenate(out) if out else np.zeros((0,), np.float32)
 
 

@@ -67,6 +67,27 @@ class BlockState(NamedTuple):
         return jnp.int32(0)
 
 
+def fused_fold_pays(n_rows: int, d: int) -> bool:
+    """The fused fold+select auto-gate's measured crossover (shared by
+    the single-chip and mesh paths so the constants live once).
+
+    Round-5 same-session sweep (tools/profile_round.py --ablate-only,
+    fused-vs-plain FIXED round cost, q=512, fp32, real v5e):
+
+      | rows | d=54 plain/fused | d=784 plain/fused |
+      |------|------------------|-------------------|
+      | 100k | 1.44 / 1.11 ms (-23%) | 2.03 / 2.28 ms (+13%) |
+      | 150k | 1.63 / 1.44 ms (-12%) | 2.84 / 2.66 ms (-6%)  |
+      | 250k | 1.86 / 1.79 ms (-4%)  | 3.93 / 3.85 ms (-2%)  |
+
+    Small-d rounds win from ~100k rows (selection mask-building over n
+    is a larger fraction of their round); large-d rounds cross between
+    100k and 150k (the fold matmul dominates and the fuse's extra
+    launch costs relatively more). Round-4's single 200k constant sat
+    inside the unmeasured 60k-500k band — the verdict's item 6."""
+    return n_rows >= (100_000 if d <= 128 else 150_000)
+
+
 def select_block(f, alpha, y, c, q: int, valid=None, rule: str = "mvp"):
     """Pick the q most-violating points: q/2 from I_up (smallest f) and
     q/2 from I_low (largest f). Returns (w, slot_ok, b_hi, b_lo):

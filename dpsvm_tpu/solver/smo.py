@@ -803,27 +803,29 @@ def _resolve_gram(config: SVMConfig, kp: KernelParams, n: int,
             and 4 * n * n <= _gram_budget_bytes(device))
 
 
-def _resident_gram_cached(x_host, x_p, dtype, kp: KernelParams,
-                          config: SVMConfig, device):
+def _resident_gram_cached(x_host, build_x_p, n_pad, dtype,
+                          kp: KernelParams, config: SVMConfig, device):
     """(gram, k_diag) for resident-Gram mode, memoized across legs.
 
-    Owns the whole build so a memo HIT costs nothing: no feature
-    re-upload, no squared-norm/diag recompute. A weakref finalizer
-    evicts the entry the moment the host array dies — a multi-GB device
-    Gram must never outlive the data it was built from (it would pin up
-    to ~70% of HBM against later unrelated work)."""
+    Owns the whole build so a memo HIT costs nothing: `build_x_p` is
+    only called on a miss (the padded host copy is itself ~O(n d)
+    bytes), and no feature re-upload or squared-norm/diag recompute
+    happens. A weakref finalizer evicts the entry the moment the host
+    array dies — a multi-GB device Gram must never outlive the data it
+    was built from (it would pin up to ~70% of HBM against later
+    unrelated work)."""
     import weakref
 
     from dpsvm_tpu.ops.kernels import resident_gram
 
     # Keyed on the PADDED build shape, not the host shape: the same host
     # X solved at two pad_to buckets needs two distinct Grams.
-    key = (kp, x_p.shape, config.dtype, getattr(device, "id", None),
-           config.resolve_precision())
+    key = (kp, (n_pad, x_host.shape[1]), config.dtype,
+           getattr(device, "id", None), config.resolve_precision())
     ent = _GRAM_MEMO.get(key)
     if ent is not None and ent[0]() is x_host:
         return ent[1], ent[2]
-    x_feat = jax.device_put(jnp.asarray(x_p, dtype), device)
+    x_feat = jax.device_put(jnp.asarray(build_x_p(), dtype), device)
     x_sq_f = jax.jit(squared_norms)(x_feat)
     k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq_f,
                                                             params=kp)
@@ -967,9 +969,11 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     # top-h runs over n_pad/128 per-row candidates): q/2 <= n_pad/128.
     # Auto mode additionally requires large n: the fuse removes the
     # full-n mask+approx_max_k stage but adds a pallas launch + delta
-    # round-trip + candidate top-k, measured net -11% fixed round cost
-    # at n=500k (0.617 vs 0.690 ms) and net LOSS at n=60k (headline
-    # bench 0.184 vs 0.164 s) — see PROFILE.md round-4 section.
+    # round-trip + candidate top-k — a net LOSS on small rounds. The
+    # crossover is d-dependent and pinned by the round-5 sweep
+    # (solver/block.py fused_fold_pays docstring table).
+    from dpsvm_tpu.solver.block import fused_fold_pays
+
     n_pad_fused = -(-n // 1024) * 1024
     use_fused = (use_block and config.selection != "nu"
                  and not config.active_set_size
@@ -978,7 +982,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                  <= n_pad_fused // 64
                  and (config.fused_fold if config.fused_fold is not None
                       else (device.platform == "tpu"
-                            and n_pad_fused >= 200_000)))
+                            and fused_fold_pays(n_pad_fused, d))))
     block_rows = 64
     # Engine row-granularity, then the caller's shape bucket (`pad_to`,
     # see solve()): padded rows are masked out of every selection.
@@ -1031,8 +1035,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         # kernel diag comes from the FEATURE side (exact: rbf diag is
         # exactly 1, no Gram round-trip), and the original host x stays
         # the memo key so reconstruction legs reuse one build.
-        x_dev, k_diag = _resident_gram_cached(x, build_x_p(), dtype, kp,
-                                              config, device)
+        x_dev, k_diag = _resident_gram_cached(x, build_x_p, n_pad, dtype,
+                                              kp, config, device)
         kp = KernelParams("precomputed")
         x_sq = jnp.zeros((n_pad,), jnp.float32)
     elif kp.kind == "precomputed":
@@ -1081,6 +1085,23 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 alpha=jnp.asarray(a_pad), f=jnp.asarray(f_pad),
                 b_hi=jnp.float32(bh0), b_lo=jnp.float32(bl0),
                 it=jnp.int32(it0))
+    if config.active_set_size:
+        # Measured across every regime tried over two rounds (extreme-C
+        # stress, moderate-C huge-n, sparse-margin blobs; 12 configs —
+        # BENCH_COVTYPE_SWEEP.md round-5 section), active-set shrinking
+        # NEVER beat the plain block engine on TPU: the plain engine's
+        # full-n fold is one fused MXU matmul whose cost the active
+        # gather/reconcile machinery does not undercut, and restricted
+        # cycles converge slower. The knob stays (it is exact, and other
+        # hardware may differ) but using it warrants this warning.
+        import warnings
+
+        warnings.warn(
+            "active_set_size (shrinking) is measured SLOWER than the "
+            "plain block engine in every regime tried on TPU (best case "
+            "a tie; see BENCH_COVTYPE_SWEEP.md) — prefer "
+            "active_set_size=0 unless you have measured a win on your "
+            "workload", stacklevel=2)
     if use_block:
         from dpsvm_tpu.solver.block import BlockState, run_chunk_block
 

@@ -21,19 +21,37 @@ from sklearn.utils.estimator_checks import parametrize_with_checks
 
 from dpsvm_tpu.estimators import SVC, SVR, NuSVC, NuSVR, OneClassSVM
 
-# Contracts the facade deliberately does not implement, with reasons.
-# Keyed by substring of the check name; applied to every estimator.
-_SKIPS = {
-    "check_sample_weights": "fit() has no sample_weight (the solver's "
-        "per-class weights cover LibSVM -w; per-row weights are not in "
-        "the reference's problem class)",
-    "check_estimator_sparse": "dense-only: the TPU solver's kernel rows "
-        "are MXU matmuls over dense X; callers densify first",
+# Contracts the facade deliberately does not implement, with reasons
+# (marked xfail, non-strict). Everything else in the battery passes:
+# the sparse/NaN/1-D/complex/empty rejections, n_features_in_,
+# NotFittedError ordering, OvO-multiclass NuSVC, the OneClassSVM
+# outlier API and predict_proba's available_if gating were all
+# implemented against this battery (round 5).
+_F32_INVARIANCE = (
+    "prediction evaluates in float32 MXU batches; subset batching "
+    "regroups the accumulation by ~1e-7, above the check's atol but "
+    "below any decision relevance (predict.decision_function "
+    "precision='float64' is the exact path)")
+
+_EXPECTED = {
+    "SVC": {
+        "check_class_weight_classifiers":
+            "per-class C for >2 classes needs per-row box bounds (the "
+            "solver carries the binary +-1 weight pair, LibSVM -w "
+            "parity); binary class_weight IS honored",
+    },
+    "NuSVC": {
+        "check_methods_subset_invariance": _F32_INVARIANCE,
+    },
+    "OneClassSVM": {
+        "check_methods_subset_invariance": _F32_INVARIANCE,
+        "check_methods_sample_order_invariance": _F32_INVARIANCE,
+    },
 }
 
 
 def _expected_failures(estimator):
-    return {name: reason for name, reason in _SKIPS.items()}
+    return dict(_EXPECTED.get(type(estimator).__name__, {}))
 
 
 # Small max_iter keeps each refit cheap; the checks assert contracts,

@@ -164,5 +164,8 @@ def test_predict_proba_multiclass(multi_xy):
 def test_predict_proba_requires_flag(binary_xy):
     x, y = binary_xy
     est = SVC(C=1.0, gamma=0.1).fit(x, y)
-    with pytest.raises(AttributeError, match="probability=True"):
+    # Hidden via available_if when probability=False (sklearn.SVC
+    # semantics: hasattr is False, the access raises AttributeError).
+    assert not hasattr(est, "predict_proba")
+    with pytest.raises(AttributeError, match="predict_proba"):
         est.predict_proba(x)
