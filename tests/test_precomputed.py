@@ -110,10 +110,12 @@ def test_precomputed_loud_rejections(gram_problem):
     from dpsvm_tpu.models.svr import train_svr
     with pytest.raises(ValueError, match="binary C-SVC only"):
         train_svr(K, y.astype(np.float32), config=pre)
-    # Wrong-width test Gram rejected at predict time.
+    # Wrong-width test Gram rejected at predict time. Since round 5 the
+    # sklearn validate_data layer catches the width mismatch first with
+    # its standard wording; either way the rejection is loud.
     from dpsvm_tpu.estimators import SVC as OurSVC
     est = OurSVC(C=10.0, kernel="precomputed").fit(K, y)
-    with pytest.raises(ValueError, match="columns"):
+    with pytest.raises(ValueError, match="columns|features"):
         est.decision_function(K[:, :300])
 
 
