@@ -64,7 +64,7 @@ def main() -> int:
             a_w = jnp.asarray(alpha[w_np])
             y_w = jnp.asarray(y[w_np].astype(np.float32))
             f_w = jnp.asarray(f[w_np])
-            for pb in ((1, 2) if rule == "mvp" else (1,)):
+            for pb in ((1, 2, 4) if rule == "mvp" else (1,)):
                 a_x, _, t_x = _solve_subproblem(
                     kb_w, kd_w, ok, a_w, y_w, f_w, cfg.c, cfg.epsilon,
                     cfg.tau, jnp.int32(64), rule=rule, pair_batch=pb)
@@ -90,13 +90,24 @@ def main() -> int:
         failures += status == "FAIL"
         print(f"block-engine selection={rule:13s} pairs={r.iterations} "
               f"|b-b_ref|={db:.4f} {status}")
-    r2 = solve(x, y, cfg.replace(engine="block", working_set_size=40,
-                                 pair_batch=2))
-    db2 = abs(r2.b - r_ref.b)
-    status = "OK" if (r2.converged and db2 < 5e-2) else "FAIL"
-    failures += status == "FAIL"
-    print(f"block-engine pair_batch=2    pairs={r2.iterations} "
-          f"|b-b_ref|={db2:.4f} {status}")
+    for pb in (2, 4):
+        r2 = solve(x, y, cfg.replace(engine="block", working_set_size=40,
+                                     pair_batch=pb))
+        db2 = abs(r2.b - r_ref.b)
+        status = "OK" if (r2.converged and db2 < 5e-2) else "FAIL"
+        failures += status == "FAIL"
+        print(f"block-engine pair_batch={pb}    pairs={r2.iterations} "
+              f"|b-b_ref|={db2:.4f} {status}")
+    # Per-pair micro-batch executor (solver/smo.py _run_chunk_micro):
+    # approx_max_k + unrolled dynamic slices must legalize on Mosaic/XLA
+    # TPU, and the stale-rank semantics must land on the same optimum.
+    for pb in (4, 8):
+        rm = solve(x, y, cfg.replace(engine="xla", pair_batch=pb))
+        dbm = abs(rm.b - r_ref.b)
+        status = "OK" if (rm.converged and dbm < 5e-2) else "FAIL"
+        failures += status == "FAIL"
+        print(f"micro-batch pair_batch={pb}    pairs={rm.iterations} "
+              f"|b-b_ref|={dbm:.4f} {status}")
     from dpsvm_tpu.models.nusvm import train_nusvc
 
     m1, _ = train_nusvc(x, y, nu=0.3, config=cfg)
