@@ -88,6 +88,38 @@ def test_validation_matrix():
     SVMConfig(pair_batch=4, engine="block")
 
 
+def test_free_point_in_both_top_lists_cannot_livelock():
+    """Regression (round-5 review): a FREE point sits in both I_up and
+    I_low. When it is simultaneously the rank-0 LOW candidate and a
+    mid-rank UP candidate, a global drop-the-low-copy dedup gates off
+    the maximal violating pair — the only slot guaranteed to execute —
+    and the loop spins in counted no-op trips to max_iter. The
+    rank-ordered collision gating must instead EXECUTE pair 0.
+
+    Crafted state: I_up top-3 = {0, 3, 1} by f, I_low rank-0 = 1 (free),
+    so index 1 collides across the lists exactly as in the finding."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.solver.smo import _run_chunk_micro, init_state
+
+    n, c = 6, 10.0
+    y = jnp.asarray(np.array([1, 1, 1, -1, -1, -1], np.float32))
+    alpha = np.array([0.0, 5.0, 10.0, 10.0, 0.0, 0.0], np.float32)
+    f = np.array([-2.0, -1.0, -5.0, -1.5, -1.9, -1.8], np.float32)
+    x = jnp.eye(n, 4, dtype=jnp.float32)  # any features; rbf rows exist
+    x_sq = jnp.sum(x * x, axis=1)
+    kp = KernelParams("rbf", 0.5)
+    st = init_state(n, y, 1)._replace(alpha=jnp.asarray(alpha),
+                                      f=jnp.asarray(f))
+    out = _run_chunk_micro(x, y, x_sq, jnp.ones((n,), jnp.float32), None,
+                           st, jnp.int32(3), kp, (c, c), 1e-3, 1e-12,
+                           chunk=3, k=3)
+    # The maximal violating pair (0, 1) must have APPLIED: alpha moved.
+    assert not np.allclose(np.asarray(out.alpha), alpha)
+    assert int(out.it) >= 1
+
+
 def test_micro_checkpoint_resume(tmp_path):
     """Chunked observation + checkpoint/resume work through the micro
     executor (iteration counting survives the round trip)."""
