@@ -30,7 +30,9 @@ smoke:
 
 # Real-TPU Mosaic lowering checks for the Pallas kernels (pytest covers
 # them in interpret mode only): every subproblem rule x small/unaligned q,
-# plus end-to-end block/pallas engine solves. Needs the axon TPU free.
+# plus end-to-end block/pallas/fleet engine solves. Needs the axon TPU
+# free. Writes a TPU_SMOKE_r<NN>.json artifact at the repo root — commit
+# it; the artifact, not the commit message, is the evidence of the run.
 tpu_smoke:
 	$(PY) tools/tpu_smoke.py
 

@@ -49,12 +49,54 @@ BASELINE_10GPU_SECONDS = 46.0
 REF_BUDGET = 100_000  # reference Makefile:74 --max-iter
 
 
+def _session_calibration() -> dict:
+    """Fixed-reference-kernel measurement for THIS session (VERDICT
+    round-5 weak #1): a pinned compute kernel whose FLOP count never
+    changes across PRs, timed with the same block_until_ready discipline
+    as the solver runs. Its best-of-5 device time is a property of the
+    session (chip generation, runtime, tunnel state) and NOT of any
+    solver code, so cross-session drift in the headline value can be
+    attributed: if calibration moved too, the session changed; if
+    calibration held, the regression is real. 16 chained 2048^2 f32
+    matmuls ~ 275 GFLOP — big enough to be compute-bound, small enough
+    to add < 1 s to the benchmark."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    a = jnp.asarray(np.random.default_rng(0)
+                    .normal(size=(2048, 2048)).astype(np.float32) / 45.0)
+
+    @jax.jit
+    def chain(m):
+        for _ in range(16):
+            m = jnp.tanh(m @ m)
+        return m
+
+    chain(a).block_until_ready()  # compile outside the timer
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        chain(a).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "kernel": "16x tanh(2048x2048 f32 matmul), seed-0 operand",
+        "best_of_5_seconds": round(best, 4),
+    }
+
+
 def main() -> int:
     import jax
 
     from dpsvm_tpu.config import SVMConfig
     from dpsvm_tpu.data.synth import make_mnist_like
     from dpsvm_tpu.solver.smo import solve
+
+    calibration = _session_calibration()
+    print(f"[bench] session calibration: {json.dumps(calibration)}",
+          file=sys.stderr)
 
     # noise pinned so the benchmark dataset is stable even if the
     # generator's default calibration changes.
@@ -214,6 +256,10 @@ def main() -> int:
         "dataset_hard": ("synthetic make_mnist_like(n=60000, d=784, "
                          "seed=7, noise=0.1, label_flip=0.10) — "
                          "non-separable soft-margin regime"),
+        # Session drift separator (VERDICT weak #1): compare against the
+        # same field in earlier BENCH_r*.json before reading any
+        # cross-session delta as a solver regression.
+        "session_calibration": calibration,
     }))
     return 0
 

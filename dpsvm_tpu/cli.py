@@ -82,10 +82,18 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--inner-iters", type=int, default=0,
                    help="block engine: pair updates per block "
                         "(default 0 = working-set-size)")
-    p.add_argument("--pair-batch", type=int, default=1, choices=[1, 2],
-                   help="block engine: pair updates per inner-loop trip "
-                        "(2 = batched disjoint second pair, mvp only — "
-                        "see SVMConfig.pair_batch)")
+    p.add_argument("--pair-batch", type=int, default=1,
+                   choices=[1, 2, 4, 8],
+                   help="pair updates per inner-loop trip (mvp only — "
+                        "see SVMConfig.pair_batch). 2/4 batch the block "
+                        "subproblem's disjoint stale-ranked pairs; on "
+                        "--engine xla, 2/4/8 select the micro-batched "
+                        "per-pair executor (8 is xla-only)")
+    p.add_argument("--fleet-size", type=int, default=16,
+                   help="multiclass submodels trained per batched fleet "
+                        "dispatch sequence (solver/fleet.py; power of "
+                        "two, 1 = sequential solves; applies to the "
+                        "OvR/OvO reduction on a single chip)")
     p.add_argument("--active-set-size", type=int, default=0,
                    help="block engine: shrink per-round work to the m "
                         "most-violating rows, reconciling the full "
@@ -312,6 +320,7 @@ def _cmd_train(args) -> int:
             working_set_size=args.working_set_size,
             inner_iters=args.inner_iters,
             pair_batch=args.pair_batch,
+            fleet_size=args.fleet_size,
             active_set_size=args.active_set_size,
             reconcile_rounds=args.reconcile_rounds,
             dtype=args.dtype, chunk_iters=args.chunk_iters,

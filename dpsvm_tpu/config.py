@@ -134,6 +134,19 @@ class SVMConfig:
     # rejecting the config).
     pair_batch: int = 1
 
+    # Fleet batching for MANY independent binary subproblems sharing one
+    # X (solver/fleet.py; no reference equivalent — LIBSVM-class tools
+    # train one subproblem at a time). Up to fleet_size problems stack
+    # along a leading axis and train inside ONE compiled while_loop with
+    # per-problem convergence masking: multiclass OvR/OvO submodels
+    # (models/multiclass.py routes eligible configs automatically) and
+    # C-sweeps (estimators.svc_c_sweep) collapse from K dispatch
+    # sequences to ceil(K / fleet_size). The fleet executor always runs
+    # the per-pair MVP iteration; 1 disables routing (sequential
+    # solves). Power of two so OvO's chunked fleets bucket to one
+    # compiled shape.
+    fleet_size: int = 16
+
     # Fused fold+select for the block engine (ops/pallas_fold_select.py):
     # the round's gradient fold and the NEXT round's working-set
     # selection run as ONE Pallas pass over f, removing the separate
@@ -322,6 +335,13 @@ class SVMConfig:
                     "(ops/pallas_subproblem.py); pair_batch=8 is the "
                     "per-pair micro-batch executor only (engine='xla', "
                     "solver/smo.py _run_chunk_micro)")
+        if (self.fleet_size < 1 or self.fleet_size > 64
+                or self.fleet_size & (self.fleet_size - 1)):
+            raise ValueError(
+                "fleet_size must be a power of two in [1, 64] (the fleet "
+                "executor buckets problem counts to powers of two so "
+                "chunked OvO fleets share one compiled shape; 1 = "
+                "sequential solves)")
         if self.active_set_size and self.engine != "block":
             raise ValueError(
                 "active_set_size (shrinking) is a block-engine knob; the "

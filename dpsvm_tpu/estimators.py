@@ -125,9 +125,25 @@ def _base_config(est, gamma: float) -> SVMConfig:
         # None = auto (on when the per-pair engine's (n, n) Gram fits
         # device memory); estimators expose it for the extreme-C tails.
         gram_resident=getattr(est, "gram_resident", None),
+        # Multi-problem batching (solver/fleet.py): multiclass
+        # reductions and svc_c_sweep train up to fleet_size submodels
+        # per compiled dispatch sequence.
+        fleet_size=getattr(est, "fleet_size", 16),
         cache_lines=est.cache_lines,
         dtype=est.dtype,
     )
+
+
+def _install_binary_fit(est, res, y_pm) -> None:
+    """Shared binary fit-assembly: install (fit_result_, n_support_,
+    n_iter_) from a SolveResult. One definition so SVC.fit (dense and
+    precomputed branches) and svc_c_sweep can never drift on what a
+    fitted binary estimator's counters mean."""
+    est.fit_result_ = res
+    sv_mask = np.asarray(res.alpha) > 0
+    est.n_support_ = np.array(
+        [(sv_mask & (y_pm < 0)).sum(), (sv_mask & (y_pm > 0)).sum()])
+    est.n_iter_ = res.iterations
 
 
 def _weighted_accuracy(pred, y, sample_weight=None) -> float:
@@ -168,9 +184,11 @@ class SVC(ClassifierMixin, BaseEstimator):
                  coef0=0.0, tol=1e-3, max_iter=-1, class_weight=None,
                  strategy="ovr", backend="auto", selection="mvp",
                  engine="xla", working_set_size=128, pair_batch=1,
-                 gram_resident=None, cache_lines=0, dtype="float32",
-                 probability=False, probability_cv=3, random_state=0):
+                 gram_resident=None, fleet_size=16, cache_lines=0,
+                 dtype="float32", probability=False, probability_cv=3,
+                 random_state=0):
         self.gram_resident = gram_resident
+        self.fleet_size = fleet_size
         self.C = C
         self.kernel = kernel
         self.degree = degree
@@ -254,16 +272,12 @@ class SVC(ClassifierMixin, BaseEstimator):
             res = solve(np.asarray(X, np.float32), y_pm, cfg)
             self._binary_model = None
             self._multiclass_model = None
-            self.fit_result_ = res
             self._pre_n = int(X.shape[0])
             alpha = np.asarray(res.alpha)
             self.support_ = np.nonzero(alpha > 0)[0].astype(np.int32)
             self._pre_coef = (alpha * y_pm)[self.support_].astype(np.float64)
             self._pre_b = float(res.b)
-            sv_mask = alpha > 0
-            self.n_support_ = np.array(
-                [(sv_mask & (y_pm < 0)).sum(), (sv_mask & (y_pm > 0)).sum()])
-            self.n_iter_ = res.iterations
+            _install_binary_fit(self, res, y_pm)
             return self
         self._pre_coef = None
         cfg = _base_config(self, _resolve_gamma(self.gamma, X))
@@ -275,11 +289,7 @@ class SVC(ClassifierMixin, BaseEstimator):
             model, res = train(X, y_pm, cfg, backend=self.backend)
             self._binary_model = model
             self._multiclass_model = None
-            self.fit_result_ = res
-            sv_mask = np.asarray(res.alpha) > 0
-            self.n_support_ = np.array(
-                [(sv_mask & (y_pm < 0)).sum(), (sv_mask & (y_pm > 0)).sum()])
-            self.n_iter_ = res.iterations
+            _install_binary_fit(self, res, y_pm)
             if self.probability:
                 self._platt = self._fit_platt_cv(X, y_pm, cfg)
         else:
@@ -357,6 +367,113 @@ class SVC(ClassifierMixin, BaseEstimator):
 
     def score(self, X, y, sample_weight=None):
         return _weighted_accuracy(self.predict(X), y, sample_weight)
+
+
+def svc_c_sweep(X, y, Cs, **svc_params) -> list:
+    """Fit one binary ``SVC`` per value in `Cs` with ALL the solves
+    batched through the fleet executor (solver/fleet.py): the box bound
+    is a traced per-problem value, so every C shares one compiled
+    while_loop, the shared X (or resident Gram) uploads once, and the
+    whole sweep costs ceil(len(Cs) / fleet_size) dispatch sequences
+    instead of len(Cs) — the hyperparameter-search shape GridSearchCV
+    drives as sequential fits.
+
+    Returns fitted SVC estimators in `Cs` order (each with its own
+    ``fit_result_``; per-problem convergence masking means a
+    fast-converging C never waits on a hard one's iterations beyond
+    sharing its dispatch). `svc_params` are forwarded to every SVC;
+    binary labels only, and probability / class_weight / precomputed
+    kernels are not supported under the sweep.
+
+    SINGLE-CHIP by construction (the fleet is one device's executor):
+    backend='auto' resolves to one device here — explicit mesh /
+    reference / native backends are refused, and a problem sized to fit
+    only as mesh shards must be swept per-C with
+    ``SVC(backend='mesh')``.
+    """
+    from dpsvm_tpu.models.svm_model import SVMModel
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.solver.fleet import FleetProblem, fleet_chunks, solve_fleet
+
+    Cs = [float(c) for c in Cs]
+    if not Cs:
+        raise ValueError("Cs must be non-empty")
+    template = SVC(C=Cs[0], **svc_params)
+    if template.probability:
+        raise ValueError("svc_c_sweep does not support probability=True "
+                         "(per-C Platt CV refits are sequential work)")
+    if template.class_weight is not None:
+        raise ValueError("svc_c_sweep does not support class_weight")
+    if template.backend != "single":
+        # The mesh would shard each solve across devices; the fleet is
+        # single-chip. De-sharding silently could OOM device 0 on a
+        # problem sized for shards, and backend='auto' on a multi-device
+        # host is the same hazard (SVC.fit would pick the mesh there) —
+        # so, like _fleet_eligible's auto rule, 'auto' is only accepted
+        # when one device is visible; backend='single' is the explicit
+        # opt-in.
+        multi = False
+        if template.backend == "auto":
+            import jax
+            multi = len(jax.devices()) > 1
+        if template.backend != "auto" or multi:
+            raise ValueError(
+                f"svc_c_sweep is single-chip (the fleet executor); "
+                f"backend={template.backend!r} on this host would "
+                "de-shard the solves — pass backend='single' to accept "
+                "the single-chip sweep, or fit per-C with SVC")
+    from dpsvm_tpu.solver.fleet import fleet_routing_reasons
+
+    reasons = fleet_routing_reasons(_base_config(template, 1.0))
+    if reasons:
+        # The gate train_multiclass(use_fleet=True) enforces, from the
+        # same shared predicate: silently training a requested
+        # engine='block' sweep on the per-pair MVP fleet executor would
+        # make the per-C results incomparable to SVC(engine='block').
+        raise ValueError(
+            "svc_c_sweep cannot route this config through the fleet "
+            "executor: " + "; ".join(reasons)
+            + " — fit such configs per-C with SVC instead")
+    # The same fit-time input contract SVC.fit applies — the sweep
+    # advertises per-C SVC-fit equivalence, so a NaN/mis-shaped X must
+    # raise the same clear validation error here, not flow into the
+    # solver as silently-garbage alphas.
+    X, y = _validate_fit(template, X, y)
+    _check_classification_y(y)
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y)
+    classes = np.unique(y)
+    if classes.shape[0] != 2:
+        raise ValueError(
+            f"svc_c_sweep is binary-only ({classes.shape[0]} classes "
+            "found); sweep a multiclass SVC per-C instead")
+    y_pm = np.where(y == classes[1], 1, -1).astype(np.int32)
+    cfg = _base_config(template, _resolve_gamma(template.gamma, X))
+    kp = KernelParams(cfg.kernel, cfg.resolve_gamma(X.shape[1]),
+                      cfg.degree, cfg.coef0)
+    problems = [FleetProblem(y=y_pm, c=c, tag=("C", c)) for c in Cs]
+    results = []
+    for chunk in fleet_chunks(problems, cfg.fleet_size):
+        results.extend(solve_fleet(X, chunk, cfg))
+
+    fitted = []
+    for c, res in zip(Cs, results):
+        est = SVC(C=c, **svc_params)
+        est.classes_ = classes
+        # Fit-metadata parity with SVC.fit: validate_data recorded these
+        # on the template; every returned estimator must carry them so
+        # predict-time validation behaves identically.
+        est.n_features_in_ = getattr(template, "n_features_in_",
+                                     X.shape[1])
+        if hasattr(template, "feature_names_in_"):
+            est.feature_names_in_ = template.feature_names_in_
+        est._binary_model = SVMModel.from_dense(X, y_pm, res.alpha,
+                                                res.b, kp)
+        est._multiclass_model = None
+        est._pre_coef = None
+        _install_binary_fit(est, res, y_pm)
+        fitted.append(est)
+    return fitted
 
 
 class SVR(RegressorMixin, BaseEstimator):

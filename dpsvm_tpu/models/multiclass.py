@@ -68,6 +68,95 @@ class MulticlassSVM:
         return cls(classes=z["classes"], models=models, strategy=str(z["strategy"]))
 
 
+def _fleet_eligible(config: SVMConfig, backend: str,
+                    num_devices: Optional[int], trainer,
+                    forced: bool = False) -> bool:
+    """Whether this reduction routes through the batched fleet executor
+    (solver/fleet.py) instead of K sequential solves.
+
+    The fleet runs the single-chip per-pair MVP iteration, so routing is
+    conservative: only the plain C-SVC trainer (trainer=None), on one
+    device, with a config whose iteration semantics the fleet reproduces
+    exactly. Anything else — custom trainers (nu duals), the mesh
+    backend, accuracy-mode stacks, non-MVP selection — keeps the
+    sequential path. `forced` (use_fleet=True) raises on disqualifying
+    configs instead of silently falling back."""
+    from dpsvm_tpu.solver.fleet import fleet_routing_reasons
+
+    reasons = fleet_routing_reasons(config)
+    if trainer is not None:
+        reasons.append("a custom trainer is installed")
+    if backend not in ("auto", "single"):
+        reasons.append(f"backend={backend!r} (fleet is single-chip)")
+    if config.fleet_size <= 1:
+        reasons.append("fleet_size=1")
+    if config.budget_mode:
+        reasons.append("budget_mode pins per-solve pair budgets")
+    if backend == "auto" and not reasons:
+        import jax
+        if (num_devices or len(jax.devices())) > 1:
+            # auto prefers the mesh when >1 device is visible (train()'s
+            # own rule); the fleet must not silently de-shard a problem
+            # the user sized for the mesh. backend='single' opts in.
+            reasons.append("auto backend resolves to the mesh "
+                           "(pass backend='single' to batch the fleet)")
+    if reasons and forced:
+        raise ValueError(
+            "use_fleet=True but the config cannot route through the "
+            "fleet executor: " + "; ".join(reasons))
+    return not reasons
+
+
+def _train_multiclass_fleet(x, y, classes, config: SVMConfig,
+                            strategy: str, verbose: bool):
+    """The fleet-batched reduction: OvR's k problems (identical rows) or
+    OvO's k(k-1)/2 masked problems run in ceil(K / fleet_size) dispatch
+    sequences instead of K (solver/fleet.py). Model assembly is
+    identical to the sequential path — each result's alpha covers
+    exactly the problem's masked rows."""
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.solver.fleet import FleetProblem, fleet_chunks, solve_fleet
+
+    kp = KernelParams(config.kernel, config.resolve_gamma(x.shape[1]),
+                      config.degree, config.coef0)
+    if strategy == "ovr":
+        problems = [
+            FleetProblem(y=np.where(y == cl, 1, -1).astype(np.int32),
+                         tag=("ovr", cl))
+            for cl in classes]
+    else:
+        problems = []
+        for a in range(len(classes)):
+            for b in range(a + 1, len(classes)):
+                mask = (y == classes[a]) | (y == classes[b])
+                problems.append(FleetProblem(
+                    y=np.where(y == classes[a], 1, -1).astype(np.int32),
+                    row_mask=mask, tag=("ovo", classes[a], classes[b])))
+
+    models: list[SVMModel] = []
+    results = []
+    for chunk in fleet_chunks(problems, config.fleet_size):
+        chunk_results = solve_fleet(x, chunk, config)
+        for p, res in zip(chunk, chunk_results):
+            if p.row_mask is None:
+                xs, ys = x, p.y
+            else:
+                xs = x[p.row_mask]
+                ys = p.y[p.row_mask]
+            models.append(SVMModel.from_dense(xs, ys, res.alpha, res.b, kp))
+            results.append(res)
+            if verbose:
+                tag = p.tag
+                name = (f"ovr class={tag[1]}" if tag[0] == "ovr"
+                        else f"ovo {tag[1]} vs {tag[2]}")
+                print(f"[fleet {name}] iters={res.iterations} "
+                      f"n_sv={res.n_sv} "
+                      f"(fleet of {res.stats['fleet']['size']}, "
+                      f"{res.dispatches} dispatches)")
+    return MulticlassSVM(classes=classes, models=models,
+                         strategy=strategy), results
+
+
 def train_multiclass(
     x,
     y,
@@ -77,6 +166,7 @@ def train_multiclass(
     num_devices: Optional[int] = None,
     verbose: bool = False,
     trainer=None,
+    use_fleet: Optional[bool] = None,
 ) -> tuple[MulticlassSVM, list]:
     """Train a multiclass SVM; y may hold arbitrary integer labels.
 
@@ -84,7 +174,14 @@ def train_multiclass(
     -> (SVMModel, SolveResult)` swaps the binary solver under the
     reduction — the default is C-SVC ``train``; estimators.NuSVC passes
     a nu-SVC trainer so its multiclass reduction uses the nu duals per
-    split."""
+    split.
+
+    `use_fleet`: None (default) auto-routes eligible configs through the
+    batched multi-problem executor (solver/fleet.py — all submodels
+    train in ceil(K / fleet_size) dispatch sequences; see
+    _fleet_eligible for the gate); True forces it (raising on
+    disqualifying configs); False forces the sequential per-submodel
+    path."""
     if config.kernel == "precomputed":
         raise ValueError(
             "kernel='precomputed' is implemented for binary C-SVC only "
@@ -92,6 +189,7 @@ def train_multiclass(
             "a transformed Gram matrix, not transformed features")
     from dpsvm_tpu.train import train
 
+    user_trainer = trainer  # the fleet gate needs the CALLER's trainer
     if trainer is None:
         def trainer(xx, yy, cfg, backend="auto", num_devices=None,
                     pad_to=None):
@@ -108,6 +206,12 @@ def train_multiclass(
         # (one a<b pair); the OvR loop would train two mirror-image
         # submodels and pay double at fit and predict time.
         strategy = "ovo"
+
+    if strategy in ("ovr", "ovo") and use_fleet is not False \
+            and _fleet_eligible(config, backend, num_devices, user_trainer,
+                                forced=use_fleet is True):
+        return _train_multiclass_fleet(x, y, classes, config, strategy,
+                                       verbose)
 
     models: list[SVMModel] = []
     results = []
@@ -231,8 +335,13 @@ def _stacked_decision(models, q, block: int) -> np.ndarray:
     batch = _stacked_batch_factory()
 
     # Bound the (k, nb, m) kernel tile: shrink the query block so the
-    # tile stays under ~1 GB regardless of model count / bucket size.
+    # tile stays under ~1 GB regardless of model count / bucket size,
+    # then round DOWN to a power of two — the per-block query pad below
+    # rounds nb UP to a power of two, so a non-power-of-two cap would
+    # let the PADDED tile overshoot the budget by up to 2x (ADVICE
+    # round-5, low).
     blk = max(128, min(block, (1 << 28) // max(1, k * m_pad)))
+    blk = 1 << (blk.bit_length() - 1)
     sv_d, coef_d, b_d = jnp.asarray(sv), jnp.asarray(coef), jnp.asarray(b)
     out = []
     q = np.asarray(q, np.float32)

@@ -169,6 +169,47 @@ def refresh_extrema_host(f, alpha, y, c, epsilon: float, rule: str = "mvp"):
     return b_hi, b_lo, not (b_lo > b_hi + 2.0 * epsilon)
 
 
+def select_working_set_batched(
+    f: jax.Array,
+    alpha: jax.Array,
+    y: jax.Array,
+    c_pos: jax.Array,
+    c_neg: jax.Array,
+    valid: jax.Array | None = None,
+):
+    """Maximal-violating-pair selection for a STACK of independent
+    problems (solver/fleet.py): one batched masked argmin/argmax pass
+    serves every problem in the fleet.
+
+    f, alpha, y: (k, n) per-problem rows over the shared padded X;
+    c_pos, c_neg: (k, 1) per-problem box bounds (traced, so a C sweep
+    batches without recompiling); valid: (k, n) bool row masks (padding
+    AND each problem's OvO class subset). Returns (i_hi, b_hi, i_lo,
+    b_lo), each (k,).
+
+    The set definitions are up_mask/low_mask inlined: c_of's static
+    equal-weights collapse cannot apply when the bounds are per-problem
+    arrays, so the per-row bound is materialized unconditionally (one
+    (k, n) where — noise next to the reductions)."""
+    f = f.astype(jnp.float32)
+    pos = y > 0
+    c_row = jnp.where(pos, c_pos, c_neg)
+    up = jnp.where(pos, alpha < c_row, alpha > 0)
+    low = jnp.where(pos, alpha > 0, alpha < c_row)
+    if valid is not None:
+        up = up & valid
+        low = low & valid
+    f_up = jnp.where(up, f, _INF)
+    f_low = jnp.where(low, f, -_INF)
+    i_hi = jnp.argmin(f_up, axis=1).astype(jnp.int32)
+    i_lo = jnp.argmax(f_low, axis=1).astype(jnp.int32)
+    b_hi = jnp.take_along_axis(f_up, i_hi[:, None].astype(jnp.int32),
+                               axis=1)[:, 0]
+    b_lo = jnp.take_along_axis(f_low, i_lo[:, None].astype(jnp.int32),
+                               axis=1)[:, 0]
+    return i_hi, b_hi, i_lo, b_lo
+
+
 def select_working_set(
     f: jax.Array,
     alpha: jax.Array,

@@ -1,0 +1,433 @@
+"""Batched multi-problem SMO: train a FLEET of binary subproblems that
+share one X inside a single compiled ``lax.while_loop``.
+
+Why it exists: the reference (and our ``solve()``) trains ONE binary
+problem per dispatch sequence, and the multiclass layer inherits that
+shape — 60k OvO is 45 sequential solves whose warm end-to-end time is
+dominated by per-solve dispatch/transfer glue, not device work
+(BENCH_MULTICLASS.md: 4.95 s of device time inside 112 s of warm e2e on
+a tunneled runtime, ~360 round-trips). LIBSVM-class CPU/GPU tools cannot
+batch across problems at all; on TPU the idiomatic answer is to stack
+the independent subproblems along a leading ``k`` axis and let ONE
+jitted program train them all:
+
+* per-problem carries ``(alpha, f, b_hi, b_lo, it)`` are stacked
+  ``(k, n)`` / ``(k,)`` arrays; X (or the resident Gram) is SHARED and
+  device-resident once;
+* selection is one batched masked argmin/argmax pass
+  (``ops/select.py select_working_set_batched``);
+* the 2k kernel rows of a trip ride ONE ``(2k, d) x (d, n)`` MXU matmul
+  (or 2k row gathers of the shared resident Gram);
+* the pair algebra is the SAME ``pair_alpha_update`` the per-pair engine
+  compiles, evaluated on ``(k,)`` vectors;
+* per-problem convergence MASKING freezes finished problems exactly
+  (their gated deltas are 0.0, so ``f`` and ``alpha`` are bit-frozen)
+  while stragglers keep iterating — the loop exits when every problem
+  has converged or exhausted ``max_iter``.
+
+OvO's per-pair class subsets become ROW MASKS over the shared X: no
+per-subset host copies, no per-shape recompiles — one executor shape
+per (fleet bucket, n). The per-problem box bounds ``C`` are TRACED
+``(k, 2)`` values, so a C/gamma-free hyperparameter sweep (same kernel,
+different C per problem) batches without recompiling
+(``estimators.svc_c_sweep``).
+
+Trade-off, stated honestly: each trip's row pass covers the FULL shared
+row set even for problems whose mask selects a fraction of it, and a
+fleet with one straggler still pays a full (2k, n) trip per iteration.
+The win is dispatch count and latency amortization — ceil(K /
+fleet_size) dispatch sequences instead of K — which is exactly what
+dominates multiclass training on dispatch-latency-bound runtimes.
+
+Parity contract: problem j's trajectory is the per-pair MVP engine's
+trajectory (same selection rule, same pair algebra, same f-update
+association); results match sequential ``solve()`` on the explicit
+subset within the existing parity tolerances (tests/test_fleet.py pins
+this per problem).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_rows
+from dpsvm_tpu.ops.select import refresh_extrema_host, select_working_set_batched
+from dpsvm_tpu.solver.result import SolveResult
+from dpsvm_tpu.solver.smo import (_BUDGET_EPS, _UNOBSERVED_CHUNK,
+                                  _device_x_cached, _precision_ctx,
+                                  _resident_gram_cached, _resolve_gram,
+                                  pair_alpha_update)
+
+
+@dataclasses.dataclass
+class FleetProblem:
+    """One binary subproblem over the fleet's SHARED row set.
+
+    y        -- (n,) labels in {-1, +1} over ALL shared rows; values at
+                rows outside `row_mask` are ignored (pinned to +1 in the
+                stacked carry).
+    row_mask -- (n,) bool marking this problem's rows (None = all rows).
+                This is how OvO subsets ride the shared X without
+                per-subset copies.
+    c        -- per-problem box bound override: a scalar C (the config's
+                class weights still apply) or an explicit (c_pos, c_neg)
+                pair; None = the config's c_bounds(). Traced, so a C
+                sweep shares one compiled executor.
+    tag      -- caller bookkeeping, returned in stats["tag"].
+    """
+
+    y: np.ndarray
+    row_mask: Optional[np.ndarray] = None
+    c: object = None
+    tag: object = None
+
+
+class FleetState(NamedTuple):
+    """while_loop carry: SMOState stacked along the problem axis, plus a
+    global trip counter for chunk bookkeeping (per-problem `it` counts
+    diverge once problems freeze)."""
+
+    alpha: jax.Array  # (k, n) float32
+    f: jax.Array  # (k, n) float32
+    b_hi: jax.Array  # (k,) float32
+    b_lo: jax.Array  # (k,) float32
+    it: jax.Array  # (k,) int32
+    t: jax.Array  # () int32 trips
+
+
+@partial(jax.jit, static_argnames=("kp", "eps", "tau", "chunk"))
+def _run_fleet_chunk(x, y, x_sq, valid, cb, state: FleetState, max_iter,
+                     kp: KernelParams, eps: float, tau: float,
+                     chunk: int) -> FleetState:
+    """Run up to `chunk` fleet trips fully on device. One trip advances
+    every still-active problem by exactly one reference-parity MVP
+    iteration; frozen problems ride along with gated (exact no-op)
+    updates."""
+    k, n_pad = y.shape
+    t_end = state.t + chunk
+    cp = cb[:, 0:1]  # (k, 1) for row broadcasting
+    cn = cb[:, 1:2]
+
+    def active_mask(st):
+        return (st.it < max_iter) & (st.b_lo > st.b_hi + 2.0 * eps)
+
+    def cond(st: FleetState):
+        return (st.t < t_end) & jnp.any(active_mask(st))
+
+    def body(st: FleetState):
+        active = active_mask(st)
+        i_hi, b_hi, i_lo, b_lo = select_working_set_batched(
+            st.f, st.alpha, y, cp, cn, valid)
+        idx = jnp.concatenate([i_hi, i_lo])  # (2k,)
+        # Row extraction via UNROLLED dynamic slices, never jnp.take:
+        # XLA lowers a general row gather from a large operand (X, or
+        # the (n, n) resident Gram) to a one-hot MATMUL on TPU; 2k
+        # dynamic slices are plain DMAs (_run_chunk_micro precedent).
+        qx = jnp.stack([lax.dynamic_index_in_dim(x, idx[s], 0,
+                                                 keepdims=False)
+                        for s in range(2 * k)])
+        # ONE batched pass produces every problem's hi AND lo kernel row
+        # (a (2k, d) x (d, n) MXU matmul — or, in resident-Gram /
+        # precomputed mode, the gathered rows verbatim).
+        rows = kernel_rows(x, x_sq, qx, jnp.take(x_sq, idx), kp)
+        rows_hi = rows[:k]  # (k, n)
+        rows_lo = rows[k:]
+        hi_col = i_hi[:, None]
+        lo_col = i_lo[:, None]
+        k_hh = jnp.take_along_axis(rows_hi, hi_col, axis=1)[:, 0]
+        k_ll = jnp.take_along_axis(rows_lo, lo_col, axis=1)[:, 0]
+        k_hl = jnp.take_along_axis(rows_hi, lo_col, axis=1)[:, 0]
+        eta = jnp.maximum(k_hh + k_ll - 2.0 * k_hl, tau)
+
+        y_hi = jnp.take_along_axis(y, hi_col, axis=1)[:, 0]
+        y_lo = jnp.take_along_axis(y, lo_col, axis=1)[:, 0]
+        a_hi_old = jnp.take_along_axis(st.alpha, hi_col, axis=1)[:, 0]
+        a_lo_old = jnp.take_along_axis(st.alpha, lo_col, axis=1)[:, 0]
+        c_hi = jnp.where(y_hi > 0, cb[:, 0], cb[:, 1])
+        c_lo = jnp.where(y_lo > 0, cb[:, 0], cb[:, 1])
+        # THE shared pair algebra, on (k,) vectors. `gate=active` is the
+        # convergence mask: a frozen problem's deltas are exactly 0, so
+        # its alpha/f stay bit-identical while stragglers run.
+        a_hi_new, a_lo_new = pair_alpha_update(
+            a_hi_old, a_lo_old, y_hi, y_lo, b_hi, b_lo, eta, c_hi, c_lo,
+            gate=active)
+        rowid = jnp.arange(k, dtype=jnp.int32)
+        # lo first, hi second — the per-pair engine's degenerate-pair
+        # override order (solver/smo.py _apply_pair_update).
+        alpha = st.alpha.at[rowid, i_lo].set(a_lo_new)
+        alpha = alpha.at[rowid, i_hi].set(a_hi_new)
+        d_hi = (a_hi_new - a_hi_old) * y_hi
+        d_lo = (a_lo_new - a_lo_old) * y_lo
+        # Rank-2 f update per problem, one (k, n) VPU pass for the fleet;
+        # association matches the sequential engine's left-to-right sum.
+        f = st.f + d_hi[:, None] * rows_hi + d_lo[:, None] * rows_lo
+        b_hi_new = jnp.where(active, b_hi, st.b_hi)
+        b_lo_new = jnp.where(active, b_lo, st.b_lo)
+        it = st.it + active.astype(jnp.int32)
+        return FleetState(alpha, f, b_hi_new, b_lo_new, it, st.t + 1)
+
+    return lax.while_loop(cond, body, state)
+
+
+def fleet_routing_reasons(config: SVMConfig) -> list:
+    """Why a config cannot ROUTE through the fleet executor (empty list
+    = eligible). The single source of truth for the engine-compatibility
+    gate shared by models/multiclass.py _fleet_eligible and
+    estimators.svc_c_sweep — a hand-maintained copy in each caller would
+    drift. (solve_fleet itself is slightly more permissive — it accepts
+    kernel='precomputed' directly — these are the ROUTER's rules, where
+    a silent engine swap would make results incomparable with what the
+    user configured.)"""
+    reasons = []
+    if config.engine != "xla" or config.selection != "mvp" \
+            or config.pair_batch != 1:
+        reasons.append(
+            "the fleet executor is the per-pair MVP engine "
+            "(engine='xla', selection='mvp', pair_batch=1)")
+    if config.kernel == "precomputed":
+        reasons.append("kernel='precomputed' (per-split Gram sub-matrices)")
+    if config.compensated or config.reconstruct_every:
+        reasons.append("accuracy-mode (compensated/reconstruction) solves")
+    return reasons
+
+
+def _fleet_bucket(k_real: int) -> int:
+    """Power-of-two fleet bucket: OvO routes 45 problems in fleet_size
+    chunks whose last chunk is short — padding it to the bucket keeps
+    ONE compiled executor shape per (bucket, n)."""
+    return 1 << max(0, k_real - 1).bit_length()
+
+
+def _problem_bounds(p: FleetProblem, config: SVMConfig) -> tuple:
+    """(c_pos, c_neg) of one problem: config bounds, a scalar C override
+    (config class weights still apply), or an explicit pair."""
+    if p.c is None:
+        return config.c_bounds()
+    if isinstance(p.c, tuple):
+        cp, cn = p.c
+        return float(cp), float(cn)
+    c = float(p.c)
+    if c <= 0:
+        raise ValueError("FleetProblem.c must be > 0")
+    return c * config.weight_pos, c * config.weight_neg
+
+
+def solve_fleet(
+    x,
+    problems: list,
+    config: SVMConfig,
+    device: Optional[jax.Device] = None,
+    pad_to: Optional[int] = None,
+) -> list:
+    """Train every FleetProblem in `problems` (all sharing `x`) in a
+    handful of device dispatches. Returns one SolveResult per problem,
+    in order; each result's alpha/f cover ONLY that problem's masked
+    rows (aligned with ``x[row_mask]``), so it drops into the same
+    model-assembly code a sequential per-subset ``solve()`` feeds.
+
+    Semantics: every problem runs the reference-parity per-pair MVP
+    iteration (engine='xla', selection='mvp', pair_batch=1 equivalent);
+    `config.engine` is NOT consulted for the iteration structure — the
+    fleet IS its own executor. Honored config knobs: kernel family,
+    epsilon/max_iter/tau, class weights (per-problem C overrides
+    compose with them), dtype, budget_mode, gram_resident (the shared
+    resident Gram serves all problems), matmul_precision, chunk_iters +
+    verbose (per-chunk observation). Not supported here: callbacks,
+    checkpoint/resume, compensated/reconstruction accuracy mode, the
+    LRU row cache, nu/second_order selection.
+
+    `train_seconds` is the fleet's total device time divided evenly
+    across the real problems (per-problem attribution inside one fused
+    dispatch is not separable); stats["fleet"] carries the whole-fleet
+    numbers.
+    """
+    if not problems:
+        return []
+    if config.selection != "mvp":
+        raise ValueError(
+            "solve_fleet implements the reference MVP rule only "
+            f"(selection={config.selection!r}); run those problems "
+            "through sequential solve()")
+    if config.compensated or config.reconstruct_every:
+        raise ValueError(
+            "solve_fleet does not implement the compensated/"
+            "reconstruction accuracy stack; use sequential solve() for "
+            "extreme-C problems")
+
+    x = np.asarray(x, np.float32)
+    n, d = x.shape
+    gamma = config.resolve_gamma(d)
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    if config.dtype == "bfloat16":
+        from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
+        warn_if_bf16_degrades(x, config)
+    if device is None:
+        device = jax.devices()[0]
+
+    if kp.kind == "precomputed" and x.shape[0] != x.shape[1]:
+        raise ValueError(
+            f"kernel='precomputed' needs the square (n, n) Gram matrix "
+            f"as x; got {x.shape}")
+    n_pad = max(n, min(pad_to, 2 ** 31) if pad_to else n)
+    if kp.kind == "precomputed" and n_pad != n:
+        raise ValueError(
+            "pad_to does not compose with kernel='precomputed' (the "
+            "padded Gram rows/columns would need kernel values)")
+
+    k_real = len(problems)
+    k_pad = _fleet_bucket(k_real)
+
+    def build_x_p():
+        if n_pad == n:
+            return x
+        xp = np.zeros((n_pad, d), np.float32)
+        xp[:n] = x
+        return xp
+
+    with _precision_ctx(config):
+        use_gram = _resolve_gram(config, kp, n_pad, device)
+        if use_gram:
+            x_dev, _ = _resident_gram_cached(x, build_x_p, n_pad, dtype,
+                                             kp, config, device)
+            kp_run = KernelParams("precomputed")
+            x_sq = jnp.zeros((n_pad,), jnp.float32)
+        elif kp.kind == "precomputed":
+            x_dev = jax.device_put(jnp.asarray(build_x_p(), dtype), device)
+            kp_run = kp
+            x_sq = jnp.zeros((n_pad,), jnp.float32)
+        else:
+            x_dev, x_sq = _device_x_cached(x, build_x_p, n_pad, dtype,
+                                           device)
+            kp_run = kp
+
+        # Stacked per-problem carries. Dummy bucket-padding problems have
+        # an all-False mask: their selection sets are empty, the gap
+        # reads closed after the first (sentinel) trip, and they freeze.
+        y_stack = np.ones((k_pad, n_pad), np.float32)
+        valid_stack = np.zeros((k_pad, n_pad), bool)
+        cb = np.ones((k_pad, 2), np.float32)
+        masks: list = []
+        for j, p in enumerate(problems):
+            yj = np.asarray(p.y)
+            if yj.shape != (n,):
+                raise ValueError(
+                    f"problem {j}: y has shape {yj.shape}, expected "
+                    f"({n},) over the shared row set")
+            if p.row_mask is None:
+                mask = np.ones((n,), bool)
+            else:
+                mask = np.asarray(p.row_mask, bool)
+                if mask.shape != (n,):
+                    raise ValueError(
+                        f"problem {j}: row_mask has shape {mask.shape}, "
+                        f"expected ({n},)")
+            lab = set(np.unique(yj[mask]).tolist())
+            if not lab <= {-1, 1, -1.0, 1.0}:
+                raise ValueError(
+                    f"problem {j}: masked labels must be in {{-1, +1}}, "
+                    f"got {sorted(lab)[:6]}")
+            y_stack[j, :n] = np.where(mask, yj, 1.0).astype(np.float32)
+            valid_stack[j, :n] = mask
+            cb[j] = _problem_bounds(p, config)
+            masks.append(mask)
+
+        y_dev = jax.device_put(jnp.asarray(y_stack), device)
+        valid_dev = jax.device_put(jnp.asarray(valid_stack), device)
+        cb_dev = jax.device_put(jnp.asarray(cb), device)
+        state = FleetState(
+            alpha=jnp.zeros((k_pad, n_pad), jnp.float32),
+            f=jnp.asarray(-y_stack),  # f = -y at alpha = 0
+            b_hi=jnp.full((k_pad,), -jnp.inf, jnp.float32),
+            b_lo=jnp.full((k_pad,), jnp.inf, jnp.float32),
+            it=jnp.zeros((k_pad,), jnp.int32),
+            t=jnp.int32(0),
+        )
+        state = jax.device_put(state, device)
+
+        eps_run = _BUDGET_EPS if config.budget_mode else float(config.epsilon)
+        observe = bool(config.verbose)
+        chunk = int(config.chunk_iters) if observe else _UNOBSERVED_CHUNK
+        max_iter = jnp.int32(config.max_iter)
+
+        train_seconds = 0.0
+        dispatches = 0
+        while True:
+            t0 = time.perf_counter()
+            dispatches += 1
+            state = _run_fleet_chunk(
+                x_dev, y_dev, x_sq, valid_dev, cb_dev, state, max_iter,
+                kp=kp_run, eps=eps_run, tau=float(config.tau), chunk=chunk)
+            jax.block_until_ready(state)
+            train_seconds += time.perf_counter() - t0
+            b_hi = np.asarray(state.b_hi)
+            b_lo = np.asarray(state.b_lo)
+            it = np.asarray(state.it)
+            active = (it < config.max_iter) & (b_lo > b_hi + 2.0 * eps_run)
+            if config.verbose:
+                gaps = (b_lo - b_hi)[:k_real]
+                print(f"[fleet] trips={int(state.t)} "
+                      f"active={int(active[:k_real].sum())}/{k_real} "
+                      f"max_gap={float(np.max(gaps)):.6f}")
+            if not active.any():
+                break
+
+    alpha_all = np.asarray(state.alpha)
+    f_all = np.asarray(state.f)
+    results = []
+    for j, p in enumerate(problems):
+        mask = masks[j]
+        rows_idx = np.nonzero(mask)[0]
+        full = rows_idx.shape[0] == n
+        a_sub = alpha_all[j, :n] if full else alpha_all[j, :n][rows_idx]
+        f_sub = f_all[j, :n] if full else f_all[j, :n][rows_idx]
+        y_sub = (y_stack[j, :n] if full
+                 else y_stack[j, :n][rows_idx]).astype(np.int32)
+        bh, bl = float(b_hi[j]), float(b_lo[j])
+        conv = not (bl > bh + 2.0 * eps_run)
+        if config.budget_mode:
+            # Same discipline as solve(): budget exits report the honest
+            # stopping rule at the REAL epsilon on the final state.
+            bh, bl, conv = refresh_extrema_host(
+                f_sub, a_sub, y_sub, (float(cb[j, 0]), float(cb[j, 1])),
+                config.epsilon)
+        results.append(SolveResult(
+            alpha=a_sub,
+            b=float((bl + bh) / 2.0),
+            b_hi=bh,
+            b_lo=bl,
+            iterations=int(it[j]),
+            converged=bool(conv),
+            train_seconds=train_seconds / k_real,
+            dispatches=dispatches,
+            stats={
+                "f": f_sub,
+                "tag": p.tag,
+                "fleet": {
+                    "size": k_real,
+                    "bucket": k_pad,
+                    "index": j,
+                    "dispatches": dispatches,
+                    "device_seconds": train_seconds,
+                    "gram_resident": bool(use_gram),
+                },
+            },
+        ))
+    return results
+
+
+def fleet_chunks(items: list, fleet_size: int) -> list:
+    """Split a work list into fleet-sized chunks (the multiclass router's
+    bucketing helper; the short tail chunk is padded to its power-of-two
+    bucket inside solve_fleet)."""
+    size = max(1, int(fleet_size))
+    return [items[s:s + size] for s in range(0, len(items), size)]

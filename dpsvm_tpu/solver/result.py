@@ -16,6 +16,12 @@ class SolveResult:
     iterations: int
     converged: bool
     train_seconds: float = 0.0
+    # Device executor dispatches the host loop made for this solve (0 when
+    # the backend does not count them). For a fleet member
+    # (solver/fleet.py) this is the dispatch count of the WHOLE fleet —
+    # shared, not per-problem; stats["fleet"] carries the membership so
+    # aggregators can de-duplicate.
+    dispatches: int = 0
     stats: dict = dataclasses.field(default_factory=dict)
 
     @property

@@ -465,6 +465,12 @@ def _run_chunk_micro(x, y, x_sq, k_diag, valid, state: SMOState, max_iter,
     always executes.
     """
     cp, cn = split_c(c)
+    # Clamp the selection's top-k to the (padded) row count: a toy
+    # problem with n < pair_batch would otherwise die in an obscure XLA
+    # trace error inside top_k (ADVICE round-5, low). Static shapes, so
+    # this resolves at trace time; the clamped executor just batches
+    # fewer slots per trip — same semantics.
+    k = min(k, int(y.shape[0]))
     end = jnp.minimum(state.it + chunk, max_iter)
 
     def top_pairs(scores):
@@ -731,6 +737,69 @@ def run_with_fault_retry(config: SVMConfig, checkpoint_path, resume,
 _GRAM_BUDGET_FRACTION = 0.70
 _GRAM_MIN_N = 8192
 
+def _host_fingerprint(a) -> tuple:
+    """Cheap content guard for the host-array memos (_XDEV_MEMO /
+    _GRAM_MEMO): the buffer address (ctypes.data) plus a 256-point
+    strided sample of raw values. The memos key on OBJECT IDENTITY, but
+    identity alone cannot see in-place mutation — `x *= s` keeps
+    `x is x` true while the resident device copy goes stale, and the
+    solver would silently train on old data (ADVICE round-5, medium).
+
+    Deliberately PROBABILISTIC: O(1) strided reads, no O(n) hash (a full
+    hash of a 188 MB X per solve would cost more than the transfer it
+    guards). Whole-array and regional rewrites — the observed mutation
+    patterns (rescaling, renormalizing, reloading into the same buffer)
+    — always hit sampled points; a sparse edit touching fewer than
+    size/256 contiguous elements can slip between samples, so callers
+    that surgically poke single rows should pass a fresh array (or
+    np.array-copy) instead of relying on the guard."""
+    import numpy as np
+
+    arr = np.asarray(a)
+    try:
+        addr = arr.ctypes.data
+    except (AttributeError, TypeError):
+        addr = None
+    if arr.size == 0:
+        return (addr, arr.shape, b"")
+    idx = np.linspace(0, arr.size - 1, num=min(256, arr.size),
+                      dtype=np.int64)
+    return (addr, arr.shape, arr.flat[idx].tobytes())
+
+
+def _memo_insert(memo: dict, key, x_host, payload: tuple) -> None:
+    """Install a size-1 memo entry with a SAFE weakref finalizer: the
+    eviction callback pops the key only while it still maps to THIS
+    entry. The naive `pop(key)` finalizer had a lifetime bug (ADVICE
+    round-5, low): replace the entry for the same key with a new host
+    array, then let the OLD array die — its finalizer would evict the
+    NEW, live entry (for _GRAM_MEMO, a multi-GB resident Gram rebuilt on
+    the next leg for nothing).
+
+    The entry is matched by a SENTINEL TOKEN stored inside it, not by
+    entry identity: a closure holding the entry itself would form a
+    reference cycle (entry -> weakref -> callback -> entry) that keeps
+    an evicted multi-GB device Gram alive until the cyclic GC runs —
+    refcount-immediate release on memo.clear() is the property the
+    size-1 discipline exists for. Entry layout:
+    (weakref, token, *payload, fingerprint)."""
+    import weakref
+
+    memo.clear()  # size-1 discipline: never hold two entries
+    token = object()
+
+    def _evict(_r, _memo=memo, _key=key, _token=token):
+        ent = _memo.get(_key)
+        if ent is not None and ent[1] is _token:
+            _memo.pop(_key, None)
+
+    try:
+        ref = weakref.ref(x_host, _evict)
+    except TypeError:
+        return  # non-weakrefable host container: just skip the memo
+    memo[key] = (ref, token, *payload, _host_fingerprint(x_host))
+
+
 # Size-1 memo: (key) -> (weakref-to-host-x, device Gram). Reconstruction
 # legs (solver/reconstruct.py) call solve() once per leg with the SAME
 # host array; rebuilding a ~10 GB Gram every leg would cost ~12 s of HBM
@@ -750,22 +819,19 @@ _XDEV_MEMO: dict = {}
 
 def _device_x_cached(x_host, build_x_p, n_pad, dtype, device):
     """(x_dev, x_sq) for feature-kernel solves. `build_x_p` is called
-    only on a miss (it materializes the padded host copy)."""
-    import weakref
-
+    only on a miss (it materializes the padded host copy). A hit needs
+    identity AND an unchanged content fingerprint (_host_fingerprint):
+    in-place mutation of a reused host array must rebuild, not silently
+    train on the stale device copy."""
     d = x_host.shape[1]
     key = ((n_pad, d), str(dtype), getattr(device, "id", None))
     ent = _XDEV_MEMO.get(key)
-    if ent is not None and ent[0]() is x_host:
-        return ent[1], ent[2]
+    if ent is not None and ent[0]() is x_host \
+            and ent[-1] == _host_fingerprint(x_host):
+        return ent[2], ent[3]  # (ref, token, x_dev, x_sq, fp)
     x_dev = jax.device_put(jnp.asarray(build_x_p(), dtype), device)
     x_sq = jax.jit(squared_norms)(x_dev)
-    _XDEV_MEMO.clear()
-    try:
-        ref = weakref.ref(x_host, lambda _r: _XDEV_MEMO.pop(key, None))
-        _XDEV_MEMO[key] = (ref, x_dev, x_sq)
-    except TypeError:
-        pass
+    _memo_insert(_XDEV_MEMO, key, x_host, (x_dev, x_sq))
     return x_dev, x_sq
 
 
@@ -813,9 +879,8 @@ def _resident_gram_cached(x_host, build_x_p, n_pad, dtype,
     happens. A weakref finalizer evicts the entry the moment the host
     array dies — a multi-GB device Gram must never outlive the data it
     was built from (it would pin up to ~70% of HBM against later
-    unrelated work)."""
-    import weakref
-
+    unrelated work). A hit needs identity AND an unchanged content
+    fingerprint (_host_fingerprint, the in-place-mutation guard)."""
     from dpsvm_tpu.ops.kernels import resident_gram
 
     # Keyed on the PADDED build shape, not the host shape: the same host
@@ -823,8 +888,9 @@ def _resident_gram_cached(x_host, build_x_p, n_pad, dtype,
     key = (kp, (n_pad, x_host.shape[1]), config.dtype,
            getattr(device, "id", None), config.resolve_precision())
     ent = _GRAM_MEMO.get(key)
-    if ent is not None and ent[0]() is x_host:
-        return ent[1], ent[2]
+    if ent is not None and ent[0]() is x_host \
+            and ent[-1] == _host_fingerprint(x_host):
+        return ent[2], ent[3]  # (ref, token, g, k_diag, fp)
     x_feat = jax.device_put(jnp.asarray(build_x_p(), dtype), device)
     x_sq_f = jax.jit(squared_norms)(x_feat)
     k_diag = jax.jit(kernel_diag, static_argnames="params")(x_sq_f,
@@ -836,12 +902,7 @@ def _resident_gram_cached(x_host, build_x_p, n_pad, dtype,
     # shapes this mode exists for (measured: n=50k fails async, passes
     # synced, on a 16 GiB v5e).
     jax.block_until_ready(g)
-    _GRAM_MEMO.clear()  # size-1: never hold two multi-GB grams
-    try:
-        ref = weakref.ref(x_host, lambda _r: _GRAM_MEMO.pop(key, None))
-        _GRAM_MEMO[key] = (ref, g, k_diag)
-    except TypeError:
-        pass  # non-weakrefable host container: just skip the memo
+    _memo_insert(_GRAM_MEMO, key, x_host, (g, k_diag))
     return g, k_diag
 
 
@@ -1162,8 +1223,10 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     # part of the algorithm's critical path (the host drives every
     # iteration); here the device runs the whole loop autonomously.
     train_seconds = 0.0
+    dispatches = 0  # executor dispatches this host loop made (observability)
     while True:
         t0 = time.perf_counter()
+        dispatches += 1
         if use_pallas:
             state = _run_chunk_pallas(
                 x_dev, y_dev, x_sq, valid_dev, state, max_iter,
@@ -1267,6 +1330,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         iterations=it,
         converged=converged,
         train_seconds=train_seconds,
+        dispatches=dispatches,
         stats={
             "cache_hits": int(state.hits),
             "cache_lookups": total_lookups,

@@ -1,0 +1,271 @@
+"""Batched multi-problem fleet executor (solver/fleet.py).
+
+Contract under test: every fleet member runs the reference-parity
+per-pair MVP trajectory — same selection rule, same pair algebra, same
+f-update association — so per-problem (alpha, b, iterations, n_sv) must
+match a sequential ``solve()`` of the same (sub)problem; finished
+problems freeze bit-exactly while stragglers run; OvO-style row masks
+are equivalent to explicit subset copies; and the multiclass /
+C-sweep routers produce the sequential path's models in a fraction of
+the dispatches.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.solver.fleet import (FleetProblem, _fleet_bucket,
+                                    fleet_chunks, solve_fleet)
+from dpsvm_tpu.solver.smo import solve
+
+CFG = SVMConfig(c=5.0, gamma=0.2, epsilon=1e-3, max_iter=100_000)
+
+
+def _blobs(n=300, d=10, seed=3, sep=1.2):
+    from dpsvm_tpu.data.synth import make_blobs_binary
+
+    return make_blobs_binary(n=n, d=d, seed=seed, sep=sep)
+
+
+def test_single_problem_trajectory_parity():
+    """A fleet of one IS the sequential per-pair engine: identical
+    iteration count, alpha, b, and convergence flag (the kernel-row
+    matmul shape differs, so allow float32 round-off — on the CPU
+    backend it lands bit-exact)."""
+    x, y = _blobs()
+    ref = solve(x, y, CFG)
+    res = solve_fleet(x, [FleetProblem(y=y)], CFG)[0]
+    assert res.converged and ref.converged
+    assert res.iterations == ref.iterations
+    assert abs(res.b - ref.b) < 5e-3
+    np.testing.assert_allclose(res.alpha, ref.alpha, rtol=1e-4, atol=1e-5)
+    assert res.n_sv == ref.n_sv
+
+
+def test_mixed_convergence_freezes_finished_problems():
+    """One problem converges two orders of magnitude before the other;
+    the early finisher's state must be EXACTLY its solo solution (frozen
+    by the gated no-op updates), and its iteration count must not grow
+    while the straggler runs."""
+    x, y = _blobs(sep=3.0)  # wide margin: converges in few pairs
+    xh, yh = _blobs(seed=9, sep=0.25)  # barely separated: many more pairs
+    # Shared rows: problem 0 = easy labels, problem 1 = hard labels on
+    # the hard data. Share X by concatenating and masking disjoint rows.
+    x_all = np.concatenate([x, xh])
+    n = len(x)
+    mask_easy = np.arange(2 * n) < n
+    y0 = np.concatenate([y, np.ones(n, np.int32)])
+    y1 = np.concatenate([np.ones(n, np.int32), yh])
+    res = solve_fleet(x_all, [
+        FleetProblem(y=y0, row_mask=mask_easy),
+        FleetProblem(y=y1, row_mask=~mask_easy, c=500.0),
+    ], CFG)
+    ref0 = solve(x, y, CFG)
+    ref1 = solve(xh, yh, CFG.replace(c=500.0))
+    assert ref1.iterations > 3 * ref0.iterations  # genuinely mixed
+    for res_j, ref_j in ((res[0], ref0), (res[1], ref1)):
+        assert res_j.converged
+        assert res_j.iterations == ref_j.iterations
+        assert abs(res_j.b - ref_j.b) < 5e-3
+        np.testing.assert_allclose(res_j.alpha, ref_j.alpha,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_row_masks_equal_explicit_subset_copies():
+    """OvO's masked-subset problems vs sequential solves on explicit
+    x[mask] copies: the returned alpha is subset-aligned and must agree
+    per problem."""
+    rng = np.random.default_rng(11)
+    n_per = 120
+    centers = np.array([[2.0, 0, 0], [0, 2.0, 0], [0, 0, 2.0]], np.float32)
+    xs = [rng.normal(size=(n_per, 3)).astype(np.float32) * 0.7 + c
+          for c in centers]
+    x = np.concatenate(xs)
+    lab = np.repeat(np.arange(3), n_per)
+    problems, refs = [], []
+    for a in range(3):
+        for b in range(a + 1, 3):
+            mask = (lab == a) | (lab == b)
+            ypm = np.where(lab == a, 1, -1).astype(np.int32)
+            problems.append(FleetProblem(y=ypm, row_mask=mask))
+            refs.append(solve(x[mask], ypm[mask], CFG))
+    res = solve_fleet(x, problems, CFG)
+    for r, ref in zip(res, refs):
+        assert r.converged
+        assert r.alpha.shape == ref.alpha.shape  # subset-aligned
+        assert r.iterations == ref.iterations
+        assert abs(r.b - ref.b) < 5e-3
+        np.testing.assert_allclose(r.alpha, ref.alpha, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_per_problem_c_sweep_matches_sequential():
+    """Per-problem C rides a traced (k, 2) value: every C in one
+    compiled executor, each matching its sequential solve."""
+    x, y = _blobs(sep=0.8)
+    cs = [0.5, 2.0, 8.0, 32.0]
+    res = solve_fleet(x, [FleetProblem(y=y, c=c) for c in cs], CFG)
+    assert all(r.dispatches == res[0].dispatches for r in res)
+    for c, r in zip(cs, res):
+        ref = solve(x, y, CFG.replace(c=c))
+        assert r.converged
+        assert r.iterations == ref.iterations
+        assert abs(r.b - ref.b) < 5e-3
+        assert r.alpha.max() <= c + 1e-5
+
+
+def test_class_weights_apply_per_problem():
+    x, y = _blobs(sep=0.8)
+    cfg = CFG.replace(weight_pos=2.0, weight_neg=0.5)
+    res = solve_fleet(x, [FleetProblem(y=y)], cfg)[0]
+    ref = solve(x, y, cfg)
+    assert res.converged
+    cp, cn = cfg.c_bounds()
+    assert res.alpha[y > 0].max() <= cp + 1e-5
+    assert res.alpha[y < 0].max() <= cn + 1e-5
+    assert abs(res.b - ref.b) < 5e-3
+
+
+def test_budget_mode_refreshes_extrema():
+    x, y = _blobs(sep=0.6)
+    cfg = CFG.replace(budget_mode=True, max_iter=500)
+    res = solve_fleet(x, [FleetProblem(y=y), FleetProblem(y=-y)], cfg)
+    for r in res:
+        assert r.iterations == 500
+        # budget exit reports the HONEST gap at the real epsilon
+        assert np.isfinite(r.b_hi) and np.isfinite(r.b_lo)
+
+
+def test_fleet_bucket_pads_with_dummies():
+    """3 problems bucket to 4; the dummy slot must not perturb results
+    or deadlock the loop."""
+    assert _fleet_bucket(3) == 4
+    assert _fleet_bucket(16) == 16
+    assert _fleet_bucket(45 % 16) == 16  # OvO tail chunk 13 -> 16
+    x, y = _blobs()
+    res = solve_fleet(x, [FleetProblem(y=y), FleetProblem(y=-y),
+                          FleetProblem(y=y, c=2.0)], CFG)
+    assert len(res) == 3
+    assert all(r.converged for r in res)
+    assert res[0].stats["fleet"]["bucket"] == 4
+
+
+def test_fleet_chunks_cover_in_order():
+    items = list(range(45))
+    chunks = fleet_chunks(items, 16)
+    assert [len(c) for c in chunks] == [16, 16, 13]
+    assert [i for c in chunks for i in c] == items
+
+
+def test_validation_errors():
+    x, y = _blobs(n=50)
+    with pytest.raises(ValueError, match="MVP"):
+        solve_fleet(x, [FleetProblem(y=y)],
+                    CFG.replace(selection="second_order"))
+    with pytest.raises(ValueError, match="accuracy"):
+        solve_fleet(x, [FleetProblem(y=y)], CFG.replace(compensated=True))
+    with pytest.raises(ValueError, match="shape"):
+        solve_fleet(x, [FleetProblem(y=y[:10])], CFG)
+    with pytest.raises(ValueError, match="masked labels"):
+        solve_fleet(x, [FleetProblem(y=np.arange(50))], CFG)
+    with pytest.raises(ValueError, match="power of two"):
+        SVMConfig(fleet_size=5)
+    with pytest.raises(ValueError, match="power of two"):
+        SVMConfig(fleet_size=128)
+    assert solve_fleet(x, [], CFG) == []
+
+
+def test_multiclass_router_fleet_matches_sequential():
+    """train_multiclass(use_fleet=True) must produce the sequential
+    path's submodels (same SV sets, same predictions) in fewer
+    dispatches — both strategies."""
+    from dpsvm_tpu.models.multiclass import predict_multiclass, train_multiclass
+
+    rng = np.random.default_rng(5)
+    n_per = 100
+    centers = np.array([[2.0, 0, 0, 0], [0, 2.0, 0, 0], [0, 0, 2.0, 0]],
+                       np.float32)
+    x = np.concatenate([
+        rng.normal(size=(n_per, 4)).astype(np.float32) * 0.8 + c
+        for c in centers])
+    y = np.repeat([3, 4, 5], n_per)
+    for strategy in ("ovr", "ovo"):
+        m_f, r_f = train_multiclass(x, y, CFG, strategy=strategy,
+                                    backend="single", use_fleet=True)
+        m_s, r_s = train_multiclass(x, y, CFG, strategy=strategy,
+                                    backend="single", use_fleet=False)
+        assert all(r.converged for r in r_f)
+        assert len(r_f) == len(r_s)
+        for a, b in zip(r_f, r_s):
+            assert abs(a.b - b.b) < 5e-3
+            assert a.n_sv == b.n_sv
+        np.testing.assert_array_equal(predict_multiclass(m_f, x),
+                                      predict_multiclass(m_s, x))
+        disp_fleet = sum(r.dispatches for r in r_f
+                         if r.stats["fleet"]["index"] == 0)
+        disp_seq = sum(r.dispatches for r in r_s)
+        assert disp_fleet < disp_seq
+
+
+def test_multiclass_router_force_raises_on_ineligible():
+    from dpsvm_tpu.models.multiclass import train_multiclass
+
+    x = np.random.default_rng(0).normal(size=(60, 3)).astype(np.float32)
+    y = np.repeat([0, 1, 2], 20)
+    with pytest.raises(ValueError, match="use_fleet=True"):
+        train_multiclass(x, y, CFG.replace(engine="block"),
+                         strategy="ovr", backend="single", use_fleet=True)
+
+
+def test_multiclass_router_respects_mesh_auto():
+    """On the 8-virtual-device platform, backend='auto' resolves to the
+    mesh — the fleet must NOT hijack it (sequential mesh solves)."""
+    from dpsvm_tpu.models.multiclass import _fleet_eligible
+
+    assert not _fleet_eligible(CFG, "auto", None, None)
+    assert _fleet_eligible(CFG, "single", None, None)
+    assert not _fleet_eligible(CFG, "single", None, trainer=object())
+    assert not _fleet_eligible(CFG.replace(fleet_size=1), "single", None,
+                               None)
+
+
+def test_svc_c_sweep_estimator_facade():
+    from dpsvm_tpu.estimators import SVC, svc_c_sweep
+
+    x, y = _blobs(sep=0.8)
+    cs = [0.5, 4.0]
+    # backend='single' is the explicit opt-in: the test platform shows
+    # 8 virtual devices, where 'auto' (= maybe-mesh) is refused.
+    swept = svc_c_sweep(x, y, cs, gamma=0.2, tol=1e-3, backend="single")
+    assert [e.C for e in swept] == cs
+    for c, est in zip(cs, swept):
+        solo = SVC(C=c, gamma=0.2, tol=1e-3, backend="single").fit(x, y)
+        assert est.score(x, y) == pytest.approx(solo.score(x, y),
+                                                abs=0.02)
+        np.testing.assert_array_equal(est.n_support_, solo.n_support_)
+    with pytest.raises(ValueError, match="binary-only"):
+        svc_c_sweep(x, np.arange(len(y)) % 3, [1.0], backend="single")
+    with pytest.raises(ValueError, match="single-chip"):
+        svc_c_sweep(x, y, [1.0])  # auto on an 8-device host
+    with pytest.raises(ValueError, match="single-chip"):
+        svc_c_sweep(x, y, [1.0], backend="mesh")
+    with pytest.raises(ValueError, match="fleet executor"):
+        svc_c_sweep(x, y, [1.0], backend="single", engine="block")
+
+
+def test_fleet_device_dryrun_multi_device():
+    """8-virtual-device dryrun: the fleet must run (and agree) on ANY
+    explicit device of the platform mesh — placement must not leak into
+    results (the same guarantee the sequential solver's deterministic
+    tie-breaks give the mesh engines)."""
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8  # conftest forces the 8-device CPU platform
+    x, y = _blobs(n=200)
+    base = solve_fleet(x, [FleetProblem(y=y)], CFG, device=devs[0])[0]
+    for d in (devs[3], devs[7]):
+        r = solve_fleet(x, [FleetProblem(y=y)], CFG, device=d)[0]
+        assert r.iterations == base.iterations
+        assert r.b == base.b
+        np.testing.assert_array_equal(r.alpha, base.alpha)

@@ -141,6 +141,121 @@ def test_gram_memo_evicts_when_host_array_dies():
     assert len(_GRAM_MEMO) == 0
 
 
+def test_gram_memo_rebuilds_on_inplace_mutation():
+    """Staleness regression (ADVICE round-5, medium): the memo keys on
+    object identity, but `x *= s` keeps identity while changing content
+    — the content fingerprint must force a rebuild so the solver never
+    trains on a stale device Gram."""
+    from dpsvm_tpu.ops import kernels as K
+
+    _GRAM_MEMO.clear()
+    x, y = _blobs(n=300)
+    x = np.asarray(x, np.float32)
+    calls = {"n": 0}
+    orig = K.resident_gram
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    K.resident_gram = counting
+    try:
+        r1 = solve(x, y, BASE.replace(gram_resident=True))
+        x *= 0.5  # in-place: same object, different kernel values
+        r2 = solve(x, y, BASE.replace(gram_resident=True))
+        assert calls["n"] == 2  # second solve rebuilt the Gram
+        fresh = solve(x.copy(), y, BASE.replace(gram_resident=True))
+        assert abs(r2.b - fresh.b) < 5e-4
+        assert r2.iterations == fresh.iterations
+        assert r1.iterations != r2.iterations or abs(r1.b - r2.b) > 0
+    finally:
+        K.resident_gram = orig
+        _GRAM_MEMO.clear()
+
+
+def test_xdev_memo_rebuilds_on_inplace_mutation():
+    """Same staleness guard for the (x_dev, x_sq) memo the feature-path
+    solves share (OvR multiclass, reconstruction legs)."""
+    x, y = _blobs(n=300)
+    x = np.asarray(x, np.float32)
+    r1 = solve(x, y, BASE)
+    x *= 0.5
+    r2 = solve(x, y, BASE)
+    fresh = solve(x.copy(), y, BASE)
+    assert r2.iterations == fresh.iterations
+    assert abs(r2.b - fresh.b) < 5e-4
+    np.testing.assert_allclose(r2.alpha, fresh.alpha, rtol=1e-5,
+                               atol=1e-6)
+    # and the mutation genuinely changed the problem
+    assert r1.iterations != r2.iterations or abs(r1.b - r2.b) > 0
+
+
+def test_gram_memo_finalizer_does_not_evict_live_replacement():
+    """Finalizer lifetime regression (ADVICE round-5, low): replace the
+    memo entry for the same key with a NEW host array, then let the OLD
+    array die — its finalizer must NOT evict the live entry (that would
+    silently rebuild a multi-GB Gram on the next leg)."""
+    import gc
+
+    from dpsvm_tpu.ops import kernels as K
+
+    _GRAM_MEMO.clear()
+    x1, y = _blobs(n=300)
+    x1 = np.asarray(x1, np.float32)
+    x2 = (x1 * 0.5).astype(np.float32)  # same shape/dtype => same key
+    solve(x1, y, BASE.replace(gram_resident=True))
+    solve(x2, y, BASE.replace(gram_resident=True))  # replaces the entry
+    assert len(_GRAM_MEMO) == 1
+    del x1
+    gc.collect()
+    assert len(_GRAM_MEMO) == 1  # live x2 entry survived x1's finalizer
+    calls = {"n": 0}
+    orig = K.resident_gram
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    K.resident_gram = counting
+    try:
+        solve(x2, y, BASE.replace(gram_resident=True))
+        assert calls["n"] == 0  # memo HIT: no rebuild
+    finally:
+        K.resident_gram = orig
+        _GRAM_MEMO.clear()
+
+
+def test_gram_memo_releases_evicted_payload_without_cyclic_gc():
+    """An evicted entry's multi-GB payload must free by REFCOUNT the
+    moment memo.clear() drops it — a finalizer closure holding the entry
+    would form a cycle that keeps the old device Gram alive until the
+    cyclic GC runs (never, under gc.disable())."""
+    import gc
+    import weakref
+
+    from dpsvm_tpu.solver.smo import _memo_insert
+
+    class Payload:  # weakref-able stand-in for the device Gram
+        pass
+
+    memo: dict = {}
+    host1, host2 = np.zeros(4), np.zeros(4)
+    p1, p2 = Payload(), Payload()
+    dead = weakref.ref(p1)
+    gc.disable()
+    try:
+        _memo_insert(memo, "k", host1, (p1,))
+        del p1
+        _memo_insert(memo, "k", host2, (p2,))  # evicts entry 1
+        assert dead() is None  # released by refcount, no gc.collect()
+    finally:
+        gc.enable()
+    # and the live entry still works + survives host1's death
+    del host1
+    gc.collect()
+    assert len(memo) == 1 and memo["k"][2] is p2
+
+
 def test_hybrid_switches_to_per_pair_on_block_stall():
     """solve_in_legs hands the tail to the per-pair engine when block
     legs stop cutting the true gap. Simulated stall: a base_solve that

