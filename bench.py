@@ -87,6 +87,78 @@ def _session_calibration() -> dict:
     }
 
 
+# Residual session jitter AFTER drift normalization (the calibration
+# cancels first-order session speed; what remains is the ±10%-class
+# run-to-run jitter both PROFILE.md and the round-4/5 within-session
+# A/Bs observed). A normalized delta beyond this band is FLAGged as a
+# real regression/improvement; inside it is PASS (noise).
+_REGRESSION_BAND = 0.10
+
+
+def _latest_bench_artifact(root: str):
+    """(path, parsed-dict) of the newest committed BENCH_r*.json, or
+    (None, None). Artifacts come in two shapes: the driver's wrapper
+    {"parsed": {...}} and a bare result dict."""
+    import glob
+    import os
+
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if not paths:
+        return None, None
+    with open(paths[-1]) as fh:
+        doc = json.load(fh)
+    return paths[-1], doc.get("parsed", doc)
+
+
+def _regression_gate(current: dict, root: str) -> dict:
+    """Round-over-round regression check (VERDICT round-5 item 1, second
+    half): compare THIS run's pairs/s against the latest committed
+    BENCH_r*.json, drift-normalized by the pinned session-calibration
+    kernel so a slow tunnel hour cannot masquerade as a solver
+    regression (and a fast one cannot hide it). Pure function of the
+    two artifacts — unit-tested in tests/test_bench_gate.py.
+
+    Normalization: the calibration kernel's FLOPs never change, so
+    (prev_calib_s / cur_calib_s) is the session speed ratio; dividing
+    the current pairs/s by it re-expresses the measurement in the
+    PREVIOUS session's time units before comparing. Verdicts:
+      PASS / FLAG      — |normalized delta| within / beyond the band
+      NO_BASELINE      — first run (no committed artifact)
+      NO_CALIBRATION   — previous artifact predates the calibration
+                         field: the delta is reported RAW and
+                         informational (cross-session drift cannot be
+                         separated out)."""
+    path, prev = _latest_bench_artifact(root)
+    if prev is None or "pairs_per_second" not in prev:
+        return {"regression_gate": "NO_BASELINE"}
+    out = {
+        "previous_artifact": path.rsplit("/", 1)[-1],
+        "previous_pairs_per_second": prev["pairs_per_second"],
+    }
+    cur_pps = current["pairs_per_second"]
+    prev_cal = (prev.get("session_calibration") or {}).get(
+        "best_of_5_seconds")
+    cur_cal = (current.get("session_calibration") or {}).get(
+        "best_of_5_seconds")
+    if not prev_cal or not cur_cal:
+        out["regression_gate"] = "NO_CALIBRATION"
+        out["raw_delta"] = round(
+            cur_pps / prev["pairs_per_second"] - 1.0, 4)
+        return out
+    drift = prev_cal / cur_cal  # >1: this session is FASTER than prev
+    norm_pps = cur_pps / drift
+    delta = norm_pps / prev["pairs_per_second"] - 1.0
+    out.update({
+        "session_drift_ratio": round(drift, 4),
+        "normalized_pairs_per_second": round(norm_pps),
+        "normalized_delta": round(delta, 4),
+        "regression_band": _REGRESSION_BAND,
+        "regression_gate": ("PASS" if abs(delta) <= _REGRESSION_BAND
+                            else "FLAG"),
+    })
+    return out
+
+
 def main() -> int:
     import jax
 
@@ -234,7 +306,7 @@ def main() -> int:
     # the iteration-budget-for-iteration-budget comparison that needs no
     # convergence-difficulty caveat. seconds_to_convergence is the
     # eps=0.01 run on this dataset (faster, but dataset-dependent).
-    print(json.dumps({
+    result = {
         "metric": (
             f"synthetic MNIST-even-odd-shaped 60kx784 RBF modified-SMO "
             f"training wall-clock, 1 chip, MEASURED at the reference's "
@@ -260,7 +332,27 @@ def main() -> int:
         # same field in earlier BENCH_r*.json before reading any
         # cross-session delta as a solver regression.
         "session_calibration": calibration,
-    }))
+    }
+    # Round-over-round regression gate vs the latest committed artifact
+    # (drift-normalized via the calibration kernel; see _regression_gate).
+    import os
+
+    gate = _regression_gate(result, os.path.dirname(os.path.abspath(__file__)))
+    result.update(gate)
+    if gate.get("regression_gate") in ("PASS", "FLAG"):
+        print(f"[bench] regression gate: {gate['regression_gate']} — "
+              f"drift-normalized {gate['normalized_pairs_per_second']} "
+              f"pairs/s vs {gate['previous_pairs_per_second']} in "
+              f"{gate['previous_artifact']} "
+              f"(delta {100 * gate['normalized_delta']:+.1f}%, band "
+              f"±{100 * _REGRESSION_BAND:.0f}%, session drift ratio "
+              f"{gate['session_drift_ratio']})", file=sys.stderr)
+    else:
+        print(f"[bench] regression gate: "
+              f"{gate.get('regression_gate')} "
+              f"{'(raw delta %+.1f%%)' % (100 * gate['raw_delta']) if 'raw_delta' in gate else ''}",
+              file=sys.stderr)
+    print(json.dumps(result))
     return 0
 
 

@@ -94,6 +94,15 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "dispatch sequence (solver/fleet.py; power of "
                         "two, 1 = sequential solves; applies to the "
                         "OvR/OvO reduction on a single chip)")
+    p.add_argument("--pipeline-rounds", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="block engine: software-pipeline the rounds — "
+                        "next round's selection/gather/Gram issued from "
+                        "the pre-fold gradient, overlapping the serial "
+                        "subproblem chain (stale selection, exact "
+                        "updates; SVMConfig.pipeline_rounds). auto = "
+                        "the measured gate (solver/block.py "
+                        "pipeline_pays)")
     p.add_argument("--active-set-size", type=int, default=0,
                    help="block engine: shrink per-round work to the m "
                         "most-violating rows, reconciling the full "
@@ -213,7 +222,8 @@ def _cmd_smoke(args) -> int:
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh
+    from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
+                                         mesh_shard_map)
 
     devices = jax.devices()
     print(f"platform={devices[0].platform} devices={len(devices)}")
@@ -228,7 +238,7 @@ def _cmd_smoke(args) -> int:
         print(f"  {d}: matvec {'OK' if good else 'FAIL ' + str(got)}")
     n = args.num_devices or len(devices)
     mesh = make_data_mesh(n)
-    psum = jax.jit(jax.shard_map(
+    psum = jax.jit(mesh_shard_map(
         lambda x: jax.lax.psum(x, DATA_AXIS), mesh=mesh,
         in_specs=P(DATA_AXIS), out_specs=P()))
     got = np.asarray(psum(jnp.ones((n,), jnp.float32)))
@@ -321,6 +331,8 @@ def _cmd_train(args) -> int:
             inner_iters=args.inner_iters,
             pair_batch=args.pair_batch,
             fleet_size=args.fleet_size,
+            pipeline_rounds={"auto": None, "on": True,
+                             "off": False}[args.pipeline_rounds],
             active_set_size=args.active_set_size,
             reconcile_rounds=args.reconcile_rounds,
             dtype=args.dtype, chunk_iters=args.chunk_iters,

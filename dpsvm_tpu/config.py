@@ -157,6 +157,24 @@ class SVMConfig:
     # feature kernels; nu / active-set / precomputed use the plain path.
     fused_fold: Optional[bool] = None
 
+    # Pipelined block rounds (solver/block.py run_chunk_block_pipelined,
+    # parallel/dist_block.py pipelined runner; no reference equivalent —
+    # the reference's host-driven loop cannot overlap anything): the
+    # NEXT round's working-set selection + row gather + Gram build are
+    # issued from the PRE-fold gradient and carry no data dependence on
+    # the current round's serial subproblem chain, with a corrected-
+    # gradient re-rank + gating pass at handoff so every executed update
+    # stays exact (stale SELECTION, exact UPDATE — the pair_batch
+    # contract lifted to whole rounds). On the mesh this additionally
+    # makes the per-round all_gather/psum collectives overlappable —
+    # the term docs/SCALING.md carries as the un-shrinkable per-round
+    # floor. None = auto (solver/block.py pipeline_pays: currently OFF
+    # everywhere pending the device-session measurement); True forces
+    # it (CPU tests, A/B probes); False forces the plain serial round.
+    # Applies to engine='block', selection in {mvp, second_order},
+    # active_set_size=0; supersedes fused_fold when both would apply.
+    pipeline_rounds: Optional[bool] = None
+
     # Active-set shrinking for the block engine (0 = off). When > 0, the
     # solver runs cycles of `reconcile_rounds` block rounds whose
     # selection and fold touch only the `active_set_size` most-violating
@@ -317,6 +335,23 @@ class SVMConfig:
             raise ValueError("inner_iters must be >= 0 (0 = working_set_size)")
         if self.active_set_size < 0:
             raise ValueError("active_set_size must be >= 0 (0 = shrinking off)")
+        if self.pipeline_rounds and self.engine != "block":
+            raise ValueError(
+                "pipeline_rounds is a block-engine knob (the per-pair "
+                "engines have no round structure to pipeline; the fused "
+                "pallas engine already pipelines per pair); use "
+                "engine='block'")
+        if self.pipeline_rounds and self.active_set_size:
+            raise ValueError(
+                "pipeline_rounds does not compose with active_set_size "
+                "(the active cycle's restricted rounds already defer "
+                "their folds; pipelining them would stack two staleness "
+                "contracts) — use one or the other")
+        if self.pipeline_rounds and self.selection == "nu":
+            raise ValueError(
+                "pipeline_rounds supports selection in {'mvp', "
+                "'second_order'} (the nu rule's per-class quarters keep "
+                "the plain round; same restriction as fused_fold)")
         if self.pair_batch not in (1, 2, 4, 8):
             raise ValueError("pair_batch must be 1, 2, 4 or 8")
         if self.pair_batch > 1:

@@ -138,11 +138,13 @@ def train_nusvc(
             "engine='pallas' does not implement the per-class nu "
             "selection; use engine='xla' (per-pair) or engine='block' "
             "(decomposition with per-class quarters)")
-    # pair_batch falls back to single-pair: the batched second slot is
-    # mvp-only (SVMConfig.pair_batch) and must not make a legal user
-    # config crash when this trainer switches the selection rule.
+    # pair_batch falls back to single-pair and pipeline_rounds to auto:
+    # both are mvp/second_order-only features (SVMConfig) and must not
+    # make a legal user config crash when this trainer switches the
+    # selection rule — the nu per-class quarters keep the plain round.
     cfg = config.replace(c=1.0, weight_pos=1.0, weight_neg=1.0,
-                         selection="nu", pair_batch=1)
+                         selection="nu", pair_batch=1,
+                         pipeline_rounds=None)
 
     result = _solve(x, y, cfg, backend, num_devices, callback,
                     alpha0, f_init, checkpoint_path, resume)
@@ -225,7 +227,8 @@ def train_nusvr(
             "selection; use engine='xla' (per-pair) or engine='block' "
             "(decomposition with per-class quarters)")
     cfg = config.replace(c=C, weight_pos=1.0, weight_neg=1.0,
-                         selection="nu", pair_batch=1)  # see train_nusvc
+                         selection="nu", pair_batch=1,
+                         pipeline_rounds=None)  # see train_nusvc
     result = _solve(x2, y2, cfg, backend, num_devices, callback,
                     alpha0, f_init, checkpoint_path, resume)
 

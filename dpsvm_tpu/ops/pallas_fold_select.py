@@ -43,30 +43,41 @@ _BIG = float("inf")
 _IMAX = 2 ** 31 - 1
 
 
-def _fold_select_kernel(*refs, c, rows_per_block: int, compensated: bool):
+def _fold_select_kernel(*refs, c, rows_per_block: int, compensated: bool,
+                        fold: bool = True):
     """One grid step: fold a (rows, 128) block of delta into f and emit
-    per-row selection candidates."""
-    if compensated:
+    per-row selection candidates. With fold=False (the PRE-FOLD selection
+    variant, select_rows below) there is no delta input and no f/err
+    output — the candidates are emitted from f as it stands."""
+    if not fold:
+        (f_ref, alpha_ref, y_ref, valid_ref,
+         upv_ref, upi_ref, lov_ref, loi_ref) = refs
+        if compensated:
+            raise AssertionError(
+                "select_rows passes the effective f (f - err) directly")
+        f_sel = f_ref[:]
+    elif compensated:
         (f_ref, err_ref, alpha_ref, y_ref, valid_ref, delta_ref,
          f_out_ref, err_out_ref, upv_ref, upi_ref, lov_ref, loi_ref) = refs
     else:
         (f_ref, alpha_ref, y_ref, valid_ref, delta_ref,
          f_out_ref, upv_ref, upi_ref, lov_ref, loi_ref) = refs
 
-    delta = delta_ref[:]
-    f = f_ref[:]
-    if compensated:
-        # The canonical Kahan step (true ~= f - err), shared with every
-        # other engine's fold.
-        from dpsvm_tpu.solver.smo import kahan_add
+    if fold:
+        delta = delta_ref[:]
+        f = f_ref[:]
+        if compensated:
+            # The canonical Kahan step (true ~= f - err), shared with
+            # every other engine's fold.
+            from dpsvm_tpu.solver.smo import kahan_add
 
-        f_new, err_new = kahan_add(f, err_ref[:], delta)
-        err_out_ref[:] = err_new
-        f_sel = f_new - err_new
-    else:
-        f_new = f + delta
-        f_sel = f_new
-    f_out_ref[:] = f_new
+            f_new, err_new = kahan_add(f, err_ref[:], delta)
+            err_out_ref[:] = err_new
+            f_sel = f_new - err_new
+        else:
+            f_new = f + delta
+            f_sel = f_new
+        f_out_ref[:] = f_new
 
     # Set membership is the up_mask/low_mask algebra of ops/select.py,
     # re-expressed as pure i1 logic: those helpers build on jnp.where
@@ -162,6 +173,50 @@ def fold_select(f2d, err2d, alpha2d, y2d, valid2d, delta2d, c,
         f_new, upv, upi, lov, loi = outs
         err_new = None
     return (f_new, err_new, upv[:, 0], upi[:, 0], lov[:, 0], loi[:, 0])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("c", "block_rows", "interpret"))
+def select_rows(f2d, alpha2d, y2d, valid2d, c, block_rows: int = 8,
+                interpret: bool = False):
+    """PRE-FOLD selection variant of fold_select: emit per-row working-set
+    candidates from f AS IT STANDS (no delta, no fold). Built for the
+    pipelined block engine (solver/block.py run_chunk_block_pipelined),
+    whose next-round selection is issued from the pre-fold gradient and
+    therefore has no delta to fold — the ONE pass over f replaces the
+    full-n mask-building + approx_max_k stage of select_block exactly as
+    fold_select does for the fused engine, without manufacturing a
+    zero-delta fold (which would still write the (R, 128) f output back
+    to HBM for nothing).
+
+    Compensated carries pass the effective f (f - err) — the caller
+    already holds both and the selection only READS f, so no err
+    plumbing is needed here. Same contract as fold_select otherwise:
+    (R, 128) float32 arrays, R % block_rows == 0; returns (up_vals,
+    up_ids, low_vals, low_ids), one candidate per 128-element row, ids
+    flat over the (R, 128) layout."""
+    rows = f2d.shape[0]
+    assert rows % block_rows == 0, (rows, block_rows)
+    nblocks = rows // block_rows
+
+    block = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    cand = pl.BlockSpec((block_rows, 1), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    kern = functools.partial(_fold_select_kernel, c=c,
+                             rows_per_block=block_rows,
+                             compensated=False, fold=False)
+    cval = jax.ShapeDtypeStruct((rows, 1), jnp.float32)
+    cidx = jax.ShapeDtypeStruct((rows, 1), jnp.int32)
+    upv, upi, lov, loi = pl.pallas_call(
+        kern,
+        grid=(nblocks,),
+        in_specs=[block] * 4,
+        out_specs=[cand, cand, cand, cand],
+        out_shape=[cval, cidx, cval, cidx],
+        interpret=interpret,
+    )(f2d, alpha2d, y2d, valid2d)
+    return upv[:, 0], upi[:, 0], lov[:, 0], loi[:, 0]
 
 
 def assemble_working_set(upv, upi, lov, loi, h: int):

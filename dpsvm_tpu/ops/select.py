@@ -64,6 +64,26 @@ def low_mask(alpha: jax.Array, y: jax.Array, c_pos: float,
     return xp.where(y > 0, alpha > 0, alpha < c)
 
 
+def candidate_live_mask(alpha_w, y_w, c, xp=jnp):
+    """Handoff gate for PIPELINED block rounds (solver/block.py
+    run_chunk_block_pipelined, parallel/dist_block.py pipelined runner):
+    a working set selected from the PRE-fold gradient is only handed to
+    the subproblem after this corrected-gradient pass re-derives each
+    slot's admissibility from the CURRENT alpha. A slot stays live iff
+    its point is still in I_up or I_low — a candidate the previous
+    round's updates saturated out of both sets is masked (not
+    recomputed; the prefetched Gram row for it is simply unused). The
+    subproblem re-checks per-iteration membership itself, so this gate
+    is the documented staleness contract, not a hidden correctness
+    crutch: it keeps dead slots from occupying selection ranks.
+
+    alpha_w/y_w are the (q,) gathered CURRENT per-slot values; `c` is a
+    scalar or (c_pos, c_neg)."""
+    cp, cn = split_c(c)
+    return (up_mask(alpha_w, y_w, cp, cn, xp=xp)
+            | low_mask(alpha_w, y_w, cp, cn, xp=xp))
+
+
 def nu_stopping_pair(bh_p, bl_p, bh_n, bl_n, xp=jnp):
     """LibSVM's nu stopping gap: report the per-class (b_hi, b_lo) of the
     class with the larger violation, so b_lo - b_hi ==

@@ -38,10 +38,10 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
-from dpsvm_tpu.ops.select import (low_mask, nu_stopping_pair, split_c,
-                                  up_mask)
+from dpsvm_tpu.ops.select import (candidate_live_mask, low_mask,
+                                  nu_stopping_pair, split_c, up_mask)
 from dpsvm_tpu.parallel.dist_smo import _global_ids
-from dpsvm_tpu.parallel.mesh import DATA_AXIS
+from dpsvm_tpu.parallel.mesh import DATA_AXIS, mesh_shard_map
 from dpsvm_tpu.solver.block import (BlockState, _round_core,
                                     _solve_subproblem, _top_h,
                                     combine_halves)
@@ -261,12 +261,147 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
     state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
                              pairs=rep, rounds=rep,
                              f_err=shard if compensated else None)
-    mapped = jax.shard_map(
+    mapped = mesh_shard_map(
         chunk_body,
         mesh=mesh,
         in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
         out_specs=state_specs,
-        check_vma=False,
+        check=False,  # while_loop carries defeat the replication checker
+    )
+    return jax.jit(mapped)
+
+
+def make_block_pipelined_chunk_runner(mesh: Mesh, kp: KernelParams, c,
+                                      eps: float, tau: float, q: int,
+                                      inner_iters: int,
+                                      rounds_per_chunk: int,
+                                      inner_impl: str = "xla",
+                                      interpret: bool = False,
+                                      selection: str = "mvp",
+                                      compensated: bool = False,
+                                      pair_batch: int = 1):
+    """PIPELINED mesh block runner (config.pipeline_rounds — the mesh
+    counterpart of solver/block.py run_chunk_block_pipelined, and the
+    path where the overlap is STRUCTURAL rather than scheduler luck):
+    the next round's distributed selection (all_gather of per-shard
+    candidates) and working-set recovery (the (q, d+3) masked psum — the
+    round's only bulk collective) are issued from the PRE-fold carry, so
+    they have no data dependence on the current round's replicated
+    subproblem chain and XLA's async collectives can run them UNDER it.
+    docs/SCALING.md carries exactly these two terms (t_ici plus the
+    selection share of the a-floor) as the per-round latency that shrinks
+    with neither P nor n — this engine is the remedy VERDICT round-5
+    ranked as item 3.
+
+    What stays on the critical path: ONE tiny handoff psum per round —
+    the (q, 2) replication of the staged working set's CURRENT
+    [alpha, f] (those change under the in-flight round, so they cannot
+    be prefetched; x rows / x_sq / k_diag / y are static and prefetch
+    EXACTLY regardless of selection staleness) — then the replicated
+    subproblem, the purely local fold, and the owned-slot scatter.
+    Staleness/exactness contract is run_chunk_block_pipelined's: stale
+    SELECTION, exact UPDATES via the handoff's corrected-gradient
+    re-rank + candidate_live_mask gating; a zero-progress round folds a
+    zero delta so the next prefetch reads the unchanged (exact) gradient
+    — stale selection wastes at most one round, never cycles.
+
+    Feature kernels only (a precomputed Gram's symmetric-gather round is
+    already collective-light — its kb psum is (q, q); use the plain
+    runner there). selection in {mvp, second_order}.
+    """
+    if kp.kind == "precomputed":
+        raise ValueError(
+            "pipelined mesh rounds support feature kernels only (the "
+            "precomputed Gram's symmetric round has no (q, d) psum to "
+            "hide; use make_block_chunk_runner)")
+
+    def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
+                   state: BlockState, max_iter):
+        n_loc = x_loc.shape[0]
+        end = state.rounds + rounds_per_chunk
+        # Static per-row scalars: pure functions of the data, so the
+        # prefetched values are exact no matter how stale the selection.
+        stat_loc = jnp.stack([x_sq_loc, k_diag_loc, y_loc], axis=1)
+
+        def prefetch(f_eff, alpha):
+            """Next working set + its data-side artifacts from the
+            pre-fold (f, alpha). All collectives here are overlappable:
+            nothing downstream of the in-flight subproblem feeds them."""
+            w, ok, b_hi, b_lo = _select_block_mesh(
+                f_eff, alpha, y_loc, valid_loc, c, q, rule=selection)
+            qx, stat, _, _ = _gather_ws(x_loc, stat_loc, w, ok, n_loc)
+            qsq, kd, y_w = stat[:, 0], stat[:, 1], stat[:, 2]
+            dots = jnp.dot(qx, qx.T, preferred_element_type=jnp.float32)
+            kb = kernel_from_dots(dots, qsq, qsq, kp)
+            return (w, ok, qx, qsq, kb, kd, y_w), b_hi, b_lo
+
+        cand0, bhi0, blo0 = prefetch(eff_f(state), state.alpha)
+        st0 = state._replace(b_hi=bhi0, b_lo=blo0)
+
+        def cond(carry):
+            st, _ = carry
+            return ((st.rounds < end) & (st.pairs < max_iter)
+                    & (st.b_lo > st.b_hi + 2.0 * eps))
+
+        def body(carry):
+            st, cand = carry
+            w, slot_ok0, qx, qsq, kb_w, kd_w, y_w = cand
+            f_cur = eff_f(st)
+            # ---- handoff: ONE (q, 2) psum replicates the staged W's
+            # CURRENT per-slot alpha/f, then the corrected-gradient
+            # gating masks slots the previous round saturated.
+            l, own, l_safe = _ws_owners(w, slot_ok0, n_loc)
+            dyn = _psum_scal(jnp.stack([st.alpha, f_cur], axis=1),
+                             own, l_safe)
+            a_w0, f_w0 = dyn[:, 0], dyn[:, 1]
+            slot_ok = slot_ok0 & candidate_live_mask(a_w0, y_w, c)
+            # No gap gate on `limit`: cond() guarantees the carried gap
+            # is open on body entry (see run_chunk_block_pipelined).
+            limit = jnp.minimum(jnp.int32(inner_iters),
+                                max_iter - st.pairs)
+            if inner_impl == "pallas":
+                from dpsvm_tpu.ops.pallas_subproblem import (
+                    solve_subproblem_pallas)
+
+                alpha_w, t = solve_subproblem_pallas(
+                    kb_w, a_w0, y_w, f_w0, kd_w,
+                    slot_ok.astype(jnp.float32), limit, c, eps, tau,
+                    rule=selection, interpret=interpret,
+                    pair_batch=pair_batch)
+            else:
+                alpha_w, _, t = _solve_subproblem(
+                    kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
+                    limit, rule=selection, pair_batch=pair_batch)
+            coef = jnp.where(slot_ok, (alpha_w - a_w0) * y_w, 0.0)
+            # ---- next prefetch from the PRE-fold carry: its all_gather
+            # + row psum never wait on the subproblem above.
+            nxt, bhi_n, blo_n = prefetch(f_cur, st.alpha)
+            # ---- purely local fold + owned-slot scatter.
+            k_rows_loc = kernel_rows(x_loc, x_sq_loc,
+                                     qx.astype(x_loc.dtype), qsq, kp)
+            f, f_err = maybe_kahan(st.f, st.f_err, coef @ k_rows_loc)
+            own_live = own & slot_ok
+            l_scatter = jnp.where(own_live, l, jnp.int32(n_loc))
+            alpha = st.alpha.at[l_scatter].set(
+                jnp.where(own_live, alpha_w, 0.0), mode="drop")
+            new_st = BlockState(alpha, f, bhi_n, blo_n, st.pairs + t,
+                                st.rounds + 1, f_err)
+            return new_st, nxt
+
+        final, _ = lax.while_loop(cond, body, (st0, cand0))
+        return final
+
+    shard = P(DATA_AXIS)
+    rep = P()
+    state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
+                             pairs=rep, rounds=rep,
+                             f_err=shard if compensated else None)
+    mapped = mesh_shard_map(
+        chunk_body,
+        mesh=mesh,
+        in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
+        out_specs=state_specs,
+        check=False,  # while_loop carries defeat the replication checker
     )
     return jax.jit(mapped)
 
@@ -382,12 +517,12 @@ def make_block_fused_chunk_runner(mesh: Mesh, kp: KernelParams, c,
     state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
                              pairs=rep, rounds=rep,
                              f_err=shard if compensated else None)
-    mapped = jax.shard_map(
+    mapped = mesh_shard_map(
         chunk_body,
         mesh=mesh,
         in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
         out_specs=state_specs,
-        check_vma=False,
+        check=False,  # while_loop carries defeat the replication checker
     )
     return jax.jit(mapped)
 
@@ -524,11 +659,11 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
     state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
                              pairs=rep, rounds=rep,
                              f_err=shard if compensated else None)
-    mapped = jax.shard_map(
+    mapped = mesh_shard_map(
         chunk_body,
         mesh=mesh,
         in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
         out_specs=state_specs,
-        check_vma=False,
+        check=False,  # while_loop carries defeat the replication checker
     )
     return jax.jit(mapped)

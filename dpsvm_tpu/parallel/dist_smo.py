@@ -46,7 +46,8 @@ from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pa
 from dpsvm_tpu.solver.result import SolveResult
 from dpsvm_tpu.solver.smo import (SMOState, assert_finite_state, eff_f,
                                   kahan_add)
-from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh, pad_rows
+from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
+                                     mesh_shard_map, pad_rows)
 
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -336,12 +337,12 @@ def _make_chunk_runner(mesh: Mesh, kp: KernelParams, c: float, eps: float,
         hits=rep,
         f_err=shard if compensated else None,
     )
-    mapped = jax.shard_map(
+    mapped = mesh_shard_map(
         chunk_body,
         mesh=mesh,
         in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
         out_specs=state_specs,
-        check_vma=False,
+        check=False,  # while_loop carries defeat the replication checker
     )
     return jax.jit(mapped)
 
@@ -448,12 +449,24 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     # (solver/block.py fused_fold_pays — round-5 sweep covering the
     # n_loc band pods actually land in). Needs n_loc padded to 1024 and
     # q/2 <= n_loc/128.
-    from dpsvm_tpu.solver.block import fused_fold_pays
+    from dpsvm_tpu.solver.block import fused_fold_pays, pipeline_pays
 
     _platform = mesh.devices.flat[0].platform
     _n_pad_f = pad_rows(n, n_dev, multiple=1024)
     _n_loc_f = _n_pad_f // n_dev
-    use_fused = (use_block and config.selection != "nu"
+    # Pipelined mesh rounds (config.pipeline_rounds; dist_block.py
+    # make_block_pipelined_chunk_runner): the per-round all_gather/psum
+    # collectives are issued from the pre-fold carry and can hide behind
+    # the replicated subproblem chain. Supersedes the fused fold+select
+    # when both would apply (same precedence as the single-chip path).
+    use_pipe = (use_block and config.selection != "nu"
+                and not config.active_set_size
+                and kp.kind != "precomputed"
+                and (config.pipeline_rounds
+                     if config.pipeline_rounds is not None
+                     else (_platform == "tpu"
+                           and pipeline_pays(_n_loc_f, d))))
+    use_fused = (use_block and not use_pipe and config.selection != "nu"
                  and not config.active_set_size
                  and kp.kind != "precomputed"
                  and min(config.working_set_size, _n_loc_f)
@@ -598,6 +611,17 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 mesh, kp, config.c_bounds(), eps_run,
                 float(config.tau), q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds), inner_impl,
+                selection=config.selection,
+                compensated=config.compensated,
+                pair_batch=int(config.pair_batch))
+        elif use_pipe:
+            from dpsvm_tpu.parallel.dist_block import (
+                make_block_pipelined_chunk_runner)
+
+            run_chunk = make_block_pipelined_chunk_runner(
+                mesh, kp, config.c_bounds(), eps_run,
+                float(config.tau), q, inner, rounds_per_chunk, inner_impl,
+                interpret=_platform != "tpu",
                 selection=config.selection,
                 compensated=config.compensated,
                 pair_batch=int(config.pair_batch))

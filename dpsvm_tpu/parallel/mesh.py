@@ -19,6 +19,28 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 
 
+def mesh_shard_map(f, mesh: Mesh, in_specs, out_specs, check: bool = True):
+    """``shard_map`` across jax versions — THE one place the API skew is
+    absorbed (every shard_map call site routes through here). jax >=
+    0.5 exposes ``jax.shard_map`` with the replication check named
+    ``check_vma``; 0.4.x has only ``jax.experimental.shard_map`` with
+    the same knob named ``check_rep``.
+
+    ``check=False`` is for the solver chunk runners ONLY: their
+    replicated-output claims (b_hi/b_lo/pairs) are true by construction
+    (identical replicated compute) but the static checker cannot see
+    that through while_loop carries. Everything else (prediction,
+    smoke psums) keeps the check on so a broken replication claim fails
+    at trace time instead of returning per-shard garbage."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check)
+
+
 def make_data_mesh(
     num_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,

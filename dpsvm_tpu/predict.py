@@ -133,7 +133,8 @@ def _mesh_decision_executor(n_dev: int, kp: KernelParams):
     from jax.sharding import PartitionSpec as P
 
     from dpsvm_tpu.ops.kernels import kernel_rows, squared_norms
-    from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh
+    from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
+                                         mesh_shard_map)
 
     mesh = make_data_mesh(n_dev)
 
@@ -141,7 +142,7 @@ def _mesh_decision_executor(n_dev: int, kp: KernelParams):
         k = kernel_rows(sv_loc, sv_sq_loc, qb, squared_norms(qb), kp)
         return lax.psum(k @ coef_loc, DATA_AXIS)
 
-    mapped = jax.jit(jax.shard_map(
+    mapped = jax.jit(mesh_shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P()))

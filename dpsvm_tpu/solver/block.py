@@ -40,8 +40,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
-from dpsvm_tpu.ops.select import (c_of, low_mask, nu_stopping_pair,
-                                  select_working_set_nu, split_c, up_mask)
+from dpsvm_tpu.ops.select import (c_of, candidate_live_mask, low_mask,
+                                  nu_stopping_pair, select_working_set_nu,
+                                  split_c, up_mask)
 from dpsvm_tpu.solver.smo import eff_f, maybe_kahan, pair_alpha_update
 
 
@@ -86,6 +87,94 @@ def fused_fold_pays(n_rows: int, d: int) -> bool:
     launch costs relatively more). Round-4's single 200k constant sat
     inside the unmeasured 60k-500k band — the verdict's item 6."""
     return n_rows >= (100_000 if d <= 128 else 150_000)
+
+
+def pipeline_pays(n_rows: int, d: int) -> bool:
+    """Auto-gate for the PIPELINED round engine (run_chunk_block_pipelined
+    / the mesh pipelined runner), same single-source discipline as
+    fused_fold_pays: gate constants come from measurement or the gate
+    stays off.
+
+    Status (2026-08-03): the engine is implemented and CPU-verified
+    exact, and the A/B ablation probes exist (tools/profile_round.py
+    --pipeline), but no TPU was reachable this session, so there is no
+    measured crossover yet — the honest auto default is OFF everywhere
+    (config.pipeline_rounds=True forces it on for measurement and for
+    the CPU tests). Expected shape of the eventual gate, from the
+    SCALING.md overlapped cost model: single-chip is predicted ~wash
+    (TPU cores run one kernel at a time, so the reordering only
+    shortens the dependency chain, not the kernel-time sum), while the
+    MESH engine is where the overlap is structural — the prefetched
+    all_gather/psum pair is collective-async and CAN hide behind the
+    replicated subproblem chain. Flip this to the measured rule when
+    the device session lands; PROFILE.md's pipelined section tracks the
+    pending measurement."""
+    return False
+
+
+class PipelinedCand(NamedTuple):
+    """The pipelined engine's loop-carried prefetch: the NEXT round's
+    working set plus everything about it that does not depend on the
+    in-flight round's updates (rows, norms, Gram block, kernel diag —
+    all pure functions of X and the candidate ids, hence EXACT no matter
+    how stale the selection that picked them). Per-slot alpha/f are NOT
+    staged: they change under the in-flight round, so the handoff
+    gathers them fresh (the corrected-gradient re-rank contract)."""
+
+    w: jax.Array  # (q,) int32 global candidate ids
+    ok: jax.Array  # (q,) bool live-slot mask from the selection
+    b_hi: jax.Array  # f32 stopping extrema of the f the selection saw
+    b_lo: jax.Array
+    qx: jax.Array  # (q, d) candidate rows (x.dtype)
+    qsq: jax.Array  # (q,) squared norms
+    kb: jax.Array  # (q, q) f32 Gram block K(W, W)
+    kd: jax.Array  # (q,) f32 kernel diagonal at W
+
+
+def prefetch_working_set(x, y, x_sq, k_diag, f, alpha, valid, kp, c,
+                         q: int, selection: str,
+                         pallas_select: bool = False,
+                         interpret: bool = False) -> PipelinedCand:
+    """Select the NEXT round's working set from (f, alpha) and stage its
+    data-side artifacts. Everything here is a function of the PRE-fold
+    carry only — no data dependence on the in-flight round's subproblem,
+    fold or scatter — which is the whole point: XLA is free to schedule
+    this stage (and on the mesh, its collectives) concurrently with the
+    round's serial q-sized chain.
+
+    pallas_select=True swaps the full-n mask+approx_max_k selection for
+    the one-pass Pallas candidate kernel (ops/pallas_fold_select.py
+    select_rows + assemble_working_set — the pre-fold variant of the
+    fused engine's selection); requires the fused path's padding
+    contract (n % 1024 == 0, q/2 <= n/128, two-sided selection)."""
+    if pallas_select:
+        from dpsvm_tpu.ops.pallas_fold_select import (assemble_working_set,
+                                                      select_rows)
+
+        n_pad = y.shape[0]
+        shp = (n_pad // 128, 128)
+        upv, upi, lov, loi = select_rows(
+            f.reshape(shp), alpha.reshape(shp), y.reshape(shp),
+            valid.astype(jnp.float32).reshape(shp), c,
+            interpret=interpret)
+        w, ok, b_hi, b_lo = assemble_working_set(upv, upi, lov, loi,
+                                                 q // 2)
+    else:
+        w, ok, b_hi, b_lo = select_block(f, alpha, y, c, q, valid=valid,
+                                         rule=selection)
+    qx = jnp.take(x, w, axis=0)
+    qsq = jnp.take(x_sq, w)
+    if kp.kind == "precomputed":
+        # x IS the Gram matrix: the (q, q) block is a column gather of
+        # the already-gathered rows (same contract as _round_core).
+        kb = jnp.take(qx.astype(jnp.float32), w, axis=1)
+    else:
+        dots = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
+                       preferred_element_type=jnp.float32)
+        kb = kernel_from_dots(dots, qsq, qsq, kp)
+    kd = jnp.take(k_diag, w)
+    return PipelinedCand(w, ok, b_hi.astype(jnp.float32),
+                         b_lo.astype(jnp.float32), qx, qsq, kb, kd)
 
 
 def select_block(f, alpha, y, c, q: int, valid=None, rule: str = "mvp"):
@@ -530,6 +619,126 @@ def run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
         return new_st, w_n, ok_n
 
     final, _, _ = lax.while_loop(cond, body, (st0, w0, ok0))
+    return final
+
+
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
+                                  "inner_iters", "rounds_per_chunk",
+                                  "inner_impl", "interpret", "selection",
+                                  "pair_batch", "pallas_select"))
+def run_chunk_block_pipelined(x, y, x_sq, k_diag, valid,
+                              state: BlockState, max_iter,
+                              kp: KernelParams, c, eps: float, tau: float,
+                              q: int, inner_iters: int,
+                              rounds_per_chunk: int,
+                              inner_impl: str = "xla",
+                              interpret: bool = False,
+                              selection: str = "mvp",
+                              pair_batch: int = 1,
+                              pallas_select: bool = False) -> BlockState:
+    """PIPELINED round engine (config.pipeline_rounds): hide the fixed
+    selection/launch floor behind the serial subproblem chain.
+
+    The plain round body is a strict dependency chain
+    select -> gather -> Gram -> subproblem -> fold -> scatter, so its
+    fixed O(n) stages (PROFILE.md: 0.20-0.74 ms/round) serialize with
+    the ~0.5 us/pair chain — the two terms SCALING.md's model carries as
+    the un-shrinkable Amdahl floor. This body software-pipelines the
+    rounds instead: the working set for round t+1 is selected — and its
+    rows, norms and (q, q) Gram block built — from round t's PRE-fold
+    carry, so that whole stage has NO data dependence on round t's
+    subproblem and the XLA scheduler may overlap the two; only the fold
+    contraction and the scatter still trail the chain.
+
+    Staleness contract (the pair_batch precedent, docs/ARCHITECTURE.md):
+    SELECTION may be stale — round t+1's W ranks violators by the
+    gradient as it stood before round t's fold — but every EXECUTED
+    update is exact against the then-current gradient: the handoff
+    gathers each slot's CURRENT alpha/f, re-derives admissibility from
+    the current alpha (ops/select.py candidate_live_mask — saturated
+    candidates are masked, never recomputed), and the subproblem's own
+    per-iteration masks and eps gates do the rest. Keerthi et al.'s
+    convergence argument needs exactly this much; Fan et al.'s WSS2
+    likewise tolerates stale candidate RANKING.
+
+    No-stall property: a round whose stale W absorbs zero pairs folds a
+    zero delta, so the NEXT prefetch reads the unchanged — i.e. exact —
+    gradient and recovers the true maximal violating pair; stale
+    selection can therefore waste at most one round, never cycle. The
+    same argument makes the convergence exit exact: the loop only exits
+    on extrema selected from a gradient the exiting round did not change
+    (a globally closed gap closes every subproblem gate), and budget
+    exits are refreshed host-side (ops/select.py refresh_extrema_host)
+    exactly as for the other block engines.
+
+    pallas_select routes the prefetch selection through the one-pass
+    Pallas candidate kernel (pre-fold variant of the fused engine's
+    fold_select; needs that path's padding contract — the caller gates).
+    selection in {"mvp", "second_order"}; the nu rule's per-class
+    quarters keep the plain engine (same restriction as the fused path).
+    """
+    n = y.shape[0]
+    end = state.rounds + rounds_per_chunk
+
+    def prefetch(f, alpha):
+        return prefetch_working_set(x, y, x_sq, k_diag, f, alpha, valid,
+                                    kp, c, q, selection,
+                                    pallas_select=pallas_select,
+                                    interpret=interpret)
+
+    # Seed from the chunk's entry state (exact, amortized over
+    # rounds_per_chunk rounds — the run_chunk_block_fused pattern).
+    cand0 = prefetch(eff_f(state), state.alpha)
+    st0 = state._replace(b_hi=cand0.b_hi, b_lo=cand0.b_lo)
+
+    def cond(carry):
+        st, _ = carry
+        return ((st.rounds < end) & (st.pairs < max_iter)
+                & (st.b_lo > st.b_hi + 2.0 * eps))
+
+    def body(carry):
+        st, cand = carry
+        f_cur = eff_f(st)
+        # ---- handoff: gather CURRENT per-slot state for the staged W
+        # and gate slots the previous round invalidated.
+        a_w0 = jnp.take(st.alpha, cand.w)
+        y_w = jnp.take(y, cand.w)
+        f_w0 = jnp.take(f_cur, cand.w)
+        slot_ok = cand.ok & candidate_live_mask(a_w0, y_w, c)
+        # No gap gate on `limit` here: cond() already guarantees the
+        # carried gap is open on every body entry (the plain engine
+        # gates because ITS extrema come from a fresh mid-body
+        # selection; this body's extrema ARE the carry).
+        limit = jnp.minimum(jnp.int32(inner_iters), max_iter - st.pairs)
+        if inner_impl == "pallas":
+            from dpsvm_tpu.ops.pallas_subproblem import (
+                solve_subproblem_pallas)
+
+            a_w, t = solve_subproblem_pallas(
+                cand.kb, a_w0, y_w, f_w0, cand.kd,
+                slot_ok.astype(jnp.float32), limit, c, eps, tau,
+                rule=selection, interpret=interpret,
+                pair_batch=pair_batch)
+        else:
+            a_w, _, t = _solve_subproblem(
+                cand.kb, cand.kd, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
+                limit, rule=selection, pair_batch=pair_batch)
+        coef = jnp.where(slot_ok, (a_w - a_w0) * y_w, 0.0)
+        # ---- next round's prefetch, from the PRE-fold carry: depends
+        # only on (f_cur, st.alpha), never on the subproblem above —
+        # the overlap the whole engine exists for.
+        nxt = prefetch(f_cur, st.alpha)
+        # ---- fold + scatter: the only stages that consume the chain.
+        k_rows = kernel_rows(x, x_sq, cand.qx, cand.qsq, kp)
+        f, f_err = maybe_kahan(st.f, st.f_err, coef @ k_rows)
+        safe_w = jnp.where(slot_ok, cand.w, jnp.int32(n))
+        alpha = st.alpha.at[safe_w].set(
+            jnp.where(slot_ok, a_w, 0.0), mode="drop")
+        new_st = BlockState(alpha, f, nxt.b_hi, nxt.b_lo, st.pairs + t,
+                            st.rounds + 1, f_err)
+        return new_st, nxt
+
+    final, _ = lax.while_loop(cond, body, (st0, cand0))
     return final
 
 

@@ -57,6 +57,53 @@ _MAX_LEGS = 1000  # runaway guard; real runs end on gap/budget/floor
 # legitimately progress slower than this and are never re-judged.
 _BLOCK_STALL_RATIO = 0.5
 
+# Upfront regime gate (VERDICT round-5 item 6, heuristic half): the
+# reactive stall detector above only fires AFTER a full block leg has
+# been burned — at the covtype-stress shape that wasted leg is minutes
+# of device time the trajectory shows is predictable from (C, n, d) up
+# front. C·n/d is the discriminator: the block engine's restricted
+# working sets cycle when the box is so loose (huge C) relative to the
+# problem's effective dimension that the dual face is wide and the
+# per-round q-subset keeps re-optimizing interchangeable coordinates.
+# Validated against every measured regime on file:
+#
+#   | regime (measured verdict)                      | C·n/d  | gate |
+#   |------------------------------------------------|--------|------|
+#   | covtype stress n=50k d=54 C=2048 (block CYCLES,|        |      |
+#   |   PARITY.md/BENCH_COVTYPE.md)                  | 1.9e6  | per-pair |
+#   | covtype-shaped n=500k d=54 C=10 (block healthy,|        |      |
+#   |   BENCH_COVTYPE_SWEEP.md round-5)              | 9.3e4  | block |
+#   | blobs n=500k d=24 C=10 (block healthy, ditto)  | 2.1e5  | block |
+#   | adult-shaped n=32.5k d=123 C=100 (healthy,     |        |      |
+#   |   PARITY.md)                                   | 2.6e4  | block |
+#
+# The threshold sits an order of magnitude above the largest healthy
+# point and ~2x below the measured-doomed one. The gate ALSO requires
+# the resident (n, n) Gram to fit the device budget: the per-pair tail
+# only beats block legs when its rows are gathers (22 vs 49.7 us/pair,
+# PROFILE.md round-5) — at full-covtype n=500k the Gram cannot fit, so
+# block legs + the reactive detector remain the best available start
+# even though C·n/d is far past the threshold.
+_UPFRONT_CND = 1e6
+
+
+def block_tail_doomed(config: SVMConfig, n: int, d: int, device=None,
+                      gram_budget_bytes: int = None) -> bool:
+    """True when a hybrid (engine='block' + reconstruction legs) run
+    should START on the per-pair engine (+ auto resident Gram) instead
+    of burning a block leg the C·n/d heuristic predicts will stall.
+    `gram_budget_bytes` overrides the device-derived budget (tests)."""
+    if config.c * n / max(d, 1) < _UPFRONT_CND:
+        return False
+    from dpsvm_tpu.solver.smo import _GRAM_MIN_N, _gram_budget_bytes
+
+    if gram_budget_bytes is None:
+        import jax
+
+        gram_budget_bytes = _gram_budget_bytes(
+            device if device is not None else jax.devices()[0])
+    return n >= _GRAM_MIN_N and 4 * n * n <= gram_budget_bytes
+
 
 def _stored_x64(x, dtype: str) -> np.ndarray:
     """The float64 view of X as the SOLVER sees it: under bfloat16
@@ -242,6 +289,7 @@ def solve_in_legs(base_solve, x, y, config: SVMConfig, callback=None,
     converged = False
     hybrid = config.engine == "block"
     switch_pairs = None  # cumulative pair count at the block->xla switch
+    upfront = False
 
     def switch_to_per_pair():
         # The per-pair engine takes over for the remaining legs: same
@@ -249,12 +297,28 @@ def solve_in_legs(base_solve, x, y, config: SVMConfig, callback=None,
         # validation on engine='xla').
         nonlocal inner, switch_pairs
         inner = inner.replace(engine="xla", pair_batch=1,
-                              active_set_size=0, fused_fold=None)
+                              active_set_size=0, fused_fold=None,
+                              pipeline_rounds=None)
         switch_pairs = pairs_done
-        if config.verbose:
+        if config.verbose and not upfront:
             print(f"[reconstruct] block legs stalled at true gap "
                   f"{gap:.6f} after {pairs_done} pairs; switching "
                   f"remaining legs to the per-pair engine", flush=True)
+
+    if hybrid and block_tail_doomed(config, n, d,
+                                    device=solve_kw.get("device")):
+        # Upfront regime gate: start the per-pair (+ auto resident Gram)
+        # tail DIRECTLY — at this (C, n, d) the block legs are measured
+        # to cycle and the reactive stall detector below would burn a
+        # full leg re-learning it (VERDICT round-5 item 6, heuristic
+        # half; see _UPFRONT_CND's validation table).
+        upfront = True
+        switch_to_per_pair()
+        if config.verbose:
+            print(f"[reconstruct] upfront regime gate: C*n/d = "
+                  f"{config.c * n / max(d, 1):.3g} >= {_UPFRONT_CND:.0e} "
+                  f"and the resident Gram fits — starting legs on the "
+                  f"per-pair engine", flush=True)
 
     def reconstruct(alpha):
         f64 = gram_matvec_f64(
@@ -361,7 +425,10 @@ def solve_in_legs(base_solve, x, y, config: SVMConfig, callback=None,
             "reconstruct_seconds": recon_s,
             "final_leg_budget": leg_budget,
             # Cumulative pair count at which hybrid mode handed the tail
-            # to the per-pair engine (None: never switched / not block).
+            # to the per-pair engine (None: never switched / not block;
+            # 0 with hybrid_upfront: the C·n/d regime gate fired before
+            # any leg ran).
             "hybrid_switch_pairs": switch_pairs,
+            "hybrid_upfront": upfront,
         },
     )

@@ -1033,10 +1033,34 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     # round-trip + candidate top-k — a net LOSS on small rounds. The
     # crossover is d-dependent and pinned by the round-5 sweep
     # (solver/block.py fused_fold_pays docstring table).
-    from dpsvm_tpu.solver.block import fused_fold_pays
+    from dpsvm_tpu.solver.block import fused_fold_pays, pipeline_pays
 
     n_pad_fused = -(-n // 1024) * 1024
-    use_fused = (use_block and config.selection != "nu"
+    # Pipelined rounds (config.pipeline_rounds; solver/block.py
+    # run_chunk_block_pipelined): next-round selection/gather/Gram issued
+    # from the pre-fold carry, overlappable with the subproblem chain.
+    # Supersedes the fused fold+select when both would apply (the
+    # prefetch removes the selection from the round's critical path
+    # entirely; fusing it into the fold would re-serialize it). Works
+    # with precomputed kernels and the resident Gram (the prefetch's
+    # Gram block is a column gather there).
+    use_pipe = (use_block and config.selection != "nu"
+                and not config.active_set_size
+                and (config.pipeline_rounds
+                     if config.pipeline_rounds is not None
+                     else (device.platform == "tpu"
+                           and pipeline_pays(n, d))))
+    # The prefetch's own selection pass: the one-pass Pallas candidate
+    # kernel where the fused path's padding contract holds on a real
+    # TPU, else the plain masked top-k (CPU tests keep the jnp path —
+    # interpret-mode Pallas inside every round would crawl; the kernel
+    # itself is unit-tested in interpret mode).
+    pipe_pallas_select = (use_pipe and kp.kind != "precomputed"
+                          and not use_gram
+                          and device.platform == "tpu"
+                          and min(config.working_set_size, n_pad_fused)
+                          <= n_pad_fused // 64)
+    use_fused = (use_block and not use_pipe and config.selection != "nu"
                  and not config.active_set_size
                  and kp.kind != "precomputed" and not use_gram
                  and min(config.working_set_size, n_pad_fused)
@@ -1053,7 +1077,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         # padding is masked out of selection via `valid`.
         blk = block_rows * 128
         n_pad = -(-n_min // blk) * blk
-    elif use_fused:
+    elif use_fused or pipe_pallas_select:
         blk = 8 * 128  # fold_select's (block_rows=8, 128) grid blocks
         n_pad = -(-n_min // blk) * blk
     else:
@@ -1082,7 +1106,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         y_p = np.ones((n_pad,), np.float32)
         y_p[:n] = y_np
     y_dev = jax.device_put(jnp.asarray(y_p, jnp.float32), device)
-    if n_pad == n and not (use_pallas or use_fused):
+    if n_pad == n and not (use_pallas or use_fused or pipe_pallas_select):
         valid_dev = None
     else:
         valid_np = np.zeros((n_pad,), bool)
@@ -1243,6 +1267,18 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 inner_impl="pallas" if not interpret else "xla",
                 selection=config.selection,
                 pair_batch=int(config.pair_batch))
+        elif use_block and use_pipe:
+            from dpsvm_tpu.solver.block import run_chunk_block_pipelined
+
+            state = run_chunk_block_pipelined(
+                x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
+                kp, config.c_bounds(), eps_run, float(config.tau),
+                q, inner, rounds_per_chunk,
+                inner_impl="pallas" if not interpret else "xla",
+                interpret=interpret,
+                selection=config.selection,
+                pair_batch=int(config.pair_batch),
+                pallas_select=pipe_pallas_select)
         elif use_block and use_fused:
             from dpsvm_tpu.solver.block import run_chunk_block_fused
 

@@ -1,0 +1,81 @@
+"""bench.py round-over-round regression gate (VERDICT round-5 item 1,
+second half): drift-normalized comparison against the latest committed
+BENCH_r*.json. Pure-function tests — no device work."""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root for bench.py
+
+import bench
+
+
+def _write(tmp_path, name, parsed, wrap=True):
+    doc = {"parsed": parsed} if wrap else parsed
+    (tmp_path / name).write_text(json.dumps(doc))
+
+
+def _cur(pps=700_000, cal=0.5):
+    return {"pairs_per_second": pps,
+            "session_calibration": {"best_of_5_seconds": cal}}
+
+
+def test_no_baseline(tmp_path):
+    assert bench._regression_gate(_cur(), str(tmp_path)) == {
+        "regression_gate": "NO_BASELINE"}
+
+
+def test_latest_artifact_wins(tmp_path):
+    _write(tmp_path, "BENCH_r05.json", {"pairs_per_second": 1,
+                                        "session_calibration":
+                                        {"best_of_5_seconds": 0.5}})
+    _write(tmp_path, "BENCH_r06.json", {"pairs_per_second": 700_000,
+                                        "session_calibration":
+                                        {"best_of_5_seconds": 0.5}})
+    out = bench._regression_gate(_cur(), str(tmp_path))
+    assert out["previous_artifact"] == "BENCH_r06.json"
+    assert out["regression_gate"] == "PASS"
+
+
+def test_pass_within_band_after_normalization(tmp_path):
+    # This session is 10% SLOWER (calibration 0.55 vs 0.5): a raw -12%
+    # pairs/s reading normalizes to ~-3% => PASS, not a regression.
+    _write(tmp_path, "BENCH_r06.json", {"pairs_per_second": 700_000,
+                                        "session_calibration":
+                                        {"best_of_5_seconds": 0.5}})
+    out = bench._regression_gate(_cur(pps=616_000, cal=0.55),
+                                 str(tmp_path))
+    assert out["regression_gate"] == "PASS"
+    assert abs(out["normalized_delta"]) < 0.05
+    # ...while the same raw numbers WITHOUT the drift would FLAG:
+    out_raw = bench._regression_gate(_cur(pps=616_000, cal=0.5),
+                                     str(tmp_path))
+    assert out_raw["regression_gate"] == "FLAG"
+
+
+def test_flag_beyond_band(tmp_path):
+    _write(tmp_path, "BENCH_r06.json", {"pairs_per_second": 700_000,
+                                        "session_calibration":
+                                        {"best_of_5_seconds": 0.5}})
+    out = bench._regression_gate(_cur(pps=500_000), str(tmp_path))
+    assert out["regression_gate"] == "FLAG"
+    assert out["normalized_delta"] < -bench._REGRESSION_BAND
+
+
+def test_no_calibration_in_previous_artifact(tmp_path):
+    # Pre-round-6 artifacts (e.g. the committed BENCH_r05.json) carry no
+    # session_calibration: the delta reports RAW and informational.
+    _write(tmp_path, "BENCH_r06.json", {"pairs_per_second": 623_782})
+    out = bench._regression_gate(_cur(), str(tmp_path))
+    assert out["regression_gate"] == "NO_CALIBRATION"
+    assert "raw_delta" in out
+
+
+def test_bare_artifact_shape(tmp_path):
+    # Bare (unwrapped) result dicts parse too.
+    _write(tmp_path, "BENCH_r06.json",
+           {"pairs_per_second": 700_000,
+            "session_calibration": {"best_of_5_seconds": 0.5}},
+           wrap=False)
+    out = bench._regression_gate(_cur(), str(tmp_path))
+    assert out["regression_gate"] == "PASS"

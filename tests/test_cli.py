@@ -146,6 +146,24 @@ def test_train_cli_block_engine(csvs, capsys):
     assert "converged at iteration" in out
 
 
+def test_train_cli_pipelined_rounds(csvs, capsys):
+    """--pipeline-rounds on routes the block engine through the
+    pipelined chunk runner (and off/auto stay legal)."""
+    train_p, test_p, d = csvs
+    model_p = d + "/model_pipe.txt"
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5", "-g",
+               "0.1", "--engine", "block", "--working-set-size", "16",
+               "--pipeline-rounds", "on", "--backend", "single", "-q"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "converged at iteration" in out
+    # Non-block engine + forced pipelining is a clean config error.
+    rc = main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+               "--engine", "xla", "--pipeline-rounds", "on",
+               "--backend", "single", "-q"])
+    assert rc == 2
+
+
 def test_train_cli_svm_types(csvs, capsys, tmp_path):
     """LibSVM's -s svm_type role: every problem type trains and evaluates
     through the CLI."""

@@ -300,3 +300,69 @@ def test_block_without_stall_keeps_block_engine():
     res = solve(x, y, cfg)
     assert res.converged
     assert res.stats["hybrid_switch_pairs"] is None
+
+
+def test_block_tail_doomed_heuristic_regimes():
+    """The upfront regime gate (solver/reconstruct.py block_tail_doomed,
+    VERDICT round-5 item 6 heuristic half) against the measured regimes
+    its threshold was validated on. gram_budget_bytes is pinned to the
+    v5e budget (0.7 * 16 GiB) so the decision is about C*n/d and the
+    Gram fit, not this host's (unreported) memory."""
+    from dpsvm_tpu.solver.reconstruct import block_tail_doomed
+
+    v5e = int(0.7 * 16 * (1 << 30))
+
+    def gate(c, n, d):
+        return block_tail_doomed(SVMConfig(c=c), n, d,
+                                 gram_budget_bytes=v5e)
+
+    # covtype stress (block legs measured to CYCLE; PARITY.md): per-pair.
+    assert gate(2048.0, 50_000, 54)
+    # covtype-shaped moderate C (block healthy, BENCH_COVTYPE_SWEEP).
+    assert not gate(10.0, 500_000, 54)
+    # well-separated blobs (block healthy, BENCH_COVTYPE_SWEEP round-5).
+    assert not gate(10.0, 500_000, 24)
+    # adult-shaped (block healthy, PARITY.md).
+    assert not gate(100.0, 32_561, 123)
+    # full-covtype stress: C*n/d is far past the threshold but the
+    # (n, n) Gram cannot fit — keep block legs + the reactive detector.
+    assert not gate(2048.0, 500_000, 54)
+    # Small problems never gate (resident-Gram auto floor).
+    assert not gate(2048.0, 4_000, 10)
+
+
+def test_hybrid_upfront_gate_starts_per_pair(monkeypatch):
+    """When the regime gate fires, solve_in_legs never burns a block
+    leg: every leg runs the per-pair engine and the stats record the
+    upfront switch."""
+    from dpsvm_tpu.solver import reconstruct as rec
+
+    x, y = _blobs(sep=0.8)
+    calls = {"block": 0, "xla": 0}
+
+    def base(xx, yy, cfg, callback=None, alpha_init=None, f_init=None,
+             **kw):
+        calls[cfg.engine] += 1
+        return solve(xx, yy, cfg, callback=callback,
+                     alpha_init=alpha_init, f_init=f_init, **kw)
+
+    monkeypatch.setattr(rec, "block_tail_doomed",
+                        lambda *a, **k: True)
+    cfg = BASE.replace(c=500.0, engine="block", compensated=True,
+                       reconstruct_every=100_000, max_iter=2_000_000)
+    res = rec.solve_in_legs(base, x, y, cfg)
+    assert res.converged
+    assert calls["block"] == 0 and calls["xla"] >= 1
+    assert res.stats["hybrid_upfront"] is True
+    assert res.stats["hybrid_switch_pairs"] == 0
+
+
+def test_hybrid_upfront_gate_respects_heuristic(monkeypatch):
+    """Below the C*n/d threshold the legs start on the block engine as
+    before (the reactive detector remains the safety net)."""
+    x, y = _blobs()
+    cfg = BASE.replace(engine="block", working_set_size=32,
+                       compensated=True, reconstruct_every=500_000)
+    res = solve(x, y, cfg)
+    assert res.converged
+    assert res.stats["hybrid_upfront"] is False

@@ -1,0 +1,372 @@
+"""Pipelined block rounds (config.pipeline_rounds; solver/block.py
+run_chunk_block_pipelined, parallel/dist_block.py pipelined runner).
+
+Correctness battery for ISSUE 2's tentpole: CPU bit-exactness against
+the unpipelined engine at single-round chunk cadence (where the two
+engines are algebraically identical programs), same-optimum parity where
+the round sequences legitimately diverge (stale selection), the handoff
+invalidation gating, the Pallas pre-fold selection kernel, and the
+8-virtual-device mesh dryrun with the overlapped collectives.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.solver.smo import solve
+
+BASE = SVMConfig(c=5.0, gamma=0.1, epsilon=1e-3, max_iter=200_000,
+                 engine="block", working_set_size=32)
+
+
+def _plain(cfg):
+    return cfg.replace(pipeline_rounds=False)
+
+
+def _piped(cfg):
+    return cfg.replace(pipeline_rounds=True)
+
+
+@pytest.mark.parametrize("selection", ["mvp", "second_order"])
+def test_pipelined_matches_plain_optimum(blobs_medium, selection):
+    x, y = blobs_medium
+    cfg = BASE.replace(selection=selection)
+    rp = solve(x, y, _plain(cfg))
+    rq = solve(x, y, _piped(cfg))
+    assert rp.converged and rq.converged
+    # Stale selection reorders the rounds (and usually costs extra
+    # pairs) but the optimum must match: compare dual state.
+    np.testing.assert_allclose(rq.alpha, rp.alpha, atol=5e-2)
+    assert rq.b == pytest.approx(rp.b, abs=5e-3)
+    assert abs(rq.n_sv - rp.n_sv) <= max(3, 0.03 * rp.n_sv)
+
+
+def test_pipelined_bit_exact_at_single_round_chunks(blobs_small):
+    """At rounds_per_chunk=1 the pipelined engine IS the plain engine:
+    each chunk's seed prefetch selects from the same entry state the
+    plain body selects from, the handoff gathers untouched values, and
+    the live-mask gate is the identity (selection only admits I_up/I_low
+    members and nothing ran in between). Trajectories must be
+    BIT-identical — alpha, f, extrema and pair counts at every chunk
+    boundary."""
+    x, y = blobs_small
+    obs_p, obs_q = [], []
+
+    def cb(sink):
+        return lambda it, bh, bl, st: sink.append((it, bh, bl)) and None
+
+    # chunk_iters == inner_iters => rounds_per_chunk = 1; the callback
+    # forces observed chunking (and records the boundary scalars).
+    cfg = BASE.replace(working_set_size=16, inner_iters=32,
+                       chunk_iters=32)
+    rp = solve(x, y, _plain(cfg), callback=cb(obs_p))
+    rq = solve(x, y, _piped(cfg), callback=cb(obs_q))
+    assert rp.converged and rq.converged
+    assert rp.iterations == rq.iterations
+    assert obs_p == obs_q
+    np.testing.assert_array_equal(rq.alpha, rp.alpha)
+    np.testing.assert_array_equal(rq.stats["f"], rp.stats["f"])
+    assert (rq.b_hi, rq.b_lo) == (rp.b_hi, rp.b_lo)
+
+
+def test_pipelined_matches_per_pair_reference(blobs_small):
+    x, y = blobs_small
+    rq = solve(x, y, _piped(BASE.replace(working_set_size=16)))
+    rx = solve(x, y, SVMConfig(c=5.0, gamma=0.1, epsilon=1e-3,
+                               max_iter=200_000))
+    assert rq.converged and rx.converged
+    np.testing.assert_allclose(rq.alpha, rx.alpha, atol=5e-2)
+    assert rq.b == pytest.approx(rx.b, abs=5e-3)
+
+
+def test_pipelined_heavy_invalidation_regime(blobs_medium):
+    """Mixed-convergence stress for the handoff gate: tiny C drives most
+    alphas to the box bound within a few rounds, so prefetched
+    candidates are routinely saturated out of I_up/I_low by the time
+    they are handed to the subproblem. The gated engine must still reach
+    the per-pair optimum."""
+    x, y = blobs_medium
+    cfg = BASE.replace(c=0.05, working_set_size=16)
+    rq = solve(x, y, _piped(cfg))
+    rp = solve(x, y, _plain(cfg))
+    assert rq.converged and rp.converged
+    np.testing.assert_allclose(rq.alpha, rp.alpha, atol=5e-3)
+    assert rq.b == pytest.approx(rp.b, abs=5e-3)
+    # The regime really is bound-saturated (the point of the test).
+    assert np.mean(np.isclose(rp.alpha, 0.05)) > 0.5
+
+
+def test_handoff_invalidation_masks_saturated_candidates():
+    """Unit semantics of the handoff gate (ops/select.py
+    candidate_live_mask): a staged candidate whose alpha the in-flight
+    round moved to a bound it cannot leave drops out of the working set
+    — masked, never recomputed."""
+    from dpsvm_tpu.ops.select import candidate_live_mask
+    import jax.numpy as jnp
+
+    c = 2.0
+    y_w = jnp.asarray([1.0, 1.0, -1.0, -1.0, 1.0])
+    # Selected while free; the previous round then moved slots 1/3 to
+    # their bounds.
+    alpha_now = jnp.asarray([0.5, c, 0.7, 0.0, 0.0])
+    live = np.asarray(candidate_live_mask(alpha_now, y_w, c))
+    # With a SCALAR C every in-box (alpha, y) stays in I_up u I_low
+    # (a=C keeps I_low membership via a>0; a=0 keeps I_up via a<C), so
+    # the gate is the identity — the re-rank inside the subproblem does
+    # the violation-ordering work. The gate BITES where a slot can
+    # leave both sets: degenerate class-weighted boxes and dead filler.
+    assert live.all()
+    # Degenerate weighted box: c_neg=0 pins y=-1 rows at alpha=0 into
+    # NEITHER set (a>0 false, a<c_neg false) — exactly those drop.
+    live_w = np.asarray(candidate_live_mask(alpha_now, y_w, (c, 0.0)))
+    np.testing.assert_array_equal(live_w, [True, True, True, False,
+                                           True])
+
+
+def test_pipelined_class_weights(blobs_small):
+    x, y = blobs_small
+    cfg = BASE.replace(working_set_size=16, weight_pos=2.0,
+                       weight_neg=0.5)
+    rq = solve(x, y, _piped(cfg))
+    rp = solve(x, y, _plain(cfg))
+    assert rq.converged and rp.converged
+    np.testing.assert_allclose(rq.alpha, rp.alpha, atol=5e-2)
+    assert rq.b == pytest.approx(rp.b, abs=5e-3)
+
+
+def test_pipelined_budget_mode_exact_pairs(blobs_medium):
+    x, y = blobs_medium
+    cfg = BASE.replace(budget_mode=True, max_iter=1000, inner_iters=50)
+    rq = solve(x, y, _piped(cfg))
+    assert rq.iterations == 1000
+
+
+def test_pipelined_compensated_carry(blobs_small):
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.solver.reconstruct import gram_matvec_f64
+
+    x, y = blobs_small
+    cfg = BASE.replace(working_set_size=16, c=2000.0, gamma=0.05,
+                       compensated=True)
+    rq = solve(x, y, _piped(cfg))
+    rp = solve(x, y, _plain(cfg))
+    assert rq.converged and rp.converged
+    kp = KernelParams("rbf", cfg.gamma)
+
+    def dec(r):
+        f64 = gram_matvec_f64(x, np.asarray(r.alpha, np.float64) * y, kp)
+        return f64 - r.b
+
+    agree = np.mean(np.sign(dec(rq)) == np.sign(dec(rp)))
+    assert agree >= 0.995
+    assert rq.b == pytest.approx(rp.b, abs=5e-2)
+
+
+def test_pipelined_with_reconstruction_legs(blobs_small):
+    # The extreme-C accuracy mode composes with pipelined rounds (and
+    # the hybrid tail switch resets pipeline_rounds with the other
+    # block-only knobs).
+    x, y = blobs_small
+    cfg = BASE.replace(working_set_size=16, c=2000.0, gamma=0.05,
+                       compensated=True, reconstruct_every=40_000,
+                       max_iter=400_000, pipeline_rounds=True)
+    rq = solve(x, y, cfg)
+    assert rq.converged
+    assert rq.stats["true_gap"] <= 2 * cfg.epsilon + 1e-9
+
+
+def test_pipelined_precomputed_kernel(blobs_small):
+    """The prefetch's Gram-block build degenerates to a column gather on
+    a precomputed kernel — parity against the plain engine there too."""
+    x, y = blobs_small
+    g = x @ x.T  # linear Gram
+    cfg = BASE.replace(kernel="precomputed", working_set_size=16)
+    rq = solve(g, y, _piped(cfg))
+    rp = solve(g, y, _plain(cfg))
+    assert rq.converged and rp.converged
+    np.testing.assert_allclose(rq.alpha, rp.alpha, atol=5e-2)
+    assert rq.b == pytest.approx(rp.b, abs=5e-3)
+
+
+def test_select_rows_kernel_matches_oracle():
+    """ops/pallas_fold_select.py select_rows (the pre-fold selection
+    variant, interpret mode): per-row candidates and assembled extrema
+    against a NumPy oracle of the I_up/I_low algebra."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.pallas_fold_select import (assemble_working_set,
+                                                  select_rows)
+
+    rng = np.random.default_rng(5)
+    n, c = 1024, 1.5
+    shp = (n // 128, 128)
+    f = rng.normal(size=n).astype(np.float32)
+    alpha = rng.uniform(0, c, size=n).astype(np.float32)
+    alpha[rng.random(n) < 0.3] = 0.0
+    alpha[rng.random(n) < 0.2] = c
+    y = np.where(rng.random(n) < 0.5, 1.0, -1.0).astype(np.float32)
+    valid = np.ones(n, np.float32)
+    valid[1000:] = 0.0
+
+    upv, upi, lov, loi = select_rows(
+        jnp.asarray(f.reshape(shp)), jnp.asarray(alpha.reshape(shp)),
+        jnp.asarray(y.reshape(shp)), jnp.asarray(valid.reshape(shp)),
+        c, interpret=True)
+
+    up = np.where(y > 0, alpha < c, alpha > 0) & (valid > 0)
+    low = np.where(y > 0, alpha > 0, alpha < c) & (valid > 0)
+    f_up = np.where(up, f, np.inf).reshape(shp)
+    f_low = np.where(low, f, -np.inf).reshape(shp)
+    np.testing.assert_array_equal(np.asarray(upv), f_up.min(axis=1))
+    np.testing.assert_array_equal(np.asarray(lov), f_low.max(axis=1))
+    # ids: the LOWEST flat id achieving each row extremum (tie-break).
+    for r in range(shp[0]):
+        if np.isfinite(f_up[r].min()):
+            assert np.asarray(upi)[r] == r * 128 + int(
+                np.argmin(f_up[r]))
+        if np.isfinite(f_low[r].max()):
+            assert np.asarray(loi)[r] == r * 128 + int(
+                np.argmax(f_low[r]))
+    # Assembled extrema are the exact global stopping pair.
+    w, ok, b_hi, b_lo = assemble_working_set(upv, upi, lov, loi, 8)
+    assert float(b_hi) == np.where(up, f, np.inf).min()
+    assert float(b_lo) == np.where(low, f, -np.inf).max()
+
+
+def test_pipeline_rounds_validation():
+    with pytest.raises(ValueError, match="block-engine"):
+        SVMConfig(engine="xla", pipeline_rounds=True)
+    with pytest.raises(ValueError, match="active_set_size"):
+        SVMConfig(engine="block", pipeline_rounds=True,
+                  active_set_size=64)
+    # auto (None) and off are legal anywhere.
+    SVMConfig(engine="xla", pipeline_rounds=None)
+    SVMConfig(engine="xla", pipeline_rounds=False)
+
+
+def test_pipelined_nusvc_falls_back_cleanly(blobs_small):
+    """A user config with pipeline_rounds=True must not crash the nu
+    trainers (they switch to the per-class selection rule, which the
+    pipelined engine does not implement — same fallback contract as
+    pair_batch)."""
+    from dpsvm_tpu.models.nusvm import train_nusvc
+
+    x, y = blobs_small
+    model = train_nusvc(x, y, nu=0.3,
+                        config=BASE.replace(pipeline_rounds=True,
+                                            gamma=0.1))
+    assert model is not None
+
+
+# ---- mesh (8 virtual devices) --------------------------------------
+
+
+def test_pipelined_mesh_matches_single_chip(blobs_medium):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_medium
+    cfg = BASE.replace(selection="second_order")
+    rp = solve(x, y, _plain(cfg))
+    rm = solve_mesh(x, y, _piped(cfg), num_devices=8)
+    assert rp.converged and rm.converged
+    np.testing.assert_allclose(rm.alpha, rp.alpha, atol=5e-2)
+    assert rm.b == pytest.approx(rp.b, abs=5e-3)
+
+
+def test_pipelined_mesh_compensated(blobs_small):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    cfg = BASE.replace(working_set_size=16, compensated=True)
+    rm = solve_mesh(x, y, _piped(cfg), num_devices=8)
+    rp = solve(x, y, _plain(cfg))
+    assert rm.converged and rp.converged
+    np.testing.assert_allclose(rm.alpha, rp.alpha, atol=5e-2)
+    assert rm.b == pytest.approx(rp.b, abs=5e-3)
+
+
+def test_pipelined_mesh_budget_mode(blobs_medium):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_medium
+    cfg = BASE.replace(budget_mode=True, max_iter=1000, inner_iters=50)
+    rm = solve_mesh(x, y, _piped(cfg), num_devices=8)
+    assert rm.iterations == 1000
+
+
+def test_pipelined_mesh_uneven_rows(blobs_medium):
+    """n not divisible by the device count: pad rows masked from the
+    prefetch selection and the handoff psum alike."""
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_medium
+    x, y = x[:1199], y[:1199]
+    rm = solve_mesh(x, y, _piped(BASE), num_devices=8)
+    rp = solve(x, y, _plain(BASE))
+    assert rm.converged and rp.converged
+    np.testing.assert_allclose(rm.alpha, rp.alpha, atol=5e-2)
+
+
+def test_pipelined_mesh_rejects_precomputed(blobs_small):
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_pipelined_chunk_runner)
+    from dpsvm_tpu.parallel.mesh import make_data_mesh
+
+    with pytest.raises(ValueError, match="feature kernels"):
+        make_block_pipelined_chunk_runner(
+            make_data_mesh(2), KernelParams("precomputed"), (1.0, 1.0),
+            1e-3, 1e-12, 16, 32, 4)
+
+
+def test_pipelined_mesh_round_collectives():
+    """Structural claim behind the overlap story (docs/SCALING.md
+    pipelined model): the pipelined mesh round still emits exactly one
+    all_gather dispatch sequence (candidate values + ids) and the SAME
+    total psum payload as the plain round — q*(d+5) f32, now split
+    (q, d) + (q, 3) prefetched (overlappable) plus the (q, 2) handoff
+    (serial) — and nothing else. Asserted from compiled HLO like
+    test_hlo_collectives.py, at a small shape (op structure is
+    shape-independent)."""
+    import jax
+    import jax.numpy as jnp
+
+    from test_hlo_collectives import _collective_ops
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_pipelined_chunk_runner)
+    from dpsvm_tpu.parallel.mesh import make_data_mesh
+    from dpsvm_tpu.solver.block import BlockState
+
+    n, d, q, p_dev = 4096, 24, 64, 8
+    h = q // 2
+    mesh = make_data_mesh(p_dev)
+    runner = make_block_pipelined_chunk_runner(
+        mesh, KernelParams("rbf", 0.1), (5.0, 5.0), 1e-3, 1e-12, q, 128,
+        rounds_per_chunk=1, inner_impl="xla")
+    sds = jax.ShapeDtypeStruct
+    state = BlockState(
+        alpha=sds((n,), jnp.float32), f=sds((n,), jnp.float32),
+        b_hi=sds((), jnp.float32), b_lo=sds((), jnp.float32),
+        pairs=sds((), jnp.int32), rounds=sds((), jnp.int32))
+    text = runner.lower(
+        sds((n, d), jnp.float32), sds((n,), jnp.float32),
+        sds((n,), jnp.float32), sds((n,), jnp.float32),
+        sds((n,), jnp.bool_), state, sds((), jnp.int32),
+    ).compile().as_text()
+
+    gathers = _collective_ops(text, "all-gather")
+    reduces = _collective_ops(text, "all-reduce")
+    others = (_collective_ops(text, "all-to-all")
+              + _collective_ops(text, "collective-permute"))
+    assert not others, others
+    # The compiled text holds the SEED prefetch (outside the loop: one
+    # all_gather pair + the (q, d)+(q, 3) psum) AND the loop body (one
+    # all_gather pair + (q, d)+(q, 3) prefetch psum + (q, 2) handoff
+    # psum). Payload accounting:
+    gather_sizes = sorted(s for _, sizes in gathers for _, s in sizes)
+    assert gather_sizes == [p_dev * 2 * h * 4] * 4, \
+        (gather_sizes, gathers)
+    reduce_total = sum(s for _, sizes in reduces for _, s in sizes)
+    assert reduce_total == q * (d + 3) * 4 + q * (d + 5) * 4, \
+        (reduce_total, reduces)

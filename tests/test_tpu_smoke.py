@@ -31,6 +31,21 @@ def test_pallas_lowering_on_device():
         f for f in flags.split()
         if "xla_force_host_platform_device_count" not in f)
     env.pop("JAX_PLATFORMS", None)
+    # Reachability preflight: a half-up device tunnel can HANG backend
+    # init rather than fail it (observed 2026-08-03: `jax.devices()` in
+    # the child blocked >90 s on the axon endpoint where the same probe
+    # failed fast at session start). A hung tunnel is the same "no TPU
+    # reachable" condition this test already skips on — detect it with a
+    # short-timeout child instead of letting the 1800 s tool budget eat
+    # the whole tier-1 wall clock.
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=60)
+    except subprocess.TimeoutExpired:
+        pytest.skip("device platform backend init hung (tunnel "
+                    "unreachable); the tool's own SKIP path never ran")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "tpu_smoke.py")],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=1800)

@@ -43,7 +43,7 @@ def child_main(coordinator: str, process_id: int) -> int:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dpsvm_tpu.parallel.mesh import (DATA_AXIS, initialize_multihost,
-                                         make_data_mesh)
+                                         make_data_mesh, mesh_shard_map)
 
     initialize_multihost(coordinator, NPROC, process_id)
     assert jax.process_count() == NPROC, jax.process_count()
@@ -53,9 +53,9 @@ def child_main(coordinator: str, process_id: int) -> int:
 
     # Global psum across both processes' devices (the b_hi/b_lo reduction
     # pattern of parallel/dist_smo.py and dist_block.py).
-    total = jax.jit(jax.shard_map(
+    total = jax.jit(mesh_shard_map(
         lambda v: jax.lax.psum(jnp.sum(v), DATA_AXIS), mesh=mesh,
-        in_specs=P(DATA_AXIS), out_specs=P(), check_vma=False))(
+        in_specs=P(DATA_AXIS), out_specs=P()))(
             jnp.ones((n_global,), jnp.float32))
     np.testing.assert_allclose(np.asarray(total), n_global)
 
@@ -67,10 +67,9 @@ def child_main(coordinator: str, process_id: int) -> int:
         process_id * LOCAL_DEVICES:(process_id + 1) * LOCAL_DEVICES]
     garr = jax.make_array_from_process_local_data(shard, local,
                                                   (n_global, 1))
-    gathered = jax.jit(jax.shard_map(
+    gathered = jax.jit(mesh_shard_map(
         lambda v: jax.lax.all_gather(v, DATA_AXIS).reshape(-1, 1),
-        mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(),
-        check_vma=False))(garr)
+        mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P()))(garr)
     np.testing.assert_allclose(np.asarray(gathered)[:, 0],
                                np.arange(n_global))
 
@@ -135,15 +134,24 @@ def main() -> int:
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
         coordinator = f"127.0.0.1:{port}"
+        # Child output is captured (small — a few assert/traceback
+        # lines) both to diagnose failures and to detect the
+        # capability-missing case below.
         procs = [subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child",
-             coordinator, str(pid)], env=env, cwd=REPO)
+             coordinator, str(pid)], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
             for pid in range(NPROC)]
+        outs = []
         try:
             # Well under the callers' own timeouts (tests/test_multihost.py
             # allows 1200 s total) so the finally-kill below always gets
             # to run before an outer SIGKILL would orphan the children.
-            rcs = [p.wait(timeout=240) for p in procs]
+            rcs = []
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out or "")
+                rcs.append(p.returncode)
         except subprocess.TimeoutExpired:
             rcs = [1] * NPROC
         finally:
@@ -153,6 +161,21 @@ def main() -> int:
                     p.wait()
         if not any(rcs):
             print("MULTIHOST CHECK: PASS")
+            return 0
+        sys.stdout.write("".join(outs))
+        if any("Multiprocess computations aren't implemented" in o
+               for o in outs):
+            # This jax build's CPU backend refuses cross-process
+            # COMPUTATIONS (the jax.distributed bring-up itself — the
+            # coordinator wiring, process_count, global device view —
+            # succeeded before the first collective dispatched). A
+            # missing backend capability is an environment limit, not a
+            # launcher failure: report SKIP and exit clean, the same
+            # contract as tools/tpu_smoke.py on a non-TPU platform.
+            print("MULTIHOST CHECK: SKIP — this jax build's CPU "
+                  "backend does not implement multiprocess "
+                  "computations (distributed bring-up itself "
+                  "succeeded)")
             return 0
         print(f"attempt {attempt}: child exit codes {rcs}"
               + ("; retrying with a fresh port" if attempt == 1 else ""))

@@ -205,6 +205,38 @@ def main() -> int:
     print(f"mesh fused fold+select pairs={rm.iterations} "
           f"|b-b_ref|={db:.4f} {status}")
 
+    # Pipelined block rounds (ISSUE 2): real Mosaic lowering of the
+    # pre-fold selection kernel (ops/pallas_fold_select.py select_rows
+    # — engaged automatically on TPU at this padded shape) + the
+    # handoff-gated round body, plain and compensated, then the mesh
+    # runner's overlapped-collective round on the 1-device mesh.
+    for comp in (False, True):
+        rp = solve(xf, yf, cfg.replace(engine="block",
+                                       working_set_size=32,
+                                       pipeline_rounds=True,
+                                       compensated=comp,
+                                       matmul_precision="default"))
+        db = abs(rp.b - rf_ref.b)
+        status = "OK" if (rp.converged and db < 5e-2) else "FAIL"
+        failures += status == "FAIL"
+        record(f"pipelined/compensated={comp}",
+               rp.converged and db < 5e-2, pairs=int(rp.iterations),
+               db=round(db, 5))
+        print(f"pipelined rounds compensated={comp} pairs="
+              f"{rp.iterations} |b-b_ref|={db:.4f} {status}")
+    rpm = solve_mesh(xf, yf, cfg.replace(engine="block",
+                                         working_set_size=32,
+                                         pipeline_rounds=True,
+                                         matmul_precision="default"),
+                     num_devices=1)
+    db = abs(rpm.b - rf_ref.b)
+    status = "OK" if (rpm.converged and db < 5e-2) else "FAIL"
+    failures += status == "FAIL"
+    record("mesh/pipelined", rpm.converged and db < 5e-2,
+           pairs=int(rpm.iterations), db=round(db, 5))
+    print(f"mesh pipelined rounds pairs={rpm.iterations} "
+          f"|b-b_ref|={db:.4f} {status}")
+
     # Fused per-pair Pallas engine.
     r_pl = solve(x, y, cfg.replace(engine="pallas"))
     db = abs(r_pl.b - r_ref.b)
