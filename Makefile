@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify bench bench_predict smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify bench bench_predict bench_serve fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -73,6 +73,19 @@ parity_full:
 # timed; the reference's CPU tester publishes no timing).
 bench_predict:
 	$(PY) tools/bench_predict.py
+
+# Serving benchmark: compacted-vs-stacked A/B + PredictServer offered-
+# load sweep -> BENCH_SERVE_r<NN>.json (commit it) + BENCH_SERVE.md,
+# through the drift-normalized cross-session regression gate.
+bench_serve:
+	$(PY) tools/bench_serve.py
+
+# Real-dataset recipe (MNIST / covtype / Adult a9a): download, verify
+# sha256, run the converters into data/*.csv. Exits 0 with a SKIP note
+# when the environment has no egress; real-data test/parity legs
+# activate automatically once the files exist.
+fetch_real_data:
+	$(PY) tools/fetch_real_data.py
 
 # Delegates to the Python builder so the compile command lives in exactly
 # one place (dpsvm_tpu/utils/native.py, which also fingerprints the flags).

@@ -95,14 +95,14 @@ def _session_calibration() -> dict:
 _REGRESSION_BAND = 0.10
 
 
-def _latest_bench_artifact(root: str):
-    """(path, parsed-dict) of the newest committed BENCH_r*.json, or
-    (None, None). Artifacts come in two shapes: the driver's wrapper
-    {"parsed": {...}} and a bare result dict."""
+def _latest_bench_artifact(root: str, pattern: str = "BENCH_r*.json"):
+    """(path, parsed-dict) of the newest committed artifact matching
+    `pattern`, or (None, None). Artifacts come in two shapes: the
+    driver's wrapper {"parsed": {...}} and a bare result dict."""
     import glob
     import os
 
-    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    paths = sorted(glob.glob(os.path.join(root, pattern)))
     if not paths:
         return None, None
     with open(paths[-1]) as fh:
@@ -110,17 +110,24 @@ def _latest_bench_artifact(root: str):
     return paths[-1], doc.get("parsed", doc)
 
 
-def _regression_gate(current: dict, root: str) -> dict:
+def _regression_gate(current: dict, root: str,
+                     pattern: str = "BENCH_r*.json",
+                     key: str = "pairs_per_second") -> dict:
     """Round-over-round regression check (VERDICT round-5 item 1, second
-    half): compare THIS run's pairs/s against the latest committed
-    BENCH_r*.json, drift-normalized by the pinned session-calibration
-    kernel so a slow tunnel hour cannot masquerade as a solver
-    regression (and a fast one cannot hide it). Pure function of the
-    two artifacts — unit-tested in tests/test_bench_gate.py.
+    half): compare THIS run's throughput metric against the latest
+    committed artifact, drift-normalized by the pinned session-
+    calibration kernel so a slow tunnel hour cannot masquerade as a
+    solver regression (and a fast one cannot hide it). Pure function of
+    the two artifacts — unit-tested in tests/test_bench_gate.py.
+
+    Generalized over (pattern, key) so every benchmark family gets the
+    same cross-session adjudication: the headline solver bench uses the
+    defaults (BENCH_r*.json, pairs_per_second); the serving bench gates
+    BENCH_SERVE_r*.json on examples_per_second (tools/bench_serve.py).
 
     Normalization: the calibration kernel's FLOPs never change, so
     (prev_calib_s / cur_calib_s) is the session speed ratio; dividing
-    the current pairs/s by it re-expresses the measurement in the
+    the current metric by it re-expresses the measurement in the
     PREVIOUS session's time units before comparing. Verdicts:
       PASS / FLAG      — |normalized delta| within / beyond the band
       NO_BASELINE      — first run (no committed artifact)
@@ -128,29 +135,28 @@ def _regression_gate(current: dict, root: str) -> dict:
                          field: the delta is reported RAW and
                          informational (cross-session drift cannot be
                          separated out)."""
-    path, prev = _latest_bench_artifact(root)
-    if prev is None or "pairs_per_second" not in prev:
+    path, prev = _latest_bench_artifact(root, pattern)
+    if prev is None or key not in prev:
         return {"regression_gate": "NO_BASELINE"}
     out = {
         "previous_artifact": path.rsplit("/", 1)[-1],
-        "previous_pairs_per_second": prev["pairs_per_second"],
+        f"previous_{key}": prev[key],
     }
-    cur_pps = current["pairs_per_second"]
+    cur_pps = current[key]
     prev_cal = (prev.get("session_calibration") or {}).get(
         "best_of_5_seconds")
     cur_cal = (current.get("session_calibration") or {}).get(
         "best_of_5_seconds")
     if not prev_cal or not cur_cal:
         out["regression_gate"] = "NO_CALIBRATION"
-        out["raw_delta"] = round(
-            cur_pps / prev["pairs_per_second"] - 1.0, 4)
+        out["raw_delta"] = round(cur_pps / prev[key] - 1.0, 4)
         return out
     drift = prev_cal / cur_cal  # >1: this session is FASTER than prev
     norm_pps = cur_pps / drift
-    delta = norm_pps / prev["pairs_per_second"] - 1.0
+    delta = norm_pps / prev[key] - 1.0
     out.update({
         "session_drift_ratio": round(drift, 4),
-        "normalized_pairs_per_second": round(norm_pps),
+        f"normalized_{key}": round(norm_pps),
         "normalized_delta": round(delta, 4),
         "regression_band": _REGRESSION_BAND,
         "regression_gate": ("PASS" if abs(delta) <= _REGRESSION_BAND

@@ -195,6 +195,53 @@ def _build_test_parser(sub) -> argparse.ArgumentParser:
                         "values for SVR; with -b 1: 'label p(+1)' with "
                         "the label from p >= 0.5, LibSVM svm-predict "
                         "-b 1 style)")
+    p.add_argument("--precision", choices=["auto", "float32", "float64"],
+                   default="auto",
+                   help="binary decision evaluation precision (default "
+                        "auto: consult predict.decision_risk and route "
+                        "extreme-|coef| models to the exact host float64 "
+                        "path — the PARITY.md 59%%-sign-agreement footgun "
+                        "made opt-out; float32 forces the device path)")
+    return p
+
+
+def _build_serve_parser(sub) -> argparse.ArgumentParser:
+    p = sub.add_parser(
+        "serve",
+        help="persistent prediction server (compacted SV union resident "
+             "on device, bucketed micro-batching; serve.py)")
+    p.add_argument("-m", "--model", required=True,
+                   help="model path (.npz multiclass bundle or binary "
+                        "model, .txt binary)")
+    p.add_argument("--buckets", default="16,64,256,1024,4096",
+                   help="comma-separated power-of-two query buckets "
+                        "(pre-compiled at startup)")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="float32",
+                   help="SV-union storage dtype (bfloat16 halves the "
+                        "resident footprint; f32 accumulation; quality-"
+                        "guarded)")
+    p.add_argument("--precision", choices=["auto", "float32", "float64"],
+                   default="auto",
+                   help="per-submodel evaluation routing (auto = "
+                        "decision_risk-gated host float64 for extreme-"
+                        "|coef| submodels)")
+    p.add_argument("--num-devices", type=int, default=1,
+                   help="shard the SV union over this many devices "
+                        "(psum-combined partial columns; default 1)")
+    p.add_argument("--server-bench", action="store_true",
+                   help="run the offered-load micro-benchmark (through-"
+                        "put + p50/p95/p99 latency per bucket) instead "
+                        "of serving stdin")
+    p.add_argument("--requests", type=int, default=512,
+                   help="--server-bench: number of requests (default 512)")
+    p.add_argument("--request-sizes", default="1,2,4,8,16,32,64,128",
+                   help="--server-bench: comma list request row counts "
+                        "are drawn from")
+    p.add_argument("--group", type=int, default=8,
+                   help="--server-bench: requests arriving together "
+                        "(shared flush dispatches; default 8)")
+    p.add_argument("-q", "--quiet", action="store_true")
     return p
 
 
@@ -204,6 +251,7 @@ def main(argv=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     _build_train_parser(sub)
     _build_test_parser(sub)
+    _build_serve_parser(sub)
     p = sub.add_parser("smoke", help="device/mesh environment smoke test")
     p.add_argument("--num-devices", type=int, default=None)
     args = parser.parse_args(argv)
@@ -211,6 +259,8 @@ def main(argv=None) -> int:
         return _cmd_train(args)
     if args.command == "smoke":
         return _cmd_smoke(args)
+    if args.command == "serve":
+        return _cmd_serve(args)
     return _cmd_test(args)
 
 
@@ -844,6 +894,104 @@ def _write_predictions(args, values, fmt: str = "%d") -> None:
     print(f"predictions written to {args.output}")
 
 
+def _cmd_serve(args) -> int:
+    """Run the persistent serving engine (serve.py PredictServer) on a
+    saved model: either the offered-load micro-benchmark
+    (--server-bench) or a stdin prediction loop (one comma-separated
+    feature row per line -> one predicted label per line, micro-batched
+    into the pre-compiled buckets; a blank line forces a flush)."""
+    import json
+
+    from dpsvm_tpu.config import ServeConfig
+    from dpsvm_tpu.serve import PredictServer, offered_load_sweep
+
+    model_type = "classifier"
+    if args.model.endswith(".npz"):
+        z = np.load(args.model, allow_pickle=False)
+        mt = str(z.get("model_type", ""))
+        if mt == "multiclass" or ("n_models" in z and "strategy" in z):
+            model_type = "multiclass"
+        elif mt in ("svr", "oneclass", "precomputed_svc"):
+            print(f"error: cannot serve a {mt} model (the serving "
+                  "engine is the classifier decision path)",
+                  file=sys.stderr)
+            return 2
+    if model_type == "multiclass":
+        from dpsvm_tpu.models.multiclass import MulticlassSVM
+        model = MulticlassSVM.load(args.model)
+    else:
+        from dpsvm_tpu.models.svm_model import SVMModel
+        model = SVMModel.load(args.model)
+
+    try:
+        buckets = tuple(int(t) for t in args.buckets.split(",") if t)
+        config = ServeConfig(buckets=buckets, dtype=args.dtype,
+                             precision=args.precision,
+                             num_devices=args.num_devices)
+        t0 = time.perf_counter()
+        server = PredictServer(model, config)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not args.quiet:
+        ens = server.ens
+        # server.buckets, not config.buckets: the server trims buckets
+        # whose kernel tile would cross the memory budget.
+        print(f"server ready in {time.perf_counter() - t0:.2f}s: "
+              f"{server.k} decision columns over a {ens.n_union}-row SV "
+              f"union ({int(ens.counts.sum())} stacked SVs compacted; "
+              f"{len(server.f64_cols)} float64-routed columns), "
+              f"buckets {server.buckets}, dtype {config.dtype}",
+              file=sys.stderr)
+
+    if args.server_bench:
+        try:
+            sizes = [int(t) for t in args.request_sizes.split(",") if t]
+            rec = offered_load_sweep(server, sizes, args.requests,
+                                     group=args.group)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(rec))
+        return 0
+
+    buf: list = []
+
+    def _emit(lines) -> None:
+        rows = np.asarray([[float(v) for v in ln.split(",")]
+                           for ln in lines], np.float32)
+        for lab in server.predict(rows):
+            print(int(lab))
+        # Piped clients wait for these labels (stdout is block-buffered
+        # off a tty; without the flush a blank-line "flush" request
+        # would deadlock the client against Python's 8 KB buffer).
+        sys.stdout.flush()
+
+    try:
+        for line in sys.stdin:
+            ln = line.strip()
+            if not ln:
+                if buf:
+                    _emit(buf)
+                    buf = []
+                continue
+            buf.append(ln)
+            if len(buf) >= server.buckets[-1]:
+                _emit(buf)
+                buf = []
+        if buf:
+            _emit(buf)
+    except ValueError as e:
+        print(f"error: bad query row ({e})", file=sys.stderr)
+        return 2
+    if not args.quiet:
+        st = server.stats
+        print(f"served {st['rows']} rows in {st['dispatches']} "
+              f"dispatches (bucket counts {st['bucket_counts']}, "
+              f"{st['padded_rows']} padded rows)", file=sys.stderr)
+    return 0
+
+
 def _cmd_test(args) -> int:
     from dpsvm_tpu.models.svm_model import SVMModel
     from dpsvm_tpu.ops.kernels import KernelParams
@@ -868,6 +1016,15 @@ def _cmd_test(args) -> int:
         # -b 1 needs Platt calibration, which only classifier models
         # carry; failing loudly beats silently ignoring the flag.
         print(f"error: -b 1 is not applicable to a {model_type} model",
+              file=sys.stderr)
+        return 2
+
+    if model_type != "classifier" and args.precision != "auto":
+        # Same loud-failure convention: the precision wiring lives on
+        # the binary decision path only (multiclass bundles risk-route
+        # per submodel via the serving engine's decision_risk gate).
+        print(f"error: --precision {args.precision} applies to binary "
+              f"classifier models only, not a {model_type} model",
               file=sys.stderr)
         return 2
 
@@ -971,9 +1128,18 @@ def _cmd_test(args) -> int:
               "the test data (or test against the multiclass .npz "
               "model trained from the original labels)", file=sys.stderr)
         return 2
-    from dpsvm_tpu.predict import decision_function
+    from dpsvm_tpu.predict import (decision_function, decision_risk,
+                                   resolve_precision)
 
-    dec = np.asarray(decision_function(model, x))
+    prec = args.precision
+    if prec == "auto":
+        prec = resolve_precision(model)
+        if prec == "float64":
+            print(f"precision auto: decision_risk "
+                  f"{decision_risk(model):.3g} >= 0.1 -> exact float64 "
+                  "evaluation (pass --precision float32 to force the "
+                  "device path)", file=sys.stderr)
+    dec = np.asarray(decision_function(model, x, precision=prec))
     proba = None
     if args.probability:
         if not model.has_probability:
@@ -1021,6 +1187,12 @@ def train_main() -> int:
 def test_main() -> int:
     """`svmtest` console entry — the reference's svmTest/seq_test role."""
     return main(["test"] + sys.argv[1:])
+
+
+def serve_main() -> int:
+    """`svmserve` console entry — the persistent serving engine (no
+    reference equivalent; its tester scores a file and exits)."""
+    return main(["serve"] + sys.argv[1:])
 
 
 if __name__ == "__main__":

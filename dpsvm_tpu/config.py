@@ -441,3 +441,67 @@ class SVMConfig:
 
     def replace(self, **kw) -> "SVMConfig":
         return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Runtime knobs for the persistent serving engine (serve.py
+    PredictServer) — the inference-side sibling of SVMConfig.
+
+    buckets: power-of-two query micro-batch sizes. Incoming requests are
+      merged and padded to the smallest bucket that fits (XLA executors
+      are shape-keyed: without bucketing, every distinct request size
+      pays a fresh compile — the same discipline as training's pad_to
+      buckets). Batches beyond the largest bucket loop over it.
+    dtype: SV-union storage dtype. "bfloat16" halves the resident-union
+      HBM footprint and kernel-matmul read bandwidth; dot products still
+      accumulate in float32 (preferred_element_type), and construction
+      runs the existing bf16 quality guard (ops/kernels.py
+      bf16_rbf_perturbation) — a loud warning when coefficient scale
+      amplifies storage rounding into O(1) decision changes.
+    precision: "auto" consults predict.decision_risk per submodel and
+      routes extreme-|coef| columns to the exact host float64 path
+      (predict.AUTO_F64_RISK); "float32" forces the device path;
+      "float64" forces the host path for every column.
+    num_devices: >1 shards the SV union (rows) over a data mesh and
+      psums partial decision columns — inference memory scales with
+      device count, like training's X sharding.
+    warm_start: pre-compile (and pre-touch) every bucket executor at
+      construction so the first live request never pays a compile.
+    max_pending: queued query rows before enqueue() forces a flush —
+      bounds host memory under offered overload.
+    """
+
+    buckets: tuple = (16, 64, 256, 1024, 4096)
+    dtype: str = "float32"
+    precision: str = "auto"
+    num_devices: int = 1
+    warm_start: bool = True
+    max_pending: int = 65536
+
+    def __post_init__(self):
+        if not self.buckets:
+            raise ValueError("buckets must be non-empty")
+        bs = tuple(int(b) for b in self.buckets)
+        if any(b < 1 or (b & (b - 1)) for b in bs):
+            raise ValueError(
+                f"buckets must be powers of two, got {self.buckets!r} "
+                "(XLA executors are shape-keyed; arbitrary sizes would "
+                "compile per request size)")
+        if list(bs) != sorted(set(bs)):
+            raise ValueError("buckets must be strictly ascending")
+        object.__setattr__(self, "buckets", bs)
+        if self.dtype not in ("float32", "bfloat16"):
+            raise ValueError("dtype must be 'float32' or 'bfloat16'")
+        if self.precision not in ("auto", "float32", "float64"):
+            raise ValueError(
+                "precision must be 'auto', 'float32' or 'float64'")
+        if self.num_devices < 1:
+            raise ValueError("num_devices must be >= 1")
+        if self.max_pending < self.buckets[-1]:
+            raise ValueError(
+                "max_pending must be at least the largest bucket "
+                f"({self.buckets[-1]})")
+
+    def replace(self, **kw) -> "ServeConfig":
+        return dataclasses.replace(self, **kw)

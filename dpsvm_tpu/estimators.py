@@ -330,10 +330,9 @@ class SVC(ClassifierMixin, BaseEstimator):
             p_pos = platt_probability(self.decision_function(X), *self._platt)
             return np.stack([1.0 - p_pos, p_pos], axis=1)
         from dpsvm_tpu.models.multiclass import decision_matrix
+        from dpsvm_tpu.models.platt import platt_probability_matrix
         scores = decision_matrix(self._multiclass_model, X)
-        probs = np.stack([
-            platt_probability(scores[:, j], *self._platt[j])
-            for j in range(len(self.classes_))], axis=1)
+        probs = platt_probability_matrix(scores, self._platt)
         probs = np.clip(probs, 1e-12, 1.0)
         return probs / probs.sum(axis=1, keepdims=True)
 

@@ -21,51 +21,255 @@ from dpsvm_tpu.predict import decision_function
 
 
 @dataclasses.dataclass
+class CompactedEnsemble:
+    """Shared-SV compacted view of a multiclass ensemble.
+
+    Every submodel's SVs are rows of the SAME training matrix (OvR trains
+    on all rows, OvO on row subsets; ``SVMModel.from_dense`` gathers rows,
+    never recomputes them), so the k replicated per-model SV stacks
+    collapse into ONE union matrix plus a coefficient matrix — the
+    union-of-SVs structure LIBSVM-family tools exploit for multiclass
+    prediction. The whole multiclass decision becomes one
+    ``K(Q, sv_union)`` kernel matmul for all k columns instead of k
+    replicated ones (~k x fewer kernel FLOPs and bytes on the OvO hot
+    path).
+
+    Fields:
+      sv_union  (S+1, d) f32  deduplicated SV rows (exact byte-identity)
+                            plus ONE trailing all-zero PAD row — the
+                            same zero-row padding the stacked path
+                            uses, so a non-finite kernel value of a
+                            real row (e.g. poly overflow) can never
+                            leak inf*0=NaN through pad slots into
+                            other submodels' columns. Empty when no
+                            submodel has SVs.
+      coef      (S+1, k) f32  dense dual-coefficient matrix: column j
+                            holds submodel j's alpha*y at its rows'
+                            union positions, zero elsewhere (duplicate
+                            rows WITHIN a model accumulate; the pad
+                            row is all-zero) — the serving engine's
+                            ``K @ coef`` contraction operand
+      b         (k,)   f32  per-submodel offsets
+      idx       (k, m_pad) i32  submodel j's SVs as union positions, in
+                            submodel j's OWN SV order (pad slots point
+                            at the zero PAD row) — the
+                            exact-contraction gather operand
+      coef_pad  (k, m_pad) f32  submodel j's dual coefs in the same order
+      counts    (k,)   i32  true n_sv per submodel (pad slots carry
+                            coef 0 and contribute exact +0.0)
+      kernel    shared KernelParams
+    """
+
+    sv_union: np.ndarray
+    coef: np.ndarray
+    b: np.ndarray
+    idx: np.ndarray
+    coef_pad: np.ndarray
+    counts: np.ndarray
+    kernel: object  # KernelParams (deferred import at module top-level)
+    # Device residency: built once per ensemble object, evicted with it.
+    # The arrays are treated as FROZEN after build (mutating them would
+    # serve stale device copies; rebuild via compact_models instead).
+    _device: tuple = dataclasses.field(default=None, repr=False,
+                                       compare=False)
+
+    @property
+    def n_union(self) -> int:
+        """Deduplicated REAL SV rows (excluding the trailing pad row)."""
+        s = int(self.sv_union.shape[0])
+        return max(0, s - 1)
+
+    @property
+    def n_models(self) -> int:
+        return int(self.coef.shape[1])
+
+    @property
+    def m_pad(self) -> int:
+        return int(self.idx.shape[1])
+
+    def device_arrays(self):
+        """(sv_union, coef_pad, idx, b) resident on device — uploaded
+        once per ensemble, not per decision_matrix call (the serving
+        residency contract; the dense ``coef`` operand is staged by the
+        serving engine separately because it may live in a different
+        storage dtype there)."""
+        if self._device is None:
+            import jax.numpy as jnp
+            self._device = (jnp.asarray(self.sv_union),
+                            jnp.asarray(self.coef_pad),
+                            jnp.asarray(self.idx),
+                            jnp.asarray(self.b))
+        return self._device
+
+
+def compact_models(models, x_train=None) -> CompactedEnsemble:
+    """Deduplicate SV rows across submodels into a CompactedEnsemble.
+
+    Exact row-identity dedup: rows match by raw float32 bytes. When the
+    training matrix is available (train time) the union keeps
+    training-row order — training rows are hashed once and SV rows map
+    through that index; rows not found there (or with no ``x_train``,
+    the load path) dedup by byte equality in first-seen order. Bit-level
+    parity with the stacked path does NOT depend on union order: the
+    exact contraction gathers each model's kernel values back into the
+    model's own SV order (see _compacted_batch_factory)."""
+    kp = models[0].kernel
+    d = models[0].sv_x.shape[1]
+    k = len(models)
+    # Same padded height as _stacked_decision so the two contractions
+    # sum identical term sequences (pad slots are exact zeros in both).
+    m_pad = 1 << max(4, (max((mm.sv_x.shape[0] for mm in models),
+                            default=1) - 1).bit_length())
+    svs_list = []
+    coef_pad = np.zeros((k, m_pad), np.float32)
+    counts = np.zeros((k,), np.int32)
+    b = np.zeros((k,), np.float32)
+    for j, mm in enumerate(models):
+        if mm.kernel != kp:
+            raise ValueError(
+                "compact_models needs all submodels on one shared kernel "
+                f"(model 0 has {kp}, model {j} has {mm.kernel})")
+        svs = np.ascontiguousarray(np.asarray(mm.sv_x, np.float32))
+        svs_list.append(svs)
+        counts[j] = svs.shape[0]
+        b[j] = mm.b
+        coef_pad[j, :svs.shape[0]] = mm.dual_coef
+
+    def _void(a):
+        """Rows as opaque byte scalars — C-speed exact row identity."""
+        return np.ascontiguousarray(a).view(
+            np.dtype((np.void, a.dtype.itemsize * d))).reshape(-1)
+
+    total = int(counts.sum())
+    if total == 0:
+        return CompactedEnsemble(
+            sv_union=np.zeros((0, d), np.float32),
+            coef=np.zeros((0, k), np.float32), b=b,
+            idx=np.zeros((k, m_pad), np.int32), coef_pad=coef_pad,
+            counts=counts, kernel=kp)
+
+    # Vectorized dedup (np.unique over void rows — no per-row Python
+    # hashing; at MNIST-OvO scale the tobytes/dict formulation costs
+    # seconds of pure-Python time per build).
+    all_rows = np.concatenate([s for s in svs_list if len(s)])
+    _, first_idx, inverse = np.unique(_void(all_rows),
+                                      return_index=True,
+                                      return_inverse=True)
+    # np.unique sorts by bytes; re-rank to FIRST-SEEN order.
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(len(first_idx), np.int64)
+    rank[order] = np.arange(len(first_idx))
+    pos_of_row = rank[inverse.reshape(-1)]  # union position per SV row
+    union_rows = all_rows[first_idx[order]]
+
+    if x_train is not None:
+        xt = np.ascontiguousarray(np.asarray(x_train, np.float32))
+        if xt.ndim == 2 and xt.shape[1] == d and xt.shape[0]:
+            # Reorder the union to training-row order where rows are
+            # found in x_train (unmatched rows keep first-seen order at
+            # the tail). One np.unique over both row sets yields the
+            # join; no per-row hashing of the 60k x 784 matrix.
+            both = np.concatenate([_void(xt), _void(union_rows)])
+            _, inv2 = np.unique(both, return_inverse=True)
+            inv2 = inv2.reshape(-1)
+            tid, uid = inv2[:xt.shape[0]], inv2[xt.shape[0]:]
+            sentinel = np.iinfo(np.int64).max
+            tpos = np.full(int(inv2.max()) + 1, sentinel, np.int64)
+            np.minimum.at(tpos, tid, np.arange(xt.shape[0]))
+            order2 = np.argsort(tpos[uid], kind="stable")
+            union_rows = union_rows[order2]
+            rank2 = np.empty(len(order2), np.int64)
+            rank2[order2] = np.arange(len(order2))
+            pos_of_row = rank2[pos_of_row]
+
+    # Trailing all-zero PAD row: pad slots of idx gather ITS kernel
+    # value (times coef 0) — exactly the stacked path's zero-row
+    # padding, so a non-finite kernel value of a real row never turns
+    # into inf*0 = NaN in unrelated columns.
+    s_real = union_rows.shape[0]
+    sv_union = np.concatenate(
+        [union_rows, np.zeros((1, d), np.float32)])
+    idx = np.full((k, m_pad), s_real, np.int32)
+    coef = np.zeros((s_real + 1, k), np.float32)
+    off = 0
+    for j, svs in enumerate(svs_list):
+        nsv = svs.shape[0]
+        pj = pos_of_row[off:off + nsv]
+        idx[j, :nsv] = pj
+        # scatter-add: in-model duplicate rows accumulate
+        np.add.at(coef[:, j], pj, coef_pad[j, :nsv])
+        off += nsv
+    return CompactedEnsemble(sv_union=sv_union, coef=coef, b=b, idx=idx,
+                             coef_pad=coef_pad, counts=counts, kernel=kp)
+
+
+@dataclasses.dataclass
 class MulticlassSVM:
     classes: np.ndarray  # (k,) sorted original labels
     models: list[SVMModel]  # OvR: k models; OvO: k(k-1)/2 in (i<j) order
     strategy: str  # "ovr" | "ovo"
+    # Shared-SV compacted view (None until built or when submodels do not
+    # share one kernel). Built once at train/load time, persisted in the
+    # .npz format (version 2).
+    compacted: Optional[CompactedEnsemble] = None
+
+    def shared_kernel(self) -> bool:
+        return bool(self.models) and all(
+            mm.kernel == self.models[0].kernel for mm in self.models)
+
+    def ensure_compacted(self, x_train=None) -> Optional[CompactedEnsemble]:
+        """Build (once) and return the compacted view; None when the
+        submodels do not share one kernel (mixed ensembles keep the
+        stacked / per-model fallbacks)."""
+        if self.compacted is None and self.shared_kernel():
+            self.compacted = compact_models(self.models, x_train=x_train)
+        return self.compacted
 
     def save(self, path: str) -> None:
         if not path.endswith(".npz"):
             raise ValueError("multiclass models are saved as .npz")
+        # format_version 2 adds the persisted compacted arrays (c_*).
+        # Backward compatible BOTH ways: a v1 reader ignores the c_* keys
+        # (it only reads n_models/m{i}_*), and this reader rebuilds the
+        # compaction when a v1 file has none.
         payload = {
-            "format_version": 1,
+            "format_version": 2,
             "model_type": "multiclass",  # cli test dispatches on this
             "strategy": self.strategy,
             "classes": self.classes,
             "n_models": len(self.models),
         }
         for i, m in enumerate(self.models):
-            payload[f"m{i}_sv_x"] = m.sv_x
-            payload[f"m{i}_sv_alpha"] = m.sv_alpha
-            payload[f"m{i}_sv_y"] = m.sv_y
-            payload[f"m{i}_b"] = np.float32(m.b)
-            payload[f"m{i}_kernel_kind"] = m.kernel.kind
-            payload[f"m{i}_gamma"] = np.float32(m.kernel.gamma)
-            payload[f"m{i}_degree"] = np.int32(m.kernel.degree)
-            payload[f"m{i}_coef0"] = np.float32(m.kernel.coef0)
+            payload.update(m.npz_payload(f"m{i}_"))
+        comp = self.ensure_compacted()
+        if comp is not None:
+            payload.update(
+                c_sv_union=comp.sv_union, c_coef=comp.coef,
+                c_coef_pad=comp.coef_pad, c_idx=comp.idx,
+                c_counts=comp.counts, c_b=comp.b)
         np.savez_compressed(path, **payload)
 
     @classmethod
     def load(cls, path: str) -> "MulticlassSVM":
-        from dpsvm_tpu.ops.kernels import KernelParams
         z = np.load(path, allow_pickle=False)
-        models = []
-        for i in range(int(z["n_models"])):
-            models.append(SVMModel(
-                sv_x=z[f"m{i}_sv_x"].astype(np.float32),
-                sv_alpha=z[f"m{i}_sv_alpha"].astype(np.float32),
-                sv_y=z[f"m{i}_sv_y"].astype(np.int32),
-                b=float(z[f"m{i}_b"]),
-                kernel=KernelParams(
-                    kind=str(z[f"m{i}_kernel_kind"]),
-                    gamma=float(z[f"m{i}_gamma"]),
-                    degree=int(z[f"m{i}_degree"]),
-                    coef0=float(z[f"m{i}_coef0"]),
-                ),
-            ))
-        return cls(classes=z["classes"], models=models, strategy=str(z["strategy"]))
+        models = [SVMModel.from_npz_payload(z, f"m{i}_")
+                  for i in range(int(z["n_models"]))]
+        obj = cls(classes=z["classes"], models=models,
+                  strategy=str(z["strategy"]))
+        if "c_sv_union" in z and obj.shared_kernel():
+            obj.compacted = CompactedEnsemble(
+                sv_union=z["c_sv_union"].astype(np.float32),
+                coef=z["c_coef"].astype(np.float32),
+                b=z["c_b"].astype(np.float32),
+                idx=z["c_idx"].astype(np.int32),
+                coef_pad=z["c_coef_pad"].astype(np.float32),
+                counts=z["c_counts"].astype(np.int32),
+                kernel=models[0].kernel)
+        else:
+            # v1 file (or a mixed-kernel bundle): compaction happens once
+            # at load, byte-equality dedup (no training matrix here).
+            obj.ensure_compacted()
+        return obj
 
 
 def _fleet_eligible(config: SVMConfig, backend: str,
@@ -153,8 +357,11 @@ def _train_multiclass_fleet(x, y, classes, config: SVMConfig,
                       f"n_sv={res.n_sv} "
                       f"(fleet of {res.stats['fleet']['size']}, "
                       f"{res.dispatches} dispatches)")
-    return MulticlassSVM(classes=classes, models=models,
-                         strategy=strategy), results
+    mc = MulticlassSVM(classes=classes, models=models, strategy=strategy)
+    # Compaction happens once at model build (the training matrix is at
+    # hand, so the union keeps training-row order).
+    mc.ensure_compacted(x_train=x)
+    return mc, results
 
 
 def train_multiclass(
@@ -248,7 +455,10 @@ def train_multiclass(
                 results.append(res)
     else:
         raise ValueError(f"unknown strategy {strategy!r}; use 'ovr' or 'ovo'")
-    return MulticlassSVM(classes=classes, models=models, strategy=strategy), results
+    mc = MulticlassSVM(classes=classes, models=models, strategy=strategy)
+    if mc.shared_kernel():
+        mc.ensure_compacted(x_train=x)
+    return mc, results
 
 
 def predict_multiclass(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
@@ -303,6 +513,142 @@ def _stacked_batch_factory():
 _STACKED_BATCH = None
 
 
+def _compacted_batch_factory():
+    """Module-level jitted compacted evaluator (lazy jax import; cached
+    on the wrapper OBJECT — see _stacked_batch_factory for why)."""
+    global _COMPACT_BATCH
+    if _COMPACT_BATCH is not None:
+        return _COMPACT_BATCH
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("kp",))
+    def batch(qb, sv, coef_pad, idx, b, kp):
+        # ONE kernel matmul against the SV union for ALL k columns —
+        # vs the stacked path's (k, n, m_pad) replicated chain — then an
+        # EXACT contraction: gather each submodel's kernel values back
+        # into ITS OWN SV order and contract exactly as the stacked
+        # einsum does. The per-(model, query) reduction then sums
+        # identical terms in the identical order (pad slots contribute
+        # exact +0.0 in both), so the result is BIT-IDENTICAL to
+        # _stacked_decision (pinned in tests/test_compacted.py) while
+        # the kernel work shrank ~k x. The serving engine's dense
+        # K @ coef contraction (serve.py) trades this bit guarantee for
+        # the smaller (S, k) operand.
+        #
+        # Orientation per kernel family: bit-parity additionally needs
+        # the contraction operand in the same PHYSICAL layout XLA
+        # materializes for the stacked chain, and that choice differs by
+        # kernel — the rbf/poly stacked chain materializes kernel values
+        # (k, n, m)-contiguous, while linear/sigmoid keep the raw
+        # (k*m, n) dot layout. Mirror each (the tests pin it; an XLA
+        # upgrade that shifts a layout shows up as a parity failure, the
+        # same contract as the repo's other compiled-program pins).
+        from dpsvm_tpu.ops.kernels import kernel_from_dots
+
+        qsq = jnp.einsum("nd,nd->n", qb, qb)
+        ssq = jnp.einsum("sd,sd->s", sv, sv)
+        if kp.kind in ("rbf", "poly"):
+            dots = jnp.dot(qb, sv.T,
+                           preferred_element_type=jnp.float32)  # (n, S)
+            kv = kernel_from_dots(dots, ssq, qsq, kp)
+            kg = kv[:, idx]  # (n, k, m_pad) gather — no recompute
+            return (jnp.einsum("nkm,km->kn", kg, coef_pad)
+                    - b[:, None]).T
+        if kp.kind in ("linear", "sigmoid"):
+            dots = jnp.dot(sv, qb.T,
+                           preferred_element_type=jnp.float32)  # (S, n)
+            kv = kernel_from_dots(dots, qsq, ssq, kp)
+            kg = kv[idx]  # (k, m_pad, n) row gather
+            return (jnp.einsum("kmn,km->kn", kg, coef_pad)
+                    - b[:, None]).T
+        raise ValueError(f"unknown kernel kind {kp.kind!r}")
+
+    _COMPACT_BATCH = batch
+    return batch
+
+
+_COMPACT_BATCH = None
+
+
+def _compacted_decision(ens: CompactedEnsemble, q, block: int) -> np.ndarray:
+    """All submodels' decision values through the compacted path:
+    (n, k) float32, bit-identical to _stacked_decision (tests pin it)."""
+    import jax.numpy as jnp
+
+    k, m_pad = ens.idx.shape
+    s_union = int(ens.sv_union.shape[0])  # incl. the trailing pad row
+    d = ens.sv_union.shape[1]
+    if s_union == 0:
+        # Degenerate all-empty ensemble: the decision is exactly -b.
+        q = np.asarray(q, np.float32)
+        return np.broadcast_to(-ens.b, (q.shape[0], k)).astype(np.float32)
+    sv_d, coef_d, idx_d, b_d = ens.device_arrays()
+    batch = _compacted_batch_factory()
+    # Bound the LARGER of the round's two tiles — the (blk, k, m_pad)
+    # gather tensor and the (blk, S) kernel tile — to ~1 GB, then round
+    # DOWN to a power of two (same discipline as _stacked_decision: the
+    # per-block query pad rounds UP, so a non-power-of-two cap could
+    # overshoot 2x).
+    blk = max(128, min(block, (1 << 28) // max(1, k * m_pad + s_union)))
+    blk = 1 << (blk.bit_length() - 1)
+    out = []
+    q = np.asarray(q, np.float32)
+    for s in range(0, q.shape[0], blk):
+        qb = q[s:s + blk]
+        nb = qb.shape[0]
+        nb_pad = 1 << max(4, (nb - 1).bit_length())
+        if nb_pad != nb:
+            qp = np.zeros((nb_pad, d), np.float32)
+            qp[:nb] = qb
+            qb = qp
+        out.append(np.asarray(batch(jnp.asarray(qb), sv_d, coef_d,
+                                    idx_d, b_d, ens.kernel))[:nb])
+    return (np.concatenate(out) if out
+            else np.zeros((0, k), np.float32))
+
+
+# Size-1 device-stack memo for the stacked FALLBACK path, with the
+# _XDEV_MEMO/_GRAM_MEMO content-fingerprint discipline (solver/smo.py):
+# repeated decision_matrix/vote_matrix calls must not re-upload the
+# (k, m_pad, d) replicated stack (hundreds of MB at MNIST-OvO shape)
+# per call. Keyed on the stack shape + kernel; validated by per-model
+# content fingerprints so in-place mutation rebuilds instead of serving
+# stale rows.
+_STACK_MEMO: dict = {}
+
+
+def _stacked_device_stack(models, kp, m_pad: int):
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.solver.smo import _host_fingerprint
+
+    k = len(models)
+    d = models[0].sv_x.shape[1]
+    key = (k, m_pad, d, kp)
+    fps = tuple((_host_fingerprint(mm.sv_x),
+                 _host_fingerprint(mm.sv_alpha),
+                 _host_fingerprint(mm.sv_y), float(mm.b))
+                for mm in models)
+    ent = _STACK_MEMO.get(key)
+    if ent is not None and ent[0] == fps:
+        return ent[1]
+    sv = np.zeros((k, m_pad, d), np.float32)
+    coef = np.zeros((k, m_pad), np.float32)
+    b = np.zeros((k,), np.float32)
+    for i, mm in enumerate(models):
+        ns = mm.sv_x.shape[0]
+        sv[i, :ns] = mm.sv_x
+        coef[i, :ns] = mm.dual_coef
+        b[i] = mm.b
+    dev = (jnp.asarray(sv), jnp.asarray(coef), jnp.asarray(b))
+    _STACK_MEMO.clear()  # size-1 discipline: never hold two stacks
+    _STACK_MEMO[key] = (fps, dev)
+    return dev
+
+
 def _stacked_decision(models, q, block: int) -> np.ndarray:
     """All submodels' decision values in ONE batched dispatch per query
     block: (n, n_models) float32.
@@ -323,14 +669,7 @@ def _stacked_decision(models, q, block: int) -> np.ndarray:
     m_pad = 1 << max(4, (max(mm.sv_x.shape[0] for mm in models) - 1)
                      .bit_length())
     k = len(models)
-    sv = np.zeros((k, m_pad, d), np.float32)
-    coef = np.zeros((k, m_pad), np.float32)
-    b = np.zeros((k,), np.float32)
-    for i, mm in enumerate(models):
-        ns = mm.sv_x.shape[0]
-        sv[i, :ns] = mm.sv_x
-        coef[i, :ns] = mm.dual_coef
-        b[i] = mm.b
+    sv_d, coef_d, b_d = _stacked_device_stack(models, kp, m_pad)
 
     batch = _stacked_batch_factory()
 
@@ -342,7 +681,6 @@ def _stacked_decision(models, q, block: int) -> np.ndarray:
     # round-5, low).
     blk = max(128, min(block, (1 << 28) // max(1, k * m_pad)))
     blk = 1 << (blk.bit_length() - 1)
-    sv_d, coef_d, b_d = jnp.asarray(sv), jnp.asarray(coef), jnp.asarray(b)
     out = []
     q = np.asarray(q, np.float32)
     for s in range(0, q.shape[0], blk):
@@ -359,30 +697,46 @@ def _stacked_decision(models, q, block: int) -> np.ndarray:
             else np.zeros((0, k), np.float32))
 
 
-def decision_matrix(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
+def decision_matrix(m: MulticlassSVM, q, block: int = 8192,
+                    path: str = "auto") -> np.ndarray:
     """Raw decision values, one column per fitted model: (n, k) per-class
-    scores for OvR, (n, k*(k-1)/2) pairwise columns (a<b order) for OvO."""
+    scores for OvR, (n, k*(k-1)/2) pairwise columns (a<b order) for OvO.
+
+    path: "auto" routes shared-kernel ensembles through the compacted
+    SV-union path (ONE kernel matmul for all k columns; bit-identical to
+    the stacked path) and mixed-kernel ensembles through the per-model
+    loop. "compacted" / "stacked" force those paths (raising on mixed
+    kernels — kept for A/B benchmarking, tools/bench_serve.py);
+    "per_model" forces the sequential decision_function loop."""
     q = np.asarray(q, np.float32)
-    if len(m.models) > 1 and all(mm.kernel == m.models[0].kernel
-                                 for mm in m.models):
+    shared = m.shared_kernel()
+    if path == "auto":
+        path = "compacted" if shared else "per_model"
+    if path in ("compacted", "stacked") and not shared:
+        raise ValueError(
+            f"path={path!r} needs all submodels on one shared kernel; "
+            "this ensemble mixes kernels (use path='per_model')")
+    if path == "compacted":
+        return _compacted_decision(m.ensure_compacted(), q, block)
+    if path == "stacked":
         return _stacked_decision(m.models, q, block)
+    if path != "per_model":
+        raise ValueError(
+            f"unknown path {path!r}; use 'auto', 'compacted', 'stacked' "
+            "or 'per_model'")
     return np.stack(
         [decision_function(mm, q, block) for mm in m.models], axis=1)
 
 
-def vote_matrix(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
-    """(n, k) per-class scores for an OvO model: pairwise votes plus a
-    sub-unit confidence term (sklearn's ovo->ovr transformation shape) so
-    ties rank by margin while vote order is never overturned."""
-    if m.strategy != "ovo":
-        return decision_matrix(m, q, block)
-    q = np.asarray(q, np.float32)
-    k = len(m.classes)
-    votes = np.zeros((q.shape[0], k), np.float64)
-    conf = np.zeros((q.shape[0], k), np.float64)
-    # One stacked device pass for all pairwise columns (see
-    # _stacked_decision); the vote fold is host numpy.
-    dec = decision_matrix(m, q, block).astype(np.float64)
+def ovo_vote_fold(dec: np.ndarray, k: int) -> np.ndarray:
+    """(n, k(k-1)/2) pairwise decision columns (a<b order) -> (n, k)
+    vote+confidence scores. Host numpy fold shared by vote_matrix and
+    the serving engine (serve.py): pairwise votes plus a sub-unit
+    confidence term (sklearn's ovo->ovr transformation shape) so ties
+    rank by margin while vote order is never overturned."""
+    dec = np.asarray(dec, np.float64)
+    votes = np.zeros((dec.shape[0], k), np.float64)
+    conf = np.zeros((dec.shape[0], k), np.float64)
     idx = 0
     for a in range(k):
         for b in range(a + 1, k):
@@ -394,6 +748,18 @@ def vote_matrix(m: MulticlassSVM, q, block: int = 8192) -> np.ndarray:
             conf[:, b] -= d
             idx += 1
     return votes + conf / (3.0 * (np.abs(conf) + 1.0))
+
+
+def vote_matrix(m: MulticlassSVM, q, block: int = 8192,
+                path: str = "auto") -> np.ndarray:
+    """(n, k) per-class scores for an OvO model (see ovo_vote_fold)."""
+    if m.strategy != "ovo":
+        return decision_matrix(m, q, block, path=path)
+    q = np.asarray(q, np.float32)
+    # One compacted device pass for all pairwise columns (see
+    # _compacted_decision); the vote fold is host numpy.
+    return ovo_vote_fold(decision_matrix(m, q, block, path=path),
+                         len(m.classes))
 
 
 def accuracy_multiclass(m: MulticlassSVM, q, y, block: int = 8192) -> float:

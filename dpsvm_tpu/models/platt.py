@@ -78,6 +78,22 @@ def platt_probability(decision: np.ndarray, a: float, b: float) -> np.ndarray:
     return 1.0 / (1.0 + np.exp(-np.clip(z, -500, 500)))
 
 
+def platt_probability_matrix(decision: np.ndarray, ab) -> np.ndarray:
+    """Per-column Platt probabilities for an (n, k) decision matrix —
+    the multiclass layout decision_matrix / the serving engine produce.
+    ``ab`` is a length-k sequence of (A, B) planes (one per column, the
+    OvR calibration set estimators.SVC fits); one vectorized sigmoid
+    replaces the per-column python loop."""
+    dec = np.asarray(decision, np.float64)
+    ab = np.asarray(ab, np.float64)
+    if dec.ndim != 2 or ab.shape != (dec.shape[1], 2):
+        raise ValueError(
+            f"expected (n, k) decisions with k (A, B) rows; got "
+            f"{dec.shape} and {ab.shape}")
+    z = dec * ab[None, :, 0] + ab[None, :, 1]
+    return 1.0 / (1.0 + np.exp(-np.clip(z, -500, 500)))
+
+
 def fit_platt_cv(x, y_pm, config, backend: str = "auto",
                  num_devices=None, k: int = 5,
                  seed=0, train_fn=None) -> tuple[float, float]:

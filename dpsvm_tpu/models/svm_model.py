@@ -95,6 +95,37 @@ class SVMModel:
         )
 
     # ------------------------------------------------------------------ io
+    def npz_payload(self, prefix: str = "") -> dict:
+        """The per-model .npz field set under a key prefix — ONE
+        definition of the binary model serialization, shared by ``save``
+        (prefix "") and the multiclass bundle writer (prefix "m{i}_",
+        models/multiclass.py) so the two formats can never skew the way
+        the reference's three text writers did (SURVEY.md bug B6)."""
+        return {
+            f"{prefix}sv_x": self.sv_x,
+            f"{prefix}sv_alpha": self.sv_alpha,
+            f"{prefix}sv_y": self.sv_y,
+            f"{prefix}b": np.float32(self.b),
+            **{f"{prefix}{k}": v
+               for k, v in self.kernel.npz_fields().items()},
+        }
+
+    @classmethod
+    def from_npz_payload(cls, z, prefix: str = "") -> "SVMModel":
+        """Inverse of ``npz_payload`` over an opened npz mapping."""
+        return cls(
+            sv_x=z[f"{prefix}sv_x"].astype(np.float32),
+            sv_alpha=z[f"{prefix}sv_alpha"].astype(np.float32),
+            sv_y=z[f"{prefix}sv_y"].astype(np.int32),
+            b=float(z[f"{prefix}b"]),
+            kernel=KernelParams(
+                kind=str(z[f"{prefix}kernel_kind"]),
+                gamma=float(z[f"{prefix}gamma"]),
+                degree=int(z[f"{prefix}degree"]),
+                coef0=float(z[f"{prefix}coef0"]),
+            ),
+        )
+
     def save(self, path: str) -> None:
         if path.endswith(".npz"):
             prob = ({"prob_a": np.float64(self.prob_a),
@@ -103,11 +134,7 @@ class SVMModel:
             np.savez_compressed(
                 path,
                 format_version=1,
-                sv_x=self.sv_x,
-                sv_alpha=self.sv_alpha,
-                sv_y=self.sv_y,
-                b=np.float32(self.b),
-                **self.kernel.npz_fields(),
+                **self.npz_payload(),
                 **prob,
             )
             return
@@ -136,15 +163,11 @@ class SVMModel:
     def load(cls, path: str) -> "SVMModel":
         if path.endswith(".npz"):
             z = np.load(path, allow_pickle=False)
-            return cls(
-                sv_x=z["sv_x"].astype(np.float32),
-                sv_alpha=z["sv_alpha"].astype(np.float32),
-                sv_y=z["sv_y"].astype(np.int32),
-                b=float(z["b"]),
-                kernel=KernelParams.from_npz(z),
-                prob_a=float(z["prob_a"]) if "prob_a" in z else None,
-                prob_b=float(z["prob_b"]) if "prob_b" in z else None,
-            )
+            model = cls.from_npz_payload(z)
+            if "prob_a" in z:
+                model.prob_a = float(z["prob_a"])
+                model.prob_b = float(z["prob_b"])
+            return model
         return cls._load_text(path)
 
     @classmethod

@@ -73,6 +73,29 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     )
 
 
+def shard_padded_rows(mesh: Mesh, arr, multiple: int = 8):
+    """Pad `arr`'s leading axis to a mesh-divisible, lane-friendly count
+    (pad_rows) and device_put it row-sharded over the data axis.
+
+    ONE definition of the "pad then shard rows" staging step, shared by
+    mesh inference (predict.decision_function_mesh) and the serving
+    engine's sharded SV union (serve.py). Pad rows are ZEROS and must be
+    inert in the consumer (zero dual coefficients contribute nothing) —
+    the same contract as the solver's padded rows."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr = np.asarray(arr)
+    n = arr.shape[0]
+    n_pad = pad_rows(n, mesh.size, multiple)
+    if n_pad != n:
+        padded = np.zeros((n_pad,) + arr.shape[1:], arr.dtype)
+        padded[:n] = arr
+        arr = padded
+    return jax.device_put(jnp.asarray(arr),
+                          NamedSharding(mesh, P(DATA_AXIS)))
+
+
 def pad_rows(n: int, num_shards: int, multiple: int = 8) -> int:
     """Padded row count: divisible by num_shards and a lane-friendly
     multiple. Replaces the reference's uneven ceil-sharding

@@ -37,13 +37,19 @@ def decision_function(model: SVMModel, q, block: int = 8192,
     values (measured at the covtype stress config: an alpha matching
     LibSVM's SV count to 0.05% read 59% sign agreement under fp32
     evaluation and 99.99% under f64 — PARITY.md). decision_risk() gives
-    a cheap a-priori estimate of when this matters.
+    a cheap a-priori estimate of when this matters;
+    precision="auto" consults it and picks the path for you (the
+    predict()/accuracy() default, so the PARITY.md 59%-sign-agreement
+    footgun is opt-out rather than opt-in).
     """
+    if precision == "auto":
+        precision = resolve_precision(model)
     if precision == "float64":
         # No fp32 quantization of the queries on the exact path.
         return _decision_f64(model, q, block)
     if precision != "float32":
-        raise ValueError("precision must be 'float32' or 'float64'")
+        raise ValueError(
+            "precision must be 'auto', 'float32' or 'float64'")
     q = np.asarray(q, np.float32)
     # Shape bucketing, both operands. XLA executors are shape-keyed and
     # every fitted model has its OWN n_sv: multiclass prediction over k
@@ -106,20 +112,52 @@ def decision_risk(model: SVMModel) -> float:
                  * np.sqrt(np.mean(coef ** 2)))
 
 
+# decision_risk above this routes precision='auto' to the exact host
+# float64 path. Calibrated between the measured covtype-stress case
+# (risk ~4, 59% fp32 sign agreement — PARITY.md) and moderate-C models
+# (~1e-4): by the time the random-walk noise estimate reaches 0.1,
+# fp32 signs near an O(1) decision boundary are noise.
+AUTO_F64_RISK = 0.1
+
+
+def decision_risk_columns(coef) -> np.ndarray:
+    """decision_risk per COLUMN of a (S, k) dual-coefficient matrix (the
+    compacted multiclass / serving layout, models/multiclass.py
+    CompactedEnsemble): sqrt(nnz_j) * eps_f32 * rms|nonzero coef_j|.
+    Vectorized so the serving engine can risk-gate all k submodels in
+    one pass."""
+    coef = np.asarray(coef, np.float64)
+    nnz = np.count_nonzero(coef, axis=0).astype(np.float64)
+    sq = np.sum(coef ** 2, axis=0)
+    rms = np.sqrt(sq / np.maximum(nnz, 1.0))
+    return np.sqrt(nnz) * 2.0 ** -23 * rms
+
+
+def resolve_precision(model: SVMModel, risk: float = None) -> str:
+    """The evaluation path precision='auto' resolves to for this model
+    (or for a precomputed `risk`): 'float64' when the a-priori fp32
+    noise estimate crosses AUTO_F64_RISK, else 'float32'."""
+    if risk is None:
+        risk = decision_risk(model)
+    return "float64" if risk >= AUTO_F64_RISK else "float32"
+
+
 def predict(model: SVMModel, q, block: int = 8192,
-            precision: str = "float32") -> np.ndarray:
+            precision: str = "auto") -> np.ndarray:
     """Class labels in {-1, +1}. sign(0) maps to +1 (matches the reference's
-    `dual >= 0` style checks, seq_test.cpp:199-203). precision='float64'
-    evaluates exactly on the host — required for trustworthy labels from
-    extreme-C models (see decision_function / decision_risk)."""
+    `dual >= 0` style checks, seq_test.cpp:199-203). precision defaults
+    to 'auto': extreme-|coef| models (decision_risk >= AUTO_F64_RISK)
+    evaluate exactly on the host in float64 — required for trustworthy
+    labels there (see decision_function / decision_risk); everything
+    else takes the fp32 device path unchanged."""
     d = decision_function(model, q, block, precision=precision)
     return np.where(d >= 0, 1, -1).astype(np.int32)
 
 
 def accuracy(model: SVMModel, q, y, block: int = 8192,
-             precision: str = "float32") -> float:
+             precision: str = "auto") -> float:
     """Fraction correct — the get_test_accuracy equivalent
-    (seq_test.cpp:187-210)."""
+    (seq_test.cpp:187-210). precision='auto' as in predict()."""
     pred = predict(model, q, block, precision=precision)
     return float(np.mean(pred == np.asarray(y)))
 
@@ -158,7 +196,7 @@ def decision_function_mesh(model: SVMModel, q, num_devices=None,
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from dpsvm_tpu.parallel.mesh import DATA_AXIS, pad_rows
+    from dpsvm_tpu.parallel.mesh import shard_padded_rows
 
     if num_devices is None:
         num_devices = len(jax.devices())
@@ -171,17 +209,11 @@ def decision_function_mesh(model: SVMModel, q, num_devices=None,
     if prepared is not None and prepared[0] == num_devices:
         sv_dev, coef_dev, sv_sq = prepared[1]
     else:
-        n_sv = model.n_sv
-        n_pad = pad_rows(n_sv, num_devices)
-        sv = np.zeros((n_pad, model.num_features), np.float32)
-        sv[:n_sv] = model.sv_x
-        coef = np.zeros((n_pad,), np.float32)
-        coef[:n_sv] = model.dual_coef  # padded rows have zero weight -> inert
-
-        shard = NamedSharding(mesh, P(DATA_AXIS))
-        sv_dev = jax.device_put(jnp.asarray(sv), shard)
-        coef_dev = jax.device_put(jnp.asarray(coef), shard)
-        sv_sq = jax.device_put(jnp.asarray((sv * sv).sum(1, dtype=np.float32)), shard)
+        sv = np.asarray(model.sv_x, np.float32)
+        sv_dev = shard_padded_rows(mesh, sv)
+        # padded rows have zero weight -> inert
+        coef_dev = shard_padded_rows(mesh, model.dual_coef)
+        sv_sq = shard_padded_rows(mesh, (sv * sv).sum(1, dtype=np.float32))
         model._mesh_prepared = (num_devices, (sv_dev, coef_dev, sv_sq))
 
     rep = NamedSharding(mesh, P())

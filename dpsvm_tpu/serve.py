@@ -1,0 +1,463 @@
+"""Persistent serving engine: compacted multiclass inference under
+bucketed micro-batching.
+
+The north star is serving heavy traffic, and the per-call inference
+entry points pay two costs a persistent server must not: the SV
+operands re-stage host->device per call (at MNIST-OvO shape the stacked
+(k, m_pad, d) fallback is ~578 MB of f32 per upload), and every distinct
+query-batch shape compiles a fresh XLA executor. ``PredictServer`` keeps
+the compacted SV union (models/multiclass.py CompactedEnsemble) RESIDENT
+on device, pre-compiles a small set of power-of-two query buckets at
+startup, and micro-batches queued requests into the next bucket — so a
+steady request stream costs one kernel matmul per merged batch and zero
+compiles/uploads.
+
+Decision algebra (the serving contraction): ``K(Q, sv_union) @ coef - b``
+— ONE (n, S) kernel matmul for all k submodel columns plus a cheap
+(S, k) coefficient contraction. This is the dense sibling of the
+model-layer exact path (multiclass._compacted_decision, which gathers
+per-model kernel values to stay bit-identical to the stacked fallback);
+dense reduction order differs from the stacked path by ~1e-7 relative
+(float32 associativity), which the risk router below covers where it
+could matter.
+
+Numerics routing: submodels whose a-priori fp32 noise estimate
+(predict.decision_risk) crosses ``predict.AUTO_F64_RISK`` are evaluated
+on the exact host float64 path instead (the PARITY.md
+59%-sign-agreement footgun, auto-routed). bf16 SV storage (halved
+union footprint/bandwidth, f32 accumulation) sits behind the existing
+bf16 quality guard (ops/kernels.py bf16_rbf_perturbation).
+
+Mesh variant: ``ServeConfig(num_devices>1)`` shards the SV union rows
+over a data mesh (parallel/mesh.py shard_padded_rows — the same pattern
+as predict._mesh_decision_executor) and psums partial decision columns,
+so serving memory scales with device count.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import time
+import warnings
+from functools import partial
+from typing import Union
+
+import numpy as np
+
+from dpsvm_tpu.config import ServeConfig
+from dpsvm_tpu.models.multiclass import (CompactedEnsemble, MulticlassSVM,
+                                         compact_models, ovo_vote_fold)
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.predict import AUTO_F64_RISK, decision_risk_columns
+
+# Per-dispatch kernel-tile budget in f32 elements (~1 GB), matching the
+# model-layer blocking discipline (multiclass._compacted_decision).
+_TILE_BUDGET_ELEMS = 1 << 28
+
+_DENSE_BATCH = None
+
+
+def _dense_batch_factory():
+    """Single-device jitted serving executor (lazy jax import; cached on
+    the wrapper object so predict calls never retrace — the
+    multiclass._stacked_batch_factory discipline)."""
+    global _DENSE_BATCH
+    if _DENSE_BATCH is not None:
+        return _DENSE_BATCH
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import kernel_from_dots
+
+    @partial(jax.jit, static_argnames=("kp",))
+    def batch(qb, sv, sv_sq, coef, b, kp):
+        # Queries round THROUGH the storage dtype (identity for f32):
+        # with bf16 storage both dot operands are bf16 (halved MXU read
+        # bandwidth) and the rbf norms must see the same rounded values
+        # or the |q|^2 + |s|^2 - 2 q.s expansion is inconsistent.
+        qc = qb.astype(sv.dtype)
+        dots = jnp.dot(qc, sv.T, preferred_element_type=jnp.float32)
+        qf = qc.astype(jnp.float32)
+        kv = kernel_from_dots(dots, sv_sq,
+                              jnp.einsum("nd,nd->n", qf, qf), kp)
+        return kv @ coef - b[None, :]
+
+    _DENSE_BATCH = batch
+    return batch
+
+
+@functools.lru_cache(maxsize=16)
+def _mesh_serve_executor(n_dev: int, kp, dtype_str: str):
+    """(mesh, mapped) for the union-sharded serving decision: each device
+    holds S/n_dev union rows (+ matching coefficient rows) and computes a
+    partial (n, k) contraction; one psum combines the columns. Cached per
+    mesh-width/kernel/storage-dtype (jit caches by function identity —
+    the predict._mesh_decision_executor discipline)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dpsvm_tpu.ops.kernels import kernel_from_dots
+    from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
+                                         mesh_shard_map)
+
+    mesh = make_data_mesh(n_dev)
+
+    def shard_fn(qb, sv_loc, sv_sq_loc, coef_loc, b):
+        qc = qb.astype(sv_loc.dtype)
+        dots = jnp.dot(qc, sv_loc.T, preferred_element_type=jnp.float32)
+        qf = qc.astype(jnp.float32)
+        kv = kernel_from_dots(dots, sv_sq_loc,
+                              jnp.einsum("nd,nd->n", qf, qf), kp)
+        return lax.psum(kv @ coef_loc, DATA_AXIS) - b[None, :]
+
+    mapped = jax.jit(mesh_shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=P()))
+    return mesh, mapped
+
+
+class PredictServer:
+    """Persistent multiclass/binary prediction server.
+
+    Request path: ``enqueue(q) -> ticket`` queues query rows;
+    ``flush()`` merges everything queued, pads to the smallest
+    pre-compiled power-of-two bucket that fits, runs ONE device dispatch
+    per bucket batch, and returns ``{ticket: decision rows}``.
+    ``decision(q)`` / ``predict(q)`` are the synchronous one-request
+    conveniences. All paths share the resident device operands staged at
+    construction.
+    """
+
+    def __init__(self, model: Union[MulticlassSVM, SVMModel],
+                 config: ServeConfig = ServeConfig()):
+        self.config = config
+        if isinstance(model, MulticlassSVM):
+            ens = model.ensure_compacted()
+            if ens is None:
+                raise ValueError(
+                    "PredictServer needs submodels sharing one kernel "
+                    "(mixed-kernel ensembles have no SV union to share); "
+                    "serve the submodels individually")
+            self.classes = np.asarray(model.classes)
+            self.strategy = model.strategy
+        elif isinstance(model, SVMModel):
+            ens = compact_models([model])
+            self.classes = None
+            self.strategy = "binary"
+        else:
+            raise TypeError(
+                f"cannot serve a {type(model).__name__}; expected "
+                "MulticlassSVM or SVMModel")
+        self.ens: CompactedEnsemble = ens
+        self.kp = ens.kernel
+        self.d = int(ens.sv_union.shape[1])
+        self.k = ens.n_models
+
+        # --- float64 risk routing (per submodel column) -------------
+        self.risks = decision_risk_columns(ens.coef)
+        if config.precision == "auto":
+            self.f64_cols = np.nonzero(self.risks >= AUTO_F64_RISK)[0]
+        elif config.precision == "float64":
+            self.f64_cols = np.arange(self.k)
+        else:
+            self.f64_cols = np.zeros((0,), np.int64)
+        self._all_f64 = len(self.f64_cols) == self.k
+
+        # --- effective buckets: cap the per-dispatch (bucket, S) kernel
+        # tile at the same ~1 GB budget the model-layer paths bound
+        # their tiles to (multiclass._compacted_decision) — a
+        # covtype-scale union must trim the large default buckets
+        # instead of OOMing during warm-up.
+        s_rows = int(self.ens.sv_union.shape[0])
+        cap = max(1, _TILE_BUDGET_ELEMS // max(1, s_rows))
+        cap = 1 << (cap.bit_length() - 1)  # floor to a power of two
+        self.buckets = (tuple(b for b in config.buckets if b <= cap)
+                        or (cap,))
+
+        # --- device staging (once; resident for the server lifetime) -
+        self._stage()
+
+        self.stats = {
+            "requests": 0, "rows": 0, "dispatches": 0, "padded_rows": 0,
+            "buckets": self.buckets,
+            "bucket_counts": {b: 0 for b in self.buckets},
+            # Bounded per-bucket dispatch timings (a long-lived server
+            # must not grow a list per dispatch forever); percentiles
+            # come from the most recent window.
+            "bucket_seconds": {b: collections.deque(maxlen=4096)
+                               for b in self.buckets},
+            "warm_seconds": {}, "f64_columns": len(self.f64_cols),
+        }
+        self._pending: list = []  # (ticket, (n, d) rows)
+        self._pending_rows = 0
+        self._done: dict = {}
+        self._next_ticket = 0
+        if config.warm_start:
+            self.warm()
+
+    # ------------------------------------------------------------ staging
+    def _stage(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        sv = np.ascontiguousarray(self.ens.sv_union, np.float32)
+        if cfg.dtype == "bfloat16":
+            self._bf16_guard(sv)
+            import ml_dtypes
+            sv_store = sv.astype(ml_dtypes.bfloat16)
+            # Norms from the ROUNDED rows — the dot operands' values.
+            sv_sq = (sv_store.astype(np.float32) ** 2).sum(
+                1, dtype=np.float32)
+        else:
+            sv_store = sv
+            sv_sq = (sv * sv).sum(1, dtype=np.float32)
+        coef = np.ascontiguousarray(self.ens.coef, np.float32)
+        b = np.ascontiguousarray(self.ens.b, np.float32)
+
+        if self.ens.n_union == 0:
+            self._call = None  # decision is exactly -b
+            return
+        if self._all_f64:
+            self._call = None  # every column routes to the host path
+            return
+        if cfg.num_devices > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dpsvm_tpu.parallel.mesh import shard_padded_rows
+            mesh, mapped = _mesh_serve_executor(cfg.num_devices, self.kp,
+                                                cfg.dtype)
+            sv_d = shard_padded_rows(mesh, sv_store)
+            sv_sq_d = shard_padded_rows(mesh, sv_sq)
+            coef_d = shard_padded_rows(mesh, coef)  # pad rows: coef 0
+            rep = NamedSharding(mesh, P())
+            b_d = jax.device_put(jnp.asarray(b), rep)
+
+            def call(qb, _m=mapped, _rep=rep):
+                return _m(jax.device_put(jnp.asarray(qb), _rep),
+                          sv_d, sv_sq_d, coef_d, b_d)
+        else:
+            batch = _dense_batch_factory()
+            sv_d = jnp.asarray(sv_store)
+            sv_sq_d = jnp.asarray(sv_sq)
+            coef_d = jnp.asarray(coef)
+            b_d = jnp.asarray(b)
+
+            def call(qb, _kp=self.kp):
+                return batch(jnp.asarray(qb), sv_d, sv_sq_d, coef_d,
+                             b_d, _kp)
+        self._call = call
+
+    def _bf16_guard(self, sv: np.ndarray) -> None:
+        """The serving analog of ops/kernels.warn_if_bf16_degrades: the
+        decision-sum perturbation from bf16 feature rounding is bounded
+        by ||coef||_1 * |dK| per column, so the risk scale is the max
+        column L1 norm times the sampled p90 kernel perturbation (the
+        training guard's C plays the same amplifier role there)."""
+        if self.kp.kind != "rbf" or sv.shape[0] == 0:
+            return
+        from dpsvm_tpu.ops.kernels import (BF16_RISK_THRESHOLD,
+                                           bf16_rbf_perturbation)
+        l1 = float(np.abs(self.ens.coef).sum(axis=0).max())
+        risk = l1 * bf16_rbf_perturbation(sv, self.kp.gamma)
+        if risk > BF16_RISK_THRESHOLD:
+            warnings.warn(
+                f"ServeConfig(dtype='bfloat16') is likely to perturb "
+                f"decision values for this model: max-column "
+                f"||coef||_1 * p90|dK| = {risk:.3f} > "
+                f"{BF16_RISK_THRESHOLD} (same amplification mechanism "
+                f"as training's bf16 guard, ops/kernels.py). Use "
+                f"dtype='float32' for this ensemble.",
+                stacklevel=4)
+
+    # ------------------------------------------------------------- warmup
+    def warm(self) -> dict:
+        """Pre-compile every bucket executor on zero queries so the first
+        live request never pays a compile. Returns {bucket: seconds}
+        (first-call time, i.e. compile + execute)."""
+        for bucket in self.buckets:
+            t0 = time.perf_counter()
+            self._run_bucket(np.zeros((bucket, self.d), np.float32),
+                             bucket, warm=True)
+            self.stats["warm_seconds"][bucket] = (time.perf_counter()
+                                                  - t0)
+        return dict(self.stats["warm_seconds"])
+
+    # ----------------------------------------------------------- dispatch
+    def _bucket_for(self, n: int) -> int:
+        for bucket in self.buckets:
+            if n <= bucket:
+                return bucket
+        return self.buckets[-1]
+
+    def _run_bucket(self, qb: np.ndarray, bucket: int,
+                    warm: bool = False) -> np.ndarray:
+        """One device dispatch of a bucket-shaped (bucket, d) batch ->
+        (bucket, k) float32 decision values (device columns only; f64
+        columns are overwritten by the caller on the unpadded rows)."""
+        if self._call is None:
+            return np.broadcast_to(
+                -self.ens.b, (qb.shape[0], self.k)).astype(np.float32)
+        t0 = time.perf_counter()
+        out = np.asarray(self._call(qb))
+        if not warm:
+            self.stats["bucket_seconds"][bucket].append(
+                time.perf_counter() - t0)
+        return out
+
+    def decision(self, q) -> np.ndarray:
+        """(n, k) decision columns for a query batch, synchronously,
+        through the bucketed resident executors. Device columns see the
+        queries quantized to float32 (their compute dtype); the
+        risk-routed float64 columns see the CALLER'S dtype unquantized
+        — the exact-path contract of predict.decision_function."""
+        q_in = np.asarray(q)
+        if q_in.ndim != 2 or q_in.shape[1] != self.d:
+            raise ValueError(
+                f"queries must be (n, {self.d}); got {q_in.shape}")
+        q32 = np.asarray(q_in, np.float32)
+        n = q32.shape[0]
+        out = np.empty((n, self.k), np.float32)
+        top = self.buckets[-1]
+        s = 0
+        while s < n:
+            take = min(n - s, top)
+            bucket = self._bucket_for(take)
+            qb = q32[s:s + take]
+            if take != bucket:
+                qp = np.zeros((bucket, self.d), np.float32)
+                qp[:take] = qb
+                qb = qp
+            out[s:s + take] = self._run_bucket(qb, bucket)[:take]
+            self.stats["dispatches"] += 1
+            self.stats["bucket_counts"][bucket] += 1
+            self.stats["padded_rows"] += bucket - take
+            s += take
+        self.stats["rows"] += n
+        if len(self.f64_cols):
+            self._overwrite_f64(q_in, out)
+        return out
+
+    def _overwrite_f64(self, q: np.ndarray, out: np.ndarray) -> None:
+        """Exact host float64 evaluation of the risk-routed columns
+        (predict._decision_f64's algebra via the single shared f64
+        kernel definition, solver/reconstruct.gram_matvec_f64)."""
+        from dpsvm_tpu.solver.reconstruct import gram_matvec_f64
+        q64 = np.asarray(q, np.float64)
+        for j in self.f64_cols:
+            out[:, j] = (gram_matvec_f64(self.ens.sv_union,
+                                         self.ens.coef[:, j], self.kp,
+                                         queries=q64)
+                         - float(self.ens.b[j])).astype(np.float32)
+
+    # ------------------------------------------------------------- labels
+    def labels(self, dec: np.ndarray) -> np.ndarray:
+        """Decision columns -> predicted labels (strategy-aware: OvR
+        argmax, OvO vote fold, binary sign)."""
+        if self.strategy == "binary":
+            return np.where(dec[:, 0] >= 0, 1, -1).astype(np.int32)
+        if self.strategy == "ovr":
+            return self.classes[np.argmax(dec, axis=1)]
+        return self.classes[np.argmax(
+            ovo_vote_fold(dec, len(self.classes)), axis=1)]
+
+    def predict(self, q) -> np.ndarray:
+        return self.labels(self.decision(q))
+
+    # -------------------------------------------------- micro-batch queue
+    def enqueue(self, q) -> int:
+        """Queue a request's query rows; returns its ticket. Requests
+        merge into shared bucket dispatches at the next flush() (forced
+        early when the queue crosses max_pending rows). The caller's
+        dtype is kept (float64 requests stay exact on risk-routed
+        columns; the merged batch promotes to the widest queued
+        dtype)."""
+        q = np.asarray(q)
+        if q.ndim != 2 or q.shape[1] != self.d:
+            raise ValueError(
+                f"queries must be (n, {self.d}); got {q.shape}")
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._pending.append((ticket, q))
+        self._pending_rows += q.shape[0]
+        self.stats["requests"] += 1
+        if self._pending_rows >= self.config.max_pending:
+            self._done.update(self._flush_pending())
+        return ticket
+
+    def _flush_pending(self) -> dict:
+        if not self._pending:
+            return {}
+        tickets = [t for t, _ in self._pending]
+        sizes = [r.shape[0] for _, r in self._pending]
+        merged = np.concatenate([r for _, r in self._pending])
+        self._pending.clear()
+        self._pending_rows = 0
+        dec = self.decision(merged)
+        out, s = {}, 0
+        for t, n in zip(tickets, sizes):
+            out[t] = dec[s:s + n]
+            s += n
+        return out
+
+    def flush(self) -> dict:
+        """Run everything queued (merged into bucket batches) and return
+        {ticket: (n_i, k) decision rows} for every completed request,
+        including any completed by a forced early flush."""
+        done = self._done
+        self._done = {}
+        done.update(self._flush_pending())
+        return done
+
+
+def offered_load_sweep(server: PredictServer, request_sizes,
+                       n_requests: int, group: int = 8,
+                       seed: int = 0) -> dict:
+    """Drive the server with a stream of requests and report throughput
+    and latency percentiles (overall per request, and per bucket from
+    the server's own per-dispatch timings). `group` requests arrive
+    together and share flush dispatches — the micro-batching win the
+    sweep exists to measure. Shared by `cli.py serve --server-bench`
+    and tools/bench_serve.py."""
+    rng = np.random.default_rng(seed)
+    sizes = rng.choice(np.asarray(request_sizes), n_requests)
+    lat = []
+    rows = 0
+    t_start = time.perf_counter()
+    for s in range(0, n_requests, group):
+        batch_sizes = sizes[s:s + group]
+        t0 = time.perf_counter()
+        for n in batch_sizes:
+            server.enqueue(rng.random((int(n), server.d),
+                                      dtype=np.float32))
+        server.flush()
+        t1 = time.perf_counter()
+        lat.extend([t1 - t0] * len(batch_sizes))
+        rows += int(batch_sizes.sum())
+    wall = time.perf_counter() - t_start
+
+    def pct(v):
+        v = np.asarray(v, np.float64)
+        return {"p50": round(float(np.percentile(v, 50)), 6),
+                "p95": round(float(np.percentile(v, 95)), 6),
+                "p99": round(float(np.percentile(v, 99)), 6)}
+
+    per_bucket = {}
+    for bucket, secs in server.stats["bucket_seconds"].items():
+        if secs:
+            per_bucket[str(bucket)] = {
+                "dispatches": len(secs), **pct(list(secs))}
+    return {
+        "requests": int(n_requests), "rows": int(rows), "group": group,
+        "wall_seconds": round(wall, 4),
+        "rows_per_second": round(rows / max(wall, 1e-9)),
+        "requests_per_second": round(n_requests / max(wall, 1e-9)),
+        "request_latency": pct(lat),
+        "bucket_latency": per_bucket,
+        "dispatches": server.stats["dispatches"],
+        "padded_rows": server.stats["padded_rows"],
+    }

@@ -760,3 +760,106 @@ def test_libsvm_zero_based_index_rejected(tmp_path):
     p.write_text("1 0:1.5 1:0.3\n")
     with pytest.raises(ValueError, match="1-based"):
         parse_libsvm(str(p))
+
+
+def test_serve_cli_server_bench(multi_csvs, capsys):
+    """`serve --server-bench` on a trained multiclass bundle: offered-
+    load sweep JSON on stdout, server summary on stderr."""
+    import json
+
+    train_p, _, d = multi_csvs
+    model_p = d + "/serve_mc.npz"
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    capsys.readouterr()
+    assert main(["serve", "-m", model_p, "--buckets", "16,64",
+                 "--server-bench", "--requests", "24"]) == 0
+    cap = capsys.readouterr()
+    assert "server ready" in cap.err and "SV union" in cap.err
+    rec = json.loads(cap.out)
+    assert rec["requests"] == 24
+    assert rec["rows_per_second"] > 0
+    assert {"p50", "p95", "p99"} <= set(rec["request_latency"])
+
+
+def test_serve_cli_stdin_loop(multi_csvs, capsys, monkeypatch):
+    """Default serve mode: feature rows on stdin -> one label per line,
+    micro-batched through the pre-compiled buckets."""
+    import io
+
+    train_p, test_p, d = multi_csvs
+    model_p = d + "/serve_mc2.npz"
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    from dpsvm_tpu.data.loader import load_csv
+    from dpsvm_tpu.models.multiclass import (MulticlassSVM,
+                                             predict_multiclass)
+    x, y = load_csv(test_p)
+    lines = "\n".join(",".join(repr(float(v)) for v in row)
+                      for row in x[:10]) + "\n"
+    capsys.readouterr()
+    monkeypatch.setattr("sys.stdin", io.StringIO(lines))
+    assert main(["serve", "-m", model_p, "--buckets", "16"]) == 0
+    cap = capsys.readouterr()
+    got = np.asarray([int(t) for t in cap.out.split()])
+    want = predict_multiclass(MulticlassSVM.load(model_p), x[:10])
+    np.testing.assert_array_equal(got, want)
+    assert "served 10 rows" in cap.err
+
+
+def test_serve_cli_rejects_unservable_model(tmp_path, capsys):
+    p = str(tmp_path / "svr.npz")
+    np.savez_compressed(p, model_type="svr")
+    assert main(["serve", "-m", p]) == 2
+    assert "cannot serve a svr model" in capsys.readouterr().err
+
+
+def test_test_cli_precision_flag(csvs, capsys):
+    """test --precision float64 runs the exact host path; --precision
+    auto on an extreme-|coef| model prints the routing note (the
+    PARITY.md footgun made opt-out)."""
+    train_p, test_p, d = csvs
+    model_p = d + "/prec.npz"
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    capsys.readouterr()
+    assert main(["test", "-f", test_p, "-m", model_p,
+                 "--precision", "float64"]) == 0
+    acc64 = float(capsys.readouterr().out
+                  .split("test accuracy: ")[1].split()[0])
+    assert acc64 > 0.85
+
+    # Hand-build an extreme-|coef| model: auto must announce f64 routing.
+    from dpsvm_tpu.models.svm_model import SVMModel
+    from dpsvm_tpu.ops.kernels import KernelParams
+    rng = np.random.default_rng(0)
+    big = SVMModel(
+        sv_x=rng.normal(size=(600, 12)).astype(np.float32),
+        sv_alpha=(rng.random(600).astype(np.float32) + 0.01) * 6e5,
+        sv_y=np.where(rng.random(600) < 0.5, 1, -1).astype(np.int32),
+        b=0.0, kernel=KernelParams("rbf", 0.1))
+    big_p = d + "/big.npz"
+    big.save(big_p)
+    assert main(["test", "-f", test_p, "-m", big_p]) == 0
+    cap = capsys.readouterr()
+    assert "exact float64 evaluation" in cap.err
+    capsys.readouterr()
+    assert main(["test", "-f", test_p, "-m", big_p,
+                 "--precision", "float32"]) == 0
+    assert "float64" not in capsys.readouterr().err
+
+
+def test_test_cli_precision_rejected_for_multiclass(multi_csvs, capsys):
+    """--precision (non-auto) on a multiclass bundle fails loudly — the
+    wiring lives on the binary path only (the same convention as -g and
+    -b 1 on inapplicable models)."""
+    train_p, test_p, d = multi_csvs
+    model_p = d + "/prec_mc.npz"
+    assert main(["train", "-f", train_p, "-m", model_p, "-c", "5",
+                 "-g", "0.1", "--backend", "single", "-q"]) == 0
+    capsys.readouterr()
+    assert main(["test", "-f", test_p, "-m", model_p,
+                 "--precision", "float64"]) == 2
+    assert "--precision float64 applies to binary" \
+        in capsys.readouterr().err
+    assert main(["test", "-f", test_p, "-m", model_p]) == 0  # auto OK

@@ -1,0 +1,379 @@
+"""Shared-SV compacted multiclass inference (models/multiclass.py
+CompactedEnsemble).
+
+The contract under test: the compacted path evaluates ONE kernel matmul
+against the SV union per query block (HLO-pinned) and is BIT-IDENTICAL
+to the replicated stacked path on shared-kernel ensembles — the exact
+contraction gathers each submodel's kernel values back into its own SV
+order, so the per-model reduction sums identical terms in identical
+order (pad slots are exact +0.0 in both paths)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.models.multiclass import (
+    CompactedEnsemble,
+    MulticlassSVM,
+    _STACK_MEMO,
+    compact_models,
+    decision_matrix,
+    predict_multiclass,
+    train_multiclass,
+    vote_matrix,
+)
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+
+CFG = SVMConfig(c=5.0, gamma=0.2, epsilon=1e-3, max_iter=100_000,
+                chunk_iters=256)
+
+
+@pytest.fixture(scope="module")
+def four_class():
+    rng = np.random.default_rng(23)
+    xs, ys = [], []
+    for k in range(4):
+        c = np.zeros(6, np.float32)
+        c[k] = 2.2
+        xs.append(rng.normal(size=(90, 6)).astype(np.float32) * 0.8 + c)
+        ys.append(np.full(90, k + 7))  # non-contiguous labels on purpose
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+@pytest.fixture(scope="module", params=["ovr", "ovo"])
+def trained(request, four_class):
+    x, y = four_class
+    m, _ = train_multiclass(x[:300], y[:300], CFG,
+                            strategy=request.param)
+    return m, x
+
+
+def _hand_model(rows, coefs, b, kp, rng):
+    rows = np.asarray(rows, np.float32)
+    coefs = np.asarray(coefs, np.float32)
+    y = np.where(rng.random(len(coefs)) < 0.5, 1, -1).astype(np.int32)
+    return SVMModel(sv_x=rows, sv_alpha=np.abs(coefs), sv_y=y,
+                    b=float(b), kernel=kp)
+
+
+# ------------------------------------------------------------- bit parity
+
+def test_compacted_bit_identical_to_stacked(trained):
+    m, x = trained
+    q = np.asarray(x[280:420], np.float32)
+    a = decision_matrix(m, q, path="stacked")
+    b = decision_matrix(m, q, path="compacted")
+    np.testing.assert_array_equal(a, b)
+    # ...and the auto route IS the compacted path for shared kernels.
+    np.testing.assert_array_equal(decision_matrix(m, q), b)
+
+
+def test_compacted_dedup_is_real(trained):
+    m, _ = trained
+    ens = m.compacted
+    assert ens is not None
+    total = sum(mm.n_sv for mm in m.models)
+    assert ens.n_union < total  # submodels genuinely share rows
+    assert int(ens.counts.sum()) == total
+    # The dense coefficient matrix scatters exactly the per-model coefs.
+    assert np.count_nonzero(ens.coef) <= total
+
+
+def test_compacted_blocked_queries_bit_identical(trained):
+    m, x = trained
+    q = np.asarray(x[:100], np.float32)
+    np.testing.assert_array_equal(
+        decision_matrix(m, q, block=16, path="compacted"),
+        decision_matrix(m, q, path="stacked"))
+
+
+def test_union_order_training_matrix_vs_byte_fallback(trained):
+    """Compaction with and without the training matrix may order the
+    union differently, but decisions are bit-identical either way (the
+    gather re-establishes per-model order)."""
+    m, x = trained
+    with_x = compact_models(m.models, x_train=x[:300])
+    without = compact_models(m.models)
+    assert with_x.n_union == without.n_union
+    me_with = MulticlassSVM(classes=m.classes, models=m.models,
+                            strategy=m.strategy, compacted=with_x)
+    me_wo = MulticlassSVM(classes=m.classes, models=m.models,
+                          strategy=m.strategy, compacted=without)
+    q = np.asarray(x[:64], np.float32)
+    np.testing.assert_array_equal(
+        decision_matrix(me_with, q, path="compacted"),
+        decision_matrix(me_wo, q, path="compacted"))
+
+
+@pytest.mark.parametrize("kind,kw", [("linear", {}),
+                                     ("poly", {"degree": 3, "coef0": 1.0}),
+                                     ("sigmoid", {"coef0": 0.5})])
+def test_compacted_parity_other_kernels(kind, kw):
+    rng = np.random.default_rng(5)
+    kp = KernelParams(kind=kind, gamma=0.3, **kw)
+    pool = rng.normal(size=(80, 7)).astype(np.float32)
+    models = []
+    for j in range(5):
+        idx = np.sort(rng.choice(80, 30 + 5 * j, replace=False))
+        models.append(_hand_model(pool[idx], rng.normal(size=len(idx)),
+                                  rng.normal() * 0.1, kp, rng))
+    m = MulticlassSVM(classes=np.arange(5), models=models,
+                      strategy="ovr")
+    q = rng.normal(size=(33, 7)).astype(np.float32)
+    np.testing.assert_array_equal(decision_matrix(m, q, path="stacked"),
+                                  decision_matrix(m, q, path="compacted"))
+
+
+# ------------------------------------------------- degenerate submodels
+
+def test_empty_sv_submodel():
+    """A submodel that converged to zero SVs (degenerate split) must
+    compact and evaluate: its column is exactly -b."""
+    rng = np.random.default_rng(9)
+    kp = KernelParams("rbf", 0.25)
+    pool = rng.normal(size=(40, 5)).astype(np.float32)
+    empty = SVMModel(sv_x=np.zeros((0, 5), np.float32),
+                     sv_alpha=np.zeros((0,), np.float32),
+                     sv_y=np.zeros((0,), np.int32), b=0.37, kernel=kp)
+    full = _hand_model(pool[:20], rng.normal(size=20), -0.1, kp, rng)
+    m = MulticlassSVM(classes=np.arange(2), models=[empty, full],
+                      strategy="ovr")
+    q = rng.normal(size=(17, 5)).astype(np.float32)
+    dec = decision_matrix(m, q, path="compacted")
+    np.testing.assert_array_equal(dec[:, 0],
+                                  np.full(17, -0.37, np.float32))
+    np.testing.assert_array_equal(dec,
+                                  decision_matrix(m, q, path="stacked"))
+
+
+def test_all_empty_ensemble():
+    kp = KernelParams("rbf", 0.25)
+    models = [SVMModel(sv_x=np.zeros((0, 4), np.float32),
+                       sv_alpha=np.zeros((0,), np.float32),
+                       sv_y=np.zeros((0,), np.int32), b=b0, kernel=kp)
+              for b0 in (0.5, -0.25, 0.0)]
+    m = MulticlassSVM(classes=np.arange(3), models=models,
+                      strategy="ovr")
+    ens = m.ensure_compacted()
+    assert ens.n_union == 0
+    dec = decision_matrix(m, np.zeros((6, 4), np.float32),
+                          path="compacted")
+    np.testing.assert_array_equal(
+        dec, np.broadcast_to([-0.5, 0.25, 0.0],
+                             (6, 3)).astype(np.float32))
+
+
+def test_duplicate_rows_within_one_model():
+    """Byte-identical duplicate SV rows inside ONE model: the dense
+    coefficient matrix accumulates them, the exact gather keeps them
+    separate — both must match the stacked evaluation."""
+    rng = np.random.default_rng(3)
+    kp = KernelParams("rbf", 0.5)
+    row = rng.normal(size=(1, 6)).astype(np.float32)
+    rows = np.concatenate([row, row, rng.normal(size=(3, 6))
+                           .astype(np.float32)])
+    ma = _hand_model(rows, rng.normal(size=5), 0.1, kp, rng)
+    mb = _hand_model(rows[1:], rng.normal(size=4), -0.2, kp, rng)
+    m = MulticlassSVM(classes=np.arange(2), models=[ma, mb],
+                      strategy="ovr")
+    assert m.ensure_compacted().n_union == 4  # 5+4 rows -> 4 unique
+    q = rng.normal(size=(11, 6)).astype(np.float32)
+    np.testing.assert_array_equal(decision_matrix(m, q, path="stacked"),
+                                  decision_matrix(m, q, path="compacted"))
+
+
+def test_mixed_kernels_fall_back_per_model():
+    rng = np.random.default_rng(4)
+    pool = rng.normal(size=(30, 5)).astype(np.float32)
+    ma = _hand_model(pool[:10], rng.normal(size=10), 0.0,
+                     KernelParams("rbf", 0.5), rng)
+    mb = _hand_model(pool[10:20], rng.normal(size=10), 0.0,
+                     KernelParams("linear", 1.0), rng)
+    m = MulticlassSVM(classes=np.arange(2), models=[ma, mb],
+                      strategy="ovr")
+    assert m.ensure_compacted() is None
+    q = rng.normal(size=(8, 5)).astype(np.float32)
+    dec = decision_matrix(m, q)  # auto -> per-model loop
+    assert dec.shape == (8, 2)
+    with pytest.raises(ValueError):
+        decision_matrix(m, q, path="compacted")
+    with pytest.raises(ValueError):
+        decision_matrix(m, q, path="stacked")
+
+
+# ------------------------------------------------- format v2 round-trip
+
+def test_roundtrip_v2_persists_compaction(trained, tmp_path):
+    m, x = trained
+    p = str(tmp_path / "mc2.npz")
+    m.save(p)
+    z = np.load(p)
+    assert int(z["format_version"]) == 2
+    assert "c_sv_union" in z and "c_coef" in z and "c_idx" in z
+    m2 = MulticlassSVM.load(p)
+    assert m2.compacted is not None
+    np.testing.assert_array_equal(m2.compacted.sv_union,
+                                  m.compacted.sv_union)
+    np.testing.assert_array_equal(m2.compacted.coef, m.compacted.coef)
+    q = np.asarray(x[:50], np.float32)
+    np.testing.assert_array_equal(decision_matrix(m2, q),
+                                  decision_matrix(m, q))
+
+
+def test_loads_v1_file_and_rebuilds_compaction(trained, tmp_path):
+    """A pre-compaction (format_version 1) bundle — per-model fields
+    only — must load and rebuild the compaction at load time, with
+    bit-identical decisions."""
+    m, x = trained
+    payload = {
+        "format_version": 1, "model_type": "multiclass",
+        "strategy": m.strategy, "classes": m.classes,
+        "n_models": len(m.models),
+    }
+    for i, mm in enumerate(m.models):  # the v1 writer's field set
+        payload[f"m{i}_sv_x"] = mm.sv_x
+        payload[f"m{i}_sv_alpha"] = mm.sv_alpha
+        payload[f"m{i}_sv_y"] = mm.sv_y
+        payload[f"m{i}_b"] = np.float32(mm.b)
+        payload[f"m{i}_kernel_kind"] = mm.kernel.kind
+        payload[f"m{i}_gamma"] = np.float32(mm.kernel.gamma)
+        payload[f"m{i}_degree"] = np.int32(mm.kernel.degree)
+        payload[f"m{i}_coef0"] = np.float32(mm.kernel.coef0)
+    p = str(tmp_path / "mc1.npz")
+    np.savez_compressed(p, **payload)
+    m1 = MulticlassSVM.load(p)
+    assert m1.compacted is not None  # rebuilt at load
+    q = np.asarray(x[:50], np.float32)
+    np.testing.assert_array_equal(decision_matrix(m1, q),
+                                  decision_matrix(m, q))
+    np.testing.assert_array_equal(predict_multiclass(m1, q),
+                                  predict_multiclass(m, q))
+
+
+# ------------------------------------- Platt / vote consumers unchanged
+
+def test_vote_matrix_through_compacted(four_class):
+    x, y = four_class
+    m, _ = train_multiclass(x[:300], y[:300], CFG, strategy="ovo")
+    q = np.asarray(x[300:], np.float32)
+    np.testing.assert_array_equal(vote_matrix(m, q, path="compacted"),
+                                  vote_matrix(m, q, path="stacked"))
+    pred = predict_multiclass(m, q)
+    assert set(np.unique(pred)) <= set(m.classes.tolist())
+    assert float(np.mean(pred == y[300:])) > 0.8
+
+
+def test_platt_proba_through_compacted(four_class):
+    from dpsvm_tpu.estimators import SVC
+    x, y = four_class
+    clf = SVC(C=5.0, gamma=0.2, probability=True,
+              random_state=0).fit(x[:240], y[:240])
+    p = clf.predict_proba(x[240:300])
+    assert p.shape == (60, 4)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-9)
+    assert np.isfinite(p).all()
+    # Probability argmax should mostly agree with the raw prediction.
+    lab = clf.classes_[np.argmax(p, axis=1)]
+    assert float(np.mean(lab == clf.predict(x[240:300]))) > 0.9
+
+
+# --------------------------------------------------------- HLO structure
+
+def test_hlo_one_kernel_matmul_per_query_block(trained):
+    """The compacted executor must contain exactly ONE feature-dim
+    kernel matmul — the (nb, S, d) union product — and NO rank-3
+    batched (k, nb, m_pad, d) product (the stacked path's shape). The
+    coefficient contraction is the only other dot. Structure facts of
+    the compiled program, platform-independent (the
+    test_hlo_collectives.py discipline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.models.multiclass import _compacted_batch_factory
+
+    m, x = trained
+    ens = m.compacted
+    k, m_pad = ens.idx.shape
+    nb, d = 64, ens.sv_union.shape[1]
+    sds = jax.ShapeDtypeStruct
+    text = _compacted_batch_factory().lower(
+        sds((nb, d), jnp.float32),
+        sds((ens.sv_union.shape[0], d), jnp.float32),
+        sds((k, m_pad), jnp.float32),
+        sds((k, m_pad), jnp.int32),
+        sds((k,), jnp.float32),
+        kp=ens.kernel,
+    ).compile().as_text()
+
+    dots = [ln for ln in text.splitlines()
+            if re.search(r"= *[a-z0-9]+\[[^\]]*\][^=]* dot\(", ln)]
+    # THE kernel matmul = the dot producing the (nb, S) kernel tile
+    # (either orientation; S includes the trailing pad row). The
+    # row-norm einsums also lower to dots but produce rank-1 results;
+    # the coefficient contraction produces (k, nb).
+    s_union = ens.sv_union.shape[0]
+    ker = [ln for ln in dots
+           if re.search(rf"= *f32\[({nb},{s_union}|{s_union},{nb})\]",
+                        ln)]
+    assert len(ker) == 1, ker or text[:2000]
+    # No replicated stack product anywhere: a rank-3 (*, m_pad, d)
+    # operand would be the stacked path's shape.
+    assert not re.search(rf"f32\[\d+,{m_pad},{d}\]", text)
+    # Kernel matmul + coefficient contraction + at most the two
+    # row-norm reductions.
+    assert len(dots) <= 4, dots
+
+
+# ----------------------------------------------------- stacked-path memo
+
+def test_stacked_decision_memoizes_device_stack(trained):
+    """Repeated stacked-path calls on the same models must upload the
+    (k, m_pad, d) stack ONCE (content-fingerprint memo, the _XDEV_MEMO
+    discipline) — the fallback path stays honest in serving A/Bs."""
+    import jax
+
+    m, x = trained
+    q = np.asarray(x[:40], np.float32)
+    calls = {"n": 0}
+    orig = jax.device_put
+
+    def counting(v, *a, **kw):
+        # Count host-ndarray uploads only (see
+        # test_pad_bucketing.test_xdev_memo_reuses_across_solves).
+        if isinstance(v, np.ndarray) and v.ndim == 3:
+            calls["n"] += 1
+        return orig(v, *a, **kw)
+
+    _STACK_MEMO.clear()
+    jax.device_put = counting
+    try:
+        decision_matrix(m, q, path="stacked")
+        decision_matrix(m, q[:16], path="stacked")
+        decision_matrix(m, q, path="stacked")
+        assert calls["n"] == 1
+    finally:
+        jax.device_put = orig
+        _STACK_MEMO.clear()
+
+
+def test_stacked_memo_rebuilds_on_mutation(trained):
+    """In-place mutation of a submodel's SVs must invalidate the memo
+    (fingerprint mismatch), not serve stale rows."""
+    m, x = trained
+    q = np.asarray(x[:24], np.float32)
+    _STACK_MEMO.clear()
+    before = decision_matrix(m, q, path="stacked")
+    mm = m.models[0]
+    old = mm.sv_x.copy()
+    try:
+        mm.sv_x *= 2.0  # identity-preserving in-place rescale
+        after = decision_matrix(m, q, path="stacked")
+        assert not np.array_equal(before, after)
+    finally:
+        mm.sv_x[:] = old
+        _STACK_MEMO.clear()

@@ -1,0 +1,288 @@
+"""Serving engine unit tests (dpsvm_tpu/serve.py PredictServer):
+bucket routing, startup warm-up, micro-batch merging, decision_risk
+float64 auto-routing, bf16 storage guard, and the mesh-sharded union."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig, ServeConfig
+from dpsvm_tpu.models.multiclass import (MulticlassSVM, decision_matrix,
+                                         predict_multiclass,
+                                         train_multiclass)
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.serve import PredictServer, offered_load_sweep
+
+CFG = SVMConfig(c=5.0, gamma=0.25, epsilon=1e-3, chunk_iters=256)
+
+
+@pytest.fixture(scope="module")
+def three_class():
+    rng = np.random.default_rng(31)
+    xs, ys = [], []
+    for k in range(3):
+        c = np.zeros(5, np.float32)
+        c[k] = 2.5
+        xs.append(rng.normal(size=(70, 5)).astype(np.float32) * 0.7 + c)
+        ys.append(np.full(70, k))
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+@pytest.fixture(scope="module", params=["ovr", "ovo"])
+def served(request, three_class):
+    x, y = three_class
+    m, _ = train_multiclass(x, y, CFG, strategy=request.param)
+    return m, x
+
+
+def _binary_model(n_sv=40, d=6, coef_scale=1.0, seed=0):
+    rng = np.random.default_rng(seed)
+    return SVMModel(
+        sv_x=rng.normal(size=(n_sv, d)).astype(np.float32),
+        sv_alpha=(rng.random(n_sv).astype(np.float32) + 0.01)
+        * coef_scale,
+        sv_y=np.where(rng.random(n_sv) < 0.5, 1, -1).astype(np.int32),
+        b=0.05, kernel=KernelParams("rbf", 0.3))
+
+
+# -------------------------------------------------------------- routing
+
+def test_decision_matches_model_layer(served):
+    m, x = served
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64)))
+    q = np.asarray(x[:50], np.float32)
+    np.testing.assert_allclose(srv.decision(q), decision_matrix(m, q),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(srv.predict(q),
+                                  predict_multiclass(m, q))
+
+
+def test_bucket_routing(served):
+    m, x = served
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64)))
+    srv.decision(np.asarray(x[:5], np.float32))
+    assert srv.stats["bucket_counts"] == {16: 1, 64: 0}
+    assert srv.stats["padded_rows"] == 11
+    srv.decision(np.asarray(x[:40], np.float32))
+    assert srv.stats["bucket_counts"] == {16: 1, 64: 1}
+    # Beyond the largest bucket: loop over it (64 + 64, second padded).
+    srv.decision(np.asarray(x[:100], np.float32))
+    assert srv.stats["bucket_counts"] == {16: 1, 64: 3}
+    assert srv.stats["rows"] == 145
+
+
+def test_warm_start_precompiles_every_bucket(served):
+    from dpsvm_tpu.serve import _dense_batch_factory
+    m, x = served
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64, 128)))
+    assert sorted(srv.stats["warm_seconds"]) == [16, 64, 128]
+    # The warm-up's whole point: live requests never trace/compile a
+    # new executor — every bucket shape is already in the jit cache.
+    fn = _dense_batch_factory()
+    before = fn._cache_size()
+    srv.decision(np.asarray(x[:10], np.float32))
+    srv.decision(np.asarray(x[:60], np.float32))
+    srv.decision(np.asarray(x[:100], np.float32))
+    assert fn._cache_size() == before
+    assert srv.stats["dispatches"] == 3
+
+
+def test_rejects_wrong_width(served):
+    m, _ = served
+    srv = PredictServer(m, ServeConfig(buckets=(16,), warm_start=False))
+    with pytest.raises(ValueError):
+        srv.decision(np.zeros((4, 3), np.float32))
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError):
+        ServeConfig(buckets=(24,))  # not a power of two
+    with pytest.raises(ValueError):
+        ServeConfig(buckets=(64, 16))  # not ascending
+    with pytest.raises(ValueError):
+        ServeConfig(dtype="float16")
+    with pytest.raises(ValueError):
+        ServeConfig(max_pending=8, buckets=(16,))
+
+
+# ---------------------------------------------------------- micro-batch
+
+def test_micro_batch_merges_requests(served):
+    m, x = served
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64)))
+    q = np.asarray(x[:14], np.float32)
+    full = srv.decision(q)
+    d0 = dict(srv.stats)
+    t1 = srv.enqueue(q[:3])
+    t2 = srv.enqueue(q[3:8])
+    t3 = srv.enqueue(q[8:14])
+    out = srv.flush()
+    # Three requests, ONE merged bucket dispatch.
+    assert srv.stats["dispatches"] == d0["dispatches"] + 1
+    assert srv.stats["requests"] == 3
+    np.testing.assert_array_equal(out[t1], full[:3])
+    np.testing.assert_array_equal(out[t2], full[3:8])
+    np.testing.assert_array_equal(out[t3], full[8:14])
+    assert srv.flush() == {}  # queue drained
+
+
+def test_max_pending_forces_flush(served):
+    m, x = served
+    srv = PredictServer(m, ServeConfig(buckets=(16,), max_pending=16))
+    q = np.asarray(x[:12], np.float32)
+    srv.enqueue(q)
+    d = srv.stats["dispatches"]
+    srv.enqueue(q)  # crosses 16 pending rows -> forced early flush
+    assert srv.stats["dispatches"] > d
+    out = srv.flush()
+    assert sorted(out) == [0, 1]
+    np.testing.assert_array_equal(out[0], out[1])
+
+
+# --------------------------------------------------------- f64 routing
+
+def test_f64_auto_routing_extreme_coef():
+    """A model whose decision_risk crosses the threshold must be served
+    from the exact host float64 path — its decisions match
+    predict.decision_function(precision='float64') and NOT the noisy
+    fp32 evaluation."""
+    from dpsvm_tpu.predict import decision_function, decision_risk
+
+    big = _binary_model(n_sv=600, d=8, coef_scale=6e5, seed=2)
+    assert decision_risk(big) >= 0.1
+    srv = PredictServer(big, ServeConfig(buckets=(32,)))
+    assert srv.stats["f64_columns"] == 1
+    rng = np.random.default_rng(1)
+    q = rng.normal(size=(20, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        srv.decision(q)[:, 0],
+        decision_function(big, q, precision="float64").astype(
+            np.float32), rtol=1e-6)
+    # Forcing float32 serves the device path instead.
+    srv32 = PredictServer(big, ServeConfig(buckets=(32,),
+                                           precision="float32"))
+    assert srv32.stats["f64_columns"] == 0
+
+
+def test_moderate_model_stays_on_device(served):
+    m, _ = served
+    srv = PredictServer(m, ServeConfig(buckets=(16,)))
+    assert srv.stats["f64_columns"] == 0
+
+
+def test_binary_model_labels(three_class):
+    from dpsvm_tpu.predict import predict as predict_binary
+    from dpsvm_tpu.solver.smo import solve
+    x, y = three_class
+    y_pm = np.where(y == 1, 1, -1).astype(np.int32)
+    res = solve(x, y_pm, CFG)
+    model = SVMModel.from_dense(x, y_pm, res.alpha, res.b,
+                                KernelParams("rbf", 0.25))
+    srv = PredictServer(model, ServeConfig(buckets=(64, 256)))
+    np.testing.assert_array_equal(srv.predict(x),
+                                  predict_binary(model, x))
+
+
+# ------------------------------------------------------------- bf16
+
+def test_bf16_storage_close_and_guarded(served):
+    m, x = served
+    q = np.asarray(x[:30], np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # moderate coefs: no warning
+        srv = PredictServer(m, ServeConfig(buckets=(32,),
+                                           dtype="bfloat16"))
+    np.testing.assert_allclose(srv.decision(q), decision_matrix(m, q),
+                               rtol=0.05, atol=0.05)
+
+
+def test_bf16_guard_warns_on_risky_coefficients():
+    big = _binary_model(n_sv=500, d=8, coef_scale=100.0, seed=4)
+    with pytest.warns(UserWarning, match="bfloat16"):
+        PredictServer(big, ServeConfig(buckets=(16,),
+                                       precision="float32",
+                                       dtype="bfloat16",
+                                       warm_start=False))
+
+
+# --------------------------------------------------------------- mesh
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_mesh_sharded_union_matches_single(served, n_dev):
+    m, x = served
+    q = np.asarray(x[:40], np.float32)
+    srv = PredictServer(m, ServeConfig(buckets=(64,),
+                                       num_devices=n_dev))
+    np.testing.assert_allclose(srv.decision(q), decision_matrix(m, q),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(srv.predict(q),
+                                  predict_multiclass(m, q))
+
+
+# -------------------------------------------------------------- sweep
+
+def test_offered_load_sweep_shape(served):
+    m, _ = served
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64)))
+    rec = offered_load_sweep(srv, [1, 4, 16], 24, group=6)
+    assert rec["requests"] == 24
+    assert rec["rows_per_second"] > 0
+    for key in ("p50", "p95", "p99"):
+        assert rec["request_latency"][key] >= 0
+    assert rec["bucket_latency"]  # at least one bucket saw dispatches
+
+
+def test_all_empty_ensemble_served():
+    kp = KernelParams("rbf", 0.25)
+    models = [SVMModel(sv_x=np.zeros((0, 4), np.float32),
+                       sv_alpha=np.zeros((0,), np.float32),
+                       sv_y=np.zeros((0,), np.int32), b=b0, kernel=kp)
+              for b0 in (0.5, -0.25)]
+    m = MulticlassSVM(classes=np.arange(2), models=models,
+                      strategy="ovr")
+    srv = PredictServer(m, ServeConfig(buckets=(16,)))
+    dec = srv.decision(np.zeros((3, 4), np.float32))
+    np.testing.assert_array_equal(
+        dec, np.broadcast_to([-0.5, 0.25], (3, 2)).astype(np.float32))
+
+
+def test_bucket_cap_trims_oversized_buckets(served, monkeypatch):
+    """The per-dispatch kernel tile is budget-bounded: buckets whose
+    (bucket, S) tile would cross the budget are trimmed at construction
+    (a covtype-scale union must not OOM during warm-up)."""
+    import dpsvm_tpu.serve as serve_mod
+    m, _ = served
+    s_rows = int(m.compacted.sv_union.shape[0])
+    # Shrink the budget so only buckets <= 32 survive for THIS union.
+    monkeypatch.setattr(serve_mod, "_TILE_BUDGET_ELEMS", s_rows * 32)
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64, 4096)))
+    assert srv.buckets == (16,)
+    assert sorted(srv.stats["warm_seconds"]) == [16]
+    # Still serves batches beyond the trimmed top bucket (loops it).
+    dec = srv.decision(np.zeros((40, srv.d), np.float32))
+    assert dec.shape == (40, srv.k)
+    assert srv.stats["bucket_counts"][16] == 3
+
+
+def test_f64_routed_columns_see_unquantized_queries():
+    """The exact-path contract (predict.py: no fp32 quantization of the
+    queries) holds through the server: float64 queries reach the
+    risk-routed columns unrounded."""
+    from dpsvm_tpu.predict import decision_function
+
+    big = _binary_model(n_sv=600, d=8, coef_scale=6e5, seed=2)
+    srv = PredictServer(big, ServeConfig(buckets=(32,),
+                                         warm_start=False))
+    rng = np.random.default_rng(3)
+    # Queries with structure below f32 resolution: exact evaluation at
+    # the raw f64 values differs from the f32-rounded ones.
+    q64 = (rng.normal(size=(16, 8)) * (1 + 1e-9)).astype(np.float64)
+    want = decision_function(big, q64, precision="float64")
+    np.testing.assert_allclose(srv.decision(q64)[:, 0], want,
+                               rtol=1e-6)
+    t = srv.enqueue(q64)  # the queue keeps the caller's dtype too
+    np.testing.assert_allclose(srv.flush()[t][:, 0], want, rtol=1e-6)

@@ -1,0 +1,260 @@
+"""Real-dataset recipe: download, verify, convert — one command.
+
+The parity/bench claims about "MNIST-shaped" and "covtype-shaped" runs
+use synthetic stand-ins because this environment ships no datasets and
+(usually) no egress (VERDICT gap 1). This tool makes the REAL runs one
+command away the day egress is available:
+
+    python tools/fetch_real_data.py            # fetch + verify + convert
+    python tools/fetch_real_data.py --check    # report what's present
+    make fetch_real_data
+
+Per dataset it downloads the upstream files, verifies sha256 checksums,
+runs the existing converters (dpsvm_tpu/data/converters.py) into the
+reference CSV formats under data/, and exits 0 with a clean SKIP
+message when the network is unreachable — so CI and cron runs never
+fail on a sealed environment. Consumers activate their real-data legs
+only when the converted files exist (tests/test_real_data.py skips
+cleanly otherwise — the same contract as the TPU-reachability
+preflight).
+
+Checksum policy: pins marked RECORD_ON_FIRST_FETCH could not be
+verified from inside this sealed environment; the first fetch PRINTS
+the observed sha256 and refuses to report the file VERIFIED until the
+value is committed here. MNIST's pins are the widely mirrored ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import hashlib
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DATA = os.path.join(REPO, "data")
+RAW = os.path.join(DATA, "raw")
+
+RECORD_ON_FIRST_FETCH = None  # sentinel: pin after the first real fetch
+
+# (url, sha256-or-None). MNIST via the ossci S3 mirror (the original
+# yann.lecun.com host 403s unauthenticated fetches); covtype from UCI;
+# Adult a9a from the LIBSVM dataset page (reference Makefile:83 shape).
+SOURCES = {
+    "mnist-train-images": (
+        "https://ossci-datasets.s3.amazonaws.com/mnist/"
+        "train-images-idx3-ubyte.gz",
+        "440fcabf73cc546fa21475e81ea370265605f56be210a4024d2ca8f203523609"),
+    "mnist-train-labels": (
+        "https://ossci-datasets.s3.amazonaws.com/mnist/"
+        "train-labels-idx1-ubyte.gz",
+        "3552534a0a558bbed6aed32b30c495cca23d567ec52cac8be1a0730e8010255c"),
+    "mnist-test-images": (
+        "https://ossci-datasets.s3.amazonaws.com/mnist/"
+        "t10k-images-idx3-ubyte.gz",
+        "8d422c7b0a1c1c79245a5bcf07fe86e33eeafee792b84584aec276f5a2dbc4e6"),
+    "mnist-test-labels": (
+        "https://ossci-datasets.s3.amazonaws.com/mnist/"
+        "t10k-labels-idx1-ubyte.gz",
+        "f7ae60f92e00ec6debd23a6088c31dbd2371eca3ffa0defaefb259924204aec6"),
+    "covtype": (
+        "https://archive.ics.uci.edu/static/public/31/covertype.zip",
+        RECORD_ON_FIRST_FETCH),
+    "adult-a9a-train": (
+        "https://www.csie.ntu.edu.tw/~cjlin/libsvmtools/datasets/"
+        "binary/a9a",
+        RECORD_ON_FIRST_FETCH),
+    "adult-a9a-test": (
+        "https://www.csie.ntu.edu.tw/~cjlin/libsvmtools/datasets/"
+        "binary/a9a.t",
+        RECORD_ON_FIRST_FETCH),
+}
+
+# Converted artifacts (the files consumers gate on).
+CONVERTED = {
+    "mnist_odd_even_train": os.path.join(DATA, "mnist_odd_even_train.csv"),
+    "mnist_odd_even_test": os.path.join(DATA, "mnist_odd_even_test.csv"),
+    "mnist_digits_train": os.path.join(DATA, "mnist_digits_train.csv"),
+    "mnist_digits_test": os.path.join(DATA, "mnist_digits_test.csv"),
+    "covtype_multiclass": os.path.join(DATA, "covtype_multiclass.csv"),
+    "covtype_binary": os.path.join(DATA, "covtype_binary.csv"),
+    "adult_train": os.path.join(DATA, "adult_train.csv"),
+    "adult_test": os.path.join(DATA, "adult_test.csv"),
+}
+
+
+def real_data_available(*names: str) -> bool:
+    """Whether the named converted artifacts (default: any) exist —
+    THE gate consumers use to activate real-data legs."""
+    paths = ([CONVERTED[n] for n in names] if names
+             else list(CONVERTED.values()))
+    return all(os.path.exists(p) for p in paths)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fetch(name: str, timeout: float) -> str | None:
+    """Download + checksum one source into data/raw. Returns the local
+    path, or None on a (clean-skip) network failure; raises on a
+    checksum MISMATCH (corrupt download is an error, not a skip)."""
+    url, want = SOURCES[name]
+    os.makedirs(RAW, exist_ok=True)
+    local = os.path.join(RAW, url.rsplit("/", 1)[-1])
+    if not os.path.exists(local):
+        tmp = local + ".part"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as fh:
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    fh.write(chunk)
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            print(f"  SKIP {name}: {url} unreachable ({e})")
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            return None
+        os.replace(tmp, local)
+    got = _sha256(local)
+    if want is RECORD_ON_FIRST_FETCH:
+        print(f"  FETCHED {name}: sha256 {got} is UNPINNED — verify it "
+              f"out-of-band and commit it in SOURCES[{name!r}] before "
+              "publishing numbers from this file")
+    elif got != want:
+        raise RuntimeError(
+            f"{name}: sha256 mismatch for {local}\n  want {want}\n"
+            f"  got  {got}\n(corrupt or tampered download; delete the "
+            "file and re-fetch)")
+    else:
+        print(f"  VERIFIED {name}: sha256 {got[:16]}…")
+    return local
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an (gzipped) IDX file — the MNIST container format."""
+    with gzip.open(path, "rb") as fh:
+        raw = fh.read()
+    magic = int.from_bytes(raw[0:4], "big")
+    ndim = magic & 0xFF
+    dims = [int.from_bytes(raw[4 + 4 * i:8 + 4 * i], "big")
+            for i in range(ndim)]
+    return (np.frombuffer(raw, np.uint8, offset=4 + 4 * ndim)
+            .reshape(dims))
+
+
+def _write_csv(path: str, x: np.ndarray, y: np.ndarray) -> None:
+    from dpsvm_tpu.data.loader import save_csv
+    save_csv(path, np.asarray(x, np.float32), y)
+    print(f"  wrote {os.path.relpath(path, REPO)}: "
+          f"{x.shape[0]} x {x.shape[1]}")
+
+
+def _convert_mnist(files: dict) -> None:
+    from dpsvm_tpu.data.converters import mnist_to_odd_even
+    for split in ("train", "test"):
+        img_k, lab_k = f"mnist-{split}-images", f"mnist-{split}-labels"
+        if not (files.get(img_k) and files.get(lab_k)):
+            continue
+        x = _read_idx(files[img_k]).reshape(-1, 784)
+        digits = _read_idx(files[lab_k])
+        # Even/odd binary relabelling (the reference's benchmark task,
+        # scripts/convert_mnist_to_odd_even.py) ...
+        xs, y = mnist_to_odd_even(x, digits)
+        _write_csv(CONVERTED[f"mnist_odd_even_{split}"], xs, y)
+        # ... plus the raw 10-digit labels for the multiclass/serving
+        # paths (models/multiclass.py, serve.py).
+        _write_csv(CONVERTED[f"mnist_digits_{split}"], x / 255.0,
+                   digits.astype(np.int32))
+
+
+def _convert_covtype(local: str) -> None:
+    import io
+    import zipfile
+    with zipfile.ZipFile(local) as zf:
+        inner = next(n for n in zf.namelist()
+                     if n.endswith("covtype.data.gz"))
+        raw = gzip.decompress(zf.read(inner))
+    arr = np.loadtxt(io.BytesIO(raw), delimiter=",", dtype=np.float32)
+    x, labels = arr[:, :54], arr[:, 54].astype(np.int32)  # 1..7
+    _write_csv(CONVERTED["covtype_multiclass"], x, labels)
+    # The reference's binary stress task: class 2 vs rest
+    # (BENCH_COVTYPE.md's convention).
+    _write_csv(CONVERTED["covtype_binary"], x,
+               np.where(labels == 2, 1, -1).astype(np.int32))
+
+
+def _convert_adult(files: dict) -> None:
+    from dpsvm_tpu.data.converters import libsvm_to_csv
+    for key, out in (("adult-a9a-train", "adult_train"),
+                     ("adult-a9a-test", "adult_test")):
+        if files.get(key):
+            # The reference pins Adult to 123 features (Makefile:83).
+            n, d = libsvm_to_csv(files[key], CONVERTED[out],
+                                 num_features=123)
+            print(f"  wrote {os.path.relpath(CONVERTED[out], REPO)}: "
+                  f"{n} x {d}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="report present raw/converted files; no network")
+    ap.add_argument("--timeout", type=float, default=30.0,
+                    help="per-download timeout seconds (default 30)")
+    ap.add_argument("--only", choices=["mnist", "covtype", "adult"],
+                    default=None, help="fetch one dataset family only")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        for name, path in CONVERTED.items():
+            state = "present" if os.path.exists(path) else "missing"
+            print(f"  {name}: {state} ({os.path.relpath(path, REPO)})")
+        print("real-data legs " +
+              ("ACTIVE" if real_data_available() else
+               "inactive (run this tool with egress to activate)"))
+        return 0
+
+    os.makedirs(DATA, exist_ok=True)
+    fam = args.only
+    files: dict = {}
+    any_skip = False
+    for name in SOURCES:
+        if fam and not name.startswith(
+                {"mnist": "mnist", "covtype": "covtype",
+                 "adult": "adult"}[fam]):
+            continue
+        local = _fetch(name, args.timeout)
+        files[name] = local
+        any_skip |= local is None
+
+    if (not fam or fam == "mnist"):
+        _convert_mnist(files)
+    if (not fam or fam == "covtype") and files.get("covtype"):
+        _convert_covtype(files["covtype"])
+    if (not fam or fam == "adult"):
+        _convert_adult(files)
+
+    if any_skip:
+        print("SKIP: some sources were unreachable (sealed environment?) "
+              "— exit 0 by design; re-run when egress is available")
+    else:
+        print("all requested datasets fetched, verified and converted")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
