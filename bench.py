@@ -95,19 +95,34 @@ def _session_calibration() -> dict:
 _REGRESSION_BAND = 0.10
 
 
-def _latest_bench_artifact(root: str, pattern: str = "BENCH_r*.json"):
+def _latest_bench_artifact(root: str, pattern: str = "BENCH_r*.json",
+                           key: str = None):
     """(path, parsed-dict) of the newest committed artifact matching
     `pattern`, or (None, None). Artifacts come in two shapes: the
-    driver's wrapper {"parsed": {...}} and a bare result dict."""
+    driver's wrapper {"parsed": {...}} and a bare result dict.
+
+    When `key` is given, returns the newest artifact that CARRIES that
+    metric: the MULTICHIP_r*.json family mixes driver-written
+    {rc, ok, skipped} run records with metric-bearing mesh-bench
+    records, and a metric-less newest file must not blind the gate to
+    an older adjudicable baseline (ISSUE 4 satellite)."""
     import glob
     import os
 
-    paths = sorted(glob.glob(os.path.join(root, pattern)))
-    if not paths:
-        return None, None
-    with open(paths[-1]) as fh:
-        doc = json.load(fh)
-    return paths[-1], doc.get("parsed", doc)
+    for path in sorted(glob.glob(os.path.join(root, pattern)),
+                       reverse=True):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            # A truncated artifact (driver killed mid-write) must not
+            # crash the gate — skip to the next candidate; the gate's
+            # contract is NO_BASELINE, never an exception.
+            continue
+        doc = doc.get("parsed", doc)
+        if key is None or key in doc:
+            return path, doc
+    return None, None
 
 
 def _regression_gate(current: dict, root: str,
@@ -123,7 +138,11 @@ def _regression_gate(current: dict, root: str,
     Generalized over (pattern, key) so every benchmark family gets the
     same cross-session adjudication: the headline solver bench uses the
     defaults (BENCH_r*.json, pairs_per_second); the serving bench gates
-    BENCH_SERVE_r*.json on examples_per_second (tools/bench_serve.py).
+    BENCH_SERVE_r*.json on examples_per_second (tools/bench_serve.py);
+    the mesh bench (`python bench.py --mesh`) gates MULTICHIP_r*.json
+    on mesh_pairs_per_second, skipping the driver's metric-less
+    {rc, ok} run records (ISSUE 4 satellite — mesh-path regressions
+    become adjudicable like headline ones).
 
     Normalization: the calibration kernel's FLOPs never change, so
     (prev_calib_s / cur_calib_s) is the session speed ratio; dividing
@@ -135,8 +154,8 @@ def _regression_gate(current: dict, root: str,
                          field: the delta is reported RAW and
                          informational (cross-session drift cannot be
                          separated out)."""
-    path, prev = _latest_bench_artifact(root, pattern)
-    if prev is None or key not in prev:
+    path, prev = _latest_bench_artifact(root, pattern, key=key)
+    if prev is None:
         return {"regression_gate": "NO_BASELINE"}
     out = {
         "previous_artifact": path.rsplit("/", 1)[-1],
@@ -163,6 +182,83 @@ def _regression_gate(current: dict, root: str,
                             else "FLAG"),
     })
     return out
+
+
+def mesh_main() -> int:
+    """Mesh-path benchmark (`python bench.py --mesh`) — the MULTICHIP
+    sibling of the headline bench (ISSUE 4 satellite). One budget-mode
+    mesh block solve over every visible device at a covtype-shaped
+    operating point, reported as mesh_pairs_per_second and gated
+    against the latest metric-bearing MULTICHIP_r*.json with the same
+    drift-normalized regression gate as the headline — so a mesh-path
+    regression (collective regression, sharding regression, runner
+    regression) is adjudicable across sessions instead of invisible
+    behind the single-chip number. The driver's {rc, ok} MULTICHIP run
+    records carry no metric and are skipped by the artifact scan.
+
+    Uses the GLOBAL-working-set engine (the default mesh path):
+    budget_mode promises an exact pair count, which the shard-local
+    engine's concurrent spending cannot honor (config validation);
+    shard-local throughput is measured by its own A/B probe
+    (tools/profile_round.py --shardlocal)."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    calibration = _session_calibration()
+    print(f"[bench --mesh] session calibration: {json.dumps(calibration)}",
+          file=sys.stderr)
+    # covtype-shaped synthetic, scaled to a row count every harness can
+    # hold (same generator family as tools/profile_round.py --dataset
+    # covtype; pinned seed).
+    rng = np.random.default_rng(0)
+    n, d = 65_536, 54
+    x = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
+    y = np.where(x[:, 0] + 0.2 * rng.standard_normal(n) > 0,
+                 1, -1).astype(np.int32)
+    budget = 200_000
+    cfg = SVMConfig(c=32.0, gamma=0.03125, epsilon=1e-3, engine="block",
+                    working_set_size=256, budget_mode=True,
+                    max_iter=budget)
+    n_dev = len(jax.devices())
+    solve_mesh(x, y, cfg.replace(max_iter=64), num_devices=n_dev)  # warm
+    runs = [solve_mesh(x, y, cfg, num_devices=n_dev) for _ in range(3)]
+    best = min(runs, key=lambda r: r.train_seconds)
+    if best.iterations < budget:
+        # A broken budget contract must fail LOUDLY before a bogus
+        # pairs/s is gated and printed (and must not vanish under -O
+        # the way a bare assert would).
+        print(f"[bench --mesh] ERROR: budget run executed "
+              f"{best.iterations} < {budget} pairs — mesh budget "
+              "contract broken; no result emitted", file=sys.stderr)
+        return 1
+    pps = best.iterations / max(best.train_seconds, 1e-9)
+    result = {
+        "metric": (f"synthetic covtype-shaped {n}x{d} RBF mesh block "
+                   f"solve over {n_dev} devices, MEASURED at a "
+                   f"{budget} pair-update budget"),
+        "value": round(best.train_seconds, 3),
+        "unit": "seconds",
+        "n_devices": n_dev,
+        "device": str(jax.devices()[0]),
+        "pair_updates": int(best.iterations),
+        "mesh_pairs_per_second": round(pps),
+        "session_calibration": calibration,
+    }
+    gate = _regression_gate(result,
+                            os.path.dirname(os.path.abspath(__file__)),
+                            pattern="MULTICHIP_r*.json",
+                            key="mesh_pairs_per_second")
+    result.update(gate)
+    print(f"[bench --mesh] {n_dev} devices: {best.iterations} pairs in "
+          f"{best.train_seconds:.3f}s ({pps:.0f}/s); gate: "
+          f"{gate.get('regression_gate')}", file=sys.stderr)
+    print(json.dumps(result))
+    return 0
 
 
 def main() -> int:
@@ -363,4 +459,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(mesh_main() if "--mesh" in sys.argv[1:] else main())

@@ -103,6 +103,20 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "updates; SVMConfig.pipeline_rounds). auto = "
                         "the measured gate (solver/block.py "
                         "pipeline_pays)")
+    p.add_argument("--local-working-sets", type=int, default=0,
+                   help="mesh block engine: 0 = auto (measured gate, "
+                        "currently off), 1 = one global working set per "
+                        "round (the exact current engine), >= 2 = shard-"
+                        "parallel working sets — every chip solves a "
+                        "subproblem selected from its OWN shard "
+                        "concurrently, reconciling at syncs, with an "
+                        "automatic endgame demotion to the exact global "
+                        "runner (SVMConfig.local_working_sets)")
+    p.add_argument("--sync-rounds", type=int, default=1,
+                   help="shard-parallel working sets: local select/"
+                        "solve/fold rounds between cross-shard syncs "
+                        "(Cascade-style; needs --local-working-sets "
+                        ">= 2; default 1)")
     p.add_argument("--active-set-size", type=int, default=0,
                    help="block engine: shrink per-round work to the m "
                         "most-violating rows, reconciling the full "
@@ -383,6 +397,9 @@ def _cmd_train(args) -> int:
             fleet_size=args.fleet_size,
             pipeline_rounds={"auto": None, "on": True,
                              "off": False}[args.pipeline_rounds],
+            local_working_sets=(None if args.local_working_sets == 0
+                                else args.local_working_sets),
+            sync_rounds=args.sync_rounds,
             active_set_size=args.active_set_size,
             reconcile_rounds=args.reconcile_rounds,
             dtype=args.dtype, chunk_iters=args.chunk_iters,

@@ -175,6 +175,36 @@ class SVMConfig:
     # active_set_size=0; supersedes fused_fold when both would apply.
     pipeline_rounds: Optional[bool] = None
 
+    # Shard-parallel working sets for the MESH block engine
+    # (parallel/dist_block.py make_block_shardlocal_chunk_runner — the
+    # Cascade-SVM / partitioned-parallel-SMO structure, PAPERS.md; no
+    # reference equivalent: the reference replicates one working pair on
+    # every rank). local_working_sets:
+    #   None -- auto: the measured gate (solver/block.py
+    #           shardlocal_pays — currently OFF everywhere pending the
+    #           device-session measurement, same discipline as
+    #           pipeline_rounds);
+    #   1    -- one GLOBAL working set per round: exactly the current
+    #           mesh engine (make_block_chunk_runner), bit-identical
+    #           trajectories (pinned in tests/test_shardlocal.py);
+    #   >= 2 -- ON: every chip selects a q-sized working set from its
+    #           OWN shard and runs its subproblem chain concurrently
+    #           with all other chips — P chains per wall-clock round
+    #           instead of P replicas of one chain (the docs/SCALING.md
+    #           Amdahl term), reconciled by one touched-rows all_gather
+    #           per sync. The value is a switch, not a count: the
+    #           concurrent-chain count is always the mesh's device
+    #           count. Final convergence is exact regardless — solve_mesh
+    #           demotes to the global-working-set engine at the endgame
+    #           (gap stalled across a sync window, or below 10*epsilon).
+    # sync_rounds (R): local select/solve/fold rounds between
+    # cross-shard syncs (Cascade-style). R > 1 divides the per-sync
+    # collective DISPATCHES and the stopping handoff by R at the cost of
+    # R rounds of cross-shard gradient staleness. Mesh-only knobs; the
+    # single-chip solver has one shard and ignores them.
+    local_working_sets: Optional[int] = None
+    sync_rounds: int = 1
+
     # Active-set shrinking for the block engine (0 = off). When > 0, the
     # solver runs cycles of `reconcile_rounds` block rounds whose
     # selection and fold touch only the `active_set_size` most-violating
@@ -352,6 +382,51 @@ class SVMConfig:
                 "pipeline_rounds supports selection in {'mvp', "
                 "'second_order'} (the nu rule's per-class quarters keep "
                 "the plain round; same restriction as fused_fold)")
+        if self.local_working_sets is not None and self.local_working_sets < 1:
+            raise ValueError(
+                "local_working_sets must be None (auto), 1 (global "
+                "working set — the exact current engine) or >= 2 "
+                "(shard-parallel working sets)")
+        if self.local_working_sets is not None and self.local_working_sets >= 2:
+            if self.engine != "block":
+                raise ValueError(
+                    "local_working_sets >= 2 is a mesh block-engine knob "
+                    "(the per-pair engines have no working set to "
+                    "shard-localize); use engine='block'")
+            if self.kernel == "precomputed":
+                raise ValueError(
+                    "local_working_sets >= 2 supports feature kernels "
+                    "only (a precomputed Gram's sync fold would need "
+                    "global column ids for rows the shard does not own)")
+            if self.active_set_size:
+                raise ValueError(
+                    "local_working_sets >= 2 does not compose with "
+                    "active_set_size (the active cycle already runs "
+                    "replicated collective-free rounds; stacking the "
+                    "two staleness contracts is untested) — use one or "
+                    "the other")
+            if self.pipeline_rounds:
+                raise ValueError(
+                    "local_working_sets >= 2 does not compose with "
+                    "pipeline_rounds=True (shard-local rounds have no "
+                    "per-round collectives left to hide; the two "
+                    "engines solve the same floor differently) — use "
+                    "one or the other")
+            if self.budget_mode:
+                raise ValueError(
+                    "local_working_sets >= 2 does not compose with "
+                    "budget_mode: P shards spend the pair budget "
+                    "concurrently, so the exact-max_iter contract "
+                    "cannot hold — use the global working set there")
+        if self.sync_rounds < 1:
+            raise ValueError("sync_rounds must be >= 1")
+        if self.sync_rounds > 1 and (self.local_working_sets is None
+                                     or self.local_working_sets < 2):
+            raise ValueError(
+                "sync_rounds > 1 amortizes the shard-local engine's "
+                "sync collectives; it needs local_working_sets >= 2 "
+                "(with the global working set there is no sync to "
+                "amortize)")
         if self.pair_batch not in (1, 2, 4, 8):
             raise ValueError("pair_batch must be 1, 2, 4 or 8")
         if self.pair_batch > 1:

@@ -141,6 +141,41 @@ def select_working_set_nu(
     return i_up, b_hi, i_low, b_lo
 
 
+def stopping_extrema(f, alpha, y, c, valid=None, rule: str = "mvp"):
+    """Device-side masked stopping extrema (b_hi, b_lo) of the CURRENT
+    state — the jnp sibling of extrema_np, sharing the same
+    up_mask/low_mask/nu_stopping_pair set definitions.
+
+    Used by the shard-local mesh engine's sync handoff
+    (parallel/dist_block.py make_block_shardlocal_chunk_runner): each
+    shard reduces its LOCAL extrema of the post-sync corrected gradient
+    with this, then ONE max-allreduce of (-b_hi, b_lo) replicates the
+    exact global pair — the whole KKT stopping test costs one tiny
+    collective per sync instead of a selection exchange per round.
+    rule="second_order" shares the mvp extrema (the stopping rule is the
+    same b_lo <= b_hi + 2 eps over I_up/I_low; only the PAIRING differs).
+    The "nu" branch is the per-class rule for completeness — note its
+    per-shard result does NOT compose under a plain cross-shard max (the
+    class choice must be made from global per-class extrema), which is
+    one reason the shard-local engine is restricted to the C-SVC rules."""
+    cp, cn = split_c(c)
+    f = f.astype(jnp.float32)
+    up = up_mask(alpha, y, cp, cn)
+    low = low_mask(alpha, y, cp, cn)
+    if valid is not None:
+        up = up & valid
+        low = low & valid
+    if rule == "nu":
+        pos = y > 0
+        bh_p = jnp.min(jnp.where(up & pos, f, _INF))
+        bl_p = jnp.max(jnp.where(low & pos, f, -_INF))
+        bh_n = jnp.min(jnp.where(up & ~pos, f, _INF))
+        bl_n = jnp.max(jnp.where(low & ~pos, f, -_INF))
+        return nu_stopping_pair(bh_p, bl_p, bh_n, bl_n)
+    return (jnp.min(jnp.where(up, f, _INF)),
+            jnp.max(jnp.where(low, f, -_INF)))
+
+
 def extrema_np(f, alpha, y, c, rule: str = "mvp"):
     """Host-side (NumPy) stopping extrema (b_hi, b_lo) of a final state.
 

@@ -39,12 +39,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_rows
 from dpsvm_tpu.ops.select import (candidate_live_mask, low_mask,
-                                  nu_stopping_pair, split_c, up_mask)
+                                  nu_stopping_pair, split_c,
+                                  stopping_extrema, up_mask)
 from dpsvm_tpu.parallel.dist_smo import _global_ids
 from dpsvm_tpu.parallel.mesh import DATA_AXIS, mesh_shard_map
 from dpsvm_tpu.solver.block import (BlockState, _round_core,
                                     _solve_subproblem, _top_h,
-                                    combine_halves)
+                                    combine_halves, run_local_round)
 from dpsvm_tpu.solver.smo import eff_f, maybe_kahan
 
 
@@ -255,6 +256,195 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                               st.pairs + t, st.rounds + 1, f_err)
 
         return lax.while_loop(cond, body, state)
+
+    shard = P(DATA_AXIS)
+    rep = P()
+    state_specs = BlockState(alpha=shard, f=shard, b_hi=rep, b_lo=rep,
+                             pairs=rep, rounds=rep,
+                             f_err=shard if compensated else None)
+    mapped = mesh_shard_map(
+        chunk_body,
+        mesh=mesh,
+        in_specs=(shard, shard, shard, shard, shard, state_specs, rep),
+        out_specs=state_specs,
+        check=False,  # while_loop carries defeat the replication checker
+    )
+    return jax.jit(mapped)
+
+
+def make_block_shardlocal_chunk_runner(mesh: Mesh, kp: KernelParams, c,
+                                       eps: float, tau: float, q: int,
+                                       inner_iters: int,
+                                       rounds_per_chunk: int,
+                                       sync_rounds: int = 1,
+                                       inner_impl: str = "xla",
+                                       interpret: bool = False,
+                                       selection: str = "mvp",
+                                       compensated: bool = False,
+                                       pair_batch: int = 1):
+    """SHARD-PARALLEL working sets (config.local_working_sets — the
+    Cascade-SVM / partitioned-parallel-SMO structure re-derived for the
+    mesh; Graf et al. NIPS 2004, Cao et al. IEEE TNN 2006, PAPERS.md):
+    instead of every chip replicating ONE global q-sized subproblem
+    chain per round (make_block_chunk_runner — the Amdahl term that caps
+    docs/SCALING.md's covtype P=8 projection at 1.3x), every chip
+    selects a q-sized working set FROM ITS OWN SHARD, builds its (q, q)
+    Gram fully locally (working rows ARE local rows), and runs its own
+    subproblem chain concurrently with all other chips — P different
+    chains in the same wall-clock, so useful pairs per round scale ~P.
+
+    One LOCAL round, per device (zero collectives — the whole point):
+
+      1. local masked selection over the shard's rows (the single-chip
+         select_block; no _global_top all_gather);
+      2. local gathers + local (q, q) Gram + the subproblem chain;
+      3. local fold f_loc += coef @ K(W_loc, shard) and local alpha
+         scatter (working rows are owned rows — the disjoint-row
+         regime: shards can never write the same alpha).
+
+    Every `sync_rounds` (R) local rounds, one SYNC:
+
+      4. ONE all_gather of the window's (R*q, d+3) touched-row blocks
+         [x row | x_sq | fold coef | pair-count lane] — the only bulk
+         collective; each shard folds the OTHER shards' P-1 blocks into
+         its local gradient with (R*q, d) x (d, n_loc) kernel-row
+         matmuls (its own block was already folded locally each round
+         and is skipped by rotation — fp grouping differs per shard
+         but f is shard-local state);
+      5. the exact global KKT stopping pair from the corrected f: local
+         masked extrema (ops/select.py stopping_extrema) + ONE (2,) max
+         allreduce handoff. b_hi/b_lo therefore have the SAME semantics
+         as every other block engine's carry — exact extrema of the
+         post-fold gradient, never of a stale view.
+
+    Staleness contract (the pair_batch/pipelined discipline, lifted from
+    pairs/rounds to shards): each shard's SELECTION ranks violators by a
+    gradient that is stale w.r.t. other shards' concurrent updates, but
+    every EXECUTED update is exact on the shard's own view — own-row
+    alpha is always current (disjoint rows), the subproblem maintains
+    f_W incrementally from its own updates, and cross-shard
+    contributions enter f only through the sync fold, after which the
+    next window re-ranks from the corrected gradient (the
+    candidate_live_mask role is played by the selection masks
+    themselves: they re-derive I_up/I_low membership from the CURRENT
+    own-shard alpha every round, so a slot can never go stale the way a
+    prefetched cross-round candidate can). Wrong-priority work burns
+    rounds, not correctness. Because per-shard working sets can starve
+    near the optimum (the global violating pair may need rows from two
+    shards, which no local chain can pair), final convergence is owned
+    by the ENDGAME DEMOTION in solve_mesh: when the global gap stops
+    halving across a sync window or falls below 10*eps, the host drops
+    back to the exact global-working-set runner — so parity artifacts
+    and final-ulp convergence are unaffected, and this engine is purely
+    a bulk-phase accelerator.
+
+    Budget semantics: each shard clamps its own window spend to the
+    replicated remaining budget, but P shards spend concurrently, so
+    `pairs` may overshoot max_iter by up to (P-1) * R * inner_iters —
+    the reason config validation refuses budget_mode (which promises an
+    EXACT pair count) for this engine.
+
+    Collectives per sync: 2 dispatches (one all_gather of
+    P * R*q * (d+3) f32 + one (2,) f32 allreduce) for up to P*R*inner
+    executed pairs — vs the global runner's 3 dispatches per round for
+    `inner` pairs: dispatches per pair drop ~3PR/2 (>= P for any R).
+    Payload BYTES per pair drop only ~(2P+d+5)/(d+3) (rows must travel
+    exactly once either way) — see docs/SCALING.md round-7 for the
+    honest accounting.
+
+    Feature kernels, selection in {mvp, second_order} (config
+    validates). Bit-exact reduction: local_working_sets=1 routes to
+    make_block_chunk_runner in solve_mesh — this runner never runs.
+    """
+    if kp.kind == "precomputed":
+        raise ValueError(
+            "shard-local working sets support feature kernels only (a "
+            "precomputed Gram's sync fold would need global column ids "
+            "for rows the shard does not own; use the plain runner)")
+    if selection not in ("mvp", "second_order"):
+        raise ValueError(
+            "shard-local working sets support selection in {'mvp', "
+            "'second_order'} (the nu rule's per-class stopping pair "
+            "does not reduce shard-locally; see ops/select.py "
+            "stopping_extrema)")
+    p_dev = int(mesh.devices.size)
+    r_sync = int(sync_rounds)
+
+    def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
+                   state: BlockState, max_iter):
+        n_loc, d = x_loc.shape
+        end = state.rounds + rounds_per_chunk
+        dev = lax.axis_index(DATA_AXIS).astype(jnp.int32)
+
+        def cond(st: BlockState):
+            return ((st.rounds < end) & (st.pairs < max_iter)
+                    & (st.b_lo > st.b_hi + 2.0 * eps))
+
+        def window(st: BlockState):
+            pend0 = jnp.zeros((r_sync, q, d + 3), jnp.float32)
+
+            def local_round(r, carry):
+                alpha, f, f_err, pend, t_tot = carry
+                # The SAME round body the single-chip engine compiles
+                # (solver/block.py run_local_round), on the shard views:
+                # selection, Gram, subproblem, own-delta fold, scatter —
+                # all local, zero collectives. The returned extrema are
+                # the shard-LOCAL pair; they gate this shard's budget
+                # (a shard whose local gap closed idles the round) and
+                # are otherwise discarded — the global stopping pair is
+                # computed at the sync from the corrected gradient.
+                alpha, f, f_err, _, _, t, coef, qx, qsq = run_local_round(
+                    x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
+                    alpha, f, f_err, max_iter - st.pairs - t_tot,
+                    kp, c, eps, tau, q, inner_iters, inner_impl,
+                    interpret, selection, pair_batch=pair_batch)
+                # Record the round's touched block for the sync fold.
+                # Dead slots carry coef 0 (their filler rows are real
+                # rows, so the gathered block stays finite); lane d+2
+                # smuggles the round's pair count in slot 0 so the
+                # replicated global counter rides the SAME all_gather
+                # (exact: integer-valued f32 well under 2^24).
+                tcol = jnp.zeros((q,), jnp.float32).at[0].set(
+                    t.astype(jnp.float32))
+                blk = jnp.concatenate(
+                    [qx.astype(jnp.float32), qsq[:, None],
+                     coef[:, None], tcol[:, None]], axis=1)
+                return alpha, f, f_err, pend.at[r].set(blk), t_tot + t
+
+            alpha, f, f_err, pend, _ = lax.fori_loop(
+                0, r_sync, local_round,
+                (st.alpha, st.f, st.f_err, pend0, jnp.int32(0)))
+
+            # ---- SYNC: the window's ONLY collectives.
+            ag = lax.all_gather(pend.reshape(r_sync * q, d + 3),
+                                DATA_AXIS)  # (P, R*q, d+3), replicated
+            pairs = st.pairs + jnp.sum(ag[:, :, d + 2]).astype(jnp.int32)
+
+            # Cross-shard fold: one (R*q, n_loc) kernel-row fold per
+            # PEER block — the same per-step footprint as R plain
+            # rounds' folds. The rotation skips the own block entirely
+            # (its deltas were folded locally each round; a masked
+            # all-P loop would burn one full fold matmul on zeros).
+            def fold_one(i, carry):
+                f, f_err = carry
+                blk = ag[(dev + 1 + i) % p_dev]
+                delta = blk[:, d + 1] @ kernel_rows(
+                    x_loc, x_sq_loc, blk[:, :d].astype(x_loc.dtype),
+                    blk[:, d], kp)
+                return maybe_kahan(f, f_err, delta)
+
+            f, f_err = lax.fori_loop(0, p_dev - 1, fold_one, (f, f_err))
+
+            # ---- global stopping pair from the CORRECTED gradient:
+            # local masked extrema + one (2,) max-allreduce handoff.
+            f_eff = f if f_err is None else f - f_err
+            bh_l, bl_l = stopping_extrema(f_eff, alpha, y_loc, c,
+                                          valid=valid_loc, rule=selection)
+            g = lax.pmax(jnp.stack([-bh_l, bl_l]), DATA_AXIS)
+            return BlockState(alpha, f, -g[0], g[1], pairs,
+                              st.rounds + r_sync, f_err)
+
+        return lax.while_loop(cond, window, state)
 
     shard = P(DATA_AXIS)
     rep = P()

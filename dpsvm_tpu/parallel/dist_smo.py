@@ -51,6 +51,14 @@ from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
 
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
+# Shard-local engine chunk bound when nothing else observes: the host's
+# endgame-demotion check reads the gap at chunk boundaries, so chunks
+# are capped at this many sync windows (the exact-runner tail after
+# demotion runs the usual unobserved cadence). Small enough that a
+# stalled engine is demoted promptly; large enough that the per-chunk
+# host round-trip stays amortized over thousands of pair updates.
+_SHARDLOCAL_WINDOWS_PER_CHUNK = 8
+
 
 def _global_ids(n_loc: int) -> jax.Array:
     """Global row ids of this shard (contiguous row partitioning, like the
@@ -449,24 +457,45 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     # (solver/block.py fused_fold_pays — round-5 sweep covering the
     # n_loc band pods actually land in). Needs n_loc padded to 1024 and
     # q/2 <= n_loc/128.
-    from dpsvm_tpu.solver.block import fused_fold_pays, pipeline_pays
+    from dpsvm_tpu.solver.block import (fused_fold_pays, pipeline_pays,
+                                        shardlocal_pays)
 
     _platform = mesh.devices.flat[0].platform
     _n_pad_f = pad_rows(n, n_dev, multiple=1024)
     _n_loc_f = _n_pad_f // n_dev
+    # Shard-parallel working sets (config.local_working_sets;
+    # dist_block.py make_block_shardlocal_chunk_runner): P concurrent
+    # shard-local subproblem chains per round, reconciled by one
+    # touched-rows all_gather per sync — the engine that attacks the
+    # replicated-chain Amdahl term directly. Takes precedence over the
+    # pipelined/fused round variants (it removes the per-round
+    # collectives those engines merely hide). The nu trainers fall back
+    # to the plain runner silently (same contract as pair_batch) — their
+    # per-class stopping pair does not reduce shard-locally.
+    _lws = config.local_working_sets
+    use_shardlocal = (use_block and config.selection != "nu"
+                      and not config.active_set_size
+                      and kp.kind != "precomputed"
+                      and not config.budget_mode
+                      and not config.pipeline_rounds
+                      and (_lws >= 2 if _lws is not None
+                           else (_platform == "tpu"
+                                 and shardlocal_pays(_n_loc_f, d))))
     # Pipelined mesh rounds (config.pipeline_rounds; dist_block.py
     # make_block_pipelined_chunk_runner): the per-round all_gather/psum
     # collectives are issued from the pre-fold carry and can hide behind
     # the replicated subproblem chain. Supersedes the fused fold+select
     # when both would apply (same precedence as the single-chip path).
-    use_pipe = (use_block and config.selection != "nu"
+    use_pipe = (use_block and not use_shardlocal
+                and config.selection != "nu"
                 and not config.active_set_size
                 and kp.kind != "precomputed"
                 and (config.pipeline_rounds
                      if config.pipeline_rounds is not None
                      else (_platform == "tpu"
                            and pipeline_pays(_n_loc_f, d))))
-    use_fused = (use_block and not use_pipe and config.selection != "nu"
+    use_fused = (use_block and not use_pipe and not use_shardlocal
+                 and config.selection != "nu"
                  and not config.active_set_size
                  and kp.kind != "precomputed"
                  and min(config.working_set_size, _n_loc_f)
@@ -598,6 +627,17 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
         rounds_per_chunk = (max(1, chunk_len // inner)
                             if observe else _UNOBSERVED_CHUNK)
         inner_impl = "pallas" if _platform == "tpu" else "xla"
+
+        def _plain_runner(rpc):
+            # Shared by the default dispatch and the shard-local
+            # engine's endgame demotion (which swaps runners mid-solve).
+            return make_block_chunk_runner(
+                mesh, kp, config.c_bounds(), eps_run,
+                float(config.tau), q, inner, rpc, inner_impl,
+                selection=config.selection,
+                compensated=config.compensated,
+                pair_batch=int(config.pair_batch))
+
         if config.active_set_size:
             from dpsvm_tpu.parallel.dist_block import (
                 make_block_active_chunk_runner)
@@ -611,6 +651,26 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 mesh, kp, config.c_bounds(), eps_run,
                 float(config.tau), q, inner, rounds_per_chunk,
                 m_act, int(config.reconcile_rounds), inner_impl,
+                selection=config.selection,
+                compensated=config.compensated,
+                pair_batch=int(config.pair_batch))
+        elif use_shardlocal:
+            from dpsvm_tpu.parallel.dist_block import (
+                make_block_shardlocal_chunk_runner)
+
+            r_sync = int(config.sync_rounds)
+            # The host-side ENDGAME DEMOTION must observe the gap at
+            # chunk boundaries, so shard-local chunks are always bounded
+            # to a few sync windows — never _UNOBSERVED_CHUNK (after
+            # demotion the exact tail runner gets the usual cadence).
+            # `rounds` here count LOCAL rounds; the while cond steps
+            # whole windows, so the bound is a multiple of sync_rounds.
+            win = (max(1, max(1, chunk_len // inner) // r_sync)
+                   if observe else _SHARDLOCAL_WINDOWS_PER_CHUNK)
+            run_chunk = make_block_shardlocal_chunk_runner(
+                mesh, kp, config.c_bounds(), eps_run,
+                float(config.tau), q, inner, win * r_sync, r_sync,
+                inner_impl, interpret=_platform != "tpu",
                 selection=config.selection,
                 compensated=config.compensated,
                 pair_batch=int(config.pair_batch))
@@ -637,12 +697,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 compensated=config.compensated,
                 pair_batch=int(config.pair_batch))
         else:
-            run_chunk = make_block_chunk_runner(
-                mesh, kp, config.c_bounds(), eps_run,
-                float(config.tau), q, inner, rounds_per_chunk, inner_impl,
-                selection=config.selection,
-                compensated=config.compensated,
-                pair_batch=int(config.pair_batch))
+            run_chunk = _plain_runner(rounds_per_chunk)
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,
                            b_lo=state.b_lo, pairs=state.it,
                            rounds=jax.device_put(jnp.int32(0), rep),
@@ -659,6 +714,25 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     # Device time only, clock stopped during host observation — see the
     # matching loop in solver/smo.py for the rationale.
     train_seconds = 0.0
+    # Shard-local endgame demotion state (docs/ARCHITECTURE.md): the
+    # concurrent shard-local chains are a BULK-phase accelerator; once
+    # the global gap stops halving across a chunk of sync windows (the
+    # remaining violators need cross-shard pairs no local chain can
+    # form) or drops within 10x epsilon of done, the host swaps in the
+    # exact global-working-set runner for the tail, so final
+    # convergence and parity artifacts are identical to the plain
+    # engine's.
+    shardlocal_live = use_shardlocal
+    shardlocal_demoted = False
+    # Stall reference for the demotion test: (gap, rounds) at the last
+    # halving. Measured in LOCAL ROUNDS, not chunks, so the test is
+    # independent of the observation cadence (a verbose/callback run
+    # shrinks chunks to ~1 sync window; requiring a halving per CHUNK
+    # there would demote almost immediately and silently change engine
+    # behavior between observed and unobserved runs of one config).
+    gap_ref = None
+    stall_rounds = (_SHARDLOCAL_WINDOWS_PER_CHUNK
+                    * int(config.sync_rounds))
     while True:
         t0 = time.perf_counter()
         state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter)
@@ -683,6 +757,23 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                       np.asarray(eff_f(state))[:n], b_hi, b_lo, force=True)
         if config.verbose:
             print(f"[smo-mesh p={n_dev}] iter={it} gap={b_lo - b_hi:.6f}")
+        if shardlocal_live and not converged and it < config.max_iter:
+            gap = float(b_lo) - float(b_hi)
+            rounds_now = int(state.rounds)
+            if gap_ref is None or gap <= 0.5 * gap_ref[0]:
+                gap_ref = (gap, rounds_now)  # halved: advance the ref
+            stalled = rounds_now - gap_ref[1] >= stall_rounds
+            if gap <= 10.0 * float(config.epsilon) or stalled:
+                run_chunk = _plain_runner(rounds_per_chunk)
+                shardlocal_live = False
+                shardlocal_demoted = True
+                if config.verbose:
+                    why = (f"gap not halved in {stall_rounds} local "
+                           "rounds" if stalled
+                           else f"gap within 10x epsilon ({gap:.6f})")
+                    print(f"[smo-mesh p={n_dev}] shard-local endgame "
+                          f"demotion at iter={it}: {why} -> exact "
+                          "global-working-set runner")
         if converged or it >= config.max_iter:
             break
         if abort:
@@ -715,5 +806,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
             "cache_hit_rate": (int(state.hits) / lookups) if lookups else 0.0,
             "f": f_final,
             **({"outer_rounds": int(state.rounds)} if use_block else {}),
+            **({"shardlocal_demoted": shardlocal_demoted}
+               if use_shardlocal else {}),
         },
     )

@@ -89,6 +89,31 @@ def fused_fold_pays(n_rows: int, d: int) -> bool:
     return n_rows >= (100_000 if d <= 128 else 150_000)
 
 
+def shardlocal_pays(n_loc: int, d: int) -> bool:
+    """Auto-gate for the SHARD-LOCAL mesh working-set engine
+    (parallel/dist_block.py make_block_shardlocal_chunk_runner;
+    config.local_working_sets). Same single-source discipline as
+    fused_fold_pays / pipeline_pays: the gate constants come from a
+    device measurement or the gate stays off.
+
+    Status (2026-08-03): the engine is implemented and CPU-verified
+    (tests/test_shardlocal.py: 8-virtual-device trajectories reach the
+    oracle optimum; the endgame demotion restores exact final
+    convergence), its per-sync collective structure is pinned from
+    compiled HLO, and the A/B probe exists (tools/profile_round.py
+    --shardlocal) — but no TPU was reachable this session, so there is
+    no measured crossover and the honest auto default is OFF everywhere
+    (config.local_working_sets >= 2 forces it on for measurement and
+    for the CPU tests). Expected shape of the eventual gate, from the
+    docs/SCALING.md round-7 model: pays when the replicated subproblem
+    chain dominates the round (the covtype P=8 regime, where it is THE
+    Amdahl term) and the CPU-measured pair-inflation factor kappa stays
+    under ~5; does NOT pay at P=1 (pure sync overhead) or under tiny
+    per-shard row counts where local working sets starve. Flip to the
+    measured rule when the device session lands."""
+    return False
+
+
 def pipeline_pays(n_rows: int, d: int) -> bool:
     """Auto-gate for the PIPELINED round engine (run_chunk_block_pipelined
     / the mesh pipelined runner), same single-source discipline as
@@ -489,6 +514,46 @@ def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
     return w, slot_ok, b_hi, b_lo, a_w, coef, t, qx, qsq
 
 
+def run_local_round(x, y, x_sq, k_diag, valid, alpha, f, f_err,
+                    budget_left, kp: KernelParams, c, eps: float,
+                    tau: float, q: int, inner_iters: int, inner_impl: str,
+                    interpret: bool, selection: str, pair_batch: int = 1):
+    """ONE complete block round on whatever row view the caller holds:
+    selection (extrema ride the pass), Gram, subproblem, the fold into
+    THIS view's gradient, and the alpha scatter. Factored out of
+    run_chunk_block's body so the single-chip engine and the mesh
+    SHARD-LOCAL engine (parallel/dist_block.py
+    make_block_shardlocal_chunk_runner) execute the identical round
+    body — the shard-local engine runs this verbatim on its (n_loc,)
+    shard views, which is what makes its local rounds bit-identical to
+    single-chip rounds over the same rows.
+
+    Returns (alpha, f, f_err, b_hi, b_lo, t, coef, qx, qsq): the
+    updated row state, the selection-pass extrema of the gradient this
+    round SAW (one fold behind, as every block engine's carry), the
+    executed pair count, and the fold's (coef, rows, norms) so a caller
+    can REPLAY the fold against other row sets — the shard-local sync's
+    cross-shard reconciliation."""
+    f_cur = f if f_err is None else f - f_err  # eff_f on loose fields
+    w, slot_ok, b_hi, b_lo, alpha_w, coef, t, qx, qsq = _round_core(
+        x, y, x_sq, k_diag, f_cur, alpha, valid, budget_left,
+        kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
+        selection, pair_batch=pair_batch)
+    # Fold the round's alpha deltas into the global state with one
+    # fused matmul chain over X (the single O(n d q) pass per round):
+    # f += (dalpha * y)_W @ K(W, :), with K(W, :) from the same
+    # kernel_rows machinery every other engine uses.
+    k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n) fp32
+    f, f_err = maybe_kahan(f, f_err, coef @ k_rows)
+    # Dead slots must not scatter. The inert index must be OUT OF
+    # RANGE (n), not -1: mode="drop" only drops beyond-range indices,
+    # while -1 wraps to the LAST row and would erase its alpha.
+    safe_w = jnp.where(slot_ok, w, jnp.int32(alpha.shape[0]))
+    alpha = alpha.at[safe_w].set(
+        jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
+    return alpha, f, f_err, b_hi, b_lo, t, coef, qx, qsq
+
+
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
                                   "inner_iters", "rounds_per_chunk",
                                   "inner_impl", "interpret", "selection",
@@ -517,24 +582,12 @@ def run_chunk_block(x, y, x_sq, k_diag, valid, state: BlockState, max_iter,
                 & (st.b_lo > st.b_hi + 2.0 * eps))
 
     def body(st: BlockState):
-        f_cur = eff_f(st)
-        w, slot_ok, b_hi, b_lo, alpha_w, coef, t, qx, qsq = _round_core(
-            x, y, x_sq, k_diag, f_cur, st.alpha, valid,
-            max_iter - st.pairs,
-            kp, c, eps, tau, q, inner_iters, inner_impl, interpret,
-            selection, pair_batch=pair_batch)
-        # Fold the round's alpha deltas into the global state with one
-        # fused matmul chain over X (the single O(n d q) pass per round):
-        # f += (dalpha * y)_W @ K(W, :), with K(W, :) from the same
-        # kernel_rows machinery every other engine uses.
-        k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n) fp32
-        f, f_err = maybe_kahan(st.f, st.f_err, coef @ k_rows)
-        # Dead slots must not scatter. The inert index must be OUT OF
-        # RANGE (n), not -1: mode="drop" only drops beyond-range indices,
-        # while -1 wraps to the LAST row and would erase its alpha.
-        safe_w = jnp.where(slot_ok, w, jnp.int32(st.alpha.shape[0]))
-        alpha = st.alpha.at[safe_w].set(
-            jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
+        # The whole round body lives in run_local_round (shared with the
+        # mesh shard-local engine's local rounds — one definition).
+        alpha, f, f_err, b_hi, b_lo, t, _, _, _ = run_local_round(
+            x, y, x_sq, k_diag, valid, st.alpha, st.f, st.f_err,
+            max_iter - st.pairs, kp, c, eps, tau, q, inner_iters,
+            inner_impl, interpret, selection, pair_batch=pair_batch)
         return BlockState(alpha, f, b_hi, b_lo, st.pairs + t, st.rounds + 1,
                           f_err)
 

@@ -71,6 +71,65 @@ def test_no_calibration_in_previous_artifact(tmp_path):
     assert "raw_delta" in out
 
 
+def _mesh_cur(pps=1_000_000, cal=0.5):
+    return {"mesh_pairs_per_second": pps,
+            "session_calibration": {"best_of_5_seconds": cal}}
+
+
+def test_multichip_gate_skips_metricless_driver_records(tmp_path):
+    """The MULTICHIP family mixes the driver's {rc, ok} run records
+    with metric-bearing mesh-bench records: the gate must baseline
+    against the newest artifact that CARRIES the metric, not go blind
+    because the newest file is a run record (ISSUE 4 satellite)."""
+    _write(tmp_path, "MULTICHIP_r04.json", _mesh_cur(), wrap=False)
+    _write(tmp_path, "MULTICHIP_r05.json",
+           {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+            "tail": ""}, wrap=False)
+    out = bench._regression_gate(_mesh_cur(), str(tmp_path),
+                                 pattern="MULTICHIP_r*.json",
+                                 key="mesh_pairs_per_second")
+    assert out["previous_artifact"] == "MULTICHIP_r04.json"
+    assert out["regression_gate"] == "PASS"
+
+
+def test_multichip_gate_no_metric_anywhere(tmp_path):
+    # Only driver run records exist (the committed state today):
+    # NO_BASELINE, not a crash — the first metric-bearing artifact
+    # becomes the baseline.
+    _write(tmp_path, "MULTICHIP_r05.json",
+           {"n_devices": 8, "rc": 0, "ok": True}, wrap=False)
+    out = bench._regression_gate(_mesh_cur(), str(tmp_path),
+                                 pattern="MULTICHIP_r*.json",
+                                 key="mesh_pairs_per_second")
+    assert out == {"regression_gate": "NO_BASELINE"}
+
+
+def test_gate_skips_corrupt_artifacts(tmp_path):
+    # A truncated artifact (driver killed mid-write) is skipped, never
+    # raised: the gate still finds an older healthy baseline, and with
+    # no healthy candidate at all reports NO_BASELINE.
+    _write(tmp_path, "MULTICHIP_r04.json", _mesh_cur(), wrap=False)
+    (tmp_path / "MULTICHIP_r05.json").write_text('{"rc": 0, "ok"')
+    out = bench._regression_gate(_mesh_cur(), str(tmp_path),
+                                 pattern="MULTICHIP_r*.json",
+                                 key="mesh_pairs_per_second")
+    assert out["previous_artifact"] == "MULTICHIP_r04.json"
+    (tmp_path / "MULTICHIP_r04.json").write_text("{trunc")
+    out = bench._regression_gate(_mesh_cur(), str(tmp_path),
+                                 pattern="MULTICHIP_r*.json",
+                                 key="mesh_pairs_per_second")
+    assert out == {"regression_gate": "NO_BASELINE"}
+
+
+def test_multichip_gate_flags_mesh_regression(tmp_path):
+    _write(tmp_path, "MULTICHIP_r06.json", _mesh_cur(), wrap=False)
+    out = bench._regression_gate(_mesh_cur(pps=700_000), str(tmp_path),
+                                 pattern="MULTICHIP_r*.json",
+                                 key="mesh_pairs_per_second")
+    assert out["regression_gate"] == "FLAG"
+    assert out["normalized_delta"] < -bench._REGRESSION_BAND
+
+
 def test_bare_artifact_shape(tmp_path):
     # Bare (unwrapped) result dicts parse too.
     _write(tmp_path, "BENCH_r06.json",

@@ -102,3 +102,105 @@ def test_mesh_block_round_collectives_match_scaling_model():
     reduce_total = sum(s for _, sizes in reduces for _, s in sizes)
     assert reduce_total == Q * (D + 5) * 4, (reduce_total, reduces)
     assert 1 <= len(reduces) <= 2, "\n".join(r[0] for r in reduces)
+
+
+# ---- shard-parallel working sets (ISSUE 4) --------------------------
+#
+# Compiled at a small shape (op structure is shape-independent, like
+# test_pipelined.py's mesh claim) so the CPU compile stays cheap.
+
+N_S, D_S, Q_S, R_SYNC, INNER_S = 4096, 24, 64, 4, 128
+H_S = Q_S // 2
+
+
+def _compile_runner(make, *args, **kw):
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.solver.block import BlockState
+
+    runner = make(*args, **kw)
+    sds = jax.ShapeDtypeStruct
+    state = BlockState(
+        alpha=sds((N_S,), jnp.float32), f=sds((N_S,), jnp.float32),
+        b_hi=sds((), jnp.float32), b_lo=sds((), jnp.float32),
+        pairs=sds((), jnp.int32), rounds=sds((), jnp.int32))
+    return runner.lower(
+        sds((N_S, D_S), jnp.float32), sds((N_S,), jnp.float32),
+        sds((N_S,), jnp.float32), sds((N_S,), jnp.float32),
+        sds((N_S,), jnp.bool_), state, sds((), jnp.int32),
+    ).compile().as_text()
+
+
+def test_shardlocal_sync_collectives_and_comms_win():
+    """The shard-local engine's comms contract (ISSUE 4 acceptance),
+    pinned from compiled HLO:
+
+      * ZERO selection all_gathers per local round — the compiled chunk
+        carries exactly ONE all_gather (the per-sync (P, R*q, d+3)
+        touched-rows exchange) and ONE all-reduce (the (2,) f32 max
+        stopping handoff) for a whole R-round sync window, independent
+        of R;
+      * collective DISPATCHES per potential pair drop >= P x vs the
+        global runner (measured here: ~3PR/2 = 24x at P=8, R=4);
+      * payload BYTES per potential pair DROP, but NOT by >= P x: the
+        touched rows must cross the interconnect exactly once either
+        way, so the analytic ceiling is (2P + d + 5)/(d + 3) — ~1.7x at
+        this shape, ~1.3x at covtype's d=54. The issue's >= P x bytes
+        hope is REFUTED by this accounting (recorded as the honest
+        negative in docs/SCALING.md round-7); the engine's win is chain
+        parallelism plus dispatch-latency amortization, not bandwidth.
+    """
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_chunk_runner, make_block_shardlocal_chunk_runner)
+    from dpsvm_tpu.parallel.mesh import make_data_mesh
+
+    mesh = make_data_mesh(P_DEV)
+    kp = KernelParams("rbf", 0.1)
+    text_sl = _compile_runner(
+        make_block_shardlocal_chunk_runner, mesh, kp, (5.0, 5.0), 1e-3,
+        1e-12, Q_S, INNER_S, rounds_per_chunk=R_SYNC,
+        sync_rounds=R_SYNC, inner_impl="xla")
+    text_g = _compile_runner(
+        make_block_chunk_runner, mesh, kp, (5.0, 5.0), 1e-3, 1e-12,
+        Q_S, INNER_S, rounds_per_chunk=1, inner_impl="xla")
+
+    gathers = _collective_ops(text_sl, "all-gather")
+    reduces = _collective_ops(text_sl, "all-reduce")
+    others = (_collective_ops(text_sl, "all-to-all")
+              + _collective_ops(text_sl, "collective-permute"))
+    assert not others, others
+
+    # ONE touched-rows all_gather per sync: (P, R*q, d+3) f32.
+    assert len(gathers) == 1, "\n".join(g[0] for g in gathers)
+    gather_bytes = sum(s for _, sizes in gathers for _, s in sizes)
+    assert gather_bytes == P_DEV * R_SYNC * Q_S * (D_S + 3) * 4, \
+        (gather_bytes, gathers)
+    # ONE (2,) f32 max-allreduce stopping handoff per sync.
+    assert len(reduces) == 1, "\n".join(r[0] for r in reduces)
+    reduce_bytes = sum(s for _, sizes in reduces for _, s in sizes)
+    assert reduce_bytes == 2 * 4, (reduce_bytes, reduces)
+
+    # Per-potential-pair accounting vs the global runner at the same
+    # shape: the global round's collectives buy `inner` pairs (one
+    # replicated chain); the shard-local sync's buy P * R * inner (P
+    # concurrent chains for R rounds).
+    g_gathers = _collective_ops(text_g, "all-gather")
+    g_reduces = _collective_ops(text_g, "all-reduce")
+    g_ops = len(g_gathers) + len(g_reduces)
+    g_bytes = sum(s for _, sizes in g_gathers + g_reduces
+                  for _, s in sizes)
+    assert g_bytes == 2 * P_DEV * 2 * H_S * 4 + Q_S * (D_S + 5) * 4, \
+        (g_bytes, g_gathers, g_reduces)
+
+    pairs_g = INNER_S
+    pairs_sl = P_DEV * R_SYNC * INNER_S
+    dispatch_ratio = (g_ops / pairs_g) / (2 / pairs_sl)
+    byte_ratio = (g_bytes / pairs_g) / (
+        (gather_bytes + reduce_bytes) / pairs_sl)
+    assert dispatch_ratio >= P_DEV, (dispatch_ratio, g_ops)
+    # Bytes per pair DO drop (the model's (2P+d+5)/(d+3) = 1.67 here)...
+    assert byte_ratio >= 1.5, byte_ratio
+    # ...but the >= P x hope is analytically impossible — pin the honest
+    # ceiling so the SCALING.md claim can never silently inflate.
+    assert byte_ratio <= (2 * P_DEV + D_S + 5) / (D_S + 3) + 0.01, \
+        byte_ratio

@@ -1,0 +1,236 @@
+"""Shard-parallel working sets (config.local_working_sets;
+parallel/dist_block.py make_block_shardlocal_chunk_runner).
+
+Correctness battery for ISSUE 4's tentpole: bit-exact reduction to the
+current mesh engine at local_working_sets=1, CPU-mesh (8 virtual
+devices) trajectory convergence to the per-pair oracle optimum, the
+endgame demotion to the exact global runner, the budget/knob
+validation surface, and the cross-shard staleness regimes (heavy
+bound-saturation, class weights, compensated carry, uneven rows). The
+heavy 8-device legs are `slow`; tier-1 keeps a cheap 2-device smoke
+(ISSUE 4 CI-budget satellite).
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.parallel.dist_smo import solve_mesh
+from dpsvm_tpu.solver.smo import solve
+
+BASE = SVMConfig(c=5.0, gamma=0.1, epsilon=1e-3, max_iter=200_000,
+                 engine="block", working_set_size=32)
+
+
+def _sl(cfg, r=2):
+    return cfg.replace(local_working_sets=2, sync_rounds=r)
+
+
+# ---- bit-exact reduction (acceptance criterion) ---------------------
+
+
+def test_bitexact_reduction_at_local_working_sets_1(blobs_medium):
+    """local_working_sets=1, sync_rounds=1 IS the current engine:
+    solve_mesh routes to make_block_chunk_runner, so the trajectory —
+    alpha, f, extrema, pair counts, every chunk boundary — must be
+    BIT-identical to the default (auto) config's."""
+    x, y = blobs_medium
+    obs_a, obs_b = [], []
+
+    def cb(sink):
+        return lambda it, bh, bl, st: sink.append((it, bh, bl)) and None
+
+    cfg = BASE.replace(inner_iters=64, chunk_iters=64)
+    r1 = solve_mesh(x, y, cfg.replace(local_working_sets=1,
+                                      sync_rounds=1),
+                    num_devices=8, callback=cb(obs_a))
+    r0 = solve_mesh(x, y, cfg, num_devices=8, callback=cb(obs_b))
+    assert r1.converged and r0.converged
+    assert r1.iterations == r0.iterations
+    assert obs_a == obs_b
+    np.testing.assert_array_equal(r1.alpha, r0.alpha)
+    np.testing.assert_array_equal(r1.stats["f"], r0.stats["f"])
+    assert (r1.b_hi, r1.b_lo) == (r0.b_hi, r0.b_lo)
+    # The reduction really did route around the shard-local engine.
+    assert "shardlocal_demoted" not in r1.stats
+
+
+# ---- tier-1 smoke (2 devices, small set) ----------------------------
+
+
+def test_shardlocal_two_device_smoke(blobs_small):
+    """Cheap tier-1 leg: 2 concurrent shard chains reach the per-pair
+    oracle optimum (the endgame demotion owns the exact tail)."""
+    x, y = blobs_small
+    rm = solve_mesh(x, y, _sl(BASE.replace(working_set_size=16)),
+                    num_devices=2)
+    rx = solve(x, y, SVMConfig(c=5.0, gamma=0.1, epsilon=1e-3,
+                               max_iter=200_000))
+    assert rm.converged and rx.converged
+    np.testing.assert_allclose(rm.alpha, rx.alpha, atol=5e-2)
+    assert rm.b == pytest.approx(rx.b, abs=5e-3)
+
+
+def test_shardlocal_demotion_reports_and_converges(blobs_small):
+    """The endgame demotion is observable (stats) and final convergence
+    is exact: `converged` comes from the demoted global runner's own
+    stopping rule, never from a shard-local window's stale view."""
+    x, y = blobs_small
+    rm = solve_mesh(x, y, _sl(BASE.replace(working_set_size=16), r=4),
+                    num_devices=2)
+    assert rm.converged
+    assert "shardlocal_demoted" in rm.stats
+    # On every pinned set the local chains starve before the global gap
+    # closes (the last violating pair straddles shards), so the exact
+    # tail must have engaged.
+    assert rm.stats["shardlocal_demoted"] is True
+
+
+def test_shardlocal_validation():
+    with pytest.raises(ValueError, match="block-engine"):
+        SVMConfig(engine="xla", local_working_sets=2)
+    with pytest.raises(ValueError, match="budget_mode"):
+        SVMConfig(engine="block", local_working_sets=2, budget_mode=True)
+    with pytest.raises(ValueError, match="active_set_size"):
+        SVMConfig(engine="block", local_working_sets=2,
+                  active_set_size=64)
+    with pytest.raises(ValueError, match="pipeline_rounds"):
+        SVMConfig(engine="block", local_working_sets=2,
+                  pipeline_rounds=True)
+    with pytest.raises(ValueError, match="feature kernels"):
+        SVMConfig(engine="block", local_working_sets=2,
+                  kernel="precomputed")
+    with pytest.raises(ValueError, match="local_working_sets"):
+        SVMConfig(engine="block", local_working_sets=0)
+    with pytest.raises(ValueError, match="sync_rounds"):
+        SVMConfig(engine="block", sync_rounds=0)
+    # sync_rounds without the shard-local engine would silently no-op.
+    with pytest.raises(ValueError, match="local_working_sets >= 2"):
+        SVMConfig(engine="block", sync_rounds=4)
+    with pytest.raises(ValueError, match="local_working_sets >= 2"):
+        SVMConfig(engine="block", sync_rounds=4, local_working_sets=1)
+    # Legal shapes.
+    SVMConfig(engine="block", local_working_sets=1)
+    SVMConfig(engine="block", local_working_sets=2, sync_rounds=8)
+    SVMConfig(engine="xla", local_working_sets=None, sync_rounds=1)
+
+
+def test_shardlocal_runner_rejects_unsupported():
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_shardlocal_chunk_runner)
+    from dpsvm_tpu.parallel.mesh import make_data_mesh
+
+    with pytest.raises(ValueError, match="feature kernels"):
+        make_block_shardlocal_chunk_runner(
+            make_data_mesh(2), KernelParams("precomputed"), (1.0, 1.0),
+            1e-3, 1e-12, 16, 32, 4)
+    with pytest.raises(ValueError, match="selection"):
+        make_block_shardlocal_chunk_runner(
+            make_data_mesh(2), KernelParams("rbf", 0.1), (1.0, 1.0),
+            1e-3, 1e-12, 16, 32, 4, selection="nu")
+
+
+def test_shardlocal_with_reconstruction_legs(blobs_small):
+    """The extreme-C accuracy mode composes: legs run shard-local with
+    the endgame demotion, convergence is judged on the reconstructed
+    f64 gap, and the hybrid block->per-pair tail switch resets the
+    shard-local knobs with the other block-only ones
+    (solver/reconstruct.py)."""
+    x, y = blobs_small
+    cfg = BASE.replace(working_set_size=16, c=200.0, gamma=0.05,
+                       compensated=True, reconstruct_every=40_000,
+                       max_iter=400_000, local_working_sets=2,
+                       sync_rounds=2)
+    r = solve_mesh(x, y, cfg, num_devices=2)
+    assert r.converged
+    assert r.stats["true_gap"] <= 2 * cfg.epsilon + 1e-9
+
+
+def test_shardlocal_nusvc_falls_back_cleanly(blobs_small):
+    """A user config with local_working_sets=2 must not crash the nu
+    trainers (per-class selection keeps the plain mesh runner — the
+    same silent-fallback contract as pair_batch)."""
+    from dpsvm_tpu.models.nusvm import train_nusvc
+
+    x, y = blobs_small
+    model, res = train_nusvc(x, y, nu=0.3,
+                             config=_sl(BASE.replace(gamma=0.1)),
+                             backend="mesh", num_devices=2)
+    assert res.converged
+
+
+# ---- 8-device trajectory legs (slow: several mesh solves) -----------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sync_rounds", [1, 4])
+def test_shardlocal_mesh_matches_oracle(blobs_medium, sync_rounds):
+    """8 concurrent chains, R in {1, 4}: the shard-local path must reach
+    the oracle duality gap (converged == the refreshed exact stopping
+    rule) and optimum within the mesh tolerance, at a bounded pair
+    inflation — the kappa docs/SCALING.md's round-7 projection charges
+    for cross-shard staleness."""
+    x, y = blobs_medium
+    rp = solve(x, y, BASE)
+    rm = solve_mesh(x, y, _sl(BASE, r=sync_rounds), num_devices=8)
+    assert rp.converged and rm.converged
+    np.testing.assert_allclose(rm.alpha, rp.alpha, atol=5e-2)
+    assert rm.b == pytest.approx(rp.b, abs=5e-3)
+    # Pair-inflation guard: staleness costs pairs, not correctness —
+    # but a runaway here would invalidate the scaling story. The 8x
+    # bound is loose (measured ~3x on this set, recorded in SCALING.md).
+    assert rm.iterations <= 8 * rp.iterations
+
+
+@pytest.mark.slow
+def test_shardlocal_heavy_saturation_regime(blobs_medium):
+    """Tiny C drives most alphas to the bound within a few windows, so
+    cross-shard staleness routinely selects rows another shard's sync
+    just saturated — the regime the selection masks' own-alpha
+    re-derivation must keep safe."""
+    x, y = blobs_medium
+    cfg = _sl(BASE.replace(c=0.05, working_set_size=16), r=4)
+    rm = solve_mesh(x, y, cfg, num_devices=8)
+    rp = solve(x, y, BASE.replace(c=0.05, working_set_size=16))
+    assert rm.converged and rp.converged
+    np.testing.assert_allclose(rm.alpha, rp.alpha, atol=5e-3)
+    assert rm.b == pytest.approx(rp.b, abs=5e-3)
+    assert np.mean(np.isclose(rp.alpha, 0.05)) > 0.5
+
+
+@pytest.mark.slow
+def test_shardlocal_class_weights(blobs_medium):
+    x, y = blobs_medium
+    cfg = _sl(BASE.replace(weight_pos=2.0, weight_neg=0.5), r=2)
+    rm = solve_mesh(x, y, cfg, num_devices=8)
+    rp = solve(x, y, BASE.replace(weight_pos=2.0, weight_neg=0.5))
+    assert rm.converged and rp.converged
+    np.testing.assert_allclose(rm.alpha, rp.alpha, atol=5e-2)
+    assert rm.b == pytest.approx(rp.b, abs=5e-3)
+
+
+@pytest.mark.slow
+def test_shardlocal_compensated_and_second_order(blobs_medium):
+    """The Kahan carry shards like f (sync folds run compensated), and
+    the WSS2 pairing rule rides the same shard-local selection."""
+    x, y = blobs_medium
+    cfg = _sl(BASE.replace(compensated=True, selection="second_order"),
+              r=2)
+    rm = solve_mesh(x, y, cfg, num_devices=8)
+    rp = solve(x, y, BASE.replace(selection="second_order"))
+    assert rm.converged and rp.converged
+    np.testing.assert_allclose(rm.alpha, rp.alpha, atol=5e-2)
+    assert rm.b == pytest.approx(rp.b, abs=5e-3)
+
+
+@pytest.mark.slow
+def test_shardlocal_uneven_rows(blobs_medium):
+    """n not divisible by the device count: pad rows are masked out of
+    every shard-local selection and carry zero fold coefficients."""
+    x, y = blobs_medium
+    x, y = x[:1199], y[:1199]
+    rm = solve_mesh(x, y, _sl(BASE, r=2), num_devices=8)
+    rp = solve(x, y, BASE)
+    assert rm.converged and rp.converged
+    np.testing.assert_allclose(rm.alpha, rp.alpha, atol=5e-2)

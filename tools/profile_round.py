@@ -189,6 +189,116 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
     return rows, fixed_ms, marg
 
 
+def ablate_shardlocal(x, y, cfg, q: int, reps: int, sync_rounds: int,
+                      dtype: str):
+    """Shard-local vs global mesh-runner whole-chunk A/B (ISSUE 4 —
+    the measurement solver/block.py shardlocal_pays is waiting on).
+
+    Builds a data mesh over every visible device and runs `reps`
+    wall-clock rounds of each engine from a salted synthetic start at
+    the full inner budget, differenced over two chunk lengths exactly
+    like ablate(). Reports ms per wall-round and us per EXECUTED pair —
+    the decisive comparison: the shard-local engine runs P concurrent
+    chains, so at equal wall-round cost its pairs/s should approach
+    P x the global runner's (minus the sync fold and any chain
+    imbalance). On a 1-device harness the probe still runs (P=1
+    measures pure sync overhead — the expected-loss regime the auto
+    gate must also know about)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dpsvm_tpu.ops.kernels import (KernelParams, kernel_diag,
+                                       squared_norms)
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_chunk_runner, make_block_shardlocal_chunk_runner)
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh, pad_rows
+    from dpsvm_tpu.solver.block import BlockState
+    from dpsvm_tpu.solver.smo import _BUDGET_EPS
+
+    kp = KernelParams("rbf", cfg.resolve_gamma(x.shape[1]))
+    mesh = make_data_mesh()
+    p_dev = int(mesh.devices.size)
+    on_tpu = jax.default_backend() == "tpu"
+    impl = "pallas" if on_tpu else "xla"
+    n, d = x.shape
+    n_pad = pad_rows(n, p_dev)
+    x_p = np.zeros((n_pad, d), np.float32)
+    x_p[:n] = x
+    y_p = np.ones((n_pad,), np.float32)
+    y_p[:n] = y
+    valid = np.zeros((n_pad,), bool)
+    valid[:n] = True
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    xd = jax.device_put(jnp.asarray(
+        x_p, jnp.bfloat16 if dtype == "bfloat16" else jnp.float32), shard)
+    yd = jax.device_put(jnp.asarray(y_p), shard)
+    x_sq = jax.jit(squared_norms, out_shardings=shard)(xd)
+    k_diag = jax.jit(kernel_diag, static_argnames="params",
+                     out_shardings=shard)(x_sq, params=kp)
+    vd = jax.device_put(jnp.asarray(valid), shard)
+    inner = 2 * q
+    base = BlockState(
+        alpha=jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard),
+        f=jax.device_put(jnp.asarray(-y_p, jnp.float32), shard),
+        b_hi=jax.device_put(jnp.float32(-1e9), rep),
+        b_lo=jax.device_put(jnp.float32(1e9), rep),
+        pairs=jax.device_put(jnp.int32(0), rep),
+        rounds=jax.device_put(jnp.int32(0), rep))
+
+    # rounds_per_chunk is a traced constant baked at build time: build
+    # one runner per chunk length so the differencing has two programs
+    # with identical per-round bodies.
+    def make(kind, rpc):
+        if kind == "global":
+            return make_block_chunk_runner(
+                mesh, kp, cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau),
+                q, inner, rpc, impl)
+        rpc = -(-rpc // sync_rounds) * sync_rounds
+        return make_block_shardlocal_chunk_runner(
+            mesh, kp, cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau),
+            q, inner, rpc, sync_rounds, impl, interpret=not on_tpu)
+
+    print(f"  shard-local A/B: P={p_dev} devices, q={q}, inner={inner}, "
+          f"sync_rounds={sync_rounds}, reps={reps}")
+    results = {}
+    for kind in ("global", "shardlocal"):
+        runs = {}
+        for rpc in (reps, 2 * reps):
+            runner = make(kind, rpc)
+            jax.block_until_ready(runner(
+                xd, yd, x_sq, k_diag, vd, base, jnp.int32(10 ** 9)))
+            best = None
+            for k in range(3):
+                st = base._replace(f=salted(base.f, 7 * rpc + k))
+                t0 = time.perf_counter()
+                out = runner(xd, yd, x_sq, k_diag, vd, st,
+                             jnp.int32(10 ** 9))
+                jax.block_until_ready(out)
+                t = time.perf_counter() - t0
+                if best is None or t < best[0]:
+                    best = (t, int(out.rounds), int(out.pairs))
+            runs[rpc] = best
+        t = max(runs[2 * reps][0] - runs[reps][0], 0.0)
+        rounds = runs[2 * reps][1] - runs[reps][1]
+        pairs = runs[2 * reps][2] - runs[reps][2]
+        results[kind] = (t, rounds, pairs)
+        print(f"  {kind:10s}: {rounds} rounds, {pairs} pairs, "
+              f"{1e3 * t / max(rounds, 1):7.3f} ms/round, "
+              f"{1e6 * t / max(pairs, 1):7.2f} us/pair "
+              f"({pairs / max(t, 1e-9):,.0f} pairs/s)")
+    tg, _, pg = results["global"]
+    ts, _, ps = results["shardlocal"]
+    if tg > 0 and ts > 0:
+        print(f"  => shard-local pairs/s = "
+              f"{(ps / ts) / max(pg / tg, 1e-9):.2f}x the global "
+              f"runner's (ideal ~{p_dev}x minus sync overhead; flip "
+              f"solver/block.py shardlocal_pays from THIS number, "
+              f"measured on a real pod)")
+    return 0
+
+
 # v5e per-chip ceilings (Google's published spec): the MXU runs bf16
 # (and default-precision f32, which lowers to one bf16 pass) matmuls at
 # 197 TFLOP/s; 'highest' f32 is ~6 bf16 passes. HBM streams 819 GB/s.
@@ -274,6 +384,15 @@ def main() -> int:
                          "carry; rows padded to 1024 so the prefetch "
                          "rides the Pallas candidate kernel) — the "
                          "pipelined-vs-plain fixed-cost A/B of ISSUE 2")
+    ap.add_argument("--shardlocal", action="store_true",
+                    help="A/B the shard-local mesh runner against the "
+                         "global-working-set mesh runner over every "
+                         "visible device (ISSUE 4: P concurrent "
+                         "subproblem chains per sync; the probe the "
+                         "shardlocal_pays auto gate is waiting on)")
+    ap.add_argument("--sync-rounds", type=int, default=4,
+                    help="--shardlocal: local rounds between syncs "
+                         "(default 4)")
     ap.add_argument("--roofline", action="store_true",
                     help="print the per-stage FLOPs/bytes roofline table "
                          "vs the v5e MXU/HBM ceilings and exit (no "
@@ -321,6 +440,9 @@ def main() -> int:
     n, d = x.shape
     if args.roofline:
         return roofline(n, d, q, args.dtype, fixed_ms=args.fixed_ms)
+    if args.shardlocal:
+        return ablate_shardlocal(x, y, cfg, q, args.reps,
+                                 args.sync_rounds, args.dtype)
     kp = KernelParams("rbf", cfg.resolve_gamma(d))
     valid_dev = None
     if args.fused or args.pipeline:
