@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify bench bench_predict bench_serve fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets bench bench_predict bench_serve fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -29,6 +29,25 @@ verify:
 
 test_all:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+# The local mirror of CI's lint gates (tier1.yml): compileall, the
+# tpulint static HLO/jaxpr contract check against committed budgets
+# (per-entrypoint PASS/DRIFT table), and ruff when installed (CI pins
+# and enforces it; locally it is best-effort so the target works on
+# the bare image).
+lint:
+	$(PY) -m compileall -q dpsvm_tpu tools tests bench.py
+	$(PY) -m tools.tpulint --check
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check dpsvm_tpu tools tests bench.py; \
+	else \
+	  echo "ruff not installed locally; CI enforces it (tier1.yml)"; \
+	fi
+
+# Regenerate dpsvm_tpu/analysis/budgets/*.json after an INTENTIONAL
+# structural change; commit the JSON diff (it is the review artifact).
+lint_budgets:
+	$(PY) -m tools.tpulint --write-budgets
 
 bench:
 	$(PY) bench.py

@@ -1,0 +1,28 @@
+"""tpulint: static analysis of the repo's compile-time contracts.
+
+The paper's contribution is structural: one gather per SMO sync, kernel
+rows as dense GEMVs, no per-row host round-trips. Those are facts about
+LOWERED PROGRAMS, not runtime samples — so they are checkable on every
+CI run with no TPU attached. This package extracts structured facts
+from the jaxpr + compiled HLO of a manifest of hot entrypoints
+(`manifest.py`), diffs them against checked-in budgets
+(`budgets/*.json`, `budget.py`), and surfaces the verdict via
+``python -m tools.tpulint`` / ``cli lint``.
+
+Modules:
+  hlo_facts -- pure fact primitives over HLO text / jaxprs
+  extract   -- per-entry orchestration (lower, compile, walk, collect)
+  manifest  -- the canonical entrypoints and shapes
+  budget    -- budget IO, drift diffing, verdicts, the lint runner
+"""
+
+from dpsvm_tpu.analysis.hlo_facts import (  # noqa: F401
+    collective_facts,
+    collective_ops,
+    donation_facts,
+    dot_facts,
+    dot_result_shapes,
+    dtype_facts,
+    jaxpr_facts,
+    transfer_facts,
+)

@@ -1,0 +1,250 @@
+"""Budget IO, drift diffing, verdicts, and the lint runner.
+
+A budget is one JSON file per manifest entry
+(``dpsvm_tpu/analysis/budgets/<entry>.json``) holding the entry's full
+fact tree. ``check`` re-extracts the facts and diffs them leaf-by-leaf
+with a DENY-by-default verdict: any changed, added, or removed fact is
+a DRIFT naming the entrypoint and the violated fact path. A budget may
+carry an explicit ``"allow"`` list of fact-path prefixes whose drifts
+are reported but tolerated (the escape hatch for facts known to vary
+across XLA releases — empty everywhere today).
+
+Regenerating after an INTENTIONAL structural change is
+``python -m tools.tpulint --write-budgets`` (then commit the diff: the
+budget delta IS the review artifact, see docs/ARCHITECTURE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+BUDGET_DIR = Path(__file__).parent / "budgets"
+
+PASS, DRIFT, MISSING, ERROR = "PASS", "DRIFT", "MISSING_BUDGET", "ERROR"
+ORPHAN = "ORPHAN_BUDGET"
+
+
+def budget_path(entry: str, budget_dir=None) -> Path:
+    return Path(budget_dir or BUDGET_DIR) / f"{entry}.json"
+
+
+def load_budget(entry: str, budget_dir=None):
+    p = budget_path(entry, budget_dir)
+    if not p.exists():
+        return None
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def write_budget(entry: str, facts: dict, budget_dir=None) -> Path:
+    import jax
+
+    p = budget_path(entry, budget_dir)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    # The facts are exact properties of lowered HLO, so they are coupled
+    # to the jax/XLA release that generated them; the recorded version
+    # lets in-suite consumers skip (rather than spuriously fail) under a
+    # different jax, while the pinned CI tpulint job stays the gate.
+    doc = {"entry": entry, "allow": [], "jax": jax.__version__,
+           "facts": facts}
+    with open(p, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return p
+
+
+def budget_jax_version(budget_dir=None):
+    """The jax version the committed budgets were generated under (None
+    when no budget records one). A MIXED set — some files regenerated
+    under a different jax than the rest, e.g. a partial
+    ``--write-budgets --entries ...`` commit — is a hard error: every
+    consumer (the in-suite skip gate, run_lint's version-skew notice)
+    would otherwise key off whichever file happens to sort first."""
+    seen = {}
+    for p in sorted(Path(budget_dir or BUDGET_DIR).glob("*.json")):
+        with open(p) as fh:
+            v = json.load(fh).get("jax")
+        if v:
+            seen.setdefault(v, []).append(p.name)
+    if len(seen) > 1:
+        raise ValueError(
+            "mixed jax versions across committed budgets — regenerate "
+            "ALL of them under one jax (make lint_budgets): "
+            + "; ".join(f"{v}: {', '.join(names)}"
+                        for v, names in sorted(seen.items())))
+    return next(iter(seen), None)
+
+
+def orphan_budgets(entries, budget_dir=None):
+    """Budget files with no manifest entry — a renamed/deleted
+    entrypoint whose stale budget would otherwise ship green (the
+    deny-by-default contract must cover the entry level too)."""
+    known = set(entries)
+    return [p.stem for p in sorted(Path(budget_dir or BUDGET_DIR)
+                                   .glob("*.json"))
+            if p.stem not in known]
+
+
+def diff_facts(budgeted, observed, path=""):
+    """Leaf-level [(fact_path, budgeted, observed)] differences, in
+    deterministic path order. Missing vs extra keys are diffs too — a
+    fact family that vanishes is as much a drift as one that changes."""
+    diffs = []
+    if isinstance(budgeted, dict) and isinstance(observed, dict):
+        for k in sorted(set(budgeted) | set(observed)):
+            sub = f"{path}.{k}" if path else k
+            if k not in budgeted:
+                diffs.append((sub, "<absent>", observed[k]))
+            elif k not in observed:
+                diffs.append((sub, budgeted[k], "<absent>"))
+            else:
+                diffs.extend(diff_facts(budgeted[k], observed[k], sub))
+    elif budgeted != observed:
+        diffs.append((path, budgeted, observed))
+    return diffs
+
+
+def check_entry(entry: str, observed: dict, budget_dir=None) -> dict:
+    doc = load_budget(entry, budget_dir)
+    if doc is None:
+        return {"entry": entry, "verdict": MISSING, "diffs": [],
+                "allowed": []}
+    allow = tuple(doc.get("allow", []))
+    diffs = diff_facts(doc.get("facts", {}), observed)
+    denied = [d for d in diffs
+              if not any(d[0].startswith(a) for a in allow)]
+    allowed = [d for d in diffs
+               if any(d[0].startswith(a) for a in allow)]
+    return {"entry": entry, "verdict": DRIFT if denied else PASS,
+            "diffs": denied, "allowed": allowed}
+
+
+def drift_table(results) -> str:
+    """The human-readable PASS/DRIFT summary (one row per entrypoint,
+    then one line per violated fact)."""
+    width = max([len(r["entry"]) for r in results] + [10])
+    lines = [f"{'entrypoint':<{width}}  verdict",
+             f"{'-' * width}  -------"]
+    for r in results:
+        note = ""
+        if r["allowed"]:
+            note = f"  ({len(r['allowed'])} allowed drift(s))"
+        lines.append(f"{r['entry']:<{width}}  {r['verdict']}{note}")
+    for r in results:
+        for path, want, got in r["diffs"]:
+            lines.append(f"  DRIFT {r['entry']}: {path}: "
+                         f"budget={want!r} observed={got!r}")
+        for path, want, got in r["allowed"]:
+            lines.append(f"  allow {r['entry']}: {path}: "
+                         f"budget={want!r} observed={got!r}")
+        if r["verdict"] == MISSING:
+            lines.append(f"  DRIFT {r['entry']}: no committed budget — "
+                         f"run tools/tpulint.py --write-budgets")
+        if r["verdict"] == ORPHAN:
+            lines.append(f"  DRIFT {r['entry']}: budget file has no "
+                         f"manifest entry — delete the stale JSON (or "
+                         f"restore the entrypoint)")
+    return "\n".join(lines)
+
+
+def _force_cpu_backend() -> None:
+    """The conftest.py dance: the budgets describe CPU-backend programs
+    over 8 virtual devices, so force that platform regardless of any
+    TPU the host may have attached. XLA_FLAGS must be set before the
+    backend initializes; jax_platforms can still be flipped after
+    import (this image's sitecustomize imports jax at startup)."""
+    from dpsvm_tpu.analysis.manifest import DEVICE_COUNT
+
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    # Replace (not skip) any pre-existing count: an inherited
+    # --xla_force_host_platform_device_count=2 would otherwise survive
+    # and dead-end require_devices() with advice the user already took.
+    flags, n = re.subn(r"--xla_force_host_platform_device_count=\d+",
+                       f"--xla_force_host_platform_device_count="
+                       f"{DEVICE_COUNT}", flags)
+    if not n:
+        flags = (flags + f" --xla_force_host_platform_device_count="
+                 f"{DEVICE_COUNT}").strip()
+    os.environ["XLA_FLAGS"] = flags
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def run_lint(argv=None) -> int:
+    """The engine behind ``python -m tools.tpulint`` and ``cli lint``.
+
+    --check (default): extract facts for the manifest and diff against
+    committed budgets; exit 0 only if every entry PASSes.
+    --write-budgets: overwrite the budget files with observed facts.
+    --entries a,b,c: restrict to a subset.
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="static HLO/jaxpr contract linter (ISSUE 5): lower "
+                    "the hot-entrypoint manifest on the CPU backend and "
+                    "diff structured facts against committed budgets")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true", default=False,
+                      help="diff facts against budgets (the default)")
+    mode.add_argument("--write-budgets", action="store_true",
+                      help="regenerate budget files from observed facts")
+    ap.add_argument("--entries", default=None,
+                    help="comma-separated manifest subset (default all)")
+    ap.add_argument("--budgets-dir", default=None,
+                    help=f"budget directory (default {BUDGET_DIR})")
+    args = ap.parse_args(argv)
+
+    _force_cpu_backend()
+    from dpsvm_tpu.analysis.extract import extract_entries
+    from dpsvm_tpu.analysis.manifest import MANIFEST, require_devices
+
+    require_devices()
+    names = args.entries.split(",") if args.entries else None
+    observed = extract_entries(MANIFEST, names)
+
+    if args.write_budgets:
+        for entry, facts in observed.items():
+            p = write_budget(entry, facts, args.budgets_dir)
+            print(f"wrote {p}")
+        if names is None:
+            # Full regeneration knows the whole manifest: prune stale
+            # budgets (a renamed/deleted entrypoint) so the very next
+            # --check doesn't fail ORPHAN on the state this tool wrote.
+            for e in orphan_budgets(MANIFEST, args.budgets_dir):
+                p = budget_path(e, args.budgets_dir)
+                p.unlink()
+                print(f"removed stale {p} (no manifest entry)")
+        return 0
+
+    import jax
+
+    gen = budget_jax_version(args.budgets_dir)
+    if gen is not None and gen != jax.__version__:
+        # Don't let a version skew masquerade as structural drift: the
+        # facts are exact properties of lowered HLO, so diffs below may
+        # be the jax/XLA release, not the repo. Still run the diff (it
+        # is exact either way) but say why it may be noisy.
+        print(f"NOTE: budgets were generated under jax {gen}; running "
+              f"{jax.__version__} — DRIFTs below may reflect the "
+              f"jax/XLA version, not a repo regression (bump the "
+              f"tier1.yml pin and `make lint_budgets` together)")
+    results = [check_entry(entry, facts, args.budgets_dir)
+               for entry, facts in observed.items()]
+    if names is None:
+        # Full-manifest check: a committed budget whose entrypoint left
+        # the manifest is lost coverage, not a silent no-op.
+        results += [{"entry": e, "verdict": ORPHAN, "diffs": [],
+                     "allowed": []}
+                    for e in orphan_budgets(MANIFEST, args.budgets_dir)]
+    print(drift_table(results))
+    bad = [r for r in results if r["verdict"] != PASS]
+    print(f"\ntpulint: {len(results) - len(bad)}/{len(results)} "
+          f"entrypoints within budget")
+    return 1 if bad else 0

@@ -1,0 +1,101 @@
+"""Per-entrypoint fact extraction: lower, compile, walk, collect.
+
+A manifest entry is a list of Units — one Unit per EXECUTABLE the host
+loop dispatches per logical step (so ``dispatches`` is itself a pinned
+fact: e.g. the shard-local engine's whole sync window costs the chunk
+runner plus the packed-observation pull, 2 dispatches — PR 4's
+contract). Each unit lowers at canonical shapes on the CPU backend and
+yields the fact families from hlo_facts; a unit that cannot even TRACE
+(Python branching on a traced value) is itself reported as a
+recompile-hazard fact instead of crashing the linter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from dpsvm_tpu.analysis import hlo_facts
+
+
+@dataclasses.dataclass
+class Unit:
+    """One lowerable executable of an entrypoint.
+
+    lower      -- () -> jax.stages.Lowered at the canonical shapes
+    make_jaxpr -- optional () -> ClosedJaxpr of the same call (for the
+                  jaxpr-walk facts; skipped when tracing is the thing
+                  under test)
+    """
+
+    name: str
+    lower: Callable
+    make_jaxpr: Optional[Callable] = None
+
+
+def _declared_donated(lowered) -> Optional[int]:
+    """Leaf count of jit-level donated args, from Lowered.args_info
+    (jax >= 0.4.31); None when the metadata is unavailable."""
+    try:
+        import jax
+
+        return sum(bool(a.donated)
+                   for a in jax.tree_util.tree_leaves(lowered.args_info))
+    except Exception:
+        return None
+
+
+def unit_facts(unit: Unit) -> dict:
+    """All fact families for one unit. Never raises for trace/compile
+    failures — those become facts (`trace_error` / `compile_error`) so
+    a hazard INTRODUCED by a refactor shows up as a budget drift naming
+    the entrypoint, exactly like any other violated fact."""
+    facts: dict = {"hazards": {"traced_branch": False}}
+    try:
+        lowered = unit.lower()
+    except Exception as e:  # TracerBoolConversionError et al.
+        kind = type(e).__name__
+        facts["hazards"]["traced_branch"] = (
+            "TracerBool" in kind or "Concretization" in kind)
+        facts["trace_error"] = kind
+        return facts
+    try:
+        text = lowered.compile().as_text()
+    except Exception as e:
+        facts["compile_error"] = type(e).__name__
+        return facts
+
+    facts["collectives"] = hlo_facts.collective_facts(text)
+    facts["transfers"] = hlo_facts.transfer_facts(text)
+    facts["dots"] = hlo_facts.dot_facts(text)
+    facts["dtypes"] = hlo_facts.dtype_facts(text)
+    facts["donation"] = hlo_facts.donation_facts(
+        text, declared_donated=_declared_donated(lowered))
+    if unit.make_jaxpr is not None:
+        jx = unit.make_jaxpr()
+        facts["hazards"].update(hlo_facts.jaxpr_facts(jx))
+    return facts
+
+
+def entry_facts(units) -> dict:
+    """Facts for one manifest entry: per-unit fact dicts plus the
+    dispatch count (len(units) — the number of executables the host
+    loop runs per logical step of this entrypoint)."""
+    return {
+        "dispatches": len(units),
+        "units": {u.name: unit_facts(u) for u in units},
+    }
+
+
+def extract_entries(manifest: dict, names=None) -> dict:
+    """{entry_name: facts} for the selected manifest entries (all when
+    `names` is None). Each manifest value is a zero-arg builder
+    returning [Unit, ...] — building is deferred so `--entries foo`
+    pays only foo's trace/compile time."""
+    selected = list(manifest) if names is None else list(names)
+    unknown = [n for n in selected if n not in manifest]
+    if unknown:
+        raise KeyError(
+            f"unknown manifest entries {unknown}; known: "
+            f"{sorted(manifest)}")
+    return {name: entry_facts(manifest[name]()) for name in selected}

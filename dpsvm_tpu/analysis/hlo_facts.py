@@ -1,0 +1,268 @@
+"""Fact primitives over compiled HLO text and jaxprs.
+
+Everything here is a pure function of program TEXT or of a traced
+jaxpr — no device work, no RNG, no wall clock — so the same program
+always yields the same facts and a budget diff is meaningful. The
+collective parser started life as tests/test_hlo_collectives.py's
+``_collective_ops`` and moved here so the ad-hoc HLO pin tests and the
+budget linter read one definition.
+"""
+
+from __future__ import annotations
+
+import re
+
+_DTYPE_BYTES = {"f32": 4, "s32": 4, "u32": 4, "pred": 1, "f64": 8,
+                "s64": 8, "u64": 8, "bf16": 2, "f16": 2, "s16": 2,
+                "u16": 2, "s8": 1, "u8": 1}
+
+#: collective op kinds the linter accounts for. reduce-scatter shows up
+#: as its own op name in modern XLA; permute/all-to-all would mean a
+#: different distribution algorithm entirely.
+COLLECTIVE_KINDS = ("all-gather", "all-reduce", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+#: ops that move data across the host boundary inside a compiled
+#: program — the "no per-row host round-trips" contract says every hot
+#: entrypoint has ZERO of these.
+TRANSFER_KINDS = ("infeed", "outfeed", "send", "recv")
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    el = 1
+    for d in dims.split(","):
+        if d:
+            el *= int(d)
+    return el * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _op_def_re(kind: str) -> re.Pattern:
+    """Regex matching the DEFINITION of a `kind` op — the op name right
+    after `= <result shape>` — not mere mentions inside operand lists or
+    metadata. Shapes may be tuples (combined collectives) and may carry
+    a layout suffix: ``f32[8,2,256]{2,1,0} all-gather(...)``."""
+    return re.compile(r"= *((?:\([^)]*\))|(?:[a-z0-9]+\[[^\]]*\]"
+                      r"(?:\{[^}]*\})?)) *"
+                      + re.escape(kind) + r"(?:-start)?\(")
+
+
+def collective_ops(hlo_text: str, kind: str):
+    """[(op_line, [(dtype, bytes), ...])] for every `kind` op defined in
+    the text, parsing the RESULT shape(s). Async collectives lower to a
+    start/done pair naming one exchange — only the `-start` (or the sync
+    form) is counted; `-done` produces no result shape of its own in the
+    texts we pin (and double-counting one exchange would corrupt the
+    payload accounting)."""
+    out = []
+    done_re = re.compile(re.escape(kind) + r"-done\(")
+    op_re = _op_def_re(kind)
+    for line in hlo_text.splitlines():
+        if done_re.search(line):
+            continue
+        m = op_re.search(line)
+        if not m:
+            continue
+        sizes = [(dt, _shape_bytes(dt, dims))
+                 for dt, dims in _SHAPE_RE.findall(m.group(1))]
+        out.append((line.strip(), sizes))
+    return out
+
+
+def collective_facts(hlo_text: str) -> dict:
+    """Per-kind dispatch count + per-result payload bytes (sorted) +
+    total bytes, for every kind in COLLECTIVE_KINDS. Kinds absent from
+    the program are recorded as explicit zeros so a budget diff names
+    the fact that APPEARED, not just a missing key."""
+    facts = {}
+    for kind in COLLECTIVE_KINDS:
+        ops = collective_ops(hlo_text, kind)
+        payloads = sorted(s for _, sizes in ops for _, s in sizes)
+        facts[kind] = {"count": len(ops), "payload_bytes": payloads,
+                       "total_bytes": sum(payloads)}
+    return facts
+
+
+_CALLBACK_TARGET_RE = re.compile(
+    r'custom_call_target="[^"]*callback[^"]*"')
+
+
+def _op_def_count(hlo_text: str, kind: str) -> int:
+    """Count definitions of `kind` ops by name, shape-agnostic —
+    infeed's nested-tuple result ((...), token[]) defeats the strict
+    shape parser collective_ops uses, and for the host-boundary
+    contract the COUNT is the fact."""
+    done = re.compile(re.escape(kind) + r"-done\(")
+    op = re.compile(r"= .*\b" + re.escape(kind) + r"(?:-start)?\(")
+    return sum(1 for line in hlo_text.splitlines()
+               if op.search(line) and not done.search(line))
+
+
+def transfer_facts(hlo_text: str) -> dict:
+    """Host-boundary op counts: infeed/outfeed/send/recv, plus
+    host_callbacks — jax host callbacks (io_callback / pure_callback /
+    debug prints) lower to custom-calls whose target names contain
+    "callback", NOT to infeed/outfeed, so a per-row host round-trip
+    smuggled in through a callback is counted here. copy-start/copy-
+    done pairs are device-side (async copies) and deliberately NOT
+    counted; the contract is about host round-trips."""
+    facts = {kind: _op_def_count(hlo_text, kind)
+             for kind in TRANSFER_KINDS}
+    facts["host_callbacks"] = len(_CALLBACK_TARGET_RE.findall(hlo_text))
+    return facts
+
+
+_DOT_DEF_RE = re.compile(
+    r"= *([a-z0-9]+)\[([0-9,]*)\](?:\{[^}]*\})? *dot\(")
+
+
+def dot_result_shapes(hlo_text: str):
+    """[(dtype, (dims...)), ...] for every dot op defined in the text —
+    the raw material for both the budget facts and the ad-hoc pin tests
+    (tests/test_compacted.py counts kernel matmuls from these)."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _DOT_DEF_RE.search(line)
+        if m:
+            dims = tuple(int(d) for d in m.group(2).split(",") if d)
+            out.append((m.group(1), dims))
+    return out
+
+
+def dot_facts(hlo_text: str) -> dict:
+    """Dot/GEMM structure: total count, max result rank, and the count
+    of BATCHED products (result rank >= 3) — the stacked-ensemble shape
+    the compacted inference contract forbids on kernel paths."""
+    shapes = dot_result_shapes(hlo_text)
+    ranks = [len(dims) for _, dims in shapes]
+    return {"count": len(shapes),
+            "max_result_rank": max(ranks, default=0),
+            "batched_rank3plus": sum(1 for r in ranks if r >= 3)}
+
+
+_CONVERT_RE = re.compile(
+    r"= *([a-z0-9]+)\[[^\]]*\](?:\{[^}]*\})? *convert\(([a-z0-9]+)\[")
+
+
+def dtype_facts(hlo_text: str) -> dict:
+    """Dtype-promotion facts of the compiled program.
+
+    f64 anywhere on a device path is a leak (the solvers' f64 legs are
+    HOST paths by design); f32->bf16 converts are counted so a budget
+    can pin exactly the INTENDED quantization points (e.g. the serving
+    engine's bf16 union storage) and any new one is a drift."""
+    converts = _CONVERT_RE.findall(hlo_text)
+    return {
+        "f64_result_ops": len(re.findall(r"= *f64\[", hlo_text)),
+        "f64_present": "f64[" in hlo_text,
+        "f32_to_bf16_converts": sum(1 for to, frm in converts
+                                    if to == "bf16" and frm == "f32"),
+        "bf16_to_f32_converts": sum(1 for to, frm in converts
+                                    if to == "f32" and frm == "bf16"),
+        "f32_to_f64_converts": sum(1 for to, frm in converts
+                                   if to == "f64" and frm == "f32"),
+    }
+
+
+_LAYOUT_HDR_RE = re.compile(r"entry_computation_layout=\{(\(.*?\))"
+                            r"->(\(?.*?\)?)(?:, [a-z_]+=|$)")
+
+
+def _header_shapes(group: str):
+    return [(dt, dims) for dt, dims in _SHAPE_RE.findall(group)]
+
+
+def donation_facts(hlo_text: str, declared_donated: int = None) -> dict:
+    """Buffer-donation facts from the HloModule header.
+
+    aliased_outputs -- entries in ``input_output_alias`` (what XLA
+        actually committed to reusing);
+    donatable -- inputs whose (dtype, dims) multiset-match some output:
+        the ceiling on what donation COULD free;
+    missed -- donatable minus aliased: donatable args not donated =
+        extra HBM live-set, the fact the budget pins at 0 for the hot
+        training loops;
+    declared_donated -- the jit-level donate_argnums leaf count when the
+        caller knows it (None when only text is available).
+    """
+    header = hlo_text.splitlines()[0] if hlo_text else ""
+    # One `may-alias`/`must-alias` token per committed alias entry —
+    # counting tokens sidesteps the nested-brace parse of the
+    # input_output_alias map.
+    aliased = (header.count("may-alias") + header.count("must-alias")
+               if "input_output_alias" in header else 0)
+    donatable = 0
+    lm = _LAYOUT_HDR_RE.search(header)
+    if lm:
+        ins = _header_shapes(lm.group(1))
+        outs = _header_shapes(lm.group(2))
+        for shp in ins:
+            if shp in outs:
+                outs.remove(shp)
+                donatable += 1
+    facts = {"aliased_outputs": aliased, "donatable": donatable,
+             "missed": max(0, donatable - aliased)}
+    if declared_donated is not None:
+        facts["declared_donated"] = declared_donated
+    return facts
+
+
+def _walk_jaxpr(jaxpr, seen, visit):
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    visit(jaxpr)
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                _walk_jaxpr(inner, seen, visit)
+            elif hasattr(v, "eqns"):
+                _walk_jaxpr(v, seen, visit)
+            elif isinstance(v, (list, tuple)):
+                for vv in v:
+                    inner = getattr(vv, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        _walk_jaxpr(inner, seen, visit)
+                    elif hasattr(vv, "eqns"):
+                        _walk_jaxpr(vv, seen, visit)
+
+
+def jaxpr_facts(closed_jaxpr) -> dict:
+    """Recompile-hazard facts from a jaxpr walk (recursing through
+    pjit/while/cond/scan sub-jaxprs).
+
+    weak_in_avals -- weak-typed ENTRY avals: the caller passed a bare
+        Python scalar as a traced arg, so a later int-vs-float call
+        retraces and type-promotes differently — the budgets pin 0;
+    weak_const_avals -- weak-typed captured constants (same promotion
+        hazard, closure-side);
+    f64_avals -- any float64 aval anywhere in the program (the jaxpr
+        view of the f64-leak fact, catching leaks XLA folds away before
+        the HLO text).
+    """
+    import numpy as np
+
+    weak_consts = 0
+    f64 = 0
+    seen: set = set()
+
+    def visit(jx):
+        nonlocal weak_consts, f64
+        for v in getattr(jx, "constvars", []):
+            if getattr(v.aval, "weak_type", False):
+                weak_consts += 1
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                dt = getattr(var.aval, "dtype", None)
+                if dt is not None and dt == np.float64:
+                    f64 += 1
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, seen, visit)
+    return {
+        "weak_in_avals": sum(bool(getattr(a, "weak_type", False))
+                             for a in closed_jaxpr.in_avals),
+        "weak_const_avals": weak_consts,
+        "f64_avals": f64,
+    }

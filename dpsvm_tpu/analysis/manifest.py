@@ -1,0 +1,289 @@
+"""The manifest of hot entrypoints tpulint lowers and budgets.
+
+One entry per compiled program whose STRUCTURE the reproduction's wins
+depend on (ISSUE 5): the single-chip block round, the fleet chain, the
+three mesh chunk runners (global / pipelined / shard-local), compacted
+multiclass decision, the serving bucket executors (f32 and the bf16
+storage variant), and mesh prediction. Shapes are canonical-small —
+op structure is shape-independent (the test_pipelined.py discipline) —
+so the whole manifest traces+compiles in seconds on the CPU backend.
+
+Chunk-runner entries carry TWO units: the runner itself plus the packed
+scalar observation pull (solver/smo.py ``_pack_obs``) — the host loop's
+complete per-observation dispatch set, so ``dispatches`` pins PR 4's
+2-dispatches-per-sync contract.
+
+Every entry requires DEVICE_COUNT visible devices (the suite's 8
+virtual CPU devices); `require_devices()` fails loudly otherwise rather
+than silently lowering a different program.
+"""
+
+from __future__ import annotations
+
+# Canonical shapes, shared with tests/test_hlo_collectives.py's
+# small-shape pins so budgets and pin tests describe the SAME programs.
+DEVICE_COUNT = 8
+N, D, Q, INNER = 4096, 24, 64, 128
+R_SYNC = 4
+ROUNDS_PER_CHUNK = 4
+C_BOUNDS = (5.0, 5.0)
+EPS, TAU = 1e-3, 1e-12
+GAMMA = 0.1
+# Serving / inference shapes: S union rows, K submodel columns, M_PAD
+# padded per-model SV slots, NB query rows per bucket.
+S_UNION, K_MODELS, M_PAD, NB = 256, 10, 64, 64
+
+
+def require_devices() -> None:
+    import jax
+
+    have = len(jax.devices())
+    if have < DEVICE_COUNT:
+        raise RuntimeError(
+            f"tpulint needs {DEVICE_COUNT} devices for the mesh entries "
+            f"but only {have} are visible. Run through "
+            f"`python -m tools.tpulint` (which forces the CPU backend "
+            f"with {DEVICE_COUNT} virtual devices) or set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={DEVICE_COUNT} "
+            f"before jax initializes.")
+
+
+def _kp():
+    from dpsvm_tpu.ops.kernels import KernelParams
+
+    return KernelParams("rbf", GAMMA)
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _block_state(n):
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.solver.block import BlockState
+
+    return BlockState(
+        alpha=_sds((n,), jnp.float32), f=_sds((n,), jnp.float32),
+        b_hi=_sds((), jnp.float32), b_lo=_sds((), jnp.float32),
+        pairs=_sds((), jnp.int32), rounds=_sds((), jnp.int32))
+
+
+def _chunk_args(n):
+    import jax.numpy as jnp
+
+    return (_sds((n, D), jnp.float32), _sds((n,), jnp.float32),
+            _sds((n,), jnp.float32), _sds((n,), jnp.float32),
+            _sds((n,), jnp.bool_), _block_state(n),
+            _sds((), jnp.int32))
+
+
+def _obs_unit():
+    """The packed-observation pull every chunk driver dispatches after
+    the runner (solver/smo.py _pack_obs)."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.solver.smo import _pack_obs
+
+    args = (_sds((), jnp.int32), _sds((), jnp.float32),
+            _sds((), jnp.float32))
+    return Unit("pack_obs", lambda: _pack_obs.lower(*args))
+
+
+def _jaxpr_of(fn, *args, **kw):
+    import jax
+
+    return lambda: jax.make_jaxpr(lambda *a: fn(*a, **kw))(*args)
+
+
+def block_chunk_single():
+    """Single-chip block-SMO chunk — the paper's one-GEMV-per-round
+    contract on one chip, via the DONATED runner the solve driver
+    dispatches."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.solver.block import run_chunk_block_donated
+
+    kw = dict(kp=_kp(), c=C_BOUNDS, eps=EPS, tau=TAU, q=Q,
+              inner_iters=INNER, rounds_per_chunk=ROUNDS_PER_CHUNK,
+              inner_impl="xla")
+    args = _chunk_args(N)
+    return [
+        Unit("chunk",
+             lambda: run_chunk_block_donated.lower(*args, **kw),
+             _jaxpr_of(run_chunk_block_donated, *args, **kw)),
+        _obs_unit(),
+    ]
+
+
+def fleet_chunk():
+    """Batched multi-problem SMO chunk (solver/fleet.py): the whole
+    OvO/OvR fleet advances in ONE dispatch per chunk."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.solver.fleet import FleetState, _run_fleet_chunk
+
+    k, n = K_MODELS, 512
+    state = FleetState(
+        alpha=_sds((k, n), jnp.float32), f=_sds((k, n), jnp.float32),
+        b_hi=_sds((k,), jnp.float32), b_lo=_sds((k,), jnp.float32),
+        it=_sds((k,), jnp.int32), t=_sds((), jnp.int32))
+    args = (_sds((n, D), jnp.float32), _sds((k, n), jnp.float32),
+            _sds((n,), jnp.float32), _sds((k, n), jnp.bool_),
+            _sds((k, 2), jnp.float32), state, _sds((), jnp.int32))
+    kw = dict(kp=_kp(), eps=EPS, tau=TAU, chunk=256)
+    return [Unit("chunk", lambda: _run_fleet_chunk.lower(*args, **kw),
+                 _jaxpr_of(_run_fleet_chunk, *args, **kw))]
+
+
+def _mesh(n_dev=DEVICE_COUNT):
+    from dpsvm_tpu.parallel.mesh import make_data_mesh
+
+    return make_data_mesh(n_dev)
+
+
+def mesh_chunk():
+    """Global mesh block chunk: ONE candidate all_gather pair + the
+    (q, d) + (q, 5) working-set psum per round, nothing else."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.parallel.dist_block import make_block_chunk_runner
+
+    runner = make_block_chunk_runner(
+        _mesh(), _kp(), C_BOUNDS, EPS, TAU, Q, INNER,
+        rounds_per_chunk=1, inner_impl="xla", donate_state=True)
+    args = _chunk_args(N)
+    return [Unit("chunk", lambda: runner.lower(*args),
+                 _jaxpr_of(runner, *args)),
+            _obs_unit()]
+
+
+def pipelined_chunk():
+    """Pipelined mesh chunk (PR 2): same total psum payload as the
+    plain round, split prefetched (overlappable) + (q, 2) handoff."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_pipelined_chunk_runner)
+
+    runner = make_block_pipelined_chunk_runner(
+        _mesh(), _kp(), C_BOUNDS, EPS, TAU, Q, INNER, 1,
+        inner_impl="xla", donate_state=True)
+    args = _chunk_args(N)
+    return [Unit("chunk", lambda: runner.lower(*args),
+                 _jaxpr_of(runner, *args)),
+            _obs_unit()]
+
+
+def shardlocal_chunk():
+    """Shard-parallel working sets (PR 4): one touched-rows all_gather
+    plus one (2,) max-allreduce per R-round sync window — and exactly
+    2 host dispatches per sync."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_shardlocal_chunk_runner)
+
+    runner = make_block_shardlocal_chunk_runner(
+        _mesh(), _kp(), C_BOUNDS, EPS, TAU, Q, INNER,
+        rounds_per_chunk=R_SYNC, sync_rounds=R_SYNC, inner_impl="xla",
+        donate_state=True)
+    args = _chunk_args(N)
+    return [Unit("chunk", lambda: runner.lower(*args),
+                 _jaxpr_of(runner, *args)),
+            _obs_unit()]
+
+
+def compacted_decision():
+    """Shared-SV compacted multiclass decision (PR 3): ONE feature-dim
+    kernel matmul per query block, NO rank-3 stacked product."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.models.multiclass import _compacted_batch_factory
+
+    batch = _compacted_batch_factory()
+    args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), jnp.float32),
+            _sds((K_MODELS, M_PAD), jnp.float32),
+            _sds((K_MODELS, M_PAD), jnp.int32),
+            _sds((K_MODELS,), jnp.float32))
+    kw = dict(kp=_kp())
+    return [Unit("batch", lambda: batch.lower(*args, **kw),
+                 _jaxpr_of(batch, *args, **kw))]
+
+
+def _serve_bucket_units(dtype_str):
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.serve import _dense_batch_factory
+
+    batch = _dense_batch_factory()
+    sv_dt = jnp.bfloat16 if dtype_str == "bfloat16" else jnp.float32
+    args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), sv_dt),
+            _sds((S_UNION,), jnp.float32),
+            _sds((S_UNION, K_MODELS), jnp.float32),
+            _sds((K_MODELS,), jnp.float32))
+    kw = dict(kp=_kp())
+    return [Unit("batch", lambda: batch.lower(*args, **kw),
+                 _jaxpr_of(batch, *args, **kw))]
+
+
+def serve_bucket():
+    """PredictServer single-device bucket executor, f32 union storage:
+    one dense (nb, S) kernel matmul + the K @ C contraction."""
+    return _serve_bucket_units("float32")
+
+
+def serve_bucket_bf16():
+    """Same executor with bf16 union storage: the budget pins EXACTLY
+    the intended quantization points (queries round through the storage
+    dtype once; norms re-widen once) — any additional f32<->bf16
+    convert is a drift."""
+    return _serve_bucket_units("bfloat16")
+
+
+def serve_mesh_bucket():
+    """Union-sharded mesh serving executor: partial (nb, k) columns
+    combined by ONE psum."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.serve import _mesh_serve_executor
+
+    _, mapped = _mesh_serve_executor(DEVICE_COUNT, _kp(), "float32")
+    args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), jnp.float32),
+            _sds((S_UNION,), jnp.float32),
+            _sds((S_UNION, K_MODELS), jnp.float32),
+            _sds((K_MODELS,), jnp.float32))
+    return [Unit("batch", lambda: mapped.lower(*args),
+                 _jaxpr_of(mapped, *args))]
+
+
+def mesh_predict():
+    """SV-row-sharded mesh decision (predict.decision_function_mesh):
+    per-shard kernel rows + ONE psum of partial decision sums."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.predict import _mesh_decision_executor
+
+    _, mapped = _mesh_decision_executor(DEVICE_COUNT, _kp())
+    args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), jnp.float32),
+            _sds((S_UNION,), jnp.float32), _sds((S_UNION,), jnp.float32))
+    return [Unit("batch", lambda: mapped.lower(*args),
+                 _jaxpr_of(mapped, *args))]
+
+
+MANIFEST = {
+    "block_chunk_single": block_chunk_single,
+    "fleet_chunk": fleet_chunk,
+    "mesh_chunk": mesh_chunk,
+    "pipelined_chunk": pipelined_chunk,
+    "shardlocal_chunk": shardlocal_chunk,
+    "compacted_decision": compacted_decision,
+    "serve_bucket": serve_bucket,
+    "serve_bucket_bf16": serve_bucket_bf16,
+    "serve_mesh_bucket": serve_mesh_bucket,
+    "mesh_predict": mesh_predict,
+}

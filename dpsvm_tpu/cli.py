@@ -259,13 +259,35 @@ def _build_serve_parser(sub) -> argparse.ArgumentParser:
     return p
 
 
+def _build_lint_parser(sub) -> argparse.ArgumentParser:
+    # Listed here only so `dpsvm-tpu --help` shows the subcommand;
+    # main() forwards `lint ...` argv verbatim to the ONE flag
+    # definition (dpsvm_tpu/analysis/budget.run_lint, the same parser
+    # behind `python -m tools.tpulint`) before this parser ever runs.
+    return sub.add_parser(
+        "lint", add_help=False,
+        help="tpulint: static HLO/jaxpr contract check of the hot-"
+             "entrypoint manifest against committed budgets "
+             "(dpsvm_tpu/analysis; no TPU needed; flags as in "
+             "`python -m tools.tpulint --help`)")
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["lint"]:
+        # Forward verbatim so `cli lint` and `python -m tools.tpulint`
+        # share one flag surface (budget.run_lint's parser) — no
+        # re-declared flags to drift out of sync.
+        from dpsvm_tpu.analysis.budget import run_lint
+
+        return run_lint(argv[1:])
     parser = argparse.ArgumentParser(
         prog="dpsvm-tpu", description="TPU-native distributed SVM trainer")
     sub = parser.add_subparsers(dest="command", required=True)
     _build_train_parser(sub)
     _build_test_parser(sub)
     _build_serve_parser(sub)
+    _build_lint_parser(sub)
     p = sub.add_parser("smoke", help="device/mesh environment smoke test")
     p.add_argument("--num-devices", type=int, default=None)
     args = parser.parse_args(argv)
@@ -285,7 +307,7 @@ def _cmd_smoke(args) -> int:
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
                                          mesh_shard_map)
 

@@ -204,13 +204,25 @@ def _mesh_round_core(x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
     return alpha_w, coef, t, l, own, k_rows_loc
 
 
+def _jit_runner(mapped, donate_state: bool):
+    """jit a chunk runner, optionally donating the BlockState carry
+    (arg 5). The solve driver (dist_smo.py) donates — its host loop
+    rebinds `state = run_chunk(...)` and never re-reads the old carry,
+    so the input alpha/f shards leave the live set per dispatch. The
+    default stays undonated for probes that legitimately re-dispatch a
+    warmed state (tools/profile_round.py). tpulint budgets pin the
+    donated facts on the driver configuration."""
+    return jax.jit(mapped, donate_argnums=(5,) if donate_state else ())
+
+
 def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                             tau: float, q: int, inner_iters: int,
                             rounds_per_chunk: int, inner_impl: str = "xla",
                             interpret: bool = False,
                             selection: str = "mvp",
                             compensated: bool = False,
-                            pair_batch: int = 1):
+                            pair_batch: int = 1,
+                            donate_state: bool = False):
     """Build the jitted shard_mapped block-round chunk executor.
     selection: "mvp" | "second_order" | "nu" (solver/block.py rules).
     compensated: carry a shard-local Kahan residual of f so the fold's
@@ -269,7 +281,7 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
         out_specs=state_specs,
         check=False,  # while_loop carries defeat the replication checker
     )
-    return jax.jit(mapped)
+    return _jit_runner(mapped, donate_state)
 
 
 def make_block_shardlocal_chunk_runner(mesh: Mesh, kp: KernelParams, c,
@@ -281,7 +293,8 @@ def make_block_shardlocal_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                        interpret: bool = False,
                                        selection: str = "mvp",
                                        compensated: bool = False,
-                                       pair_batch: int = 1):
+                                       pair_batch: int = 1,
+                                       donate_state: bool = False):
     """SHARD-PARALLEL working sets (config.local_working_sets — the
     Cascade-SVM / partitioned-parallel-SMO structure re-derived for the
     mesh; Graf et al. NIPS 2004, Cao et al. IEEE TNN 2006, PAPERS.md):
@@ -458,7 +471,7 @@ def make_block_shardlocal_chunk_runner(mesh: Mesh, kp: KernelParams, c,
         out_specs=state_specs,
         check=False,  # while_loop carries defeat the replication checker
     )
-    return jax.jit(mapped)
+    return _jit_runner(mapped, donate_state)
 
 
 def make_block_pipelined_chunk_runner(mesh: Mesh, kp: KernelParams, c,
@@ -469,7 +482,8 @@ def make_block_pipelined_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                       interpret: bool = False,
                                       selection: str = "mvp",
                                       compensated: bool = False,
-                                      pair_batch: int = 1):
+                                      pair_batch: int = 1,
+                                      donate_state: bool = False):
     """PIPELINED mesh block runner (config.pipeline_rounds — the mesh
     counterpart of solver/block.py run_chunk_block_pipelined, and the
     path where the overlap is STRUCTURAL rather than scheduler luck):
@@ -593,7 +607,7 @@ def make_block_pipelined_chunk_runner(mesh: Mesh, kp: KernelParams, c,
         out_specs=state_specs,
         check=False,  # while_loop carries defeat the replication checker
     )
-    return jax.jit(mapped)
+    return _jit_runner(mapped, donate_state)
 
 
 def _global_top_from_rows(upv, upi, lov, loi, h: int):
@@ -626,7 +640,8 @@ def make_block_fused_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                   interpret: bool = False,
                                   selection: str = "mvp",
                                   compensated: bool = False,
-                                  pair_batch: int = 1):
+                                  pair_batch: int = 1,
+                                  donate_state: bool = False):
     """Fused-fold mesh block runner: each shard's fold and per-row
     candidate selection run as ONE Pallas pass over its f shard
     (ops/pallas_fold_select.py — the mesh counterpart of solver/block.py
@@ -714,7 +729,7 @@ def make_block_fused_chunk_runner(mesh: Mesh, kp: KernelParams, c,
         out_specs=state_specs,
         check=False,  # while_loop carries defeat the replication checker
     )
-    return jax.jit(mapped)
+    return _jit_runner(mapped, donate_state)
 
 
 def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
@@ -725,7 +740,8 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                    interpret: bool = False,
                                    selection: str = "mvp",
                                    compensated: bool = False,
-                                   pair_batch: int = 1):
+                                   pair_batch: int = 1,
+                                   donate_state: bool = False):
     """Active-set ("shrinking") variant of make_block_chunk_runner — the
     mesh port of solver/block.py run_chunk_block_active (the layer the
     reference scales with MPI ranks, svmTrainMain.cpp:244). One CYCLE:
@@ -856,4 +872,4 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
         out_specs=state_specs,
         check=False,  # while_loop carries defeat the replication checker
     )
-    return jax.jit(mapped)
+    return _jit_runner(mapped, donate_state)

@@ -372,7 +372,9 @@ def solve_mesh(
     `alpha_init` / `f_init` override the standard start point exactly as in
     solver.smo.solve — the hook the SVR / one-class reductions use.
     `callback` follows solve()'s contract, including abort-on-truthy-return
-    at chunk boundaries (see solver/smo.py solve docstring).
+    at chunk boundaries and the donation caveat — the received state is
+    donated to the next chunk, so copy what outlives the call (see
+    solver/smo.py solve docstring).
     """
     if config.engine not in ("xla", "block"):
         raise ValueError(
@@ -636,7 +638,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 float(config.tau), q, inner, rpc, inner_impl,
                 selection=config.selection,
                 compensated=config.compensated,
-                pair_batch=int(config.pair_batch))
+                pair_batch=int(config.pair_batch),
+                donate_state=True)
 
         if config.active_set_size:
             from dpsvm_tpu.parallel.dist_block import (
@@ -653,7 +656,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 m_act, int(config.reconcile_rounds), inner_impl,
                 selection=config.selection,
                 compensated=config.compensated,
-                pair_batch=int(config.pair_batch))
+                pair_batch=int(config.pair_batch),
+                donate_state=True)
         elif use_shardlocal:
             from dpsvm_tpu.parallel.dist_block import (
                 make_block_shardlocal_chunk_runner)
@@ -673,7 +677,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 inner_impl, interpret=_platform != "tpu",
                 selection=config.selection,
                 compensated=config.compensated,
-                pair_batch=int(config.pair_batch))
+                pair_batch=int(config.pair_batch),
+                donate_state=True)
         elif use_pipe:
             from dpsvm_tpu.parallel.dist_block import (
                 make_block_pipelined_chunk_runner)
@@ -684,7 +689,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 interpret=_platform != "tpu",
                 selection=config.selection,
                 compensated=config.compensated,
-                pair_batch=int(config.pair_batch))
+                pair_batch=int(config.pair_batch),
+                donate_state=True)
         elif use_fused:
             from dpsvm_tpu.parallel.dist_block import (
                 make_block_fused_chunk_runner)
@@ -695,7 +701,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 interpret=_platform != "tpu",
                 selection=config.selection,
                 compensated=config.compensated,
-                pair_batch=int(config.pair_batch))
+                pair_batch=int(config.pair_batch),
+                donate_state=True)
         else:
             run_chunk = _plain_runner(rounds_per_chunk)
         state = BlockState(alpha=state.alpha, f=state.f, b_hi=state.b_hi,

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dpsvm_tpu.models.svm_model import SVMModel
-from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots, kernel_matrix
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
 
 
 @partial(jax.jit, static_argnames=("kp",))

@@ -554,17 +554,18 @@ def run_local_round(x, y, x_sq, k_diag, valid, alpha, f, f_err,
     return alpha, f, f_err, b_hi, b_lo, t, coef, qx, qsq
 
 
-@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
-                                  "inner_iters", "rounds_per_chunk",
-                                  "inner_impl", "interpret", "selection",
-                                  "pair_batch"))
-def run_chunk_block(x, y, x_sq, k_diag, valid, state: BlockState, max_iter,
-                    kp: KernelParams, c, eps: float, tau: float,
-                    q: int, inner_iters: int, rounds_per_chunk: int,
-                    inner_impl: str = "xla",
-                    interpret: bool = False,
-                    selection: str = "mvp",
-                    pair_batch: int = 1) -> BlockState:
+_CHUNK_STATICS = ("kp", "c", "eps", "tau", "q", "inner_iters",
+                  "rounds_per_chunk", "inner_impl", "interpret",
+                  "selection", "pair_batch")
+
+
+def _run_chunk_block(x, y, x_sq, k_diag, valid, state: BlockState, max_iter,
+                     kp: KernelParams, c, eps: float, tau: float,
+                     q: int, inner_iters: int, rounds_per_chunk: int,
+                     inner_impl: str = "xla",
+                     interpret: bool = False,
+                     selection: str = "mvp",
+                     pair_batch: int = 1) -> BlockState:
     """Run up to `rounds_per_chunk` outer rounds fully on device.
 
     inner_impl: "xla" runs the subproblem as a lax.while_loop of XLA ops
@@ -592,6 +593,20 @@ def run_chunk_block(x, y, x_sq, k_diag, valid, state: BlockState, max_iter,
                           f_err)
 
     return lax.while_loop(cond, body, state)
+
+
+run_chunk_block = partial(jax.jit,
+                          static_argnames=_CHUNK_STATICS)(_run_chunk_block)
+# The solve driver's variant: the carried BlockState is DONATED (the
+# host loop rebinds `state = run_chunk(...)` and never touches the old
+# one), freeing the 2x (n,) f32 input carry from the live set each
+# dispatch. A separate name — not donate-by-default — because external
+# probes legitimately re-dispatch one warmed state (tools/
+# profile_round.py's salted A/B probes); donation works on both the CPU
+# and TPU runtimes of this jax (the tpulint donation fact pins it).
+run_chunk_block_donated = partial(
+    jax.jit, donate_argnums=(5,),
+    static_argnames=_CHUNK_STATICS)(_run_chunk_block)
 
 
 @partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",

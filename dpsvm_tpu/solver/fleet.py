@@ -104,7 +104,8 @@ class FleetState(NamedTuple):
     t: jax.Array  # () int32 trips
 
 
-@partial(jax.jit, static_argnames=("kp", "eps", "tau", "chunk"))
+@partial(jax.jit, donate_argnums=(5,),
+         static_argnames=("kp", "eps", "tau", "chunk"))
 def _run_fleet_chunk(x, y, x_sq, valid, cb, state: FleetState, max_iter,
                      kp: KernelParams, eps: float, tau: float,
                      chunk: int) -> FleetState:

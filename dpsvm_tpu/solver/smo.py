@@ -933,7 +933,11 @@ def solve(
     print is commented out, svmTrainMain.cpp:237-239). ABORT CONTRACT: a
     truthy return value stops the solve cleanly at that chunk boundary
     (state is kept, a due checkpoint is forced); return None/False/0 —
-    not, say, the gap — from callbacks that only observe.
+    not, say, the gap — from callbacks that only observe. DONATION
+    CAVEAT: the state a callback receives is DONATED to the next
+    chunk's dispatch — read scalars/arrays inside the call (or copy
+    with `np.asarray`), but do not retain the state object itself;
+    its buffers are dead once the solve proceeds.
 
     With `checkpoint_path` and config.checkpoint_every > 0, solver state
     (alpha, f, iteration) is persisted periodically; `resume=True` restarts
@@ -1188,7 +1192,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
             "active_set_size=0 unless you have measured a win on your "
             "workload", stacklevel=2)
     if use_block:
-        from dpsvm_tpu.solver.block import BlockState, run_chunk_block
+        from dpsvm_tpu.solver.block import (BlockState,
+                                            run_chunk_block_donated)
 
         # Clamp the block height to the dataset (top_k k <= n), kept even
         # so the up/low halves stay balanced (multiple of 4 for the nu
@@ -1291,7 +1296,11 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 selection=config.selection,
                 pair_batch=int(config.pair_batch))
         elif use_block:
-            state = run_chunk_block(
+            # Donated carry: the old state is dead the moment the chunk
+            # is dispatched (this loop only ever reads the NEW state),
+            # so its (n,) alpha/f buffers leave the live set instead of
+            # doubling it (tpulint pins declared_donated on this path).
+            state = run_chunk_block_donated(
                 x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
                 kp, config.c_bounds(), eps_run, float(config.tau),
                 q, inner, rounds_per_chunk,

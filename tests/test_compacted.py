@@ -15,7 +15,6 @@ import pytest
 
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.models.multiclass import (
-    CompactedEnsemble,
     MulticlassSVM,
     _STACK_MEMO,
     compact_models,
@@ -310,23 +309,27 @@ def test_hlo_one_kernel_matmul_per_query_block(trained):
         kp=ens.kernel,
     ).compile().as_text()
 
-    dots = [ln for ln in text.splitlines()
-            if re.search(r"= *[a-z0-9]+\[[^\]]*\][^=]* dot\(", ln)]
+    # Expressed through the shared tpulint extractor (ISSUE 5) — the
+    # same facts the committed compacted_decision budget pins.
+    from dpsvm_tpu.analysis.hlo_facts import dot_facts, dot_result_shapes
+
+    dots = dot_result_shapes(text)
     # THE kernel matmul = the dot producing the (nb, S) kernel tile
     # (either orientation; S includes the trailing pad row). The
     # row-norm einsums also lower to dots but produce rank-1 results;
     # the coefficient contraction produces (k, nb).
     s_union = ens.sv_union.shape[0]
-    ker = [ln for ln in dots
-           if re.search(rf"= *f32\[({nb},{s_union}|{s_union},{nb})\]",
-                        ln)]
-    assert len(ker) == 1, ker or text[:2000]
-    # No replicated stack product anywhere: a rank-3 (*, m_pad, d)
-    # operand would be the stacked path's shape.
+    ker = [shp for dt, shp in dots
+           if dt == "f32" and shp in ((nb, s_union), (s_union, nb))]
+    assert len(ker) == 1, dots or text[:2000]
+    # No replicated stack product anywhere: a rank-3 batched dot (the
+    # stacked path's (*, m_pad, d) product) must not exist, nor even a
+    # rank-3 f32 stack TENSOR of that shape.
+    assert dot_facts(text)["batched_rank3plus"] == 0, dots
     assert not re.search(rf"f32\[\d+,{m_pad},{d}\]", text)
     # Kernel matmul + coefficient contraction + at most the two
     # row-norm reductions.
-    assert len(dots) <= 4, dots
+    assert dot_facts(text)["count"] <= 4, dots
 
 
 # ----------------------------------------------------- stacked-path memo

@@ -12,12 +12,14 @@ the optimized HLO text, so the cost model can never silently drift
 from the code.
 """
 
-import re
-
 import jax
 import jax.numpy as jnp
-import pytest
 
+# The collective parser lives in the tpulint fact extractor now
+# (ISSUE 5): one definition shared by these pins, test_pipelined.py,
+# and the budget linter. The payload arithmetic below is unchanged —
+# same facts, same strictness, now through the shared extractor.
+from dpsvm_tpu.analysis.hlo_facts import collective_ops as _collective_ops
 from dpsvm_tpu.ops.kernels import KernelParams
 from dpsvm_tpu.parallel.dist_block import make_block_chunk_runner
 from dpsvm_tpu.parallel.mesh import make_data_mesh
@@ -26,36 +28,6 @@ from dpsvm_tpu.solver.block import BlockState
 N, D, Q = 500_000, 54, 512
 H = Q // 2
 P_DEV = 8
-
-_DTYPE_BYTES = {"f32": 4, "s32": 4, "u32": 4, "pred": 1, "f64": 8,
-                "s64": 8, "bf16": 2, "f16": 2, "s8": 1, "u8": 1}
-
-
-def _collective_ops(hlo_text: str, kind: str):
-    """[(op_line, [(dtype, bytes), ...])] for every `kind` op in the
-    text. Parses the RESULT shape(s) — tuple-shaped for multi-operand
-    combined collectives — e.g. `(f32[8,2,256], s32[8,2,256])
-    all-gather(...)`."""
-    out = []
-    for line in hlo_text.splitlines():
-        # Match the op NAME position (` = <shape> kind(`) — not mere
-        # mentions inside operand lists or metadata. Shapes may carry a
-        # layout suffix: `f32[8,2,256]{2,1,0} all-gather(...)`.
-        m = re.search(r"= *((?:\([^)]*\))|(?:[a-z0-9]+\[[^\]]*\]"
-                      r"(?:\{[^}]*\})?)) *"
-                      + re.escape(kind) + r"(?:-start)?\(", line)
-        if not m:
-            continue
-        shapes = re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", m.group(1))
-        sizes = []
-        for dt, dims in shapes:
-            el = 1
-            for d in dims.split(","):
-                if d:
-                    el *= int(d)
-            sizes.append((dt, el * _DTYPE_BYTES.get(dt, 4)))
-        out.append((line.strip(), sizes))
-    return out
 
 
 def test_mesh_block_round_collectives_match_scaling_model():
@@ -107,9 +79,15 @@ def test_mesh_block_round_collectives_match_scaling_model():
 # ---- shard-parallel working sets (ISSUE 4) --------------------------
 #
 # Compiled at a small shape (op structure is shape-independent, like
-# test_pipelined.py's mesh claim) so the CPU compile stays cheap.
+# test_pipelined.py's mesh claim) so the CPU compile stays cheap. The
+# shapes are tpulint's canonical manifest shapes, so these pins and the
+# committed budgets (dpsvm_tpu/analysis/budgets/shardlocal_chunk.json)
+# describe the SAME compiled program.
 
-N_S, D_S, Q_S, R_SYNC, INNER_S = 4096, 24, 64, 4, 128
+from dpsvm_tpu.analysis import manifest as _mf
+
+N_S, D_S, Q_S = _mf.N, _mf.D, _mf.Q
+R_SYNC, INNER_S = _mf.R_SYNC, _mf.INNER
 H_S = Q_S // 2
 
 

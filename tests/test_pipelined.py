@@ -331,7 +331,7 @@ def test_pipelined_mesh_round_collectives():
     import jax
     import jax.numpy as jnp
 
-    from test_hlo_collectives import _collective_ops
+    from dpsvm_tpu.analysis.hlo_facts import collective_ops as _collective_ops
     from dpsvm_tpu.ops.kernels import KernelParams
     from dpsvm_tpu.parallel.dist_block import (
         make_block_pipelined_chunk_runner)

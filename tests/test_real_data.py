@@ -7,7 +7,6 @@ activation switch)."""
 
 import importlib.util
 import os
-import sys
 
 import numpy as np
 import pytest

@@ -1,0 +1,275 @@
+"""tpulint (dpsvm_tpu/analysis) — fact-extractor self-tests and the
+budget gate (ISSUE 5).
+
+Two layers: (1) the extractor itself, on tiny hand-built jitted
+functions with KNOWN facts — a deliberate collective, a deliberate f64
+leak, a deliberately missed donation, a traced-branch recompile hazard,
+each asserted detected AND its clean variant asserted quiet; (2) the
+committed budgets, re-extracted from the live manifest and required to
+PASS — the in-suite embodiment of ``python -m tools.tpulint --check``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from dpsvm_tpu.analysis import hlo_facts
+from dpsvm_tpu.analysis.extract import Unit, entry_facts, unit_facts
+
+SDS = jax.ShapeDtypeStruct
+
+
+def _compiled_text(fn, *args, **kw):
+    return jax.jit(fn).lower(*args, **kw).compile().as_text()
+
+
+# ------------------------------------------------------ collectives
+
+def test_collective_facts_detect_psum():
+    from jax.sharding import PartitionSpec as P
+
+    from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
+                                         mesh_shard_map)
+
+    mesh = make_data_mesh(8)
+
+    def shard_fn(x):
+        return jax.lax.psum(x.sum(0, keepdims=True), DATA_AXIS)
+
+    mapped = jax.jit(mesh_shard_map(shard_fn, mesh=mesh,
+                                    in_specs=(P(DATA_AXIS),),
+                                    out_specs=P()))
+    text = mapped.lower(SDS((64, 16), jnp.float32)).compile().as_text()
+    facts = hlo_facts.collective_facts(text)
+    assert facts["all-reduce"]["count"] == 1
+    # Per-device result payload: (1, 16) f32.
+    assert facts["all-reduce"]["payload_bytes"] == [64]
+    assert facts["all-gather"]["count"] == 0
+    assert facts["collective-permute"]["count"] == 0
+
+
+def test_clean_function_is_quiet():
+    text = _compiled_text(lambda a, b: jnp.dot(a, b),
+                          SDS((16, 8), jnp.float32),
+                          SDS((8, 4), jnp.float32))
+    facts = hlo_facts.collective_facts(text)
+    assert all(v["count"] == 0 for v in facts.values())
+    assert all(v == 0 for v in hlo_facts.transfer_facts(text).values())
+    dt = hlo_facts.dtype_facts(text)
+    assert not dt["f64_present"] and dt["f32_to_bf16_converts"] == 0
+    assert hlo_facts.dot_facts(text) == {
+        "count": 1, "max_result_rank": 2, "batched_rank3plus": 0}
+
+
+def test_host_callback_round_trip_detected():
+    """jax host callbacks lower to custom-calls (NOT infeed/outfeed) —
+    the 'no per-row host round-trips' contract must catch them."""
+    import numpy as np
+
+    from jax.experimental import io_callback
+
+    def f(x):
+        y = io_callback(lambda v: np.asarray(v) * 2,
+                        jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + 1
+
+    text = _compiled_text(f, SDS((8,), jnp.float32))
+    assert hlo_facts.transfer_facts(text)["host_callbacks"] >= 1
+    clean = _compiled_text(lambda x: x + 1, SDS((8,), jnp.float32))
+    assert hlo_facts.transfer_facts(clean)["host_callbacks"] == 0
+
+
+# ------------------------------------------------------- dtype leaks
+
+def test_f64_leak_detected_and_clean_variant_quiet():
+    from jax.experimental import enable_x64
+
+    def leaky(x):
+        return (x.astype(jnp.float64) * 2.0).sum()
+
+    with enable_x64():
+        text = _compiled_text(leaky, SDS((32,), jnp.float32))
+        jx = jax.make_jaxpr(leaky)(SDS((32,), jnp.float32))
+    assert hlo_facts.dtype_facts(text)["f64_present"]
+    assert hlo_facts.dtype_facts(text)["f32_to_f64_converts"] >= 1
+    assert hlo_facts.jaxpr_facts(jx)["f64_avals"] >= 1
+
+    clean = _compiled_text(lambda x: (x * 2.0).sum(),
+                           SDS((32,), jnp.float32))
+    assert not hlo_facts.dtype_facts(clean)["f64_present"]
+
+
+def test_bf16_convert_counting():
+    def quantizing(q, sv):
+        qc = q.astype(sv.dtype)  # the serving engine's one rounding
+        return jnp.dot(qc, sv.T, preferred_element_type=jnp.float32)
+
+    text = _compiled_text(quantizing, SDS((8, 4), jnp.float32),
+                          SDS((16, 4), jnp.bfloat16))
+    assert hlo_facts.dtype_facts(text)["f32_to_bf16_converts"] == 1
+
+
+# --------------------------------------------------------- donation
+
+def test_missed_donation_detected_and_donated_variant_quiet():
+    def step(carry, delta):
+        return carry + delta
+
+    a = SDS((128,), jnp.float32)
+    plain = jax.jit(step).lower(a, a)
+    fx = hlo_facts.donation_facts(plain.compile().as_text())
+    # Both inputs aval-match the output; one COULD be donated, none is.
+    assert fx["aliased_outputs"] == 0
+    assert fx["donatable"] >= 1
+    assert fx["missed"] >= 1
+
+    donated = jax.jit(step, donate_argnums=(0,)).lower(a, a)
+    fd = hlo_facts.donation_facts(donated.compile().as_text())
+    assert fd["aliased_outputs"] == 1
+    assert fd["missed"] == fd["donatable"] - 1
+
+    # unit_facts carries the jit-level declaration too.
+    uf = unit_facts(Unit("d", lambda: donated))
+    assert uf["donation"]["declared_donated"] == 1
+
+
+# ------------------------------------------------- recompile hazards
+
+def test_traced_branch_hazard_detected():
+    def branchy(x):
+        if x.sum() > 0:  # Python branch on a traced value
+            return x
+        return -x
+
+    facts = unit_facts(Unit(
+        "bad", lambda: jax.jit(branchy).lower(SDS((8,), jnp.float32))))
+    assert facts["hazards"]["traced_branch"] is True
+    assert "trace_error" in facts
+
+    ok = unit_facts(Unit(
+        "good", lambda: jax.jit(lambda x: jnp.where(x.sum() > 0, x, -x))
+        .lower(SDS((8,), jnp.float32))))
+    assert ok["hazards"]["traced_branch"] is False
+    assert "trace_error" not in ok
+
+
+def test_weak_type_arg_detected():
+    def f(x, s):
+        return x * s
+
+    weak = jax.make_jaxpr(f)(SDS((8,), jnp.float32), 2.0)
+    assert hlo_facts.jaxpr_facts(weak)["weak_in_avals"] == 1
+    strong = jax.make_jaxpr(f)(SDS((8,), jnp.float32),
+                               SDS((), jnp.float32))
+    assert hlo_facts.jaxpr_facts(strong)["weak_in_avals"] == 0
+
+
+# ------------------------------------------------- rank-3 kernel path
+
+def test_rank3_batched_product_detected():
+    text = _compiled_text(jnp.matmul, SDS((4, 8, 16), jnp.float32),
+                          SDS((4, 16, 8), jnp.float32))
+    facts = hlo_facts.dot_facts(text)
+    assert facts["batched_rank3plus"] >= 1
+    assert facts["max_result_rank"] == 3
+
+
+# ------------------------------------------------ budget diff/verdict
+
+def test_budget_check_names_entry_and_fact(tmp_path):
+    from dpsvm_tpu.analysis import budget
+
+    facts = {"dispatches": 1,
+             "units": {"chunk": {"collectives": {
+                 "all-reduce": {"count": 0}}}}}
+    budget.write_budget("toy_entry", facts, tmp_path)
+    assert budget.check_entry("toy_entry", facts,
+                              tmp_path)["verdict"] == budget.PASS
+
+    drifted = {"dispatches": 1,
+               "units": {"chunk": {"collectives": {
+                   "all-reduce": {"count": 3}}}}}
+    res = budget.check_entry("toy_entry", drifted, tmp_path)
+    assert res["verdict"] == budget.DRIFT
+    (path, want, got), = res["diffs"]
+    assert path == "units.chunk.collectives.all-reduce.count"
+    assert (want, got) == (0, 3)
+    table = budget.drift_table([res])
+    assert "toy_entry" in table and "all-reduce.count" in table
+
+    # The explicit allowlist tolerates (but still reports) the drift.
+    import json
+    doc = json.loads(budget.budget_path("toy_entry", tmp_path)
+                     .read_text())
+    doc["allow"] = ["units.chunk.collectives"]
+    budget.budget_path("toy_entry", tmp_path).write_text(
+        json.dumps(doc))
+    res2 = budget.check_entry("toy_entry", drifted, tmp_path)
+    assert res2["verdict"] == budget.PASS and res2["allowed"]
+
+    # Missing budget is a hard failure, not a silent skip.
+    assert budget.check_entry("other", facts,
+                              tmp_path)["verdict"] == budget.MISSING
+
+    # ... and so is the converse: a committed budget whose entrypoint
+    # left the manifest (rename/delete) is ORPHANed lost coverage.
+    assert budget.orphan_budgets(["toy_entry"], tmp_path) == []
+    assert budget.orphan_budgets(["renamed_entry"],
+                                 tmp_path) == ["toy_entry"]
+    table = budget.drift_table([{"entry": "toy_entry",
+                                 "verdict": budget.ORPHAN,
+                                 "diffs": [], "allowed": []}])
+    assert "no manifest entry" in table
+
+    # write_budget records the generating jax version for in-suite
+    # consumers to gate on (the facts are jax/XLA-version-coupled).
+    assert budget.budget_jax_version(tmp_path) == jax.__version__
+
+    # A partial regeneration under a different jax must be a hard
+    # error, not whichever version sorts first.
+    doc = json.loads(budget.budget_path("toy_entry", tmp_path)
+                     .read_text())
+    doc["jax"] = "0.0.0-other"
+    budget.budget_path("zz_mixed", tmp_path).write_text(json.dumps(doc))
+    import pytest
+    with pytest.raises(ValueError, match="mixed jax versions"):
+        budget.budget_jax_version(tmp_path)
+
+
+def test_entry_facts_counts_dispatches():
+    a = SDS((8,), jnp.float32)
+    units = [Unit("one", lambda: jax.jit(lambda x: x + 1).lower(a)),
+             Unit("two", lambda: jax.jit(lambda x: x * 2).lower(a))]
+    facts = entry_facts(units)
+    assert facts["dispatches"] == 2
+    assert set(facts["units"]) == {"one", "two"}
+
+
+# ------------------------------------- the committed budgets (tier-1)
+
+def test_manifest_budgets_pass_against_committed():
+    """The in-suite `tpulint --check`: every manifest entrypoint's
+    re-extracted facts must match the committed budget files exactly.
+    A structural regression in ANY budgeted entrypoint — a stray
+    collective, a dtype leak, a lost donation, an extra dispatch —
+    fails here with the entry and fact path in the message."""
+    from dpsvm_tpu.analysis import budget
+    from dpsvm_tpu.analysis.extract import extract_entries
+    from dpsvm_tpu.analysis.manifest import MANIFEST, require_devices
+
+    gen = budget.budget_jax_version()
+    if gen is not None and gen != jax.__version__:
+        import pytest
+        pytest.skip(
+            f"budgets generated under jax {gen}, running {jax.__version__}"
+            " — exact HLO facts are version-coupled; the pinned CI "
+            "tpulint job (tier1.yml) is the gate for this check")
+
+    require_devices()
+    observed = extract_entries(MANIFEST)
+    results = [budget.check_entry(entry, facts)
+               for entry, facts in observed.items()]
+    results += [{"entry": e, "verdict": budget.ORPHAN, "diffs": [],
+                 "allowed": []}
+                for e in budget.orphan_budgets(MANIFEST)]
+    bad = [r for r in results if r["verdict"] != budget.PASS]
+    assert not bad, "\n" + budget.drift_table(results)

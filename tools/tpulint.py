@@ -1,0 +1,37 @@
+"""tpulint — static HLO/jaxpr contract linter (ISSUE 5).
+
+Lowers the manifest of hot entrypoints (dpsvm_tpu/analysis/manifest.py)
+at canonical shapes on the CPU backend, extracts structured facts
+(collective ops + payload bytes, dispatch counts, host transfers,
+dtype-promotion leaks, rank-3 kernel products, donation misses,
+recompile hazards), and diffs them against the checked-in budgets in
+dpsvm_tpu/analysis/budgets/*.json.
+
+Usage:
+    python -m tools.tpulint --check           # CI / pre-merge gate
+    python -m tools.tpulint --write-budgets   # after an INTENTIONAL
+                                              # structural change;
+                                              # commit the JSON diff
+    python -m tools.tpulint --check --entries mesh_chunk,serve_bucket
+
+Exit status: 0 iff every checked entrypoint PASSes its budget.
+
+No TPU required — the facts are properties of the lowered programs,
+which is the point: the paper's contract (one gather per sync, dense
+GEMV kernel rows, no host round-trips) is checkable on every CI run.
+"""
+
+import sys
+
+
+def main(argv=None) -> int:
+    # Backend forcing (CPU platform, the manifest's virtual device
+    # count) lives in ONE place — budget._force_cpu_backend, which
+    # run_lint applies before any jax backend initialization.
+    from dpsvm_tpu.analysis.budget import run_lint
+
+    return run_lint(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
