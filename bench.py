@@ -40,6 +40,7 @@ honesty fields (see the comment above the final print).
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 
@@ -47,6 +48,75 @@ N = 60_000
 D = 784
 BASELINE_10GPU_SECONDS = 46.0
 REF_BUDGET = 100_000  # reference Makefile:74 --max-iter
+
+# Telemetry schema embedded in every benchmark artifact this repo's
+# tools emit (BENCH/MULTICHIP/SERVE/SMOKE *_r*.json) — the runlog
+# module's version, so artifacts and run logs evolve together and
+# _latest_bench_artifact can SKIP records newer than this build
+# understands instead of crashing or mis-reading them.
+def _schema_version() -> int:
+    from dpsvm_tpu.obs.runlog import SCHEMA_VERSION
+
+    return SCHEMA_VERSION
+
+
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="headline / mesh benchmark (see module docstring)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="run the MULTICHIP mesh-path benchmark instead "
+                         "of the single-chip headline")
+    ap.add_argument("--obs", action="store_true",
+                    help="enable the telemetry spine: the timed solves "
+                         "write a schema-versioned run log whose per-"
+                         "chunk records the benchmark RECONCILES with "
+                         "its own pairs/s (reported in the artifact); "
+                         "zero effect on the measured programs")
+    ap.add_argument("--obs-dir", default=None,
+                    help="run-log directory (default obs_runs; env "
+                         "DPSVM_OBS_DIR)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="with --obs: capture a jax.profiler device "
+                         "trace of the timed runs into this directory")
+    return ap.parse_args(argv)
+
+
+def _obs_config(args):
+    """ObsConfig for the timed solves (None -> flag/env defaults)."""
+    from dpsvm_tpu.config import ObsConfig
+
+    if args is None:
+        return ObsConfig()
+    return ObsConfig(enabled=args.obs, trace_dir=args.trace_dir,
+                     runlog_dir=args.obs_dir)
+
+
+def _runlog_reconciliation(res, metric_pps: float) -> dict:
+    """Cross-check the BENCH metric against the run log (ISSUE 7
+    acceptance): sum the best run's per-chunk (pairs_delta,
+    device_seconds) records and compare the implied pairs/s with the
+    artifact's. Empty when the solve ran without obs."""
+    path = res.stats.get("obs_runlog")
+    run_id = res.stats.get("obs_run_id")
+    if not path:
+        return {}
+    from dpsvm_tpu.obs.runlog import read_runlog, records_for
+
+    chunks = records_for(read_runlog(path), run_id, "chunk")
+    pairs = sum(c["pairs_delta"] for c in chunks)
+    secs = sum(c["device_seconds"] for c in chunks)
+    rl_pps = pairs / max(secs, 1e-9)
+    delta = rl_pps / metric_pps - 1.0
+    return {
+        "runlog": path,
+        "runlog_run_id": run_id,
+        "runlog_chunk_records": len(chunks),
+        "runlog_pairs_per_second": round(rl_pps),
+        "runlog_delta": round(delta, 6),
+        # 1% is the acceptance bound; in practice the two numbers are
+        # the same sums modulo record rounding.
+        "runlog_reconciles": bool(abs(delta) <= 0.01),
+    }
 
 
 def _session_calibration() -> dict:
@@ -120,6 +190,16 @@ def _latest_bench_artifact(root: str, pattern: str = "BENCH_r*.json",
             # contract is NO_BASELINE, never an exception.
             continue
         doc = doc.get("parsed", doc)
+        # Artifacts carry the telemetry schema_version (ISSUE 7); a
+        # record written by a NEWER build is skipped explicitly —
+        # fields this build doesn't understand must not be mis-read as
+        # comparable. Absent field = pre-obs artifact = version 0,
+        # always readable.
+        try:
+            if int(doc.get("schema_version", 0)) > _schema_version():
+                continue
+        except (TypeError, ValueError):
+            continue
         if key is None or key in doc:
             return path, doc
     return None, None
@@ -184,7 +264,7 @@ def _regression_gate(current: dict, root: str,
     return out
 
 
-def mesh_main() -> int:
+def mesh_main(args=None) -> int:
     """Mesh-path benchmark (`python bench.py --mesh`) — the MULTICHIP
     sibling of the headline bench (ISSUE 4 satellite). One budget-mode
     mesh block solve over every visible device at a covtype-shaped
@@ -223,7 +303,7 @@ def mesh_main() -> int:
     budget = 200_000
     cfg = SVMConfig(c=32.0, gamma=0.03125, epsilon=1e-3, engine="block",
                     working_set_size=256, budget_mode=True,
-                    max_iter=budget)
+                    max_iter=budget, obs=_obs_config(args))
     n_dev = len(jax.devices())
     solve_mesh(x, y, cfg.replace(max_iter=64), num_devices=n_dev)  # warm
     runs = [solve_mesh(x, y, cfg, num_devices=n_dev) for _ in range(3)]
@@ -247,21 +327,25 @@ def mesh_main() -> int:
         "device": str(jax.devices()[0]),
         "pair_updates": int(best.iterations),
         "mesh_pairs_per_second": round(pps),
+        "schema_version": _schema_version(),
         "session_calibration": calibration,
     }
+    result.update(_runlog_reconciliation(best, pps))
     gate = _regression_gate(result,
                             os.path.dirname(os.path.abspath(__file__)),
                             pattern="MULTICHIP_r*.json",
                             key="mesh_pairs_per_second")
     result.update(gate)
+    rl_note = (f"; runlog: {result['runlog']}"
+               if result.get("runlog") else "")
     print(f"[bench --mesh] {n_dev} devices: {best.iterations} pairs in "
           f"{best.train_seconds:.3f}s ({pps:.0f}/s); gate: "
-          f"{gate.get('regression_gate')}", file=sys.stderr)
+          f"{gate.get('regression_gate')}{rl_note}", file=sys.stderr)
     print(json.dumps(result))
     return 0
 
 
-def main() -> int:
+def main(args=None) -> int:
     import jax
 
     from dpsvm_tpu.config import SVMConfig
@@ -288,7 +372,7 @@ def main() -> int:
     config = SVMConfig(
         c=10.0, gamma=0.125, epsilon=0.01, max_iter=REF_BUDGET,
         cache_lines=0, engine="block", working_set_size=256,
-        dtype="bfloat16")
+        dtype="bfloat16", obs=_obs_config(args))
     # Budget run: inner=2048 (not the convergence run's 2q=512). The
     # dataset converges at ~7k pairs, so most of the 100k-pair budget
     # executes at the optimum either way; a larger inner budget amortizes
@@ -430,17 +514,30 @@ def main() -> int:
         "dataset_hard": ("synthetic make_mnist_like(n=60000, d=784, "
                          "seed=7, noise=0.1, label_flip=0.10) — "
                          "non-separable soft-margin regime"),
+        # Telemetry schema of this artifact (ISSUE 7): lets future
+        # builds' _latest_bench_artifact skip incompatible records
+        # explicitly instead of mis-reading them.
+        "schema_version": _schema_version(),
         # Session drift separator (VERDICT weak #1): compare against the
         # same field in earlier BENCH_r*.json before reading any
         # cross-session delta as a solver regression.
         "session_calibration": calibration,
     }
+    # Run-log reconciliation (with --obs): the per-chunk records of the
+    # PRIMARY run must imply the same pairs/s this artifact reports.
+    result.update(_runlog_reconciliation(bres, pairs_per_second))
     # Round-over-round regression gate vs the latest committed artifact
     # (drift-normalized via the calibration kernel; see _regression_gate).
     import os
 
     gate = _regression_gate(result, os.path.dirname(os.path.abspath(__file__)))
     result.update(gate)
+    # The gate line carries the run-log path when --obs produced one
+    # (ISSUE 7 satellite: the verdict and its telemetry substrate are
+    # announced together).
+    rl_note = (f"; runlog: {result['runlog']} "
+               f"(reconciles={result['runlog_reconciles']})"
+               if result.get("runlog") else "")
     if gate.get("regression_gate") in ("PASS", "FLAG"):
         print(f"[bench] regression gate: {gate['regression_gate']} — "
               f"drift-normalized {gate['normalized_pairs_per_second']} "
@@ -448,15 +545,17 @@ def main() -> int:
               f"{gate['previous_artifact']} "
               f"(delta {100 * gate['normalized_delta']:+.1f}%, band "
               f"±{100 * _REGRESSION_BAND:.0f}%, session drift ratio "
-              f"{gate['session_drift_ratio']})", file=sys.stderr)
+              f"{gate['session_drift_ratio']}){rl_note}", file=sys.stderr)
     else:
         print(f"[bench] regression gate: "
               f"{gate.get('regression_gate')} "
-              f"{'(raw delta %+.1f%%)' % (100 * gate['raw_delta']) if 'raw_delta' in gate else ''}",
+              f"{'(raw delta %+.1f%%)' % (100 * gate['raw_delta']) if 'raw_delta' in gate else ''}"
+              f"{rl_note}",
               file=sys.stderr)
     print(json.dumps(result))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(mesh_main() if "--mesh" in sys.argv[1:] else main())
+    _args = _parse_args()
+    sys.exit(mesh_main(_args) if _args.mesh else main(_args))

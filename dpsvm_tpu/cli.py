@@ -174,8 +174,22 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                    help="resume from --checkpoint if it exists")
     p.add_argument("--metrics-jsonl", default=None,
                    help="write per-chunk metrics records to this JSONL file")
-    p.add_argument("--profile-dir", default=None,
-                   help="capture a jax.profiler trace into this directory")
+    p.add_argument("--trace-dir", "--profile-dir", dest="profile_dir",
+                   default=None,
+                   help="capture a jax.profiler device trace (Perfetto/"
+                        "XPlane) into this directory; with --obs the "
+                        "solver owns the capture and its spans appear "
+                        "named in it")
+    p.add_argument("--obs", action="store_true",
+                   help="enable the telemetry spine (dpsvm_tpu/obs): "
+                        "a schema-versioned JSONL run log (manifest/"
+                        "chunk/event/span/final records), registry "
+                        "metrics and trace spans. Zero device effect — "
+                        "chunk cadence, dispatches and compiled HLO "
+                        "are unchanged (tpulint-pinned)")
+    p.add_argument("--obs-dir", default=None,
+                   help="run-log directory for --obs (default obs_runs; "
+                        "env DPSVM_OBS_DIR)")
     p.add_argument("-v", "--cross-validate", type=int, default=0,
                    metavar="N",
                    help="LibSVM svm-train -v: N-fold cross-validation "
@@ -255,6 +269,13 @@ def _build_serve_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--group", type=int, default=8,
                    help="--server-bench: requests arriving together "
                         "(shared flush dispatches; default 8)")
+    p.add_argument("--obs", action="store_true",
+                   help="enable the telemetry spine: a serve run log "
+                        "(manifest + final histogram snapshot JSONL) "
+                        "and trace spans around bucket dispatches")
+    p.add_argument("--obs-dir", default=None,
+                   help="run-log directory for --obs (default obs_runs; "
+                        "env DPSVM_OBS_DIR)")
     p.add_argument("-q", "--quiet", action="store_true")
     return p
 
@@ -407,6 +428,8 @@ def _cmd_train(args) -> int:
               f"in {time.perf_counter() - t0:.2f}s")
 
     try:
+        from dpsvm_tpu.config import ObsConfig
+
         config = SVMConfig(
             c=args.cost, gamma=args.gamma, epsilon=args.epsilon,
             max_iter=args.max_iter, cache_lines=args.cache_size,
@@ -426,7 +449,13 @@ def _cmd_train(args) -> int:
             reconcile_rounds=args.reconcile_rounds,
             dtype=args.dtype, chunk_iters=args.chunk_iters,
             checkpoint_every=args.checkpoint_every,
-            retry_faults=args.retry_faults, verbose=not args.quiet)
+            retry_faults=args.retry_faults, verbose=not args.quiet,
+            # With --obs the SOLVER owns the device-trace capture (its
+            # spans then appear named inside it); without it the CLI's
+            # profile_trace wrapper below keeps the old behavior.
+            obs=ObsConfig(enabled=args.obs,
+                          trace_dir=args.profile_dir if args.obs else None,
+                          runlog_dir=args.obs_dir))
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -454,7 +483,7 @@ def _cmd_train(args) -> int:
         sink=None if args.quiet else sys.stderr,
         jsonl_path=args.metrics_jsonl,
         lookups_per_iter=0 if args.engine == "block" else 2)
-    with profile_trace(args.profile_dir):
+    with profile_trace(None if args.obs else args.profile_dir):
         if args.svm_type == "c-svc":
             model, result = train(
                 x, y, config, backend=args.backend, num_devices=args.num_devices,
@@ -492,6 +521,9 @@ def _cmd_train(args) -> int:
     else:
         print(f"stopped at max-iter {result.iterations} without converging")
     print(f"training took {result.train_seconds:.2f}s")
+    if result.stats.get("obs_runlog"):
+        print(f"run log: {result.stats['obs_runlog']} "
+              f"(run {result.stats['obs_run_id']})")
     print(f"b: {result.b:.6f}")
     print(f"support vectors: {result.n_sv}")
     if result.stats.get("cache_lookups"):
@@ -963,10 +995,14 @@ def _cmd_serve(args) -> int:
         model = SVMModel.load(args.model)
 
     try:
+        from dpsvm_tpu.config import ObsConfig
+
         buckets = tuple(int(t) for t in args.buckets.split(",") if t)
         config = ServeConfig(buckets=buckets, dtype=args.dtype,
                              precision=args.precision,
-                             num_devices=args.num_devices)
+                             num_devices=args.num_devices,
+                             obs=ObsConfig(enabled=args.obs,
+                                           runlog_dir=args.obs_dir))
         t0 = time.perf_counter()
         server = PredictServer(model, config)
     except ValueError as e:
@@ -991,6 +1027,15 @@ def _cmd_serve(args) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+        if not args.quiet:
+            # Latency percentiles come from the SHARED obs histograms
+            # (server.request_seconds / stats["bucket_seconds"]), not a
+            # sweep-local aggregation — ISSUE 7 satellite.
+            lat = rec["request_latency"]
+            print("request latency (shared histogram): "
+                  + " ".join(f"{k}={v * 1e3:.2f}ms"
+                             for k, v in lat.items()), file=sys.stderr)
+        server.close()
         print(json.dumps(rec))
         return 0
 
@@ -1023,6 +1068,7 @@ def _cmd_serve(args) -> int:
     except ValueError as e:
         print(f"error: bad query row ({e})", file=sys.stderr)
         return 2
+    server.close()
     if not args.quiet:
         st = server.stats
         print(f"served {st['rows']} rows in {st['dispatches']} "

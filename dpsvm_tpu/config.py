@@ -22,6 +22,38 @@ KERNELS = ("rbf", "linear", "poly", "sigmoid", "precomputed")
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs (dpsvm_tpu/obs — ISSUE 7), shared by
+    SVMConfig and ServeConfig as their ``obs`` field.
+
+    enabled    -- master switch for run logs + registry metrics +
+                  trace spans. OFF by default and STRICTLY free when
+                  off (shared null instruments; no clock reads). The
+                  ``DPSVM_OBS=1`` environment variable is the ambient
+                  opt-in CI uses. Enabling obs never changes solver
+                  behavior: chunk cadence, dispatch counts and
+                  compiled HLO are identical either way — the
+                  committed tpulint budgets are checked with obs
+                  enabled to pin that contract.
+    trace_dir  -- capture a jax.profiler device trace (Perfetto/
+                  XPlane) here for the run; spans show up named in it.
+                  On backends without a profiler the spans degrade to
+                  the host-side timeline in the run log. Env override:
+                  DPSVM_TRACE_DIR.
+    runlog_dir -- directory for the JSONL run logs (one append-only
+                  file per tool and process). Default ./obs_runs; env
+                  override DPSVM_OBS_DIR.
+    """
+
+    enabled: bool = False
+    trace_dir: Optional[str] = None
+    runlog_dir: Optional[str] = None
+
+    def replace(self, **kw) -> "ObsConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
 class SVMConfig:
     """Hyper-parameters and runtime knobs for SMO training.
 
@@ -302,6 +334,14 @@ class SVMConfig:
     checkpoint_every: int = 0  # iterations between solver checkpoints; 0 = off
     verbose: bool = False
 
+    # Observability (dpsvm_tpu/obs): run logs, metrics, trace spans.
+    # A frozen sub-config so SVMConfig stays hashable; see ObsConfig.
+    # NOTE deliberately NOT part of the `observe` predicate that picks
+    # the chunk cadence — obs records ride whatever observations the
+    # solve was already making (an unobserved solve logs one chunk
+    # record), so enabling it cannot change behavior or timing.
+    obs: ObsConfig = ObsConfig()
+
     def c_bounds(self) -> tuple:
         """(c_pos, c_neg): per-class box upper bounds, hashable for jit."""
         return (self.c * self.weight_pos, self.c * self.weight_neg)
@@ -553,6 +593,11 @@ class ServeConfig:
     num_devices: int = 1
     warm_start: bool = True
     max_pending: int = 65536
+    # Observability (dpsvm_tpu/obs): serve run logs + trace spans.
+    # Bucket latency HISTOGRAMS are always on (they replaced the old
+    # bounded timing deques at identical cost); this only gates the
+    # run-log/trace layer.
+    obs: ObsConfig = ObsConfig()
 
     def __post_init__(self):
         if not self.buckets:

@@ -1,0 +1,211 @@
+"""dpsvm_tpu.obs — the telemetry spine (ISSUE 7).
+
+Three layers, one contract:
+
+* :mod:`dpsvm_tpu.obs.trace`   — spans: named host timeline + device
+  TraceAnnotation (Perfetto) when a jax trace is running.
+* :mod:`dpsvm_tpu.obs.metrics` — bounded lock-free counters / gauges /
+  histograms with a strict no-op mode.
+* :mod:`dpsvm_tpu.obs.runlog`  — schema-versioned JSONL run logs
+  (manifest / chunk / event / span / final records).
+
+THE CONTRACT — ZERO DEVICE EFFECT: observability reads only values the
+host already holds (chunk-boundary scalars, perf counters) and never
+issues a dispatch, transfer or collective of its own. The committed
+tpulint budgets are checked with obs ENABLED in CI, so a violation is
+a lint failure, not a code-review hope. Disabled (the default), every
+entry here is a strict no-op: ``run_obs`` returns the shared
+:data:`NULL_OBS`, ``trace.span`` returns the shared null context
+manager, and a disabled registry hands out null instruments.
+
+Enablement: ``config.obs.enabled`` (SVMConfig/ServeConfig), the
+``--obs`` CLI flags, or the ``DPSVM_OBS=1`` environment variable (the
+CI hook). ``DPSVM_OBS_DIR`` overrides the run-log directory,
+``DPSVM_TRACE_DIR`` the device-trace directory.
+
+The solver-facing surface is :func:`run_obs`: the host loops in
+solver/smo.py, parallel/dist_smo.py and solver/fleet.py call it once
+per solve and get either :data:`NULL_OBS` or a live :class:`RunObs`
+that owns a run log, a trace session and the registry instruments —
+``chunk()`` / ``event()`` / ``finish()`` / ``span()``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from dpsvm_tpu.obs import metrics, runlog, trace
+from dpsvm_tpu.obs.metrics import Registry, enable, get_registry
+from dpsvm_tpu.obs.runlog import SCHEMA_VERSION, RunLog, read_runlog
+from dpsvm_tpu.obs.trace import TraceSession, span
+
+__all__ = [
+    "metrics", "runlog", "trace", "Registry", "RunLog", "TraceSession",
+    "SCHEMA_VERSION", "enable", "get_registry", "read_runlog", "span",
+    "obs_enabled", "run_obs", "RunObs", "NULL_OBS",
+]
+
+
+def obs_enabled(obs_config=None) -> bool:
+    """Effective on/off: explicit config wins; DPSVM_OBS=1 is the
+    ambient opt-in (CI uses it so the tier-1 suite and the tpulint
+    budget check both run with the spine live)."""
+    if obs_config is not None and getattr(obs_config, "enabled", False):
+        return True
+    return os.environ.get("DPSVM_OBS", "") not in ("", "0")
+
+
+def _trace_dir(obs_config=None) -> Optional[str]:
+    if obs_config is not None and getattr(obs_config, "trace_dir", None):
+        return obs_config.trace_dir
+    return os.environ.get("DPSVM_TRACE_DIR") or None
+
+
+class _NullObs:
+    """Disabled-mode run handle: every method is a no-op; ``span``
+    returns the shared null context manager. One shared instance."""
+
+    __slots__ = ()
+    run_id = None
+    live = False
+
+    def chunk(self, **fields) -> None:
+        pass
+
+    def event(self, name: str, **fields) -> None:
+        pass
+
+    def finish(self, **fields) -> None:
+        pass
+
+    def span(self, name: str):
+        return trace.span(name)  # null unless an outer session is live
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_OBS = _NullObs()
+
+
+class RunObs:
+    """Live per-run observability: a RunLog (manifest written at
+    construction), a TraceSession whose span events sink into the same
+    JSONL, and the registry instruments the chunk records feed.
+
+    All record fields come from host-held values — callers pass the
+    scalars they already pulled (the packed chunk observation); this
+    class never touches device arrays.
+    """
+
+    live = True
+
+    def __init__(self, tool: str, config=None, meta=None,
+                 obs_config=None, directory: Optional[str] = None):
+        self._log = RunLog.open(tool, config=config, meta=meta,
+                                obs_config=obs_config,
+                                directory=directory)
+        self.run_id = self._log.run_id
+        self._session = TraceSession(trace_dir=_trace_dir(obs_config),
+                                     sink=self._log.span_sink)
+        self._session.__enter__()
+        # PRIVATE per-run registry, always live: a run enabled via
+        # config/--obs must record regardless of the AMBIENT
+        # (env-gated) default registry's state — using get_registry()
+        # here would silently dump "metrics": {} for every flag-enabled
+        # run. The final record's dump is therefore THIS RUN's
+        # instruments, which is also the right scoping (two runs in one
+        # process don't sum into each other).
+        self.registry = Registry(enabled=True)
+        self._pairs = self.registry.counter(f"{tool}.pairs_total")
+        self._dispatches = self.registry.counter(
+            f"{tool}.dispatches_total")
+        self._gap = self.registry.gauge(f"{tool}.gap")
+        self._chunk_s = self.registry.histogram(f"{tool}.chunk_seconds")
+        self._events = self.registry.counter(f"{tool}.events_total")
+        self._last_pairs = None
+        self._finished = False
+        self._t0 = time.perf_counter()
+
+    def chunk(self, pairs: int, b_hi: float, b_lo: float,
+              device_seconds: float, dispatch: int, **fields) -> None:
+        """One host observation of device progress. ``pairs`` is the
+        run-cumulative count the host just unpacked; the delta vs the
+        previous observation is derived here so runlog consumers can
+        sum deltas without replaying cumulative state."""
+        delta = pairs - (self._last_pairs
+                         if self._last_pairs is not None else 0)
+        self._last_pairs = pairs
+        self._pairs.add(max(delta, 0))
+        self._dispatches.add(1)
+        self._gap.set(b_lo - b_hi)
+        self._chunk_s.observe(device_seconds)
+        self._log.record("chunk", pairs=int(pairs),
+                         pairs_delta=int(delta),
+                         b_hi=float(b_hi), b_lo=float(b_lo),
+                         gap=float(b_lo - b_hi),
+                         device_seconds=round(float(device_seconds), 6),
+                         dispatch=int(dispatch), **fields)
+
+    def event(self, name: str, **fields) -> None:
+        self._events.add(1)
+        self._log.record("event", name=name, **fields)
+
+    def finish(self, **fields) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self._session.__exit__(None, None, None)
+        self._log.finish(wall_seconds=round(
+            time.perf_counter() - self._t0, 6),
+            metrics=self.registry.snapshot(), **fields)
+
+    def __del__(self):
+        # Exception safety: a solve that faults mid-loop (the
+        # fault-retry path) never reaches its finish() call; when the
+        # handler releases the frames, this closes the run log and —
+        # critically — exits the global trace session so later runs
+        # don't feed a dead session. Idempotent; best-effort during
+        # interpreter shutdown.
+        try:
+            self.finish(aborted=True)
+        except Exception:
+            pass
+
+    def span(self, name: str):
+        return trace.span(name)
+
+    @property
+    def path(self) -> str:
+        return self._log.path
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False
+
+
+def run_obs(tool: str, config=None, meta=None, directory=None):
+    """The solver/tool entry point: NULL_OBS when observability is off
+    (the strict zero-overhead default), else a live RunObs. `config`
+    may be an SVMConfig/ServeConfig (its ``obs`` field is consulted
+    and its snapshot lands in the manifest), any dataclass, or None.
+
+    IMPORTANT behavioral invariant: enabling obs never changes solver
+    control flow — chunk cadence, dispatch counts and compiled
+    programs are identical with obs on and off (records simply ride
+    the observations the host was already making). Pinned by
+    tests/test_obs.py and the obs-enabled tpulint CI check.
+    """
+    ocfg = getattr(config, "obs", None)
+    if not obs_enabled(ocfg):
+        return NULL_OBS
+    return RunObs(tool, config=config, meta=meta, obs_config=ocfg,
+                  directory=directory)

@@ -1,0 +1,251 @@
+"""Metrics layer of the telemetry spine: process-local, bounded,
+lock-free counters / gauges / histograms with a strict no-op mode.
+
+Design constraints (ISSUE 7):
+
+* SERVE-HOT-PATH SAFE: every instrument method is a few plain Python
+  ops under the GIL — no locks, no allocation on the hot path (the
+  histogram ring is pre-allocated), so a PredictServer dispatch can
+  observe a latency without perturbing what it measures.
+* BOUNDED: a histogram holds a fixed bin array plus a fixed-size ring
+  of recent raw samples (the percentile window — the role serve.py's
+  maxlen=4096 deques played); total memory is O(bins + window) no
+  matter how many observations arrive.
+* STRICT NO-OP MODE: a disabled :class:`Registry` hands out shared
+  null instruments whose methods return immediately and record
+  nothing. Nothing obs-gated ever reaches the device — metrics are fed
+  exclusively from values the host already observed (chunk scalars,
+  perf counters), which is what keeps the tpulint budgets byte-
+  identical with observability on (the CI pin).
+
+The default process registry is enabled by ``DPSVM_OBS=1`` in the
+environment or programmatically via :func:`enable`; library code that
+wants per-instance instruments regardless of the global switch (the
+serving engine's latency histograms, which predate obs and must stay
+always-on) constructs its own ``Registry(enabled=True)``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, v: int = 1) -> None:
+        self.value += v
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge:
+    """Last-written value."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def set(self, v) -> None:
+        self.value = v
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """Bounded histogram of non-negative samples (latencies, sizes).
+
+    Two bounded structures, each serving one consumer:
+
+    * log2 BINS over [2^lo_exp, 2^hi_exp): lifetime distribution shape
+      (counts never reset, O(1) memory) for the runlog's final dump;
+    * a RING of the most recent ``window`` raw samples: exact
+      percentiles of the recent window — the semantics serve.py's
+      bounded deques provided, now shared by every consumer
+      (``offered_load_sweep``, ``cli serve --server-bench``,
+      tools/bench_serve.py).
+
+    Lock-free: ``observe`` is index arithmetic + two array stores under
+    the GIL; no allocation.
+    """
+
+    __slots__ = ("name", "window", "count", "total", "vmin", "vmax",
+                 "_ring", "_i", "_bins", "_lo_exp", "_hi_exp")
+
+    def __init__(self, name: str, window: int = 4096,
+                 lo_exp: int = -20, hi_exp: int = 7):
+        # Default bin span [2^-20 s ~ 1 us, 2^7 s = 128 s) fits every
+        # latency this repo measures; out-of-range samples clamp to the
+        # edge bins (counted, never dropped).
+        self.name = name
+        self.window = int(window)
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+        self._ring = np.empty((self.window,), np.float64)
+        self._i = 0
+        self._lo_exp = lo_exp
+        self._hi_exp = hi_exp
+        self._bins = np.zeros((hi_exp - lo_exp + 1,), np.int64)
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+        self._ring[self._i % self.window] = v
+        self._i += 1
+        e = int(math.floor(math.log2(v))) if v > 0 else self._lo_exp
+        e = min(max(e, self._lo_exp), self._hi_exp)
+        self._bins[e - self._lo_exp] += 1
+
+    def __len__(self) -> int:  # recent-window size (deque parity)
+        return min(self.count, self.window)
+
+    def window_values(self, last: Optional[int] = None) -> np.ndarray:
+        """The most recent min(count, window[, last]) raw samples in
+        arrival order — `last` lets a caller scope a shared histogram
+        to the observations ITS phase added (e.g. one offered-load
+        sweep on a long-lived server)."""
+        m = len(self)
+        if last is not None:
+            m = min(m, max(int(last), 0))
+        idx = (self._i - m + np.arange(m)) % self.window
+        return self._ring[idx]
+
+    def percentiles(self, qs=(50, 95, 99),
+                    last: Optional[int] = None) -> dict:
+        """{"p50": ..., ...} over the RECENT WINDOW, or over only the
+        most recent `last` samples (exact for the window; the lifetime
+        shape lives in the bins). Empty selection reports an empty
+        dict."""
+        v = self.window_values(last)
+        if v.size == 0:
+            return {}
+        return {f"p{q}": round(float(np.percentile(v, q)), 6)
+                for q in qs}
+
+    def snapshot(self) -> dict:
+        out = {"count": self.count, "window": len(self)}
+        if self.count:
+            out.update({
+                "sum": round(self.total, 6),
+                "mean": round(self.total / self.count, 6),
+                "min": round(self.vmin, 6),
+                "max": round(self.vmax, 6),
+                **self.percentiles(),
+            })
+            nz = np.nonzero(self._bins)[0]
+            out["log2_bins"] = {
+                str(int(e) + self._lo_exp): int(self._bins[e])
+                for e in nz}
+        return out
+
+
+class _Null:
+    """Shared do-nothing instrument (all three APIs)."""
+
+    __slots__ = ()
+    name = "<null>"
+    value = 0
+    count = 0
+
+    def add(self, v: int = 1) -> None:
+        pass
+
+    def set(self, v) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return 0
+
+    def percentiles(self, qs=(50, 95, 99), last=None) -> dict:
+        return {}
+
+    def window_values(self, last=None):
+        return np.empty((0,), np.float64)
+
+    def snapshot(self):
+        return None
+
+
+NULL = _Null()
+
+
+class Registry:
+    """Name -> instrument map. Disabled registries hand out the shared
+    null instruments (strict no-op mode); enablement is resolved when
+    the instrument is REQUESTED, so per-run code fetches fresh handles
+    (the solver obs helper does) and long-lived holders keep whatever
+    mode they were created under."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._m: dict = {}
+
+    def _get(self, name: str, cls, **kw):
+        if not self.enabled:
+            return NULL
+        inst = self._m.get(name)
+        if inst is None or inst.__class__ is not cls:
+            inst = cls(name, **kw) if kw else cls(name)
+            self._m[name] = inst
+        return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, window: int = 4096) -> Histogram:
+        return self._get(name, Histogram, window=window)
+
+    def snapshot(self) -> dict:
+        """JSON-able {name: value-or-dict} of everything registered."""
+        return {k: v.snapshot() for k, v in sorted(self._m.items())}
+
+    def reset(self) -> None:
+        self._m.clear()
+
+
+_DEFAULT: Optional[Registry] = None
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("DPSVM_OBS", "") not in ("", "0")
+
+
+def get_registry() -> Registry:
+    """The process-default registry (env ``DPSVM_OBS=1`` enables)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = Registry(enabled=_env_enabled())
+    return _DEFAULT
+
+
+def enable(on: bool = True) -> Registry:
+    """Flip the default registry's mode (tests; programmatic opt-in)."""
+    reg = get_registry()
+    reg.enabled = bool(on)
+    return reg

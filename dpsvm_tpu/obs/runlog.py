@@ -1,0 +1,225 @@
+"""Structured run logs: one JSONL stream per process and kind, every
+record self-describing and schema-versioned.
+
+The repo's measurement artifacts (BENCH_r*.json, MULTICHIP_r*.json,
+BENCH_SERVE_r*.json, TPU_SMOKE_r*.json) and its ad-hoc per-tool timing
+all predate this module and each rolled its own JSON shape; the run
+log is the ONE substrate they now share (ISSUE 7): a manifest record
+(config snapshot, git sha, jax + device topology), per-chunk records
+streamed as the host observes them, free-form event records (endgame
+demotion, fault retries), span records from obs/trace.py, and a final
+record with the run's result fields and the metrics-registry dump.
+
+Record shapes (all carry ``schema`` = :data:`SCHEMA_VERSION`,
+``run`` = the writer's run id, and ``kind``):
+
+* ``manifest`` — opened-run header: ``utc``, ``tool``, ``git_sha``,
+  ``jax``, ``backend``, ``device_kind``, ``n_devices``, ``config``
+  (dataclass snapshot), plus caller metadata (n, d, engine, ...).
+* ``chunk``   — one host observation of device progress: cumulative
+  ``pairs``, per-chunk ``pairs_delta``, ``b_hi``/``b_lo``/``gap``,
+  ``device_seconds`` (this chunk's dispatch->retired time, bounded by
+  the loop's single block_until_ready), ``dispatch`` ordinal.
+* ``event``   — named occurrences: ``{"name": ..., **fields}``.
+* ``span``    — host timeline events from obs/trace.py.
+* ``final``   — run result fields + ``metrics`` (registry snapshot).
+
+Everything is computed from values the host ALREADY holds — writing a
+run log adds zero device dispatches, transfers or collectives (the
+tpulint budgets are checked with obs enabled in CI to pin this).
+
+Files are per-process append-only (``<kind>-<pid>.jsonl`` under the
+run-log directory) so concurrent runs never interleave partial lines;
+records of one run share a ``run`` id. :func:`read_runlog` loads and
+validates a stream; :func:`records_for` filters one run's records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+# Version of BOTH the runlog record schema and the telemetry fields
+# embedded in the benchmark artifacts (BENCH/MULTICHIP/SERVE/SMOKE
+# *_r*.json "schema_version"). Bump on an incompatible shape change;
+# readers (bench._latest_bench_artifact) skip records NEWER than what
+# they understand, explicitly rather than by crashing.
+SCHEMA_VERSION = 1
+
+_RUN_COUNTER = 0
+
+
+def git_sha(repo_root: Optional[str] = None) -> str:
+    """Current commit sha, read from .git directly (no subprocess —
+    run logs open on hot paths and in sandboxes without git)."""
+    root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        head_path = os.path.join(root, ".git", "HEAD")
+        with open(head_path) as fh:
+            head = fh.read().strip()
+        if head.startswith("ref:"):
+            ref = head.split(None, 1)[1]
+            ref_path = os.path.join(root, ".git", *ref.split("/"))
+            if os.path.exists(ref_path):
+                with open(ref_path) as fh:
+                    return fh.read().strip()
+            packed = os.path.join(root, ".git", "packed-refs")
+            with open(packed) as fh:
+                for line in fh:
+                    if line.strip().endswith(ref):
+                        return line.split()[0]
+            return "unknown"
+        return head
+    except OSError:
+        return "unknown"
+
+
+def config_snapshot(config) -> Optional[dict]:
+    """JSON-able snapshot of a (frozen dataclass) config; None stays
+    None. Non-JSON leaves (e.g. nested dataclasses) are stringified
+    rather than dropped."""
+    if config is None:
+        return None
+    if dataclasses.is_dataclass(config):
+        d = dataclasses.asdict(config)
+    elif isinstance(config, dict):
+        d = dict(config)
+    else:
+        return {"repr": repr(config)}
+
+    def _clean(v):
+        if isinstance(v, dict):
+            return {k: _clean(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [_clean(x) for x in v]
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        return repr(v)
+
+    return _clean(d)
+
+
+def device_topology() -> dict:
+    """Backend/topology facts for the manifest record. Never forces a
+    backend into existence on its own — callers open run logs after
+    the solver already initialized jax."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        return {
+            "jax": jax.__version__,
+            "backend": devs[0].platform,
+            "device_kind": getattr(devs[0], "device_kind", ""),
+            "n_devices": len(devs),
+            "process_count": jax.process_count(),
+        }
+    except Exception:
+        return {"jax": "unavailable", "backend": "none",
+                "device_kind": "", "n_devices": 0, "process_count": 0}
+
+
+def default_dir(obs_config=None) -> str:
+    """Run-log directory resolution: explicit config beats the
+    DPSVM_OBS_DIR env beats ./obs_runs."""
+    if obs_config is not None and getattr(obs_config, "runlog_dir", None):
+        return obs_config.runlog_dir
+    return os.environ.get("DPSVM_OBS_DIR") or "obs_runs"
+
+
+class RunLog:
+    """Append-only JSONL writer for ONE run (manifest -> chunk/event/
+    span stream -> final). Use as a context manager or call
+    :meth:`finish` explicitly; both are idempotent."""
+
+    def __init__(self, path: str, tool: str, config=None, meta=None):
+        global _RUN_COUNTER
+        _RUN_COUNTER += 1
+        self.path = path
+        self.run_id = f"{os.getpid():d}-{_RUN_COUNTER:d}"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "a")
+        self._finished = False
+        # dict-merge, caller meta last: a caller key (e.g. a solve's
+        # mesh width as n_devices) overrides the topology default
+        # instead of raising a duplicate-kwarg TypeError.
+        manifest = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+                    "tool": tool, "git_sha": git_sha(),
+                    **device_topology(),
+                    "config": config_snapshot(config),
+                    **(meta or {})}
+        self.record("manifest", **manifest)
+
+    @classmethod
+    def open(cls, tool: str, config=None, meta=None,
+             obs_config=None, directory: Optional[str] = None) -> "RunLog":
+        """Open the per-process stream for `tool` under the resolved
+        run-log directory (one file per (tool, pid); runs append)."""
+        d = directory or default_dir(obs_config)
+        return cls(os.path.join(d, f"{tool}-{os.getpid()}.jsonl"),
+                   tool, config=config, meta=meta)
+
+    def record(self, kind: str, **fields) -> None:
+        if self._fh is None:
+            return
+        rec = {"schema": SCHEMA_VERSION, "run": self.run_id,
+               "kind": kind, **fields}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    # The trace-session sink signature (obs/trace.py): span dicts
+    # arrive pre-shaped {"kind": "span", ...}.
+    def span_sink(self, rec: dict) -> None:
+        self.record(**rec)
+
+    def finish(self, **fields) -> None:
+        if self._finished or self._fh is None:
+            return
+        self._finished = True
+        self.record("final", **fields)
+        self._fh.close()
+        self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False
+
+
+def read_runlog(path: str) -> list:
+    """Parse + validate a runlog JSONL: every record must carry
+    schema/run/kind; records with a NEWER schema than this reader are
+    skipped (forward-compat contract shared with the bench artifact
+    scan). Truncated trailing lines (writer killed mid-record) are
+    dropped, matching the artifact readers' resilience."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if {"schema", "run", "kind"} - rec.keys():
+                continue
+            if rec["schema"] > SCHEMA_VERSION:
+                continue
+            out.append(rec)
+    return out
+
+
+def records_for(records: list, run_id: str, kind: Optional[str] = None):
+    """One run's records (optionally one kind), in stream order."""
+    return [r for r in records
+            if r["run"] == run_id and (kind is None or r["kind"] == kind)]

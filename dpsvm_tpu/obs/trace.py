@@ -1,0 +1,180 @@
+"""Span/annotation layer of the telemetry spine (dpsvm_tpu/obs).
+
+One primitive — ``span(name)`` — names a host-driven stage of work:
+a solver chunk dispatch, a mesh sync, a serve bucket dispatch, a
+runner build. On a device backend with an active ``jax.profiler``
+trace the span additionally enters a ``TraceAnnotation``, so the name
+shows up in the Perfetto/XPlane timeline next to the XLA ops it
+brackets; on CPU (or with no device trace running) it degrades to a
+host-side monotonic timeline: ``(name, t_start, duration)`` events
+collected by the active :class:`TraceSession` and flushed as JSONL
+records through the session's sink (normally the run log —
+obs/runlog.py — so one file carries manifest + chunks + spans).
+
+The ZERO-OVERHEAD contract: with no session active, ``span()`` returns
+one shared no-op context manager — no allocation, no clock read, no
+branch beyond the module-global check. Spans never touch the device:
+they bracket host code around already-issued dispatches, so they can
+never add dispatches, transfers or collectives (the tpulint budgets
+pin this for the compiled programs themselves; see
+docs/ARCHITECTURE.md "Observability").
+
+Span naming convention: ``area/stage`` with the area one of
+``solver`` / ``mesh`` / ``fleet`` / ``serve`` / ``bench`` /
+``profile`` and the stage a short verb-less noun (``chunk``,
+``sync``, ``bucket1024``, ``warm``, ``stage``). Nested spans are
+allowed and appear nested in the device trace.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+# Events kept in memory per session before the oldest are dropped (a
+# long-lived server must not grow a list per dispatch forever — the
+# serve.py deque discipline). Drops are counted, never silent.
+_MAX_EVENTS = 65536
+
+# Stack of live sessions, innermost last. Spans attribute to the
+# INNERMOST session live when the span was created — so two
+# concurrently open runs in one process (e.g. bench_serve's two
+# PredictServers) each collect their own spans instead of the second
+# run's events landing in the first run's log under the wrong run id.
+_STACK: list = []
+
+
+class _NullSpan:
+    """The shared disabled span: a no-op context manager."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    """One named timed region, bound at creation to the session that
+    was innermost then (stable attribution even if another session
+    opens or closes while this span is running)."""
+
+    __slots__ = ("name", "_t0", "_ann", "_sess")
+
+    def __init__(self, name: str, annotation, session):
+        self.name = name
+        self._ann = annotation
+        self._sess = session
+
+    def __enter__(self):
+        if self._ann is not None:
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self._sess._emit(self.name, self._t0, dur)
+        return False
+
+
+class TraceSession:
+    """One tracing window: optional on-device ``jax.profiler`` trace
+    plus the host-side monotonic timeline every backend gets.
+
+    ``sink(record_dict)``, when given, receives each span event as it
+    completes (the run log passes its own record writer, so span
+    events land in the same JSONL as chunk records). Without a sink
+    events accumulate in ``self.events`` (bounded at ``_MAX_EVENTS``;
+    ``self.dropped`` counts the overflow).
+
+    Nesting/concurrency: sessions stack; each span attributes to the
+    session that was INNERMOST when the span was created, so
+    concurrently open runs each collect their own timeline. Only one
+    ``jax.profiler`` device trace can run per process — the first live
+    session with a ``trace_dir`` owns it; inner sessions' spans still
+    appear in it as TraceAnnotations.
+    """
+
+    def __init__(self, trace_dir: Optional[str] = None,
+                 sink: Optional[Callable] = None):
+        self.trace_dir = trace_dir
+        self.sink = sink
+        self.events: list = []
+        self.dropped = 0
+        self._device_trace = False
+        self._closed = False
+
+    # -- lifecycle ----------------------------------------------------
+    def __enter__(self):
+        _STACK.append(self)
+        if self.trace_dir and not any(s._device_trace for s in _STACK
+                                      if s is not self):
+            try:
+                import jax
+
+                jax.profiler.start_trace(self.trace_dir)
+                self._device_trace = True
+            except Exception:
+                # No profiler backend (or one already running): the
+                # host timeline is the degraded-mode contract.
+                self._device_trace = False
+        return self
+
+    def __exit__(self, *exc):
+        if self._closed:
+            return False
+        self._closed = True
+        if self._device_trace:
+            self._device_trace = False
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        if self in _STACK:
+            _STACK.remove(self)
+        return False
+
+    # -- event path ---------------------------------------------------
+    def _emit(self, name: str, t0: float, dur: float) -> None:
+        rec = {"kind": "span", "name": name,
+               "t": round(t0, 6), "dur": round(dur, 6)}
+        if self.sink is not None:
+            self.sink(rec)
+            return
+        if len(self.events) >= _MAX_EVENTS:
+            self.dropped += 1
+            return
+        self.events.append(rec)
+
+
+def span(name: str):
+    """Named span context manager bound to the innermost live session;
+    the shared no-op when none is active (the strict zero-overhead
+    mode)."""
+    if not _STACK:
+        return _NULL_SPAN
+    sess = _STACK[-1]
+    ann = None
+    if any(s._device_trace for s in _STACK):
+        try:
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(name)
+        except Exception:
+            ann = None
+    return _LiveSpan(name, ann, sess)
+
+
+def active_session() -> Optional[TraceSession]:
+    """The innermost live session (None when tracing is off)."""
+    return _STACK[-1] if _STACK else None

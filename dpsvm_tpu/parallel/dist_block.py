@@ -67,8 +67,11 @@ def _global_top(scores, gids_loc, h: int):
     # below stays exact — it reduces only (P*h,) gathered candidates.
     v, i = _top_h(scores, h)  # (r, h)
     g = jnp.take(gids_loc, i)
-    av = lax.all_gather(v, DATA_AXIS)  # (P, r, h)
-    ag = lax.all_gather(g, DATA_AXIS)
+    # named_scope = op METADATA only (stage names in device traces;
+    # opcode structure/counts untouched — the tpulint budgets pin it).
+    with jax.named_scope("mesh_candidate_gather"):
+        av = lax.all_gather(v, DATA_AXIS)  # (P, r, h)
+        ag = lax.all_gather(g, DATA_AXIS)
     av = jnp.moveaxis(av, 0, 1).reshape(r, -1)  # (r, P*h), device-major
     ag = jnp.moveaxis(ag, 0, 1).reshape(r, -1)
     gv, gi = lax.top_k(av, h)
@@ -134,10 +137,11 @@ def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
     own (q,) bool); qx/scal are replicated across devices, while l (local
     slot index) and own (this-shard ownership mask) are PER-DEVICE."""
     l, own, l_safe = _ws_owners(w, slot_ok, n_loc)
-    qx_own = jnp.where(own[:, None], jnp.take(x_loc, l_safe, axis=0)
-                       .astype(jnp.float32), 0.0)
-    qx = lax.psum(qx_own, DATA_AXIS)
-    scal = _psum_scal(scal_loc, own, l_safe)
+    with jax.named_scope("mesh_ws_recover"):
+        qx_own = jnp.where(own[:, None], jnp.take(x_loc, l_safe, axis=0)
+                           .astype(jnp.float32), 0.0)
+        qx = lax.psum(qx_own, DATA_AXIS)
+        scal = _psum_scal(scal_loc, own, l_safe)
     return qx, scal, l, own
 
 
@@ -429,8 +433,9 @@ def make_block_shardlocal_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                 (st.alpha, st.f, st.f_err, pend0, jnp.int32(0)))
 
             # ---- SYNC: the window's ONLY collectives.
-            ag = lax.all_gather(pend.reshape(r_sync * q, d + 3),
-                                DATA_AXIS)  # (P, R*q, d+3), replicated
+            with jax.named_scope("mesh_sync"):
+                ag = lax.all_gather(pend.reshape(r_sync * q, d + 3),
+                                    DATA_AXIS)  # (P, R*q, d+3)
             pairs = st.pairs + jnp.sum(ag[:, :, d + 2]).astype(jnp.int32)
 
             # Cross-shard fold: one (R*q, n_loc) kernel-row fold per
@@ -555,8 +560,9 @@ def make_block_pipelined_chunk_runner(mesh: Mesh, kp: KernelParams, c,
             # CURRENT per-slot alpha/f, then the corrected-gradient
             # gating masks slots the previous round saturated.
             l, own, l_safe = _ws_owners(w, slot_ok0, n_loc)
-            dyn = _psum_scal(jnp.stack([st.alpha, f_cur], axis=1),
-                             own, l_safe)
+            with jax.named_scope("mesh_handoff"):
+                dyn = _psum_scal(jnp.stack([st.alpha, f_cur], axis=1),
+                                 own, l_safe)
             a_w0, f_w0 = dyn[:, 0], dyn[:, 1]
             slot_ok = slot_ok0 & candidate_live_mask(a_w0, y_w, c)
             # No gap gate on `limit`: cond() guarantees the carried gap

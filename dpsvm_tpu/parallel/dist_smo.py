@@ -436,6 +436,7 @@ def solve_mesh(
 def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                      checkpoint_path, resume, alpha_init,
                      f_init) -> SolveResult:
+    t_entry = time.perf_counter()  # phase clock: setup starts here
     use_block = config.engine == "block"
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)
@@ -718,6 +719,27 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     if callback is not None and hasattr(callback, "on_start"):
         callback.on_start(start_iter)
 
+    # Observability (dpsvm_tpu/obs; NULL_OBS when disabled) + the honest
+    # phase clock — same contract as solver/smo.py: obs never joins the
+    # `observe` predicate (chunk cadence is unchanged), phase boundaries
+    # sync ONCE, at chunk boundaries only (the setup sync below is the
+    # first boundary; without it sharded staging rides into chunk 1).
+    from dpsvm_tpu.obs import run_obs
+
+    obs = run_obs("solve_mesh", config,
+                  meta={"n": n, "d": d, "n_pad": n_pad,
+                        "n_devices": n_dev,
+                        "engine": config.engine,
+                        "kernel": config.kernel,
+                        "selection": config.selection,
+                        "shardlocal": bool(use_shardlocal),
+                        "pipelined": bool(use_block and use_pipe),
+                        "fused_fold": bool(use_block and use_fused),
+                        "observed_chunks": observe})
+    jax.block_until_ready((x_dev, y_dev, x_sq, k_diag, valid_dev, state))
+    phase_seconds = {"setup": time.perf_counter() - t_entry,
+                     "solve": 0.0, "observe": 0.0, "finalize": 0.0}
+
     # Device time only, clock stopped during host observation — see the
     # matching loop in solver/smo.py for the rationale.
     train_seconds = 0.0
@@ -740,16 +762,25 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     gap_ref = None
     stall_rounds = (_SHARDLOCAL_WINDOWS_PER_CHUNK
                     * int(config.sync_rounds))
+    dispatches = 0
     while True:
-        t0 = time.perf_counter()
-        state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter)
-        jax.block_until_ready(state)
-        train_seconds += time.perf_counter() - t0
+        with obs.span("mesh/chunk"):
+            t0 = time.perf_counter()
+            dispatches += 1
+            state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev,
+                              state, max_iter)
+            jax.block_until_ready(state)
+        chunk_dt = time.perf_counter() - t0
+        train_seconds += chunk_dt
+        t_obs0 = time.perf_counter()
         # Block-engine observability lags by <= one round here — see the
         # matching note in solver/smo.py (control flow is unaffected;
         # budget exits are refreshed exactly below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
+        obs.chunk(pairs=it, b_hi=b_hi, b_lo=b_lo,
+                  device_seconds=chunk_dt, dispatch=dispatches,
+                  shardlocal=bool(shardlocal_live))
         converged = not (b_lo > b_hi + 2.0 * eps_run)
         abort = bool(callback is not None
                      and callback(it, b_hi, b_lo, state))
@@ -774,6 +805,9 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 run_chunk = _plain_runner(rounds_per_chunk)
                 shardlocal_live = False
                 shardlocal_demoted = True
+                obs.event("shardlocal_demotion", pairs=it,
+                          gap=float(gap), stalled=bool(stalled),
+                          rounds=int(rounds_now))
                 if config.verbose:
                     why = (f"gap not halved in {stall_rounds} local "
                            "rounds" if stalled
@@ -781,6 +815,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                     print(f"[smo-mesh p={n_dev}] shard-local endgame "
                           f"demotion at iter={it}: {why} -> exact "
                           "global-working-set runner")
+        phase_seconds["observe"] += time.perf_counter() - t_obs0
         if converged or it >= config.max_iter:
             break
         if abort:
@@ -788,6 +823,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
             # convergence test so it cannot mask a converged chunk.
             break
 
+    t_fin0 = time.perf_counter()
     alpha = np.asarray(state.alpha)[:n]
     f_final = np.asarray(eff_f(state))[:n]
     if (use_block or config.budget_mode) and not converged:
@@ -797,6 +833,33 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
             f_final, alpha, y_np, config.c_bounds(),
             config.epsilon, rule=config.selection)
     lookups = 2 * (it - start_iter) if use_cache else 0
+    phase_seconds["solve"] = train_seconds
+    phase_seconds["finalize"] = time.perf_counter() - t_fin0
+    phase_seconds = {k: round(v, 6) for k, v in phase_seconds.items()}
+    stats = {
+        "num_devices": n_dev,
+        "rows_padded": n_pad - n,
+        "cache_hits": int(state.hits),
+        "cache_lookups": lookups,
+        "cache_hit_rate": (int(state.hits) / lookups) if lookups else 0.0,
+        "f": f_final,
+        # Honest per-phase wall clock (one block_until_ready per
+        # boundary, chunk boundaries only — see the phase-clock note
+        # above and solver/smo.py's matching contract).
+        "phase_seconds": phase_seconds,
+        **({"outer_rounds": int(state.rounds)} if use_block else {}),
+        **({"shardlocal_demoted": shardlocal_demoted}
+           if use_shardlocal else {}),
+    }
+    if obs.live:
+        stats["obs_run_id"] = obs.run_id
+        stats["obs_runlog"] = obs.path
+    obs.finish(iterations=it, converged=bool(converged),
+               train_seconds=round(train_seconds, 6),
+               dispatches=dispatches,
+               b_hi=float(b_hi), b_lo=float(b_lo),
+               shardlocal_demoted=bool(shardlocal_demoted),
+               phase_seconds=phase_seconds)
     return SolveResult(
         alpha=alpha,
         b=float((b_lo + b_hi) / 2.0),
@@ -805,15 +868,6 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
         iterations=it,
         converged=converged,
         train_seconds=train_seconds,
-        stats={
-            "num_devices": n_dev,
-            "rows_padded": n_pad - n,
-            "cache_hits": int(state.hits),
-            "cache_lookups": lookups,
-            "cache_hit_rate": (int(state.hits) / lookups) if lookups else 0.0,
-            "f": f_final,
-            **({"outer_rounds": int(state.rounds)} if use_block else {}),
-            **({"shardlocal_demoted": shardlocal_demoted}
-               if use_shardlocal else {}),
-        },
+        dispatches=dispatches,
+        stats=stats,
     )

@@ -36,7 +36,6 @@ so serving memory scales with device count.
 
 from __future__ import annotations
 
-import collections
 import functools
 import time
 import warnings
@@ -46,6 +45,9 @@ from typing import Union
 import numpy as np
 
 from dpsvm_tpu.config import ServeConfig
+from dpsvm_tpu.obs import run_obs
+from dpsvm_tpu.obs.metrics import Registry
+from dpsvm_tpu.obs.trace import span
 from dpsvm_tpu.models.multiclass import (CompactedEnsemble, MulticlassSVM,
                                          compact_models, ovo_vote_fold)
 from dpsvm_tpu.models.svm_model import SVMModel
@@ -181,17 +183,35 @@ class PredictServer:
         # --- device staging (once; resident for the server lifetime) -
         self._stage()
 
+        # Always-on per-server instruments (dpsvm_tpu/obs/metrics): the
+        # bounded-window histograms that replaced the old per-bucket
+        # timing deques — same O(window) memory, lock-free observe on
+        # the dispatch hot path, and ONE percentile definition shared
+        # by offered_load_sweep, `cli serve --server-bench` and
+        # tools/bench_serve.py.
+        self.metrics = Registry(enabled=True)
+        self.request_seconds = self.metrics.histogram(
+            "serve.request_seconds")
         self.stats = {
             "requests": 0, "rows": 0, "dispatches": 0, "padded_rows": 0,
             "buckets": self.buckets,
             "bucket_counts": {b: 0 for b in self.buckets},
-            # Bounded per-bucket dispatch timings (a long-lived server
-            # must not grow a list per dispatch forever); percentiles
-            # come from the most recent window.
-            "bucket_seconds": {b: collections.deque(maxlen=4096)
-                               for b in self.buckets},
+            # Bounded per-bucket dispatch timings; percentiles come
+            # from the histogram's recent window (the deque semantics,
+            # now shared).
+            "bucket_seconds": {
+                b: self.metrics.histogram(f"serve.bucket_seconds.{b}")
+                for b in self.buckets},
             "warm_seconds": {}, "f64_columns": len(self.f64_cols),
         }
+        # Run-log layer (off unless config.obs / DPSVM_OBS enables it):
+        # manifest at construction; close() writes the final snapshot.
+        self._obs = run_obs("serve", config,
+                            meta={"k": self.k, "d": self.d,
+                                  "n_union": int(self.ens.n_union),
+                                  "strategy": self.strategy,
+                                  "buckets": list(self.buckets),
+                                  "f64_columns": len(self.f64_cols)})
         self._pending: list = []  # (ticket, (n, d) rows)
         self._pending_rows = 0
         self._done: dict = {}
@@ -302,11 +322,12 @@ class PredictServer:
         if self._call is None:
             return np.broadcast_to(
                 -self.ens.b, (qb.shape[0], self.k)).astype(np.float32)
-        t0 = time.perf_counter()
-        out = np.asarray(self._call(qb))
+        with span(f"serve/bucket{bucket}"):
+            t0 = time.perf_counter()
+            out = np.asarray(self._call(qb))
+            dt = time.perf_counter() - t0
         if not warm:
-            self.stats["bucket_seconds"][bucket].append(
-                time.perf_counter() - t0)
+            self.stats["bucket_seconds"][bucket].observe(dt)
         return out
 
     def decision(self, q) -> np.ndarray:
@@ -413,6 +434,28 @@ class PredictServer:
         done.update(self._flush_pending())
         return done
 
+    # ------------------------------------------------------- telemetry
+    def snapshot(self) -> dict:
+        """JSON-able stats: the plain counters plus every histogram's
+        bounded snapshot (count/mean/min/max/p50/p95/p99/log2 bins) —
+        the shape the serve run log's final record and the bench tools
+        all consume."""
+        out = {k: v for k, v in self.stats.items()
+               if k not in ("bucket_seconds", "bucket_counts", "buckets")}
+        out["buckets"] = list(self.buckets)
+        out["bucket_counts"] = {str(b): c for b, c
+                                in self.stats["bucket_counts"].items()}
+        out["bucket_seconds"] = {
+            str(b): h.snapshot()
+            for b, h in self.stats["bucket_seconds"].items() if len(h)}
+        out["request_seconds"] = self.request_seconds.snapshot()
+        return out
+
+    def close(self) -> None:
+        """Finish the serve run log (no-op when obs is disabled or
+        already closed); the device-resident operands stay usable."""
+        self._obs.finish(**self.snapshot())
+
 
 def offered_load_sweep(server: PredictServer, request_sizes,
                        n_requests: int, group: int = 8,
@@ -425,7 +468,14 @@ def offered_load_sweep(server: PredictServer, request_sizes,
     and tools/bench_serve.py."""
     rng = np.random.default_rng(seed)
     sizes = rng.choice(np.asarray(request_sizes), n_requests)
-    lat = []
+    # Baselines: the histograms are SERVER-LIFETIME instruments (they
+    # also feed the serve run log); this sweep's report must cover only
+    # the observations THIS sweep adds, or a second sweep on the same
+    # server would report percentiles/dispatches contaminated by the
+    # first (`last=` scopes the shared window; counts are differenced).
+    req_base = server.request_seconds.count
+    bucket_base = {b: h.count
+                   for b, h in server.stats["bucket_seconds"].items()}
     rows = 0
     t_start = time.perf_counter()
     for s in range(0, n_requests, group):
@@ -436,27 +486,29 @@ def offered_load_sweep(server: PredictServer, request_sizes,
                                       dtype=np.float32))
         server.flush()
         t1 = time.perf_counter()
-        lat.extend([t1 - t0] * len(batch_sizes))
+        for _ in batch_sizes:
+            server.request_seconds.observe(t1 - t0)
         rows += int(batch_sizes.sum())
     wall = time.perf_counter() - t_start
 
-    def pct(v):
-        v = np.asarray(v, np.float64)
-        return {"p50": round(float(np.percentile(v, 50)), 6),
-                "p95": round(float(np.percentile(v, 95)), 6),
-                "p99": round(float(np.percentile(v, 99)), 6)}
-
+    # Percentiles come from the server's OWN shared histograms
+    # (obs/metrics.Histogram recent-window semantics) — the same
+    # instruments `cli serve --server-bench`, tools/bench_serve.py and
+    # the serve run log report from — scoped to THIS sweep's
+    # observations via the baselines above.
     per_bucket = {}
-    for bucket, secs in server.stats["bucket_seconds"].items():
-        if secs:
+    for bucket, h in server.stats["bucket_seconds"].items():
+        new = h.count - bucket_base[bucket]
+        if new:
             per_bucket[str(bucket)] = {
-                "dispatches": len(secs), **pct(list(secs))}
+                "dispatches": new, **h.percentiles(last=new)}
     return {
         "requests": int(n_requests), "rows": int(rows), "group": group,
         "wall_seconds": round(wall, 4),
         "rows_per_second": round(rows / max(wall, 1e-9)),
         "requests_per_second": round(n_requests / max(wall, 1e-9)),
-        "request_latency": pct(lat),
+        "request_latency": server.request_seconds.percentiles(
+            last=server.request_seconds.count - req_base),
         "bucket_latency": per_bucket,
         "dispatches": server.stats["dispatches"],
         "padded_rows": server.stats["padded_rows"],

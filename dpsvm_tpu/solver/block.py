@@ -473,43 +473,56 @@ def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
     (run_chunk_block_fused) selects as part of the PREVIOUS round's fold.
 
     Returns (w, slot_ok, b_hi, b_lo, a_w, coef, t, qx, qsq)."""
+    # jax.named_scope tags the ops of each stage with op_name METADATA
+    # (visible in Perfetto/XPlane device traces as select/gather/gram/
+    # subproblem/fold stage names) — metadata only: opcode structure,
+    # shapes and counts are untouched, which is why the committed
+    # tpulint budgets are byte-identical with the scopes in place (the
+    # obs zero-HLO-effect contract, checked in CI with obs enabled).
     if cand is not None:
         w, slot_ok, b_hi, b_lo = cand
     else:
-        w, slot_ok, b_hi, b_lo = select_block(f, alpha, y, c, q,
-                                              valid=valid, rule=selection)
+        with jax.named_scope("block_select"):
+            w, slot_ok, b_hi, b_lo = select_block(f, alpha, y, c, q,
+                                                  valid=valid,
+                                                  rule=selection)
     gap_open = b_lo > b_hi + 2.0 * eps
-    qx = jnp.take(x, w, axis=0)  # (q, d)
-    qsq = jnp.take(x_sq, w)
-    if kp.kind == "precomputed":
-        # x IS the Gram matrix: the (q, q) block is a column gather of
-        # the already-gathered rows (kernel_rows likewise returns qx
-        # verbatim for the fold).
-        kb_w = jnp.take(qx.astype(jnp.float32), w, axis=1)
-    else:
-        dots_w = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
-                         preferred_element_type=jnp.float32)
-        kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)  # (q, q)
-    kd_w = jnp.take(k_diag, w)
-    a_w0 = jnp.take(alpha, w)
-    y_w = jnp.take(y, w)
-    f_w0 = jnp.take(f, w)
+    with jax.named_scope("block_gather"):
+        qx = jnp.take(x, w, axis=0)  # (q, d)
+        qsq = jnp.take(x_sq, w)
+        kd_w = jnp.take(k_diag, w)
+        a_w0 = jnp.take(alpha, w)
+        y_w = jnp.take(y, w)
+        f_w0 = jnp.take(f, w)
+    with jax.named_scope("block_gram"):
+        if kp.kind == "precomputed":
+            # x IS the Gram matrix: the (q, q) block is a column gather
+            # of the already-gathered rows (kernel_rows likewise
+            # returns qx verbatim for the fold).
+            kb_w = jnp.take(qx.astype(jnp.float32), w, axis=1)
+        else:
+            dots_w = jnp.dot(qx.astype(x.dtype), qx.astype(x.dtype).T,
+                             preferred_element_type=jnp.float32)
+            kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)  # (q, q)
     # Per-round pair budget, clamped so total pairs never exceed the
     # caller's remaining budget (the per-pair engines cap exactly; so
     # must this one) and gated to 0 on the terminal round.
     limit = jnp.minimum(jnp.int32(inner_iters), budget_left)
     limit = jnp.where(gap_open, limit, 0)
-    if inner_impl == "pallas":
-        from dpsvm_tpu.ops.pallas_subproblem import solve_subproblem_pallas
+    with jax.named_scope("block_subproblem"):
+        if inner_impl == "pallas":
+            from dpsvm_tpu.ops.pallas_subproblem import (
+                solve_subproblem_pallas)
 
-        a_w, t = solve_subproblem_pallas(
-            kb_w, a_w0, y_w, f_w0, kd_w, slot_ok.astype(jnp.float32),
-            limit, c, eps, tau, rule=selection, interpret=interpret,
-            pair_batch=pair_batch)
-    else:
-        a_w, _, t = _solve_subproblem(
-            kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
-            limit, rule=selection, pair_batch=pair_batch)
+            a_w, t = solve_subproblem_pallas(
+                kb_w, a_w0, y_w, f_w0, kd_w,
+                slot_ok.astype(jnp.float32),
+                limit, c, eps, tau, rule=selection, interpret=interpret,
+                pair_batch=pair_batch)
+        else:
+            a_w, _, t = _solve_subproblem(
+                kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
+                limit, rule=selection, pair_batch=pair_batch)
     coef = jnp.where(slot_ok, (a_w - a_w0) * y_w, 0.0)  # (q,)
     return w, slot_ok, b_hi, b_lo, a_w, coef, t, qx, qsq
 
@@ -543,14 +556,16 @@ def run_local_round(x, y, x_sq, k_diag, valid, alpha, f, f_err,
     # fused matmul chain over X (the single O(n d q) pass per round):
     # f += (dalpha * y)_W @ K(W, :), with K(W, :) from the same
     # kernel_rows machinery every other engine uses.
-    k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n) fp32
-    f, f_err = maybe_kahan(f, f_err, coef @ k_rows)
-    # Dead slots must not scatter. The inert index must be OUT OF
-    # RANGE (n), not -1: mode="drop" only drops beyond-range indices,
-    # while -1 wraps to the LAST row and would erase its alpha.
-    safe_w = jnp.where(slot_ok, w, jnp.int32(alpha.shape[0]))
-    alpha = alpha.at[safe_w].set(
-        jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
+    with jax.named_scope("block_fold"):
+        k_rows = kernel_rows(x, x_sq, qx, qsq, kp)  # (q, n) fp32
+        f, f_err = maybe_kahan(f, f_err, coef @ k_rows)
+        # Dead slots must not scatter. The inert index must be OUT OF
+        # RANGE (n), not -1: mode="drop" only drops beyond-range
+        # indices, while -1 wraps to the LAST row and would erase its
+        # alpha.
+        safe_w = jnp.where(slot_ok, w, jnp.int32(alpha.shape[0]))
+        alpha = alpha.at[safe_w].set(
+            jnp.where(slot_ok, alpha_w, 0.0), mode="drop")
     return alpha, f, f_err, b_hi, b_lo, t, coef, qx, qsq
 
 

@@ -360,20 +360,42 @@ def solve_fleet(
         chunk = int(config.chunk_iters) if observe else _UNOBSERVED_CHUNK
         max_iter = jnp.int32(config.max_iter)
 
+        # Observability (dpsvm_tpu/obs; NULL_OBS when disabled): one
+        # run log for the whole fleet, chunk records from the per-chunk
+        # host pulls the loop already makes (zero new transfers). Not
+        # part of `observe` — chunk cadence is unchanged.
+        from dpsvm_tpu.obs import run_obs
+
+        obs = run_obs("fleet", config,
+                      meta={"n": n, "d": d, "n_pad": n_pad,
+                            "k": k_real, "bucket": k_pad,
+                            "kernel": config.kernel,
+                            "gram_resident": bool(use_gram)})
+
         train_seconds = 0.0
         dispatches = 0
         while True:
-            t0 = time.perf_counter()
-            dispatches += 1
-            state = _run_fleet_chunk(
-                x_dev, y_dev, x_sq, valid_dev, cb_dev, state, max_iter,
-                kp=kp_run, eps=eps_run, tau=float(config.tau), chunk=chunk)
-            jax.block_until_ready(state)
-            train_seconds += time.perf_counter() - t0
+            with obs.span("fleet/chunk"):
+                t0 = time.perf_counter()
+                dispatches += 1
+                state = _run_fleet_chunk(
+                    x_dev, y_dev, x_sq, valid_dev, cb_dev, state,
+                    max_iter, kp=kp_run, eps=eps_run,
+                    tau=float(config.tau), chunk=chunk)
+                jax.block_until_ready(state)
+            chunk_dt = time.perf_counter() - t0
+            train_seconds += chunk_dt
             b_hi = np.asarray(state.b_hi)
             b_lo = np.asarray(state.b_lo)
             it = np.asarray(state.it)
             active = (it < config.max_iter) & (b_lo > b_hi + 2.0 * eps_run)
+            # Fleet-wide scalars derived from the arrays the loop just
+            # pulled anyway (the convergence test needs them).
+            obs.chunk(pairs=int(it[:k_real].sum()),
+                      b_hi=float(np.min(b_hi[:k_real])),
+                      b_lo=float(np.max(b_lo[:k_real])),
+                      device_seconds=chunk_dt, dispatch=dispatches,
+                      active=int(active[:k_real].sum()))
             if config.verbose:
                 gaps = (b_lo - b_hi)[:k_real]
                 print(f"[fleet] trips={int(state.t)} "
@@ -381,6 +403,13 @@ def solve_fleet(
                       f"max_gap={float(np.max(gaps)):.6f}")
             if not active.any():
                 break
+        # Only host-held values in the final record (NULL_OBS still
+        # evaluates the arguments — a device pull here would tax the
+        # disabled path).
+        obs.finish(dispatches=dispatches,
+                   pairs=int(it[:k_real].sum()),
+                   train_seconds=round(train_seconds, 6),
+                   converged=int((~active[:k_real]).sum()))
 
     alpha_all = np.asarray(state.alpha)
     f_all = np.asarray(state.f)

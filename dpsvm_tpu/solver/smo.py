@@ -1011,6 +1011,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 alpha_init, f_init, pad_to=None) -> SolveResult:
     import numpy as np
 
+    t_entry = time.perf_counter()  # phase clock: setup starts here
     x = np.asarray(x, np.float32)
     y_np = np.asarray(y, np.int32)
     n, d = x.shape
@@ -1242,6 +1243,41 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         rounds_per_chunk = (max(1, chunk_len // inner)
                             if observe else _UNOBSERVED_CHUNK)
 
+    # Observability (dpsvm_tpu/obs; NULL_OBS when disabled). Obs is NOT
+    # part of `observe` above: its chunk records ride whatever cadence
+    # the solve already has, so enabling it cannot change chunking,
+    # dispatch counts or compiled programs.
+    from dpsvm_tpu.obs import run_obs
+
+    obs = run_obs("solve", config,
+                  meta={"n": n, "d": d, "n_pad": n_pad,
+                        "engine": config.engine,
+                        "kernel": config.kernel,
+                        "selection": config.selection,
+                        "gram_resident": bool(use_gram),
+                        "pipelined": bool(use_block and use_pipe),
+                        "fused_fold": bool(use_block and use_fused),
+                        "observed_chunks": observe})
+
+    # PHASE CLOCK (honest per-phase wall time, SolveResult.stats
+    # ["phase_seconds"]). jax dispatches are async, so phase boundaries
+    # are only meaningful at device sync points; the contract here is
+    # ONE block_until_ready per boundary, at chunk boundaries only:
+    #   setup    -- _solve_impl entry -> all staged operands + initial
+    #               state retired on device (the sync below — without
+    #               it, staging time would silently ride into the
+    #               first chunk's train_seconds);
+    #   solve    -- sum of dispatch -> chunk-retired intervals (each
+    #               bounded by the loop's existing block_until_ready —
+    #               no new sync);
+    #   observe  -- host work between chunks: the packed scalar pull,
+    #               callbacks, checkpoint writes, verbose prints;
+    #   finalize -- loop exit -> result assembly (alpha/f pulls,
+    #               budget-exit extrema refresh).
+    jax.block_until_ready((x_dev, x_sq, k_diag, state))
+    phase_seconds = {"setup": time.perf_counter() - t_entry,
+                     "solve": 0.0, "observe": 0.0, "finalize": 0.0}
+
     # train_seconds accumulates DEVICE time only (dispatch -> all chunk
     # work retired, bounded by block_until_ready). Host-side observation —
     # the packed scalar pull, callbacks, checkpoint writes — happens
@@ -1254,71 +1290,86 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     train_seconds = 0.0
     dispatches = 0  # executor dispatches this host loop made (observability)
     while True:
-        t0 = time.perf_counter()
-        dispatches += 1
-        if use_pallas:
-            state = _run_chunk_pallas(
-                x_dev, y_dev, x_sq, valid_dev, state, max_iter,
-                kp, config.c_bounds(), eps_run, float(config.tau),
-                chunk_len, use_cache, block_rows, interpret)
-        elif use_block and m_act:
-            from dpsvm_tpu.solver.block import run_chunk_block_active
+        # Span brackets dispatch -> chunk retired; try/finally so a
+        # transient device fault mid-chunk (the fault-retry path)
+        # cannot leak an entered TraceAnnotation into the captured
+        # device trace. Null span when obs/tracing are off.
+        _sp = obs.span("solver/chunk")
+        _sp.__enter__()
+        try:
+            t0 = time.perf_counter()
+            dispatches += 1
+            if use_pallas:
+                state = _run_chunk_pallas(
+                    x_dev, y_dev, x_sq, valid_dev, state, max_iter,
+                    kp, config.c_bounds(), eps_run, float(config.tau),
+                    chunk_len, use_cache, block_rows, interpret)
+            elif use_block and m_act:
+                from dpsvm_tpu.solver.block import run_chunk_block_active
 
-            state = run_chunk_block_active(
-                x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
-                kp, config.c_bounds(), eps_run, float(config.tau),
-                q, inner, rounds_per_chunk,
-                m_act, int(config.reconcile_rounds),
-                inner_impl="pallas" if not interpret else "xla",
-                selection=config.selection,
-                pair_batch=int(config.pair_batch))
-        elif use_block and use_pipe:
-            from dpsvm_tpu.solver.block import run_chunk_block_pipelined
+                state = run_chunk_block_active(
+                    x_dev, y_dev, x_sq, k_diag, valid_dev, state,
+                    max_iter, kp, config.c_bounds(), eps_run,
+                    float(config.tau), q, inner, rounds_per_chunk,
+                    m_act, int(config.reconcile_rounds),
+                    inner_impl="pallas" if not interpret else "xla",
+                    selection=config.selection,
+                    pair_batch=int(config.pair_batch))
+            elif use_block and use_pipe:
+                from dpsvm_tpu.solver.block import (
+                    run_chunk_block_pipelined)
 
-            state = run_chunk_block_pipelined(
-                x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
-                kp, config.c_bounds(), eps_run, float(config.tau),
-                q, inner, rounds_per_chunk,
-                inner_impl="pallas" if not interpret else "xla",
-                interpret=interpret,
-                selection=config.selection,
-                pair_batch=int(config.pair_batch),
-                pallas_select=pipe_pallas_select)
-        elif use_block and use_fused:
-            from dpsvm_tpu.solver.block import run_chunk_block_fused
+                state = run_chunk_block_pipelined(
+                    x_dev, y_dev, x_sq, k_diag, valid_dev, state,
+                    max_iter, kp, config.c_bounds(), eps_run,
+                    float(config.tau), q, inner, rounds_per_chunk,
+                    inner_impl="pallas" if not interpret else "xla",
+                    interpret=interpret,
+                    selection=config.selection,
+                    pair_batch=int(config.pair_batch),
+                    pallas_select=pipe_pallas_select)
+            elif use_block and use_fused:
+                from dpsvm_tpu.solver.block import run_chunk_block_fused
 
-            state = run_chunk_block_fused(
-                x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
-                kp, config.c_bounds(), eps_run, float(config.tau),
-                q, inner, rounds_per_chunk,
-                inner_impl="pallas" if not interpret else "xla",
-                interpret=interpret,
-                selection=config.selection,
-                pair_batch=int(config.pair_batch))
-        elif use_block:
-            # Donated carry: the old state is dead the moment the chunk
-            # is dispatched (this loop only ever reads the NEW state),
-            # so its (n,) alpha/f buffers leave the live set instead of
-            # doubling it (tpulint pins declared_donated on this path).
-            state = run_chunk_block_donated(
-                x_dev, y_dev, x_sq, k_diag, valid_dev, state, max_iter,
-                kp, config.c_bounds(), eps_run, float(config.tau),
-                q, inner, rounds_per_chunk,
-                inner_impl="pallas" if not interpret else "xla",
-                selection=config.selection,
-                pair_batch=int(config.pair_batch))
-        elif use_micro:
-            state = _run_chunk_micro(x_dev, y_dev, x_sq, k_diag, valid_dev,
-                                     state, max_iter, kp, config.c_bounds(),
-                                     eps_run, float(config.tau), chunk_len,
-                                     int(config.pair_batch))
-        else:
-            state = _run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev, state,
-                               max_iter, kp, config.c_bounds(), eps_run,
-                               float(config.tau), chunk_len, use_cache,
-                               config.selection)
-        jax.block_until_ready(state)
-        train_seconds += time.perf_counter() - t0
+                state = run_chunk_block_fused(
+                    x_dev, y_dev, x_sq, k_diag, valid_dev, state,
+                    max_iter, kp, config.c_bounds(), eps_run,
+                    float(config.tau), q, inner, rounds_per_chunk,
+                    inner_impl="pallas" if not interpret else "xla",
+                    interpret=interpret,
+                    selection=config.selection,
+                    pair_batch=int(config.pair_batch))
+            elif use_block:
+                # Donated carry: the old state is dead the moment the
+                # chunk is dispatched (this loop only ever reads the
+                # NEW state), so its (n,) alpha/f buffers leave the
+                # live set instead of doubling it (tpulint pins
+                # declared_donated on this path).
+                state = run_chunk_block_donated(
+                    x_dev, y_dev, x_sq, k_diag, valid_dev, state,
+                    max_iter, kp, config.c_bounds(), eps_run,
+                    float(config.tau), q, inner, rounds_per_chunk,
+                    inner_impl="pallas" if not interpret else "xla",
+                    selection=config.selection,
+                    pair_batch=int(config.pair_batch))
+            elif use_micro:
+                state = _run_chunk_micro(
+                    x_dev, y_dev, x_sq, k_diag, valid_dev, state,
+                    max_iter, kp, config.c_bounds(), eps_run,
+                    float(config.tau), chunk_len,
+                    int(config.pair_batch))
+            else:
+                state = _run_chunk(
+                    x_dev, y_dev, x_sq, k_diag, valid_dev, state,
+                    max_iter, kp, config.c_bounds(), eps_run,
+                    float(config.tau), chunk_len, use_cache,
+                    config.selection)
+            jax.block_until_ready(state)
+        finally:
+            _sp.__exit__(None, None, None)
+        chunk_dt = time.perf_counter() - t0
+        train_seconds += chunk_dt
+        t_obs0 = time.perf_counter()
         # Block-engine note: the carried extrema are computed by each
         # round's selection BEFORE its fold, so the (b_hi, b_lo) observed
         # here — callback/verbose gap, checkpointed b's — lag the pair
@@ -1329,6 +1380,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         # budget exits exactly (refresh_extrema_host below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
+        obs.chunk(pairs=it, b_hi=b_hi, b_lo=b_lo,
+                  device_seconds=chunk_dt, dispatch=dispatches)
         converged = not (b_lo > b_hi + 2.0 * eps_run)
         abort = bool(callback is not None
                      and callback(it, b_hi, b_lo, state))
@@ -1345,6 +1398,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
             gap = b_lo - b_hi
             print(f"[smo] iter={it} b_lo-b_hi={gap:.6f} "
                   f"hits={int(state.hits)}")
+        phase_seconds["observe"] += time.perf_counter() - t_obs0
         if converged or it >= config.max_iter:
             break
         if abort:
@@ -1356,6 +1410,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
             # is uninterruptible to max_iter.
             break
 
+    t_fin0 = time.perf_counter()
     alpha = np.asarray(state.alpha)[:n]
     f_final = np.asarray(eff_f(state))[:n]
     if (use_block or config.budget_mode) and not converged:
@@ -1367,6 +1422,28 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
             config.epsilon, rule=config.selection)
     # Hit-rate denominator covers only THIS run's lookups (post-resume).
     total_lookups = 2 * (it - start_iter) if use_cache else 0
+    phase_seconds["solve"] = train_seconds
+    phase_seconds["finalize"] = time.perf_counter() - t_fin0
+    phase_seconds = {k: round(v, 6) for k, v in phase_seconds.items()}
+    stats = {
+        "cache_hits": int(state.hits),
+        "cache_lookups": total_lookups,
+        "cache_hit_rate": (int(state.hits) / total_lookups) if total_lookups else 0.0,
+        "f": f_final,
+        # Honest per-phase wall clock; sync discipline documented at
+        # the phase-clock block above (one block_until_ready per
+        # boundary, chunk boundaries only).
+        "phase_seconds": phase_seconds,
+        **({"outer_rounds": int(state.rounds)} if use_block else {}),
+    }
+    if obs.live:
+        stats["obs_run_id"] = obs.run_id
+        stats["obs_runlog"] = obs.path
+    obs.finish(iterations=it, converged=bool(converged),
+               train_seconds=round(train_seconds, 6),
+               dispatches=dispatches, b_hi=b_hi, b_lo=b_lo,
+               n_sv=int(np.count_nonzero(alpha > 0)),
+               phase_seconds=phase_seconds)
     return SolveResult(
         alpha=alpha,
         b=float((b_lo + b_hi) / 2.0),  # svmTrainMain.cpp:329
@@ -1376,11 +1453,5 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         converged=converged,
         train_seconds=train_seconds,
         dispatches=dispatches,
-        stats={
-            "cache_hits": int(state.hits),
-            "cache_lookups": total_lookups,
-            "cache_hit_rate": (int(state.hits) / total_lookups) if total_lookups else 0.0,
-            "f": f_final,
-            **({"outer_rounds": int(state.rounds)} if use_block else {}),
-        },
+        stats=stats,
     )

@@ -6,6 +6,15 @@ that is declared but never written (Makefile:12,68). This module provides
 the structured equivalent SURVEY.md section 5.5 calls for: periodic
 {iteration, b-gap, SV estimate, cache hit rate, iters/sec} records, an
 optional JSONL sink, and jax.profiler trace capture (section 5.1).
+
+NOTE (ISSUE 7): the repo-wide telemetry substrate now lives in
+``dpsvm_tpu/obs`` (schema-versioned run logs, bounded registry
+metrics, trace spans — enabled via ``config.obs`` / ``--obs`` /
+``DPSVM_OBS=1``). This module remains the ``--metrics-jsonl`` callback
+surface: a USER-CADENCE progress stream (it forces chunked
+observation), whereas the obs run log rides whatever cadence the solve
+already has and never changes behavior. ``profile_trace`` remains the
+CLI's plain ``--trace-dir`` wrapper for runs without ``--obs``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,502 @@
+"""Telemetry spine (dpsvm_tpu/obs — ISSUE 7): strict no-op mode,
+zero-HLO-effect, runlog schema round-trip, bounded histograms, serve
+integration, and the bench reconciliation contract.
+
+The load-bearing claims:
+* DISABLED obs is free and invisible: shared null objects, bitwise-
+  identical solver results, jaxpr-identical chunk executors.
+* ENABLED obs never changes solver behavior: same chunk cadence, same
+  dispatch count, same alpha — records ride existing observations.
+* Everything bounded: histograms hold O(bins + window) regardless of
+  observation count (the long-lived-server discipline).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dpsvm_tpu.config import ObsConfig, ServeConfig, SVMConfig
+from dpsvm_tpu.obs import metrics as obs_metrics
+from dpsvm_tpu.obs import run_obs, trace
+from dpsvm_tpu.obs.metrics import Histogram, Registry
+from dpsvm_tpu.obs.runlog import (SCHEMA_VERSION, RunLog, read_runlog,
+                                  records_for)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs(monkeypatch):
+    """Every test here controls obs state explicitly: scrub the env
+    opt-in and reset the default registry (restored afterwards)."""
+    monkeypatch.delenv("DPSVM_OBS", raising=False)
+    monkeypatch.delenv("DPSVM_OBS_DIR", raising=False)
+    monkeypatch.setattr(obs_metrics, "_DEFAULT", None)
+    yield
+
+
+# ------------------------------------------------------ no-op mode
+
+def test_disabled_span_is_shared_null():
+    assert trace.span("a") is trace.span("b")  # no allocation
+    with trace.span("solver/chunk"):
+        pass  # and usable
+
+
+def test_disabled_registry_hands_out_nulls():
+    reg = Registry(enabled=False)
+    c = reg.counter("x")
+    c.add(5)
+    h = reg.histogram("y")
+    h.observe(1.0)
+    assert c is reg.gauge("z") is h is obs_metrics.NULL
+    assert h.percentiles() == {} and len(h) == 0
+    assert reg.snapshot() == {}
+
+
+def test_run_obs_disabled_is_shared_null(tmp_path):
+    from dpsvm_tpu.obs import NULL_OBS
+
+    cfg = SVMConfig()
+    assert run_obs("solve", cfg) is NULL_OBS
+    # ... and the null handle's surface is complete and inert.
+    NULL_OBS.chunk(pairs=1, b_hi=0.0, b_lo=1.0, device_seconds=0.1,
+                   dispatch=1)
+    NULL_OBS.event("x")
+    NULL_OBS.finish()
+    assert not list(tmp_path.iterdir())
+
+
+def test_solver_chunk_jaxpr_identical_with_obs_enabled(monkeypatch):
+    """The zero-overhead-ops satellite: the compiled solver chunk is
+    the SAME PROGRAM with observability on and off — obs never reaches
+    trace time, so the jaxprs are string-identical."""
+    from dpsvm_tpu.solver.block import BlockState, _run_chunk_block
+    from dpsvm_tpu.ops.kernels import KernelParams
+
+    n, d = 256, 8
+    args = (jnp.zeros((n, d)), jnp.ones((n,)), jnp.zeros((n,)),
+            jnp.ones((n,)), None,
+            BlockState(alpha=jnp.zeros((n,)), f=jnp.ones((n,)),
+                       b_hi=jnp.float32(-1.0), b_lo=jnp.float32(1.0),
+                       pairs=jnp.int32(0), rounds=jnp.int32(0)),
+            jnp.int32(1000))
+    kw = dict(kp=KernelParams("rbf", 0.1), c=(1.0, 1.0), eps=1e-3,
+              tau=1e-12, q=16, inner_iters=32, rounds_per_chunk=2,
+              inner_impl="xla")
+
+    def jaxpr():
+        return str(jax.make_jaxpr(
+            lambda *a: _run_chunk_block(*a, **kw))(*args))
+
+    off = jaxpr()
+    monkeypatch.setenv("DPSVM_OBS", "1")
+    monkeypatch.setattr(obs_metrics, "_DEFAULT", None)
+    assert obs_metrics.get_registry().enabled
+    assert jaxpr() == off
+
+
+def test_solve_bitwise_identical_and_same_dispatches(blobs_small,
+                                                    tmp_path,
+                                                    monkeypatch):
+    """Enabling obs changes NO solver behavior: same alpha bits, same
+    iteration count, same dispatch count, same chunk cadence."""
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_small
+    cfg = SVMConfig(c=2.0, epsilon=1e-3)
+    r_off = solve(x, y, cfg)
+    r_on = solve(x, y, cfg.replace(obs=ObsConfig(
+        enabled=True, runlog_dir=str(tmp_path))))
+    assert np.array_equal(r_off.alpha, r_on.alpha)
+    assert r_off.iterations == r_on.iterations
+    assert r_off.dispatches == r_on.dispatches
+    assert "obs_run_id" in r_on.stats and "obs_run_id" not in r_off.stats
+
+
+# ------------------------------------------------------ runlog schema
+
+def test_runlog_schema_round_trip(tmp_path):
+    cfg = SVMConfig(c=3.0, engine="block")
+    path = str(tmp_path / "solve-test.jsonl")
+    log = RunLog(path, "solve", config=cfg, meta={"n": 100, "d": 4})
+    log.record("chunk", pairs=10, pairs_delta=10, b_hi=-1.0, b_lo=1.0,
+               gap=2.0, device_seconds=0.5, dispatch=1)
+    log.record("event", name="demotion", gap=0.1)
+    log.span_sink({"kind": "span", "name": "solver/chunk",
+                   "t": 1.0, "dur": 0.5})
+    log.finish(iterations=10, converged=True)
+    log.finish()  # idempotent
+
+    recs = read_runlog(path)
+    assert [r["kind"] for r in recs] == ["manifest", "chunk", "event",
+                                         "span", "final"]
+    assert all(r["schema"] == SCHEMA_VERSION for r in recs)
+    assert len({r["run"] for r in recs}) == 1
+    man = recs[0]
+    # Config snapshot survives the trip (dataclass -> JSON -> dict).
+    assert man["config"]["c"] == 3.0
+    assert man["config"]["engine"] == "block"
+    assert man["config"]["obs"] == {"enabled": False, "trace_dir": None,
+                                    "runlog_dir": None}
+    assert man["n"] == 100 and man["tool"] == "solve"
+    assert {"git_sha", "jax", "backend", "n_devices"} <= man.keys()
+    assert recs[-1]["iterations"] == 10
+
+
+def test_runlog_reader_skips_future_schema_and_garbage(tmp_path):
+    p = tmp_path / "x.jsonl"
+    good = {"schema": SCHEMA_VERSION, "run": "1-1", "kind": "chunk"}
+    future = {"schema": SCHEMA_VERSION + 1, "run": "1-1", "kind": "chunk"}
+    p.write_text(json.dumps(good) + "\n" + json.dumps(future) + "\n"
+                 + "not json at all\n"
+                 + json.dumps({"no": "keys"}) + "\n"
+                 + '{"schema": 1, "run": "t", "ki')  # truncated tail
+    recs = read_runlog(str(p))
+    assert recs == [good]
+
+
+def test_runlog_multiple_runs_share_a_file(tmp_path):
+    path = str(tmp_path / "solve-shared.jsonl")
+    l1 = RunLog(path, "solve")
+    l1.record("chunk", pairs=1, pairs_delta=1)
+    l1.finish()
+    l2 = RunLog(path, "solve")
+    l2.record("chunk", pairs=2, pairs_delta=2)
+    l2.finish()
+    recs = read_runlog(path)
+    assert l1.run_id != l2.run_id
+    assert [c["pairs"] for c in records_for(recs, l2.run_id, "chunk")] \
+        == [2]
+
+
+# ------------------------------------------------------ metrics bounds
+
+def test_histogram_bounded_and_exact_window():
+    h = Histogram("t", window=64)
+    for v in np.linspace(0.001, 1.0, 1000):
+        h.observe(float(v))
+    assert h.count == 1000
+    assert len(h) == 64  # ring bounded
+    assert h._ring.shape == (64,)  # no growth
+    p = h.percentiles()
+    assert p["p50"] <= p["p95"] <= p["p99"]
+    snap = h.snapshot()
+    assert snap["count"] == 1000 and snap["min"] > 0
+    json.dumps(snap)  # JSON-able
+    assert int(sum(h._bins)) == 1000  # lifetime bins count everything
+
+
+def test_histogram_percentiles_match_deque_semantics():
+    """The recent-window percentile is exact over the last `window`
+    samples — what the old serve deques provided."""
+    h = Histogram("t", window=100)
+    for v in range(1000):
+        h.observe(float(v))
+    assert h.percentiles((50,))["p50"] == pytest.approx(
+        float(np.percentile(np.arange(900, 1000, dtype=float), 50)))
+
+
+def test_counter_gauge_snapshot():
+    reg = Registry(enabled=True)
+    reg.counter("a").add(3)
+    reg.counter("a").add(2)
+    reg.gauge("b").set(7.5)
+    assert reg.snapshot() == {"a": 5, "b": 7.5}
+
+
+# ------------------------------------------------------ serve path
+
+def _tiny_multiclass():
+    from dpsvm_tpu.models.multiclass import train_multiclass
+
+    rng = np.random.default_rng(0)
+    x = rng.random((90, 6), np.float32)
+    y = np.arange(90) % 3
+    m, _ = train_multiclass(x, y, SVMConfig(c=1.0, epsilon=1e-2),
+                            strategy="ovr")
+    return m, x
+
+
+def test_serve_histograms_bounded_under_sustained_enqueue(tmp_path):
+    from dpsvm_tpu.serve import PredictServer
+
+    m, x = _tiny_multiclass()
+    srv = PredictServer(m, ServeConfig(
+        buckets=(16,), obs=ObsConfig(enabled=True,
+                                     runlog_dir=str(tmp_path))))
+    for _ in range(60):
+        srv.enqueue(x[:4])
+        srv.flush()
+    h = srv.stats["bucket_seconds"][16]
+    assert isinstance(h, Histogram)
+    assert h.count == 60
+    assert len(h) <= h.window and h._ring.shape == (h.window,)
+    p = h.percentiles()
+    assert p["p50"] <= p["p99"]
+    srv.close()
+    srv.close()  # idempotent
+    recs = read_runlog(str(tmp_path / f"serve-{os.getpid()}.jsonl"))
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "manifest" and kinds[-1] == "final"
+    final = recs[-1]
+    assert final["bucket_seconds"]["16"]["count"] == 60
+    assert final["dispatches"] == 60
+
+
+def test_runobs_metrics_recorded_without_env_optin(tmp_path):
+    """Obs enabled via config/--obs alone (DPSVM_OBS unset) must still
+    record metrics: the final record's dump is the run's PRIVATE
+    registry, not the env-gated ambient one."""
+    from dpsvm_tpu.solver.smo import solve
+    from dpsvm_tpu.data.synth import make_blobs_binary
+
+    x, y = make_blobs_binary(n=200, d=8, seed=1, sep=1.2)
+    r = solve(x, y, SVMConfig(c=2.0, epsilon=1e-3, obs=ObsConfig(
+        enabled=True, runlog_dir=str(tmp_path))))
+    final = records_for(read_runlog(r.stats["obs_runlog"]),
+                        r.stats["obs_run_id"], "final")[0]
+    assert final["metrics"]["solve.pairs_total"] == r.iterations
+    assert final["metrics"]["solve.dispatches_total"] == r.dispatches
+    assert final["metrics"]["solve.chunk_seconds"]["count"] >= 1
+
+
+def test_second_sweep_not_contaminated_by_first():
+    """offered_load_sweep on a long-lived server reports ONLY its own
+    sweep's observations (the histograms are lifetime instruments; the
+    report is baseline-differenced + last-N-scoped)."""
+    from dpsvm_tpu.serve import PredictServer, offered_load_sweep
+
+    m, _ = _tiny_multiclass()
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64)))
+    offered_load_sweep(srv, [1, 4, 8], 24, group=4)
+    rec2 = offered_load_sweep(srv, [1, 4, 8], 24, group=4)
+    assert rec2["requests"] == 24
+    total_disp = sum(r["dispatches"]
+                     for r in rec2["bucket_latency"].values())
+    # Dispatch counts are this sweep's delta, not server lifetime.
+    assert total_disp < srv.stats["dispatches"]
+    # Request percentiles cover exactly this sweep's 24 samples.
+    assert rec2["request_latency"] == \
+        srv.request_seconds.percentiles(last=24)
+
+
+def test_offered_load_sweep_reports_from_shared_histograms():
+    from dpsvm_tpu.serve import PredictServer, offered_load_sweep
+
+    m, _ = _tiny_multiclass()
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64)))
+    rec = offered_load_sweep(srv, [1, 4, 8], 24, group=4)
+    lat = rec["request_latency"]
+    assert {"p50", "p95", "p99"} <= lat.keys()
+    assert lat["p50"] <= lat["p95"] <= lat["p99"]
+    # Reported percentiles ARE the server histogram's, not a private
+    # aggregation.
+    assert lat == srv.request_seconds.percentiles()
+    for b, row in rec["bucket_latency"].items():
+        assert row["dispatches"] == \
+            srv.stats["bucket_seconds"][int(b)].count
+    json.dumps(rec)
+
+
+# ------------------------------------------------- solver runlog facts
+
+def test_solve_runlog_reconciles_with_result(blobs_small, tmp_path):
+    """The bench acceptance contract at unit scale: per-chunk records
+    sum EXACTLY (mod rounding) to the result's iterations and
+    train_seconds — on a multi-chunk observed run."""
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_small
+    cfg = SVMConfig(c=2.0, epsilon=1e-3, chunk_iters=64,
+                    obs=ObsConfig(enabled=True,
+                                  runlog_dir=str(tmp_path)))
+    r = solve(x, y, cfg, callback=lambda *a: None)  # observed cadence
+    recs = read_runlog(r.stats["obs_runlog"])
+    chunks = records_for(recs, r.stats["obs_run_id"], "chunk")
+    assert len(chunks) == r.dispatches > 1
+    assert sum(c["pairs_delta"] for c in chunks) == r.iterations
+    assert sum(c["device_seconds"] for c in chunks) == pytest.approx(
+        r.train_seconds, abs=1e-4)
+    final = records_for(recs, r.stats["obs_run_id"], "final")[0]
+    assert final["iterations"] == r.iterations
+    assert final["converged"] == r.converged
+    assert "metrics" in final
+    # Gap trajectory is monotone-ish and ends converged.
+    assert chunks[-1]["gap"] <= chunks[0]["gap"]
+
+
+def test_phase_seconds_honest_shape(blobs_small):
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_small
+    r = solve(x, y, SVMConfig(c=2.0, epsilon=1e-3))
+    ph = r.stats["phase_seconds"]
+    assert set(ph) == {"setup", "solve", "observe", "finalize"}
+    assert all(v >= 0 for v in ph.values())
+    assert ph["solve"] == pytest.approx(r.train_seconds, abs=1e-5)
+
+
+def test_mesh_solve_runlog(blobs_medium, tmp_path):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_medium
+    cfg = SVMConfig(c=2.0, epsilon=1e-3, engine="block",
+                    working_set_size=16,
+                    obs=ObsConfig(enabled=True,
+                                  runlog_dir=str(tmp_path)))
+    r = solve_mesh(x, y, cfg, num_devices=4)
+    assert r.converged
+    ph = r.stats["phase_seconds"]
+    assert ph["solve"] == pytest.approx(r.train_seconds, abs=1e-5)
+    recs = read_runlog(r.stats["obs_runlog"])
+    man = records_for(recs, r.stats["obs_run_id"], "manifest")[0]
+    assert man["n_devices"] == 4 and man["tool"] == "solve_mesh"
+    chunks = records_for(recs, r.stats["obs_run_id"], "chunk")
+    assert sum(c["pairs_delta"] for c in chunks) == r.iterations
+
+
+# ------------------------------------------------------ trace session
+
+def test_trace_session_collects_host_timeline(tmp_path):
+    with trace.TraceSession() as sess:
+        with trace.span("unit/stage"):
+            pass
+        with trace.span("unit/other"):
+            with trace.span("unit/nested"):
+                pass
+    assert [e["name"] for e in sess.events] == \
+        ["unit/stage", "unit/nested", "unit/other"]
+    assert all(e["kind"] == "span" and e["dur"] >= 0
+               for e in sess.events)
+    # Session closed: spans are null again.
+    assert trace.span("x") is trace.span("y")
+
+
+def test_trace_sessions_attribute_to_innermost():
+    """Concurrent/nested sessions each collect their OWN spans (the
+    bench_serve two-servers case: run 2's events must not land in run
+    1's log under run 1's id)."""
+    with trace.TraceSession() as outer:
+        with trace.TraceSession() as inner:
+            with trace.span("inner/work"):
+                pass
+        with trace.span("outer/work"):
+            pass
+    assert [e["name"] for e in inner.events] == ["inner/work"]
+    assert [e["name"] for e in outer.events] == ["outer/work"]
+    assert trace.active_session() is None
+
+
+def test_trace_sessions_interleaved_close():
+    """Out-of-order close (server1 closed after server2 opened) must
+    not break attribution or leak stack entries."""
+    s1 = trace.TraceSession().__enter__()
+    s2 = trace.TraceSession().__enter__()
+    with trace.span("two"):
+        pass
+    s1.__exit__(None, None, None)
+    with trace.span("still-two"):
+        pass
+    s2.__exit__(None, None, None)
+    assert [e["name"] for e in s2.events] == ["two", "still-two"]
+    assert s1.events == [] and trace.active_session() is None
+
+
+def test_runobs_abort_path_clears_session_and_closes_log(tmp_path,
+                                                         monkeypatch):
+    """A solve that faults mid-loop never calls finish(); dropping the
+    handle (what the fault-retry handler's frame release does) must
+    close the run log AND exit the global trace session so later runs
+    don't feed a dead one."""
+    from dpsvm_tpu.obs import RunObs
+
+    monkeypatch.setenv("DPSVM_OBS", "1")
+    monkeypatch.setattr(obs_metrics, "_DEFAULT", None)
+    o = RunObs("solve", meta={"n": 1}, directory=str(tmp_path))
+    path = o.path
+    assert trace.active_session() is not None
+    o.chunk(pairs=5, b_hi=0.0, b_lo=1.0, device_seconds=0.1, dispatch=1)
+    del o
+    assert trace.active_session() is None
+    recs = read_runlog(path)
+    assert recs[-1]["kind"] == "final" and recs[-1]["aborted"] is True
+    # ... and the normal path is unaffected + finish stays idempotent.
+    o2 = RunObs("solve", directory=str(tmp_path))
+    o2.finish(iterations=1)
+    o2.finish(iterations=2)
+    del o2
+    finals = [r for r in read_runlog(path) if r["kind"] == "final"]
+    assert finals[-1]["iterations"] == 1
+    assert "aborted" not in finals[-1]
+
+
+def test_trace_session_events_bounded(monkeypatch):
+    monkeypatch.setattr(trace, "_MAX_EVENTS", 8)
+    with trace.TraceSession() as sess:
+        for i in range(20):
+            with trace.span(f"s{i}"):
+                pass
+    assert len(sess.events) == 8 and sess.dropped == 12
+
+
+# ------------------------------------------------------ CLI surface
+
+def test_cli_train_obs_writes_runlog(tmp_path):
+    from dpsvm_tpu import cli
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(80, 5)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, 1, -1)
+    csv = tmp_path / "train.csv"
+    csv.write_text("\n".join(
+        ",".join([str(int(yi))] + [f"{v:.5f}" for v in row])
+        for yi, row in zip(y, x)) + "\n")
+    model = tmp_path / "m.txt"
+    rc = cli.main(["train", "-f", str(csv), "-m", str(model), "-q",
+                   "--obs", "--obs-dir", str(tmp_path / "runs")])
+    assert rc == 0
+    # backend auto routes to mesh on the 8-virtual-device harness; a
+    # single-device box would write solve-*.jsonl — accept either.
+    files = list((tmp_path / "runs").glob("solve*.jsonl"))
+    assert len(files) == 1
+    recs = read_runlog(str(files[0]))
+    kinds = {r["kind"] for r in recs}
+    assert {"manifest", "chunk", "final"} <= kinds
+
+
+def test_bench_gate_skips_future_schema_artifacts(tmp_path):
+    import bench
+
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"pairs_per_second": 1000,
+         "session_calibration": {"best_of_5_seconds": 0.5}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"pairs_per_second": 777, "schema_version": SCHEMA_VERSION + 1,
+         "session_calibration": {"best_of_5_seconds": 0.5}}))
+    path, doc = bench._latest_bench_artifact(str(tmp_path))
+    assert path.endswith("BENCH_r01.json")
+    assert doc["pairs_per_second"] == 1000
+
+
+def test_bench_runlog_reconciliation(blobs_small, tmp_path):
+    """bench._runlog_reconciliation against a real obs solve: the
+    1%-acceptance field computes and passes."""
+    import bench
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_small
+    r = solve(x, y, SVMConfig(
+        c=2.0, epsilon=1e-3, budget_mode=True, max_iter=400,
+        obs=ObsConfig(enabled=True, runlog_dir=str(tmp_path))))
+    pps = r.iterations / max(r.train_seconds, 1e-9)
+    rec = bench._runlog_reconciliation(r, pps)
+    assert rec["runlog_reconciles"] is True
+    assert abs(rec["runlog_delta"]) <= 0.01
+    assert rec["runlog"] == r.stats["obs_runlog"]
+    # ... and the field set is empty without obs (no crash, no noise).
+    r2 = solve(x, y, SVMConfig(c=2.0, epsilon=1e-3))
+    assert bench._runlog_reconciliation(r2, 1.0) == {}

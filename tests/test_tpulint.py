@@ -246,15 +246,29 @@ def test_entry_facts_counts_dispatches():
 
 # ------------------------------------- the committed budgets (tier-1)
 
-def test_manifest_budgets_pass_against_committed():
+def test_manifest_budgets_pass_against_committed(monkeypatch):
     """The in-suite `tpulint --check`: every manifest entrypoint's
     re-extracted facts must match the committed budget files exactly.
     A structural regression in ANY budgeted entrypoint — a stray
     collective, a dtype leak, a lost donation, an extra dispatch —
-    fails here with the entry and fact path in the message."""
+    fails here with the entry and fact path in the message.
+
+    Runs with the telemetry spine ENABLED (DPSVM_OBS=1 + a live
+    registry — ISSUE 7): observability must change NO compiled HLO
+    fact on any manifest entrypoint, so checking the budgets under obs
+    pins the zero-device-effect contract AND the structural contracts
+    in one pass (obs off is a strict subset: the instrumented code
+    paths simply don't run)."""
     from dpsvm_tpu.analysis import budget
     from dpsvm_tpu.analysis.extract import extract_entries
     from dpsvm_tpu.analysis.manifest import MANIFEST, require_devices
+    from dpsvm_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setenv("DPSVM_OBS", "1")
+    # Re-resolve the default registry from the patched env; monkeypatch
+    # restores the previous registry object after the test.
+    monkeypatch.setattr(obs_metrics, "_DEFAULT", None)
+    assert obs_metrics.get_registry().enabled
 
     gen = budget.budget_jax_version()
     if gen is not None and gen != jax.__version__:

@@ -169,13 +169,23 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=256,
                     help="offered-load sweep request count")
     ap.add_argument("--query-block", type=int, default=1024)
+    ap.add_argument("--obs", action="store_true",
+                    help="enable the telemetry spine: serve run logs "
+                         "(manifest + final histogram snapshots) for "
+                         "the sweep servers; DPSVM_OBS=1 equivalent")
+    ap.add_argument("--obs-dir", default=None,
+                    help="run-log directory (default obs_runs; env "
+                         "DPSVM_OBS_DIR)")
     args = ap.parse_args(argv)
 
     import jax
 
     import bench
-    from dpsvm_tpu.config import ServeConfig
+    from dpsvm_tpu.config import ObsConfig, ServeConfig
     from dpsvm_tpu.serve import PredictServer, offered_load_sweep
+
+    serve_cfg = ServeConfig(obs=ObsConfig(enabled=args.obs,
+                                          runlog_dir=args.obs_dir))
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -202,12 +212,16 @@ def main(argv=None) -> int:
 
     # --- offered-load sweep through the serving engine -------------
     sizes = [1, 2, 4, 8, 16, 32, 64, 128]
-    server = PredictServer(mnist_ovo, ServeConfig())
+    server = PredictServer(mnist_ovo, serve_cfg)
     sweep_mnist = offered_load_sweep(server, sizes, args.requests,
                                      group=8, seed=0)
-    server_cov = PredictServer(covtype_ovr, ServeConfig())
+    server_cov = PredictServer(covtype_ovr, serve_cfg)
     sweep_cov = offered_load_sweep(server_cov, sizes, args.requests,
                                    group=8, seed=0)
+    # Percentiles above come from the servers' SHARED obs histograms
+    # (serve.request_seconds / bucket_seconds) — one definition across
+    # this tool, `cli serve --server-bench` and the serve run log.
+    server_cov.close()
     print(f"[bench_serve] sweep mnist-ovo: "
           f"{sweep_mnist['rows_per_second']} rows/s "
           f"p50={sweep_mnist['request_latency']['p50']}s",
@@ -233,8 +247,14 @@ def main(argv=None) -> int:
                            "CPU-harness wall clocks are for structure/"
                            "FLOP adjudication only (FLOP counts and "
                            "bit-parity are platform-independent)"),
+        # One artifact schema across BENCH/MULTICHIP/SERVE/SMOKE
+        # (dpsvm_tpu/obs/runlog.SCHEMA_VERSION via bench).
+        "schema_version": bench._schema_version(),
         "session_calibration": calibration,
     }
+    if server._obs.live:
+        result["runlog"] = server._obs.path
+    server.close()
     gate = bench._regression_gate(result, REPO,
                                   pattern="BENCH_SERVE_r*.json",
                                   key="examples_per_second")

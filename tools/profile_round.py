@@ -412,7 +412,38 @@ def main() -> int:
                     help="X storage dtype for the probes (bench_covtype "
                          "pins float32 for quality; the fold reads X so "
                          "its cost depends on this)")
+    ap.add_argument("--obs", action="store_true",
+                    help="write the ablation rows to a profile_round "
+                         "run log (dpsvm_tpu/obs/runlog — the same "
+                         "schema-versioned JSONL the solver and bench "
+                         "emit; DPSVM_OBS=1 equivalent)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="run-log directory (default obs_runs; env "
+                         "DPSVM_OBS_DIR)")
     args = ap.parse_args()
+
+    def obs_log_rows(label, rows, fixed_ms, marg_us):
+        """Mirror an ablation table into the shared run-log substrate
+        (one 'ablation' record per inner budget) when obs is enabled —
+        the ROADMAP-5 autotuner's future input format."""
+        from dpsvm_tpu.config import ObsConfig
+        from dpsvm_tpu.obs import obs_enabled
+        from dpsvm_tpu.obs.runlog import RunLog
+
+        ocfg = ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir)
+        if not obs_enabled(ocfg):
+            return
+        with RunLog.open("profile_round", obs_config=ocfg,
+                         meta={"probe": label, "dataset": args.dataset,
+                               "q": args.q, "dtype": args.dtype}) as rl:
+            for inner, rounds, pairs, ms_round, us_pair, t in rows:
+                rl.record("ablation", inner=int(inner),
+                          rounds=int(rounds), pairs=int(pairs),
+                          ms_per_round=round(ms_round, 4),
+                          us_per_pair=round(us_pair, 3),
+                          device_seconds=round(t, 6))
+            rl.finish(fixed_ms=round(fixed_ms, 4),
+                      marginal_us_per_pair=round(marg_us, 3))
 
     import jax
     import jax.numpy as jnp
@@ -491,6 +522,9 @@ def main() -> int:
                   "select+gather+gram+fold+scatter")
         print(f"  => fixed round cost {fixed_ms:.3f} ms ({stages}), "
               f"marginal {marg_us:.2f} us/pair")
+        obs_log_rows("pipelined" if args.pipeline
+                     else "fused" if args.fused else "plain",
+                     rows_a, fixed_ms, marg_us)
         return 0
 
     # --- select
@@ -603,6 +637,8 @@ def main() -> int:
               else "select+gather+gram+fold+scatter")
     print(f"  => fixed round cost {fixed_ms:.3f} ms ({stages}), marginal "
           f"{marg_us:.2f} us/pair (serial subproblem chain)")
+    obs_log_rows("fused" if args.fused else "plain", rows, fixed_ms,
+                 marg_us)
     return 0
 
 

@@ -39,7 +39,15 @@ sys.path.insert(0, REPO)
 
 def write_artifact(device: str, checks: list, failures: int) -> str:
     """TPU_SMOKE_r<NN>.json with NN = 1 + the highest existing round
-    (the MULTICHIP_r*.json / BENCH_r*.json numbering convention)."""
+    (the MULTICHIP_r*.json / BENCH_r*.json numbering convention).
+    Carries the shared telemetry schema_version (dpsvm_tpu/obs/runlog)
+    like every other benchmark artifact, and — when the telemetry
+    spine is enabled (DPSVM_OBS=1) — mirrors the checks into a
+    tpu_smoke run log so device sessions leave the same JSONL trail
+    the solver and serving runs do."""
+    from dpsvm_tpu.obs import obs_enabled
+    from dpsvm_tpu.obs.runlog import SCHEMA_VERSION, RunLog
+
     rounds = []
     for p in glob.glob(os.path.join(REPO, "TPU_SMOKE_r*.json")):
         m = re.search(r"_r(\d+)\.json$", p)
@@ -52,8 +60,18 @@ def write_artifact(device: str, checks: list, failures: int) -> str:
             "device": device,
             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "result": "PASS" if failures == 0 else f"{failures} FAILURES",
+            "schema_version": SCHEMA_VERSION,
             "checks": checks,
         }, fh, indent=1)
+    if obs_enabled():
+        with RunLog.open("tpu_smoke",
+                         meta={"device": device,
+                               "artifact": os.path.basename(path)}) as rl:
+            for c in checks:
+                rl.record("event", **c)
+            rl.finish(result="PASS" if failures == 0
+                      else f"{failures} FAILURES",
+                      checks=len(checks))
     return path
 
 
