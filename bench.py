@@ -164,6 +164,47 @@ def _session_calibration() -> dict:
 # real regression/improvement; inside it is PASS (noise).
 _REGRESSION_BAND = 0.10
 
+# Per-phase gate noise floor: a phase must have carried at least this
+# fraction of the previous run's total before its delta can FLAG —
+# the observe/finalize phases are milliseconds-scale on fast runs and
+# a 2 ms -> 5 ms move is jitter, not a regression.
+_PHASE_MIN_SHARE = 0.01
+
+
+def _phase_gate(current: dict, prev: dict, drift: float) -> dict:
+    """Per-phase regression check (ISSUE 8): compare the two artifacts'
+    ``phase_seconds`` (SolveResult.stats, embedded since PR 8) with the
+    same drift normalization as the headline — seconds MULTIPLY by the
+    session ratio (a faster session re-expresses as more prev-session
+    seconds) where throughput divides. A phase FLAGs when it got slower
+    beyond the band AND carried a non-noise share of the previous total
+    (_PHASE_MIN_SHARE). Empty dict when either artifact predates the
+    phase clock."""
+    prev_ph = prev.get("phase_seconds")
+    cur_ph = current.get("phase_seconds")
+    if not prev_ph or not cur_ph:
+        return {}
+    prev_total = sum(prev_ph.values())
+    deltas, flags = {}, []
+    for phase in sorted(set(prev_ph) | set(cur_ph)):
+        p, c = prev_ph.get(phase, 0.0), cur_ph.get(phase, 0.0)
+        if p <= 0:
+            # A phase appearing from nothing can't normalize to a
+            # ratio; report the raw seconds so it is visible.
+            deltas[phase] = round(c * drift, 6) if c else 0.0
+            continue
+        delta = (c * drift) / p - 1.0
+        deltas[phase] = round(delta, 4)
+        if (delta > _REGRESSION_BAND
+                and prev_total > 0
+                and p / prev_total >= _PHASE_MIN_SHARE):
+            flags.append(phase)
+    return {
+        "phase_deltas": deltas,
+        "phase_flags": flags,
+        "phase_gate": "FLAG" if flags else "PASS",
+    }
+
 
 def _latest_bench_artifact(root: str, pattern: str = "BENCH_r*.json",
                            key: str = None):
@@ -261,6 +302,10 @@ def _regression_gate(current: dict, root: str,
         "regression_gate": ("PASS" if abs(delta) <= _REGRESSION_BAND
                             else "FLAG"),
     })
+    # Per-phase attribution (ISSUE 8): the headline can PASS while one
+    # phase regressed and another improved — the phase gate names the
+    # phase that moved, same band, same normalization.
+    out.update(_phase_gate(current, prev, drift))
     return out
 
 
@@ -327,6 +372,11 @@ def mesh_main(args=None) -> int:
         "device": str(jax.devices()[0]),
         "pair_updates": int(best.iterations),
         "mesh_pairs_per_second": round(pps),
+        # Per-phase wall clock of the best run (SolveResult.stats):
+        # feeds the per-phase regression gate so a mesh regression is
+        # attributed to setup/solve/observe/finalize, not just seen in
+        # the headline.
+        "phase_seconds": best.stats.get("phase_seconds"),
         "schema_version": _schema_version(),
         "session_calibration": calibration,
     }
@@ -338,9 +388,14 @@ def mesh_main(args=None) -> int:
     result.update(gate)
     rl_note = (f"; runlog: {result['runlog']}"
                if result.get("runlog") else "")
+    ph_note = (f"; phase gate: {gate['phase_gate']}"
+               + (f" ({', '.join(gate['phase_flags'])})"
+                  if gate.get("phase_flags") else "")
+               if gate.get("phase_gate") else "")
     print(f"[bench --mesh] {n_dev} devices: {best.iterations} pairs in "
           f"{best.train_seconds:.3f}s ({pps:.0f}/s); gate: "
-          f"{gate.get('regression_gate')}{rl_note}", file=sys.stderr)
+          f"{gate.get('regression_gate')}{ph_note}{rl_note}",
+          file=sys.stderr)
     print(json.dumps(result))
     return 0
 
@@ -514,6 +569,11 @@ def main(args=None) -> int:
         "dataset_hard": ("synthetic make_mnist_like(n=60000, d=784, "
                          "seed=7, noise=0.1, label_flip=0.10) — "
                          "non-separable soft-margin regime"),
+        # Per-phase wall clock of the PRIMARY run (ISSUE 8): the
+        # regression gate compares these phase-by-phase, so a headline
+        # PASS cannot hide a solve-phase regression paid for by a
+        # faster setup (and vice versa).
+        "phase_seconds": bres.stats.get("phase_seconds"),
         # Telemetry schema of this artifact (ISSUE 7): lets future
         # builds' _latest_bench_artifact skip incompatible records
         # explicitly instead of mis-reading them.
@@ -539,13 +599,20 @@ def main(args=None) -> int:
                f"(reconciles={result['runlog_reconciles']})"
                if result.get("runlog") else "")
     if gate.get("regression_gate") in ("PASS", "FLAG"):
+        ph_note = ""
+        if gate.get("phase_gate"):
+            ph_note = (f"; phase gate: {gate['phase_gate']}"
+                       + (f" — {', '.join(gate['phase_flags'])} beyond "
+                          f"band ({gate['phase_deltas']})"
+                          if gate.get("phase_flags") else ""))
         print(f"[bench] regression gate: {gate['regression_gate']} — "
               f"drift-normalized {gate['normalized_pairs_per_second']} "
               f"pairs/s vs {gate['previous_pairs_per_second']} in "
               f"{gate['previous_artifact']} "
               f"(delta {100 * gate['normalized_delta']:+.1f}%, band "
               f"±{100 * _REGRESSION_BAND:.0f}%, session drift ratio "
-              f"{gate['session_drift_ratio']}){rl_note}", file=sys.stderr)
+              f"{gate['session_drift_ratio']}){ph_note}{rl_note}",
+              file=sys.stderr)
     else:
         print(f"[bench] regression gate: "
               f"{gate.get('regression_gate')} "

@@ -60,7 +60,8 @@ def unit_facts(unit: Unit) -> dict:
         facts["trace_error"] = kind
         return facts
     try:
-        text = lowered.compile().as_text()
+        compiled = lowered.compile()
+        text = compiled.as_text()
     except Exception as e:
         facts["compile_error"] = type(e).__name__
         return facts
@@ -71,6 +72,10 @@ def unit_facts(unit: Unit) -> dict:
     facts["dtypes"] = hlo_facts.dtype_facts(text)
     facts["donation"] = hlo_facts.donation_facts(
         text, declared_donated=_declared_donated(lowered))
+    # HBM-footprint accounting (ISSUE 8): argument/output/temp/alias
+    # bytes from XLA's memory_analysis — the static contract pinning
+    # the same numbers obs/compilelog makes visible at runtime.
+    facts["memory"] = hlo_facts.memory_facts(compiled)
     if unit.make_jaxpr is not None:
         jx = unit.make_jaxpr()
         facts["hazards"].update(hlo_facts.jaxpr_facts(jx))

@@ -208,6 +208,38 @@ def donation_facts(hlo_text: str, declared_donated: int = None) -> dict:
     return facts
 
 
+def memory_facts(compiled) -> dict:
+    """Static memory accounting of one compiled executable, from XLA's
+    own ``memory_analysis()`` (the one exception to this module's
+    text-only rule: the numbers live on the compiled object, but they
+    are exact, device-free properties of the program — deterministic
+    for a fixed jax/XLA version, which is what lets budgets pin them).
+
+    argument/output/temp/alias bytes are the components of the
+    executable's HBM live-set: `argument` + `output` - `alias` + `temp`
+    bounds what one dispatch holds beyond the operands themselves, so
+    a budget drift here is a FOOTPRINT regression (a lost donation
+    shows as alias_bytes collapsing; a new materialized intermediate
+    as temp_bytes growing) — the runtime cost tpulint pins statically
+    while obs/compilelog counts its compile-time sibling. Generated-
+    code size is deliberately excluded (it varies with codegen details
+    budgets should not couple to). ``{"unavailable": True}`` on
+    backends/jax builds without the API — a recorded fact, so budgets
+    regenerated there still diff cleanly."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return {"unavailable": True}
+        return {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+        }
+    except Exception:
+        return {"unavailable": True}
+
+
 def _walk_jaxpr(jaxpr, seen, visit):
     if id(jaxpr) in seen:
         return

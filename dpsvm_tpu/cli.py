@@ -257,6 +257,20 @@ def _build_serve_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--num-devices", type=int, default=1,
                    help="shard the SV union over this many devices "
                         "(psum-combined partial columns; default 1)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve an OpenMetrics/Prometheus text endpoint "
+                        "(GET /metrics) on this port: counters, "
+                        "latency summaries, SLO attainment, compile "
+                        "count (0 = ephemeral port, printed at "
+                        "startup; default: no endpoint)")
+    p.add_argument("--metrics-host", default="127.0.0.1",
+                   help="bind address for --metrics-port (default "
+                        "loopback — the endpoint is plaintext and "
+                        "unauthenticated; 0.0.0.0 exposes it to "
+                        "remote Prometheus scrapes)")
+    p.add_argument("--slo-ms", type=float, default=50.0,
+                   help="request-latency objective for the exported "
+                        "serve_slo_attainment gauge (default 50 ms)")
     p.add_argument("--server-bench", action="store_true",
                    help="run the offered-load micro-benchmark (through-"
                         "put + p50/p95/p99 latency per bucket) instead "
@@ -293,6 +307,18 @@ def _build_lint_parser(sub) -> argparse.ArgumentParser:
              "`python -m tools.tpulint --help`)")
 
 
+def _build_obs_parser(sub) -> argparse.ArgumentParser:
+    # Same forwarding pattern as `lint`: main() hands `obs ...` argv
+    # verbatim to dpsvm_tpu/obs/analyze.run_cli — one flag surface.
+    return sub.add_parser(
+        "obs", add_help=False,
+        help="runlog analytics (dpsvm_tpu/obs/analyze): `obs report "
+             "<paths>` aggregates run summaries (--md for CI job "
+             "summaries), `obs diff A B` attributes a regression to "
+             "the phase that moved, `obs tail <path>` shows the last "
+             "records of a stream; no jax or device needed")
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv[:1] == ["lint"]:
@@ -302,6 +328,13 @@ def main(argv=None) -> int:
         from dpsvm_tpu.analysis.budget import run_lint
 
         return run_lint(argv[1:])
+    if argv[:1] == ["obs"]:
+        # Same forwarding discipline for the runlog-analytics surface
+        # (dpsvm_tpu/obs/analyze.run_cli owns the flags). Pure JSONL
+        # reader — no jax import, so it works without a backend.
+        from dpsvm_tpu.obs.analyze import run_cli
+
+        return run_cli(argv[1:])
     parser = argparse.ArgumentParser(
         prog="dpsvm-tpu", description="TPU-native distributed SVM trainer")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -309,6 +342,7 @@ def main(argv=None) -> int:
     _build_test_parser(sub)
     _build_serve_parser(sub)
     _build_lint_parser(sub)
+    _build_obs_parser(sub)
     p = sub.add_parser("smoke", help="device/mesh environment smoke test")
     p.add_argument("--num-devices", type=int, default=None)
     args = parser.parse_args(argv)
@@ -1001,6 +1035,9 @@ def _cmd_serve(args) -> int:
         config = ServeConfig(buckets=buckets, dtype=args.dtype,
                              precision=args.precision,
                              num_devices=args.num_devices,
+                             metrics_port=args.metrics_port,
+                             metrics_host=args.metrics_host,
+                             slo_ms=args.slo_ms,
                              obs=ObsConfig(enabled=args.obs,
                                            runlog_dir=args.obs_dir))
         t0 = time.perf_counter()
@@ -1008,6 +1045,9 @@ def _cmd_serve(args) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if server.exporter is not None and not args.quiet:
+        print(f"metrics: {server.exporter.url} (OpenMetrics; scrape "
+              f"with curl or Prometheus)", file=sys.stderr)
     if not args.quiet:
         ens = server.ens
         # server.buckets, not config.buckets: the server trims buckets

@@ -585,6 +585,21 @@ class ServeConfig:
       construction so the first live request never pays a compile.
     max_pending: queued query rows before enqueue() forces a flush —
       bounds host memory under offered overload.
+    metrics_port: when not None, serve an OpenMetrics/Prometheus text
+      endpoint (GET /metrics, stdlib http.server thread — no new deps;
+      dpsvm_tpu/obs/export.py) with the engine's counters, latency
+      summaries, SLO-attainment gauges and compile count. 0 binds an
+      ephemeral port (read it from ``server.exporter.port``); None
+      (default) runs no endpoint. Scrapes only READ host-held
+      instruments — they can never add a device dispatch.
+    metrics_host: bind address for the endpoint. Default 127.0.0.1 —
+      loopback-only, the safe default for a plaintext unauthenticated
+      endpoint; set "0.0.0.0" (or a specific interface) to let a
+      remote Prometheus scrape it.
+    slo_ms: per-request latency objective in milliseconds for the
+      ``serve_slo_attainment`` gauge: the fraction of the recent
+      request-latency window at or under this bound (1.0 when the
+      window is empty — vacuously attained).
     """
 
     buckets: tuple = (16, 64, 256, 1024, 4096)
@@ -593,6 +608,9 @@ class ServeConfig:
     num_devices: int = 1
     warm_start: bool = True
     max_pending: int = 65536
+    metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
+    slo_ms: float = 50.0
     # Observability (dpsvm_tpu/obs): serve run logs + trace spans.
     # Bucket latency HISTOGRAMS are always on (they replaced the old
     # bounded timing deques at identical cost); this only gates the
@@ -622,6 +640,17 @@ class ServeConfig:
             raise ValueError(
                 "max_pending must be at least the largest bucket "
                 f"({self.buckets[-1]})")
+        if self.metrics_port is not None and not (
+                0 <= self.metrics_port <= 65535):
+            raise ValueError(
+                "metrics_port must be None (no endpoint), 0 "
+                "(ephemeral) or a valid TCP port")
+        if not self.metrics_host:
+            raise ValueError(
+                "metrics_host must be a bind address (default "
+                "127.0.0.1; use 0.0.0.0 for remote scrapes)")
+        if self.slo_ms <= 0:
+            raise ValueError("slo_ms must be > 0")
 
     def replace(self, **kw) -> "ServeConfig":
         return dataclasses.replace(self, **kw)

@@ -36,13 +36,14 @@ import os
 import time
 from typing import Optional
 
-from dpsvm_tpu.obs import metrics, runlog, trace
+from dpsvm_tpu.obs import compilelog, metrics, runlog, trace
 from dpsvm_tpu.obs.metrics import Registry, enable, get_registry
 from dpsvm_tpu.obs.runlog import SCHEMA_VERSION, RunLog, read_runlog
 from dpsvm_tpu.obs.trace import TraceSession, span
 
 __all__ = [
-    "metrics", "runlog", "trace", "Registry", "RunLog", "TraceSession",
+    "compilelog", "metrics", "runlog", "trace", "Registry", "RunLog",
+    "TraceSession",
     "SCHEMA_VERSION", "enable", "get_registry", "read_runlog", "span",
     "obs_enabled", "run_obs", "RunObs", "NULL_OBS",
 ]
@@ -93,6 +94,41 @@ class _NullObs:
 NULL_OBS = _NullObs()
 
 
+class _LabeledSpan:
+    """RunObs span: the trace span plus a compile-attribution label, so
+    an executor built inside this dispatch yields a ``compile`` runlog
+    record naming the span (obs/compilelog.py). Entered/exited in
+    label-then-span order so compile events during the dispatch see the
+    label either way."""
+
+    __slots__ = ("_span", "_label")
+
+    def __init__(self, name: str, shape):
+        self._span = trace.span(name)
+        self._label = compilelog.label(name, shape)
+
+    def __enter__(self):
+        self._label.__enter__()
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._span.__exit__(*exc)
+        self._label.__exit__(*exc)
+        return False
+
+
+def _shape_signature(meta) -> Optional[str]:
+    """Human-grep-able shape signature from a run's manifest meta —
+    the field compile records carry when the triggering dispatch's
+    label has no more specific one."""
+    if not meta:
+        return None
+    keys = ("n", "n_pad", "d", "k", "n_union", "n_devices", "buckets")
+    parts = [f"{k}={meta[k]}" for k in keys if k in meta]
+    return " ".join(parts) or None
+
+
 class RunObs:
     """Live per-run observability: a RunLog (manifest written at
     construction), a TraceSession whose span events sink into the same
@@ -131,6 +167,33 @@ class RunObs:
         self._last_pairs = None
         self._finished = False
         self._t0 = time.perf_counter()
+        # Compile accounting (obs/compilelog.py): every backend
+        # executable built while this run is live yields a `compile`
+        # record {entrypoint, shape, seconds} and bumps the counter —
+        # runtime visibility for the cost tpulint's budgets pin
+        # statically. Sink removed in finish() (idempotent). The sink
+        # must hold the run WEAKLY: a strong reference from the global
+        # sink registry would keep a faulted run alive and defeat the
+        # __del__ exception-safety path (the fault-retry contract).
+        import weakref
+
+        self._sig = _shape_signature(meta)
+        self._compiles = self.registry.counter(f"{tool}.compiles_total")
+        ref = weakref.ref(self)
+
+        def _sink(entrypoint, shape, seconds, _ref=ref):
+            run = _ref()
+            if run is not None:
+                run._on_compile(entrypoint, shape, seconds)
+
+        self._compile_sink = _sink
+        compilelog.add_sink(self._compile_sink)
+
+    def _on_compile(self, entrypoint: str, shape, seconds: float):
+        self._compiles.add(1)
+        self._log.record("compile", entrypoint=entrypoint,
+                         shape=shape or self._sig,
+                         seconds=round(seconds, 6))
 
     def chunk(self, pairs: int, b_hi: float, b_lo: float,
               device_seconds: float, dispatch: int, **fields) -> None:
@@ -160,6 +223,7 @@ class RunObs:
         if self._finished:
             return
         self._finished = True
+        compilelog.remove_sink(self._compile_sink)
         self._session.__exit__(None, None, None)
         self._log.finish(wall_seconds=round(
             time.perf_counter() - self._t0, 6),
@@ -177,8 +241,8 @@ class RunObs:
         except Exception:
             pass
 
-    def span(self, name: str):
-        return trace.span(name)
+    def span(self, name: str, shape: Optional[str] = None):
+        return _LabeledSpan(name, shape or self._sig)
 
     @property
     def path(self) -> str:

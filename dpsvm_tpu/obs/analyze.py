@@ -1,0 +1,443 @@
+"""Runlog analytics: turn the telemetry spine's records into answers.
+
+The spine (ISSUE 7) only *records* — runlogs pile up in CI artifacts
+with nothing that reads them. This module is the reader (ISSUE 8):
+
+* :func:`load_runs` / :func:`summarize_run` — parse one or many runlog
+  JSONL files (or directories of them) into per-run summaries:
+  convergence diagnostics (gap trajectory, stall windows, pairs/s per
+  chunk), per-phase wall-clock breakdown, compile records.
+* :func:`report` — the aggregate table (text or markdown — the
+  markdown mode is what CI renders into the GitHub job summary).
+* :func:`diff_runs` — attribute a regression between two runs to the
+  phase that moved (the Catanzaro/ThunderSVM-style per-phase
+  attribution PAPERS.md describes), plus headline pairs/s and compile
+  deltas.
+* :func:`tail_records` — the last N records of a stream, one line per
+  record (the `kubectl logs`-shaped view for live runs).
+
+CLI surface: ``python -m dpsvm_tpu.cli obs {report,diff,tail}``
+(cli.py forwards argv verbatim to :func:`run_cli` — one flag surface,
+the lint-subcommand discipline).
+
+Everything here is a pure reader of JSONL already on disk — no jax, no
+device work — so it runs anywhere the artifacts land (CI, laptops).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import List, Optional
+
+from dpsvm_tpu.obs.runlog import read_runlog
+
+#: a chunk "stalls" when its gap fails to shrink by at least this
+#: relative amount vs the previous chunk — consecutive stalled chunks
+#: form a stall window (the diagnostic that catches working-set cycling
+#: long before max_iter does).
+STALL_REL_TOL = 1e-3
+
+
+@dataclasses.dataclass
+class Run:
+    """One run's records, split by kind (stream order preserved)."""
+
+    path: str
+    run_id: str
+    manifest: dict
+    chunks: list
+    events: list
+    compiles: list
+    final: Optional[dict]
+
+
+def runlog_paths(paths) -> List[str]:
+    """Expand files/directories/globs into a sorted runlog file list
+    (directories scan for *.jsonl)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        elif os.path.exists(p):
+            out.append(p)
+        else:
+            # Globs can match subdirectories; only files are streams.
+            hits = [h for h in sorted(glob.glob(p)) if os.path.isfile(h)]
+            if not hits:
+                raise FileNotFoundError(f"no runlog at {p!r}")
+            out.extend(hits)
+    return out
+
+
+def load_runs(paths) -> List[Run]:
+    """Every run found in `paths` (files, dirs or globs), in (file,
+    stream) order. Runs interleaved in one file — concurrent writers
+    share the per-(tool, pid) stream — are separated by run id."""
+    runs: List[Run] = []
+    for path in runlog_paths(paths):
+        by_id: dict = {}
+        order: list = []
+        for rec in read_runlog(path):
+            rid = rec["run"]
+            if rid not in by_id:
+                by_id[rid] = Run(path=path, run_id=rid, manifest={},
+                                 chunks=[], events=[], compiles=[],
+                                 final=None)
+                order.append(rid)
+            run = by_id[rid]
+            kind = rec["kind"]
+            if kind == "manifest":
+                run.manifest = rec
+            elif kind == "chunk":
+                run.chunks.append(rec)
+            elif kind == "event":
+                run.events.append(rec)
+            elif kind == "compile":
+                run.compiles.append(rec)
+            elif kind == "final":
+                run.final = rec
+        runs.extend(by_id[rid] for rid in order)
+    return runs
+
+
+def _stall_windows(chunks) -> dict:
+    """Consecutive-chunk windows where the gap failed to shrink by
+    STALL_REL_TOL relative — {count, longest} (in chunks)."""
+    windows, longest, cur = 0, 0, 0
+    prev_gap = None
+    for c in chunks:
+        gap = c.get("gap")
+        if gap is None:
+            continue
+        if prev_gap is not None and not (
+                gap <= prev_gap * (1.0 - STALL_REL_TOL)):
+            cur += 1
+            if cur == 1:
+                windows += 1
+            longest = max(longest, cur)
+        else:
+            cur = 0
+        prev_gap = gap
+    return {"count": windows, "longest": longest}
+
+
+def summarize_run(run: Run) -> dict:
+    """Flat JSON-able summary of one run: identity, convergence
+    diagnostics, throughput, per-phase breakdown, compile accounting."""
+    man, fin = run.manifest, run.final or {}
+    pairs = sum(c.get("pairs_delta", 0) for c in run.chunks)
+    dev_s = sum(c.get("device_seconds", 0.0) for c in run.chunks)
+    pps = [c["pairs_delta"] / c["device_seconds"]
+           for c in run.chunks
+           if c.get("device_seconds") and c.get("pairs_delta", 0) > 0]
+    gaps = [c["gap"] for c in run.chunks if "gap" in c]
+    phases = fin.get("phase_seconds") or {}
+    out = {
+        "path": run.path,
+        "run": run.run_id,
+        "tool": man.get("tool", "?"),
+        "utc": man.get("utc"),
+        "git_sha": (man.get("git_sha") or "")[:12] or None,
+        "engine": man.get("engine"),
+        "n": man.get("n"), "d": man.get("d"),
+        "n_devices": man.get("n_devices"),
+        "chunks": len(run.chunks),
+        "pairs": pairs,
+        "device_seconds": round(dev_s, 6),
+        "pairs_per_second": round(pairs / dev_s) if dev_s else None,
+        "chunk_pairs_per_second": {
+            "min": round(min(pps)), "max": round(max(pps)),
+        } if pps else None,
+        "gap_first": gaps[0] if gaps else None,
+        "gap_last": gaps[-1] if gaps else None,
+        "stalls": _stall_windows(run.chunks),
+        "events": [e.get("name") for e in run.events],
+        "compiles": len(run.compiles),
+        "compile_seconds": round(sum(c.get("seconds", 0.0)
+                                     for c in run.compiles), 6),
+        "converged": fin.get("converged"),
+        "iterations": fin.get("iterations"),
+        "wall_seconds": fin.get("wall_seconds"),
+        "aborted": bool(fin.get("aborted")) if fin else None,
+        "finished": run.final is not None,
+        "phase_seconds": phases or None,
+    }
+    return out
+
+
+def _phases_of(summary: dict) -> dict:
+    """A run's per-phase seconds, with the chunk-sum fallback for runs
+    that carry no phase clock (serve runlogs): everything attributed to
+    'solve' so diffs still have one honest bucket."""
+    ph = summary.get("phase_seconds")
+    if ph:
+        return dict(ph)
+    return {"solve": summary.get("device_seconds") or 0.0}
+
+
+def diff_runs(a: dict, b: dict) -> dict:
+    """Attribute the wall-clock movement from run-summary `a` (baseline)
+    to `b` to the phase that moved. Deltas are ``b - a`` seconds per
+    phase; the attribution names the phase with the largest
+    |delta| and its share of the total movement."""
+    pa, pb = _phases_of(a), _phases_of(b)
+    phases = sorted(set(pa) | set(pb))
+    deltas = {p: round(pb.get(p, 0.0) - pa.get(p, 0.0), 6)
+              for p in phases}
+    total_a = sum(pa.values())
+    total_b = sum(pb.values())
+    total_delta = total_b - total_a
+    worst = max(phases, key=lambda p: abs(deltas[p])) if phases else None
+    # Share of the GROSS movement (sum of |per-phase deltas|), not the
+    # net: offsetting phases (setup +2s, solve -1.5s) are exactly the
+    # case attribution exists for, and a net denominator would print
+    # nonsense shares over 100% there.
+    gross = sum(abs(d) for d in deltas.values())
+    share = (abs(deltas[worst]) / gross
+             if worst is not None and gross > 1e-12 else None)
+    out = {
+        "a": {"path": a["path"], "run": a["run"], "tool": a["tool"]},
+        "b": {"path": b["path"], "run": b["run"], "tool": b["tool"]},
+        "total_seconds_a": round(total_a, 6),
+        "total_seconds_b": round(total_b, 6),
+        "total_delta_seconds": round(total_delta, 6),
+        "phase_deltas": deltas,
+        "attributed_phase": worst,
+        "attributed_share": round(share, 4) if share is not None else None,
+        "pairs_per_second_a": a.get("pairs_per_second"),
+        "pairs_per_second_b": b.get("pairs_per_second"),
+        "compile_delta": (b.get("compiles", 0) or 0)
+        - (a.get("compiles", 0) or 0),
+    }
+    ppa, ppb = a.get("pairs_per_second"), b.get("pairs_per_second")
+    if ppa and ppb:
+        out["pairs_per_second_delta"] = round(ppb / ppa - 1.0, 4)
+    return out
+
+
+def pick_run(runs: List[Run], run_id: Optional[str] = None,
+             tool: Optional[str] = None) -> Run:
+    """The run a diff side means: by explicit id when given, else the
+    LAST finished run (streams append; the newest complete run is the
+    one being compared), else the last run at all."""
+    cand = [r for r in runs if tool is None
+            or r.manifest.get("tool") == tool]
+    if run_id is not None:
+        for r in cand:
+            if r.run_id == run_id:
+                return r
+        raise KeyError(f"run id {run_id!r} not found "
+                       f"(have {[r.run_id for r in cand]})")
+    # "Last" means chronologically newest, not last in lexical file
+    # order (a dir can hold solve-400.jsonl written after
+    # solve-5000.jsonl): order by the manifest's utc stamp (ISO-8601,
+    # sorts lexically; stable sort keeps stream order within a second).
+    def _utc(r):
+        return r.manifest.get("utc") or ""
+
+    finished = sorted((r for r in cand if r.final is not None),
+                      key=_utc)
+    if finished:
+        return finished[-1]
+    if not cand:
+        raise ValueError("no runs found")
+    return sorted(cand, key=_utc)[-1]
+
+
+# ----------------------------------------------------------- rendering
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+_REPORT_COLS = (
+    ("tool", "tool"), ("run", "run"), ("engine", "engine"),
+    ("n", "n"), ("d", "d"), ("chunks", "chunks"), ("pairs", "pairs"),
+    ("device_s", "device_seconds"), ("pairs/s", "pairs_per_second"),
+    ("gap last", "gap_last"), ("stalls", None), ("compiles", "compiles"),
+    ("phases", None), ("done", None),
+)
+
+
+def _report_row(s: dict) -> list:
+    ph = s.get("phase_seconds")
+    ph_txt = ("/".join(f"{k[:3]}={v:.3g}" for k, v in ph.items())
+              if ph else "-")
+    stalls = s["stalls"]
+    done = ("conv" if s.get("converged")
+            else "abort" if s.get("aborted")
+            else "open" if not s.get("finished") else "stop")
+    row = []
+    for head, key in _REPORT_COLS:
+        if key is not None:
+            row.append(_fmt(s.get(key)))
+        elif head == "stalls":
+            row.append(f"{stalls['count']}(max {stalls['longest']})"
+                       if stalls["count"] else "0")
+        elif head == "phases":
+            row.append(ph_txt)
+        else:
+            row.append(done)
+    return row
+
+
+def render_report(summaries: List[dict], md: bool = False) -> str:
+    """The aggregate table over run summaries (one row per run), plus a
+    one-line total. `md=True` renders GitHub-flavored markdown (the CI
+    job-summary mode); default is an aligned text table."""
+    heads = [h for h, _ in _REPORT_COLS]
+    rows = [_report_row(s) for s in summaries]
+    total_pairs = sum(s["pairs"] or 0 for s in summaries)
+    total_dev = sum(s["device_seconds"] or 0 for s in summaries)
+    total_compiles = sum(s["compiles"] or 0 for s in summaries)
+    footer = (f"{len(summaries)} run(s): {total_pairs} pairs in "
+              f"{total_dev:.3f} device-s"
+              + (f" ({round(total_pairs / total_dev)}/s)"
+                 if total_dev else "")
+              + f", {total_compiles} compile(s)")
+    if md:
+        lines = ["| " + " | ".join(heads) + " |",
+                 "|" + "---|" * len(heads)]
+        lines += ["| " + " | ".join(r) + " |" for r in rows]
+        return "\n".join(lines + ["", footer])
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(heads)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(heads, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths))
+              for r in rows]
+    return "\n".join(lines + [footer])
+
+
+def render_diff(d: dict) -> str:
+    lines = [
+        f"A: {d['a']['tool']} run {d['a']['run']} ({d['a']['path']})",
+        f"B: {d['b']['tool']} run {d['b']['run']} ({d['b']['path']})",
+        f"total: {d['total_seconds_a']:.4g}s -> "
+        f"{d['total_seconds_b']:.4g}s "
+        f"({d['total_delta_seconds']:+.4g}s)",
+    ]
+    for p, dv in sorted(d["phase_deltas"].items()):
+        mark = " <-- attributed" if p == d["attributed_phase"] else ""
+        lines.append(f"  {p:<10} {dv:+.4g}s{mark}")
+    if d.get("pairs_per_second_delta") is not None:
+        lines.append(f"pairs/s: {d['pairs_per_second_a']} -> "
+                     f"{d['pairs_per_second_b']} "
+                     f"({100 * d['pairs_per_second_delta']:+.1f}%)")
+    if d.get("compile_delta"):
+        lines.append(f"compiles: {d['compile_delta']:+d}")
+    if d["attributed_phase"] is not None:
+        share = (f" ({100 * d['attributed_share']:.0f}% of the gross "
+                 "movement)" if d["attributed_share"] is not None else "")
+        lines.append(f"attribution: phase "
+                     f"'{d['attributed_phase']}'{share}")
+    return "\n".join(lines)
+
+
+def tail_records(path: str, n: int = 10) -> List[str]:
+    """Last `n` records of one stream, one compact line per record."""
+    if n <= 0:
+        return []  # [-0:] would be the WHOLE stream
+    out = []
+    for rec in read_runlog(path)[-n:]:
+        kind = rec["kind"]
+        body = {k: v for k, v in rec.items()
+                if k not in ("schema", "run", "kind", "config",
+                             "metrics")}
+        parts = " ".join(f"{k}={_fmt(v)}" for k, v in body.items()
+                         if not isinstance(v, (dict, list)))
+        out.append(f"[{rec['run']}] {kind:<8} {parts}")
+    return out
+
+
+# ----------------------------------------------------------------- CLI
+
+def run_cli(argv=None) -> int:
+    """``cli obs`` engine: report / diff / tail (argv forwarded
+    verbatim from dpsvm_tpu/cli.py — one flag surface)."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="dpsvm-tpu obs",
+        description="runlog analytics over the telemetry spine's JSONL "
+                    "streams (dpsvm_tpu/obs)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rp = sub.add_parser("report", help="aggregate run summaries "
+                                       "(files, dirs or globs)")
+    rp.add_argument("paths", nargs="+")
+    rp.add_argument("--md", action="store_true",
+                    help="GitHub-flavored markdown (the CI job-summary "
+                         "mode)")
+    rp.add_argument("--json", action="store_true",
+                    help="machine-readable summaries (one JSON line "
+                         "per run)")
+    rp.add_argument("--tool", default=None,
+                    help="restrict to one tool's runs (solve, "
+                         "solve_mesh, fleet, serve, ...)")
+
+    dp = sub.add_parser("diff", help="attribute A->B wall-clock "
+                                     "movement to the phase that moved")
+    dp.add_argument("run_a", help="baseline runlog (file/dir/glob)")
+    dp.add_argument("run_b", help="candidate runlog (file/dir/glob)")
+    dp.add_argument("--run-id-a", default=None)
+    dp.add_argument("--run-id-b", default=None)
+    dp.add_argument("--tool", default=None)
+    dp.add_argument("--json", action="store_true")
+
+    tp = sub.add_parser("tail", help="last N records of one stream")
+    tp.add_argument("path")
+    tp.add_argument("-n", type=int, default=10)
+
+    args = ap.parse_args(argv)
+    try:
+        if args.cmd == "report":
+            runs = load_runs(args.paths)
+            if args.tool:
+                runs = [r for r in runs
+                        if r.manifest.get("tool") == args.tool]
+            summaries = [summarize_run(r) for r in runs]
+            if args.json:
+                for s in summaries:
+                    print(json.dumps(s))
+            else:
+                print(render_report(summaries, md=args.md))
+            return 0
+        if args.cmd == "diff":
+            a = summarize_run(pick_run(load_runs([args.run_a]),
+                                       args.run_id_a, args.tool))
+            b = summarize_run(pick_run(load_runs([args.run_b]),
+                                       args.run_id_b, args.tool))
+            d = diff_runs(a, b)
+            print(json.dumps(d) if args.json else render_diff(d))
+            return 0
+        lines = tail_records(args.path, args.n)
+        print("\n".join(lines))
+        return 0
+    except BrokenPipeError:
+        # `obs report ... | head` closes the pipe early — a normal way
+        # to read a long table, not an error. Detach stdout so the
+        # interpreter's shutdown flush doesn't re-raise.
+        import os
+        import sys
+
+        try:
+            sys.stdout.close()
+        except Exception:
+            os.close(1)
+        return 0
+    except (OSError, KeyError, ValueError) as e:
+        # OSError covers FileNotFoundError AND e.g. IsADirectoryError
+        # (`obs tail obs_runs/`) — every bad-path shape gets the
+        # one-line error + exit-2 contract, never a traceback.
+        import sys
+
+        print(f"error: {e}", file=sys.stderr)
+        return 2

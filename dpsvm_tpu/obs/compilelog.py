@@ -1,0 +1,130 @@
+"""Compile accounting: make XLA executor builds visible (ISSUE 8).
+
+Recompiles are one of the two runtime costs that actually gate TPU
+scale (the other is HBM footprint, pinned statically by tpulint's
+``memory.*`` budget facts) — and until this module they were invisible:
+a shape-bucketing bug or a weak-type retrace shows up only as
+mysteriously slow first chunks. jax already reports every backend
+compile through its monitoring hooks
+(``/jax/core/compile/backend_compile_duration``); this module turns
+those events into
+
+* a PROCESS-LEVEL counter (:func:`compiles_total`) — the number the
+  serving /metrics endpoint exports as ``serve_compiles``;
+* per-run ``compile`` runlog records ``{entrypoint, shape, seconds}``
+  via registered sinks (obs/__init__.py RunObs registers one while a
+  run is live; serve.PredictServer keeps one for its lifetime), with
+  the entrypoint taken from the innermost active :func:`label` — the
+  span name of the dispatch that triggered the build (``solver/chunk``,
+  ``serve/bucket1024``, ...), or ``"<unlabeled>"`` for compiles outside
+  any instrumented dispatch.
+
+ZERO-DEVICE-EFFECT: everything here is a host-side observer of events
+jax emits anyway. The listener is installed LAZILY — the first live
+RunObs or PredictServer installs it — so a process that never enables
+observability and never serves pays nothing; once installed it stays
+(jax's listener registry has no public unregister), counting into the
+process total with an O(#sinks) fan-out that only runs at compile
+time, which is seconds-scale work already. No compiled program, chunk
+cadence or dispatch count changes (the obs-enabled tpulint budget
+check stays the pin).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+#: monitoring event names that mean "one backend executable was built".
+#: jaxpr tracing / MLIR lowering durations are deliberately excluded —
+#: the contract counts EXECUTABLES, not trace passes.
+_COMPILE_EVENTS = ("/jax/core/compile/backend_compile_duration",)
+
+_installed = False
+_compiles = 0
+_seconds = 0.0
+# (entrypoint, shape) labels, innermost last. Compiles happen on the
+# dispatching thread in this codebase (the metrics HTTP thread never
+# compiles), so a plain list under the GIL is enough.
+_labels: List[Tuple[str, Optional[str]]] = []
+_sinks: List[Callable] = []
+
+
+def _listener(event: str, secs: float, **kw) -> None:
+    global _compiles, _seconds
+    if event not in _COMPILE_EVENTS:
+        return
+    _compiles += 1
+    _seconds += secs
+    if not _sinks:
+        return
+    name, shape = _labels[-1] if _labels else ("<unlabeled>", None)
+    for sink in list(_sinks):
+        try:
+            sink(name, shape, float(secs))
+        except Exception:
+            # An observer must never break the compile that fed it.
+            pass
+
+
+def install() -> bool:
+    """Idempotently register the jax monitoring listener. Returns True
+    when the hook is live (False on jax builds without the monitoring
+    module — accounting then degrades to zeros, never an error)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_duration_secs_listener(_listener)
+    except Exception:
+        return False
+    _installed = True
+    return True
+
+
+def compiles_total() -> int:
+    """Backend executables built since :func:`install` (process-wide)."""
+    return _compiles
+
+
+def compile_seconds_total() -> float:
+    """Total backend-compile seconds since :func:`install`."""
+    return round(_seconds, 6)
+
+
+def add_sink(sink: Callable) -> None:
+    """Register ``sink(entrypoint, shape, seconds)`` for future compile
+    events (installs the listener if needed)."""
+    install()
+    if sink not in _sinks:
+        _sinks.append(sink)
+
+
+def remove_sink(sink: Callable) -> None:
+    if sink in _sinks:
+        _sinks.remove(sink)
+
+
+class label:
+    """Context manager naming the entrypoint (and optionally its shape
+    signature) for any compile events fired inside it. Nested labels
+    attribute to the innermost — the same convention as trace spans."""
+
+    __slots__ = ("_entry",)
+
+    def __init__(self, entrypoint: str, shape: Optional[str] = None):
+        self._entry = (entrypoint, shape)
+
+    def __enter__(self):
+        _labels.append(self._entry)
+        return self
+
+    def __exit__(self, *exc):
+        # Remove THIS entry even under exotic interleaving (a sibling
+        # exiting out of order must not pop our label).
+        for i in range(len(_labels) - 1, -1, -1):
+            if _labels[i] is self._entry:
+                del _labels[i]
+                break
+        return False

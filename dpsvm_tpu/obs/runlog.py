@@ -22,6 +22,9 @@ Record shapes (all carry ``schema`` = :data:`SCHEMA_VERSION`,
   the loop's single block_until_ready), ``dispatch`` ordinal.
 * ``event``   — named occurrences: ``{"name": ..., **fields}``.
 * ``span``    — host timeline events from obs/trace.py.
+* ``compile`` — one backend executable built while the run was live
+  (obs/compilelog.py): ``entrypoint`` (the dispatch label that
+  triggered it), ``shape`` (signature), ``seconds``.
 * ``final``   — run result fields + ``metrics`` (registry snapshot).
 
 Everything is computed from values the host ALREADY holds — writing a
@@ -52,22 +55,53 @@ SCHEMA_VERSION = 1
 _RUN_COUNTER = 0
 
 
+def _git_dir(root: str) -> str:
+    """The actual git directory for `root`. In a worktree or submodule
+    checkout ``.git`` is a FILE holding a ``gitdir: <path>`` pointer
+    (relative paths resolve against root) — following it is what keeps
+    manifests from logging sha "unknown" there."""
+    dot_git = os.path.join(root, ".git")
+    if os.path.isfile(dot_git):
+        with open(dot_git) as fh:
+            first = fh.readline().strip()
+        if first.startswith("gitdir:"):
+            target = first.split(":", 1)[1].strip()
+            if not os.path.isabs(target):
+                target = os.path.normpath(os.path.join(root, target))
+            return target
+    return dot_git
+
+
 def git_sha(repo_root: Optional[str] = None) -> str:
     """Current commit sha, read from .git directly (no subprocess —
-    run logs open on hot paths and in sandboxes without git)."""
+    run logs open on hot paths and in sandboxes without git). Handles
+    ``.git``-as-file checkouts (worktrees/submodules) via the
+    ``gitdir:`` pointer; a worktree's HEAD ref resolves against the
+    parent repository's common dir."""
     root = repo_root or os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     try:
-        head_path = os.path.join(root, ".git", "HEAD")
-        with open(head_path) as fh:
+        git_dir = _git_dir(root)
+        with open(os.path.join(git_dir, "HEAD")) as fh:
             head = fh.read().strip()
         if head.startswith("ref:"):
             ref = head.split(None, 1)[1]
-            ref_path = os.path.join(root, ".git", *ref.split("/"))
-            if os.path.exists(ref_path):
-                with open(ref_path) as fh:
-                    return fh.read().strip()
-            packed = os.path.join(root, ".git", "packed-refs")
+            # Worktree git dirs keep refs/packed-refs in the parent
+            # repository's common dir (the `commondir` pointer file).
+            common = git_dir
+            common_file = os.path.join(git_dir, "commondir")
+            if os.path.isfile(common_file):
+                with open(common_file) as fh:
+                    rel = fh.read().strip()
+                common = (rel if os.path.isabs(rel)
+                          else os.path.normpath(os.path.join(git_dir,
+                                                             rel)))
+            for base in (git_dir, common):
+                ref_path = os.path.join(base, *ref.split("/"))
+                if os.path.exists(ref_path):
+                    with open(ref_path) as fh:
+                        return fh.read().strip()
+            packed = os.path.join(common, "packed-refs")
             with open(packed) as fh:
                 for line in fh:
                     if line.strip().endswith(ref):

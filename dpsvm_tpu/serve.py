@@ -45,7 +45,8 @@ from typing import Union
 import numpy as np
 
 from dpsvm_tpu.config import ServeConfig
-from dpsvm_tpu.obs import run_obs
+from dpsvm_tpu.obs import compilelog, run_obs
+from dpsvm_tpu.obs import export as openmetrics
 from dpsvm_tpu.obs.metrics import Registry
 from dpsvm_tpu.obs.trace import span
 from dpsvm_tpu.models.multiclass import (CompactedEnsemble, MulticlassSVM,
@@ -192,6 +193,35 @@ class PredictServer:
         self.metrics = Registry(enabled=True)
         self.request_seconds = self.metrics.histogram(
             "serve.request_seconds")
+        # Compile accounting (obs/compilelog.py): executors built while
+        # this server lives — warm-up buckets, or the recompile a
+        # config/shape bug would cause mid-traffic, which is exactly
+        # what the exported `serve_compiles` counter exists to catch.
+        self.compiles = self.metrics.counter("serve.compiles_total")
+        # The sink holds the server WEAKLY (the RunObs discipline,
+        # obs/__init__.py): a strong reference from the module-global
+        # sink registry would keep an un-close()d server — and its
+        # device-resident union — alive forever, and close() was never
+        # mandatory before this counter existed. _in_dispatch scopes
+        # the count to THIS server's own dispatches: compiles fire
+        # synchronously on the dispatching thread, so another server's
+        # warm-up (same "serve/bucket*" labels) lands while this flag
+        # is False and is not counted.
+        import weakref
+
+        self._in_dispatch = False
+        ref = weakref.ref(self)
+
+        def _compile_sink(name, shape, secs, _ref=ref):
+            srv = _ref()
+            if srv is None:  # server GC'd without close(): self-evict
+                compilelog.remove_sink(_compile_sink)
+                return
+            if srv._in_dispatch and name.startswith("serve/"):
+                srv.compiles.add(1)
+
+        self._compile_sink = _compile_sink
+        compilelog.add_sink(self._compile_sink)
         self.stats = {
             "requests": 0, "rows": 0, "dispatches": 0, "padded_rows": 0,
             "buckets": self.buckets,
@@ -218,6 +248,22 @@ class PredictServer:
         self._next_ticket = 0
         if config.warm_start:
             self.warm()
+        # OpenMetrics endpoint (obs/export.py) — started LAST so a
+        # scrape never sees a half-constructed server. None = off;
+        # 0 = ephemeral port (tests / bench_serve self-scrape). The
+        # render callback holds the server WEAKLY: the daemon thread
+        # is a GC root, and a bound method would pin an un-close()d
+        # server (and its device operands) for the process lifetime.
+        self.exporter = None
+        if config.metrics_port is not None:
+            def _render(_ref=ref):
+                srv = _ref()
+                return (srv.render_openmetrics() if srv is not None
+                        else "# EOF\n")
+
+            self.exporter = openmetrics.MetricsExporter(
+                _render, port=config.metrics_port,
+                host=config.metrics_host)
 
     # ------------------------------------------------------------ staging
     def _stage(self) -> None:
@@ -322,10 +368,20 @@ class PredictServer:
         if self._call is None:
             return np.broadcast_to(
                 -self.ens.b, (qb.shape[0], self.k)).astype(np.float32)
-        with span(f"serve/bucket{bucket}"):
-            t0 = time.perf_counter()
-            out = np.asarray(self._call(qb))
-            dt = time.perf_counter() - t0
+        # The compile label is independent of the obs switch: the
+        # always-on serve_compiles counter attributes executor builds
+        # to their bucket even when no run log is live. _in_dispatch
+        # scopes the sink to this server (see __init__).
+        self._in_dispatch = True
+        try:
+            with compilelog.label(f"serve/bucket{bucket}",
+                                  f"({bucket},{self.d})"), \
+                    span(f"serve/bucket{bucket}"):
+                t0 = time.perf_counter()
+                out = np.asarray(self._call(qb))
+                dt = time.perf_counter() - t0
+        finally:
+            self._in_dispatch = False
         if not warm:
             self.stats["bucket_seconds"][bucket].observe(dt)
         return out
@@ -449,11 +505,99 @@ class PredictServer:
             str(b): h.snapshot()
             for b, h in self.stats["bucket_seconds"].items() if len(h)}
         out["request_seconds"] = self.request_seconds.snapshot()
+        out["compiles"] = self.compiles.value
         return out
+
+    def render_openmetrics(self) -> str:
+        """The /metrics exposition (OpenMetrics 1.0 text): counters,
+        latency summaries (quantiles = the SAME Histogram.percentiles()
+        snapshot() reports — a scrape and a snapshot cannot disagree),
+        per-model/per-bucket SLO-attainment gauges and the compile
+        counter. Reads host-held instruments only — never a device
+        dispatch. Callable directly; the HTTP thread
+        (config.metrics_port, obs/export.py) serves it on GET."""
+        om = openmetrics
+        st = self.stats
+        model_lb = {"model": self.model_id}
+        slo_s = float(self.config.slo_ms) / 1e3
+        slo_lb = {"slo_ms": f"{self.config.slo_ms:g}"}
+
+        def attainment(hist) -> float:
+            w = hist.window_values()
+            return float(np.mean(w <= slo_s)) if w.size else 1.0
+
+        fams = [
+            om.counter("serve_requests", "requests enqueued",
+                       st["requests"], model_lb),
+            om.counter("serve_rows", "query rows served", st["rows"],
+                       model_lb),
+            om.counter("serve_dispatches", "device dispatches",
+                       st["dispatches"], model_lb),
+            om.counter("serve_padded_rows",
+                       "bucket pad rows dispatched", st["padded_rows"],
+                       model_lb),
+            om.counter("serve_compiles",
+                       "bucket executors compiled while serving",
+                       self.compiles.value, model_lb),
+            om.gauge("serve_pending_rows",
+                     "rows queued for the next flush",
+                     [(model_lb, self._pending_rows)]),
+            om.gauge("serve_f64_columns",
+                     "decision columns risk-routed to host float64",
+                     [(model_lb, len(self.f64_cols))]),
+            om.gauge("serve_sv_union_rows",
+                     "resident SV-union rows",
+                     [(model_lb, int(self.ens.n_union))]),
+        ]
+        if len(self.request_seconds):
+            fams.append(om.summary(
+                "serve_request_seconds",
+                "request latency (enqueue->flush), recent-window "
+                "quantiles", self.request_seconds, labels=model_lb))
+        fams.append(om.gauge(
+            "serve_slo_attainment",
+            "fraction of the recent request-latency window at or "
+            "under the objective (1 = vacuous when empty)",
+            [({**model_lb, **slo_lb},
+              round(attainment(self.request_seconds), 6))]))
+        disp = [("_total", {"bucket": str(b)}, c)
+                for b, c in st["bucket_counts"].items()]
+        fams.append(om.metric(
+            "serve_bucket_dispatches", "counter",
+            "device dispatches per query bucket", disp))
+        bucket_att = []
+        bucket_samples = []
+        for b, h in st["bucket_seconds"].items():
+            if not len(h):
+                continue
+            bucket_samples.extend(om.summary_samples(
+                h, labels={"bucket": str(b)}))
+            bucket_att.append(({"bucket": str(b), **slo_lb},
+                               round(attainment(h), 6)))
+        if bucket_samples:
+            fams.append(om.metric(
+                "serve_bucket_seconds", "summary",
+                "per-dispatch device latency, recent-window "
+                "quantiles", bucket_samples))
+        if bucket_att:
+            fams.append(om.gauge(
+                "serve_bucket_slo_attainment",
+                "fraction of the recent per-bucket dispatch window "
+                "at or under the objective", bucket_att))
+        return om.render(fams)
+
+    @property
+    def model_id(self) -> str:
+        """The `model` label value on exported metrics."""
+        return f"{self.strategy}-{self.k}"
 
     def close(self) -> None:
         """Finish the serve run log (no-op when obs is disabled or
-        already closed); the device-resident operands stay usable."""
+        already closed), stop the /metrics endpoint and detach the
+        compile sink; the device-resident operands stay usable."""
+        compilelog.remove_sink(self._compile_sink)
+        if self.exporter is not None:
+            self.exporter.close()
         self._obs.finish(**self.snapshot())
 
 

@@ -159,6 +159,88 @@ def test_runlog_reader_skips_future_schema_and_garbage(tmp_path):
     assert recs == [good]
 
 
+def test_runlog_interleaved_runs_separate_cleanly(tmp_path):
+    """Two runs writing CONCURRENTLY to one per-(tool, pid) stream —
+    records interleaved record-by-record, not run-by-run — must
+    separate exactly by run id, and the analytics loader must yield
+    both runs with their own chunks (ISSUE 8 satellite)."""
+    from dpsvm_tpu.obs.analyze import load_runs
+
+    path = str(tmp_path / "solve-interleaved.jsonl")
+    l1 = RunLog(path, "solve")
+    l2 = RunLog(path, "solve")  # opened before l1 finishes
+    for i in range(3):
+        l1.record("chunk", pairs=10 * (i + 1), pairs_delta=10,
+                  gap=1.0 / (i + 1), device_seconds=0.1, dispatch=i + 1)
+        l2.record("chunk", pairs=5 * (i + 1), pairs_delta=5,
+                  gap=2.0 / (i + 1), device_seconds=0.2, dispatch=i + 1)
+    l2.finish(iterations=15, converged=False)
+    l1.finish(iterations=30, converged=True)
+
+    recs = read_runlog(path)
+    c1 = records_for(recs, l1.run_id, "chunk")
+    c2 = records_for(recs, l2.run_id, "chunk")
+    assert [c["pairs"] for c in c1] == [10, 20, 30]
+    assert [c["pairs"] for c in c2] == [5, 10, 15]
+    runs = load_runs([path])
+    assert [r.run_id for r in runs] == [l1.run_id, l2.run_id]
+    assert [len(r.chunks) for r in runs] == [3, 3]
+    assert runs[0].final["converged"] is True
+    assert runs[1].final["converged"] is False
+
+
+def test_runlog_reader_skips_corrupted_mid_file_record(tmp_path):
+    """A record corrupted in the MIDDLE of a stream (disk hiccup,
+    partial overwrite) must cost exactly that record — everything
+    before AND after it still parses (only the truncated-tail case was
+    pinned before)."""
+    p = tmp_path / "x.jsonl"
+    a = {"schema": SCHEMA_VERSION, "run": "1-1", "kind": "chunk",
+         "pairs": 1}
+    b = {"schema": SCHEMA_VERSION, "run": "1-1", "kind": "chunk",
+         "pairs": 2}
+    c = {"schema": SCHEMA_VERSION, "run": "1-1", "kind": "final"}
+    corrupt = json.dumps(b)[:17] + "\x00\x00garbage"
+    p.write_text("\n".join([json.dumps(a), corrupt, json.dumps(b),
+                            json.dumps(c)]) + "\n")
+    recs = read_runlog(str(p))
+    assert recs == [a, b, c]
+
+
+def test_git_sha_follows_gitdir_pointer(tmp_path):
+    """Worktree/submodule checkouts have .git as a FILE holding a
+    `gitdir:` pointer; git_sha must follow it (relative or absolute)
+    instead of logging "unknown" (ISSUE 8 satellite)."""
+    from dpsvm_tpu.obs.runlog import git_sha
+
+    sha = "deadbeef" * 5
+    # The pointed-to git dir (the layout `git worktree add` creates).
+    gd = tmp_path / "parent" / ".git" / "worktrees" / "wt"
+    gd.mkdir(parents=True)
+    (gd / "HEAD").write_text("ref: refs/heads/topic\n")
+    (gd / "commondir").write_text("../..\n")
+    common = tmp_path / "parent" / ".git"
+    (common / "refs" / "heads").mkdir(parents=True)
+    (common / "refs" / "heads" / "topic").write_text(sha + "\n")
+    # The worktree root whose .git is a pointer FILE.
+    wt = tmp_path / "wt"
+    wt.mkdir()
+    (wt / ".git").write_text(f"gitdir: {gd}\n")
+    assert git_sha(str(wt)) == sha
+    # Relative pointer resolves against the worktree root.
+    (wt / ".git").write_text("gitdir: ../parent/.git/worktrees/wt\n")
+    assert git_sha(str(wt)) == sha
+    # Detached-HEAD worktree: HEAD holds the sha directly.
+    (gd / "HEAD").write_text(sha + "\n")
+    assert git_sha(str(wt)) == sha
+    # ... and a normal .git DIRECTORY still resolves (regression).
+    norm = tmp_path / "norm"
+    (norm / ".git" / "refs" / "heads").mkdir(parents=True)
+    (norm / ".git" / "HEAD").write_text("ref: refs/heads/main\n")
+    (norm / ".git" / "refs" / "heads" / "main").write_text(sha + "\n")
+    assert git_sha(str(norm)) == sha
+
+
 def test_runlog_multiple_runs_share_a_file(tmp_path):
     path = str(tmp_path / "solve-shared.jsonl")
     l1 = RunLog(path, "solve")
@@ -210,11 +292,11 @@ def test_counter_gauge_snapshot():
 
 # ------------------------------------------------------ serve path
 
-def _tiny_multiclass():
+def _tiny_multiclass(d=6):
     from dpsvm_tpu.models.multiclass import train_multiclass
 
     rng = np.random.default_rng(0)
-    x = rng.random((90, 6), np.float32)
+    x = rng.random((90, d), np.float32)
     y = np.arange(90) % 3
     m, _ = train_multiclass(x, y, SVMConfig(c=1.0, epsilon=1e-2),
                             strategy="ovr")
@@ -300,6 +382,235 @@ def test_offered_load_sweep_reports_from_shared_histograms():
         assert row["dispatches"] == \
             srv.stats["bucket_seconds"][int(b)].count
     json.dumps(rec)
+
+
+# ----------------------------------------------- /metrics endpoint
+
+def _scrape(url: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        return resp.read().decode("utf-8")
+
+
+def _parse_openmetrics(text: str):
+    """Tiny strict OpenMetrics reader: families declared exactly once,
+    `# EOF` terminated, every sample line `name{labels} value`."""
+    assert text.endswith("# EOF\n")
+    types, samples = {}, {}
+    for ln in text.splitlines():
+        if ln == "# EOF":
+            break
+        if ln.startswith("# TYPE "):
+            _, _, name, t = ln.split()
+            assert name not in types, f"family {name} declared twice"
+            types[name] = t
+        elif ln and not ln.startswith("#"):
+            key, val = ln.rsplit(" ", 1)
+            samples[key] = float(val)
+    return types, samples
+
+
+def test_metrics_endpoint_matches_snapshot(tmp_path):
+    """Acceptance (ISSUE 8): /metrics parses as OpenMetrics and its
+    quantiles EQUAL PredictServer.snapshot()'s percentiles — one
+    definition behind both surfaces."""
+    from dpsvm_tpu.serve import PredictServer, offered_load_sweep
+
+    m, _ = _tiny_multiclass()
+    srv = PredictServer(m, ServeConfig(buckets=(16, 64),
+                                       metrics_port=0))
+    try:
+        offered_load_sweep(srv, [1, 4, 8], 24, group=4)
+        text = _scrape(srv.exporter.url)
+        types, samples = _parse_openmetrics(text)
+        assert types["serve_requests"] == "counter"
+        assert types["serve_request_seconds"] == "summary"
+        assert types["serve_slo_attainment"] == "gauge"
+        snap = srv.snapshot()
+        mdl = f'model="{srv.model_id}"'
+        assert samples[f"serve_requests_total{{{mdl}}}"] \
+            == snap["requests"]
+        assert samples[f"serve_dispatches_total{{{mdl}}}"] \
+            == snap["dispatches"]
+        rq = snap["request_seconds"]
+        for q, p in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            assert samples[
+                f'serve_request_seconds{{{mdl},quantile="{q:g}"}}'] \
+                == rq[p]
+        assert samples[f"serve_request_seconds_count{{{mdl}}}"] \
+            == rq["count"]
+        for b, row in snap["bucket_seconds"].items():
+            assert samples[
+                f'serve_bucket_seconds{{bucket="{b}",'
+                f'quantile="0.5"}}'] == row["p50"]
+        # SLO attainment over the recent window (50 ms default: every
+        # CPU-harness dispatch sits far under it).
+        att = samples[f'serve_slo_attainment{{{mdl},slo_ms="50"}}']
+        w = srv.request_seconds.window_values()
+        assert att == float(np.mean(w <= 0.05))
+        assert samples[f"serve_compiles_total{{{mdl}}}"] \
+            == srv.compiles.value
+        # Non-/metrics paths 404 (the endpoint is not a web app).
+        import urllib.error
+        import urllib.request
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                srv.exporter.url.replace("/metrics", "/admin"),
+                timeout=10)
+    finally:
+        srv.close()
+    srv.close()  # idempotent (exporter already stopped)
+
+
+def test_metrics_endpoint_concurrent_scrape_under_enqueue():
+    """Concurrent-scrape safety (ISSUE 8 satellite): a scraper
+    hammering /metrics while the server sustains enqueue/flush traffic
+    must see only complete, parseable expositions — the instruments
+    are single-writer, readers tolerate a torn recent-window."""
+    import threading
+
+    from dpsvm_tpu.serve import PredictServer
+
+    m, x = _tiny_multiclass()
+    srv = PredictServer(m, ServeConfig(buckets=(16,), metrics_port=0))
+    url = srv.exporter.url
+    errors: list = []
+    texts: list = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                texts.append(_scrape(url))
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                errors.append(e)
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(80):
+            srv.enqueue(x[:4])
+            srv.flush()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        srv.close()
+    assert not errors
+    assert len(texts) >= 3
+    for text in texts:
+        types, samples = _parse_openmetrics(text)
+        assert "serve_requests" in types
+
+
+def test_serve_config_metrics_validation():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        ServeConfig(metrics_port=70000)
+    with _pytest.raises(ValueError):
+        ServeConfig(slo_ms=0)
+    assert ServeConfig().metrics_port is None  # off by default
+
+
+# ----------------------------------------------- compile accounting
+
+def test_compile_records_in_solve_runlog(blobs_small, tmp_path):
+    """An executor built during a live run yields a `compile` runlog
+    record naming the dispatch label, plus the compiles_total counter
+    in the final metrics dump. A UNIQUE static arg (epsilon) forces a
+    genuinely fresh compile inside the observed solve."""
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_small
+    cfg = SVMConfig(c=2.0, epsilon=1.23456e-3, obs=ObsConfig(
+        enabled=True, runlog_dir=str(tmp_path)))
+    r = solve(x, y, cfg)
+    recs = read_runlog(r.stats["obs_runlog"])
+    compiles = records_for(recs, r.stats["obs_run_id"], "compile")
+    assert compiles, "no compile records for a fresh-epsilon solve"
+    assert any(c["entrypoint"] == "solver/chunk" for c in compiles)
+    assert all(c["seconds"] > 0 for c in compiles)
+    assert all("shape" in c for c in compiles)
+    final = records_for(recs, r.stats["obs_run_id"], "final")[0]
+    assert final["metrics"]["solve.compiles_total"] == len(compiles)
+    # A warm re-solve of the SAME program records zero compiles.
+    r2 = solve(x, y, cfg)
+    recs2 = read_runlog(r2.stats["obs_runlog"])
+    assert records_for(recs2, r2.stats["obs_run_id"], "compile") == []
+
+
+def test_serve_compiles_not_cross_inflated():
+    """Two live servers share the "serve/bucket*" label namespace; the
+    per-server counter must attribute a compile to the server whose
+    dispatch triggered it, not to every server alive (review fix)."""
+    from dpsvm_tpu.serve import PredictServer
+
+    # d=9 is this test's own shape: its bucket executors cannot be
+    # warm from other tests, so srv2's warm() must compile.
+    m, _ = _tiny_multiclass(d=9)
+    srv1 = PredictServer(m, ServeConfig(buckets=(16,)))
+    c1 = srv1.compiles.value
+    srv2 = PredictServer(m, ServeConfig(buckets=(32,)))
+    try:
+        assert srv2.compiles.value >= 1  # its own warm-up compile
+        assert srv1.compiles.value == c1  # not srv2's
+    finally:
+        srv1.close()
+        srv2.close()
+
+
+def test_server_collectable_without_close():
+    """An API user who drops a server without close() (legal pre-PR8:
+    close was 'a no-op when obs is disabled') must not leak it — the
+    compile sink and the exporter's render callback hold the server
+    WEAKLY (review fix; the RunObs discipline)."""
+    import gc
+    import weakref
+
+    from dpsvm_tpu.serve import PredictServer
+
+    m, _ = _tiny_multiclass()
+    srv = PredictServer(m, ServeConfig(buckets=(16,), metrics_port=0))
+    exporter = srv.exporter
+    url = exporter.url
+    r = weakref.ref(srv)
+    del srv
+    gc.collect()
+    assert r() is None, "dropped server still referenced"
+    # The orphan exporter thread degrades to an empty exposition
+    # until process exit (daemon thread) — it must still answer.
+    text = _scrape(url)
+    assert text == "# EOF\n"
+    exporter.close()
+
+
+def test_compilelog_label_nesting_and_counter():
+    from dpsvm_tpu.obs import compilelog
+
+    base = compilelog.compiles_total()
+    seen = []
+    sink = lambda name, shape, secs: seen.append((name, shape))  # noqa: E731
+    compilelog.add_sink(sink)
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        with compilelog.label("outer"), \
+                compilelog.label("test/inner", "(3,)"):
+            jax.jit(lambda v: v * 3.14159 + 2.71828)(
+                jnp.arange(3.0)).block_until_ready()
+    finally:
+        compilelog.remove_sink(sink)
+    assert compilelog.compiles_total() > base
+    assert ("test/inner", "(3,)") in seen
+    # Exited labels must not leak onto later compiles.
+    assert not compilelog._labels
 
 
 # ------------------------------------------------- solver runlog facts

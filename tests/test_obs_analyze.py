@@ -1,0 +1,307 @@
+"""Runlog analytics (dpsvm_tpu/obs/analyze — ISSUE 8): summaries,
+stall-window detection, per-phase diff attribution, tail, and the
+`cli obs` surface. Pure JSONL readers — no device work; runlogs are
+synthesized through the real RunLog writer so the reader is exercised
+against the schema the spine actually emits."""
+
+import json
+
+import pytest
+
+import bench
+from dpsvm_tpu.obs import analyze
+from dpsvm_tpu.obs.runlog import RunLog
+
+
+def _write_solve_run(path, pairs_per_chunk=(100, 100, 100),
+                     gaps=(1.0, 0.5, 0.01), device_s=0.1,
+                     phase_seconds=None, tool="solve",
+                     converged=True, compiles=0):
+    """One synthetic solve run through the REAL writer."""
+    log = RunLog(str(path), tool, meta={"n": 1000, "d": 8,
+                                        "engine": "block"})
+    total = 0
+    for i, (p, g) in enumerate(zip(pairs_per_chunk, gaps)):
+        total += p
+        log.record("chunk", pairs=total, pairs_delta=p, b_hi=-g / 2,
+                   b_lo=g / 2, gap=g, device_seconds=device_s,
+                   dispatch=i + 1)
+    for i in range(compiles):
+        log.record("compile", entrypoint="solver/chunk",
+                   shape="n=1000 d=8", seconds=0.5)
+    ph = phase_seconds or {"setup": 0.2,
+                           "solve": device_s * len(pairs_per_chunk),
+                           "observe": 0.01, "finalize": 0.02}
+    log.finish(iterations=total, converged=converged,
+               phase_seconds=ph)
+    return log.run_id
+
+
+# ------------------------------------------------------- summaries
+
+def test_summary_throughput_and_gap(tmp_path):
+    p = tmp_path / "solve-1.jsonl"
+    _write_solve_run(p)
+    (run,) = analyze.load_runs([str(p)])
+    s = analyze.summarize_run(run)
+    assert s["tool"] == "solve" and s["engine"] == "block"
+    assert s["pairs"] == 300 and s["chunks"] == 3
+    assert s["device_seconds"] == pytest.approx(0.3)
+    assert s["pairs_per_second"] == 1000
+    assert s["gap_first"] == 1.0 and s["gap_last"] == 0.01
+    assert s["stalls"] == {"count": 0, "longest": 0}
+    assert s["converged"] is True and s["finished"] is True
+    assert s["compiles"] == 0
+    json.dumps(s)  # JSON-able
+
+
+def test_summary_detects_stall_windows(tmp_path):
+    """Chunks whose gap stops shrinking form stall windows — the
+    working-set-cycling diagnostic."""
+    p = tmp_path / "solve-1.jsonl"
+    _write_solve_run(p, pairs_per_chunk=(10,) * 6,
+                     gaps=(1.0, 0.5, 0.5, 0.5, 0.2, 0.2))
+    (run,) = analyze.load_runs([str(p)])
+    s = analyze.summarize_run(run)
+    # 0.5->0.5->0.5 is one 2-chunk window; 0.2->0.2 a second 1-chunk.
+    assert s["stalls"] == {"count": 2, "longest": 2}
+
+
+def test_directory_and_tool_filter(tmp_path):
+    _write_solve_run(tmp_path / "solve-1.jsonl")
+    _write_solve_run(tmp_path / "fleet-1.jsonl", tool="fleet")
+    runs = analyze.load_runs([str(tmp_path)])
+    assert {r.manifest["tool"] for r in runs} == {"solve", "fleet"}
+    assert analyze.runlog_paths([str(tmp_path)]) == sorted(
+        str(tmp_path / n) for n in ("fleet-1.jsonl", "solve-1.jsonl"))
+    with pytest.raises(FileNotFoundError):
+        analyze.runlog_paths([str(tmp_path / "absent.jsonl")])
+
+
+def test_report_renders_text_and_md(tmp_path):
+    _write_solve_run(tmp_path / "solve-1.jsonl", compiles=2)
+    runs = analyze.load_runs([str(tmp_path)])
+    summaries = [analyze.summarize_run(r) for r in runs]
+    txt = analyze.render_report(summaries)
+    assert "solve" in txt and "pairs/s" in txt
+    assert "2 compile(s)" in txt
+    md = analyze.render_report(summaries, md=True)
+    assert md.splitlines()[0].startswith("| tool |")
+    assert md.splitlines()[1].startswith("|---")
+
+
+# ------------------------------------------------------------- diff
+
+def _summary_for(tmp_path, name, **kw):
+    p = tmp_path / name
+    _write_solve_run(p, **kw)
+    (run,) = analyze.load_runs([str(p)])
+    return analyze.summarize_run(run)
+
+
+def test_diff_attributes_injected_solve_slowdown(tmp_path):
+    """Acceptance (ISSUE 8): a synthetically injected per-phase
+    slowdown is attributed to the CORRECT phase."""
+    base = {"setup": 0.2, "solve": 1.0, "observe": 0.05,
+            "finalize": 0.02}
+    slow = dict(base, solve=1.8)  # inject: solve phase +0.8s
+    a = _summary_for(tmp_path, "solve-a.jsonl", phase_seconds=base)
+    b = _summary_for(tmp_path, "solve-b.jsonl", phase_seconds=slow)
+    d = analyze.diff_runs(a, b)
+    assert d["attributed_phase"] == "solve"
+    assert d["phase_deltas"]["solve"] == pytest.approx(0.8)
+    assert d["total_delta_seconds"] == pytest.approx(0.8)
+    assert d["attributed_share"] == pytest.approx(1.0)
+    # ... and an observe-phase injection lands on observe, even with
+    # noise elsewhere.
+    noisy = dict(base, observe=0.55, setup=0.21)
+    c = _summary_for(tmp_path, "solve-c.jsonl", phase_seconds=noisy)
+    d2 = analyze.diff_runs(a, c)
+    assert d2["attributed_phase"] == "observe"
+    txt = analyze.render_diff(d2)
+    assert "attribution: phase 'observe'" in txt
+
+
+def test_diff_share_sane_with_offsetting_phases(tmp_path):
+    """Offsetting phases (setup slower, solve faster) are the case
+    attribution exists for: the share is of the GROSS movement, so it
+    can never exceed 100% (review fix)."""
+    a = _summary_for(tmp_path, "solve-a.jsonl",
+                     phase_seconds={"setup": 1.0, "solve": 5.0})
+    b = _summary_for(tmp_path, "solve-b.jsonl",
+                     phase_seconds={"setup": 3.0, "solve": 3.5})
+    d = analyze.diff_runs(a, b)
+    assert d["attributed_phase"] == "setup"
+    assert d["total_delta_seconds"] == pytest.approx(0.5)
+    assert d["attributed_share"] == pytest.approx(2.0 / 3.5, abs=1e-4)
+    assert d["attributed_share"] <= 1.0
+    assert "gross movement" in analyze.render_diff(d)
+
+
+def test_diff_reports_pairs_per_second_and_compiles(tmp_path):
+    a = _summary_for(tmp_path, "solve-a.jsonl", device_s=0.1)
+    b = _summary_for(tmp_path, "solve-b.jsonl", device_s=0.2,
+                     compiles=3)
+    d = analyze.diff_runs(a, b)
+    assert d["pairs_per_second_delta"] == pytest.approx(-0.5)
+    assert d["compile_delta"] == 3
+    json.dumps(d)
+
+
+def test_pick_run_prefers_last_finished(tmp_path):
+    p = tmp_path / "solve-1.jsonl"
+    r1 = _write_solve_run(p)
+    r2 = _write_solve_run(p)
+    # An OPEN third run (no final record) must not win.
+    log = RunLog(str(p), "solve")
+    log.record("chunk", pairs=1, pairs_delta=1, gap=1.0,
+               device_seconds=0.1, dispatch=1)
+    open_id = log.run_id
+    runs = analyze.load_runs([str(p)])
+    assert analyze.pick_run(runs).run_id == r2
+    assert analyze.pick_run(runs, run_id=r1).run_id == r1
+    assert analyze.pick_run(runs, run_id=open_id).run_id == open_id
+    with pytest.raises(KeyError):
+        analyze.pick_run(runs, run_id="nope")
+    log.finish()
+
+
+# ------------------------------------------------------------- tail
+
+def test_tail_last_records(tmp_path):
+    p = tmp_path / "solve-1.jsonl"
+    _write_solve_run(p)
+    lines = analyze.tail_records(str(p), 2)
+    assert len(lines) == 2
+    assert "final" in lines[-1] and "iterations=300" in lines[-1]
+    assert "chunk" in lines[0]
+    # n <= 0 means zero records, not the whole stream ([-0:] footgun).
+    assert analyze.tail_records(str(p), 0) == []
+    assert analyze.tail_records(str(p), -3) == []
+
+
+def test_pick_run_orders_by_manifest_utc_not_filename(tmp_path,
+                                                      monkeypatch):
+    """A dir can hold solve-400.jsonl written AFTER solve-5000.jsonl
+    (pids don't sort by time): 'last finished run' must follow the
+    manifest utc stamp, not lexical file order."""
+    import time as time_mod
+
+    from dpsvm_tpu.obs import runlog as runlog_mod
+
+    real_strftime = time_mod.strftime
+
+    def _at(stamp):
+        monkeypatch.setattr(
+            runlog_mod.time, "strftime",
+            lambda fmt, *a, _s=stamp: _s if "%Y" in fmt
+            else real_strftime(fmt, *a))
+
+    _at("2026-08-04T10:00:00Z")  # older run, lexically LATER file
+    _write_solve_run(tmp_path / "solve-5000.jsonl")
+    _at("2026-08-04T11:00:00Z")  # newer run, lexically earlier file
+    newer = _write_solve_run(tmp_path / "solve-400.jsonl")
+    runs = analyze.load_runs([str(tmp_path)])
+    assert analyze.pick_run(runs).run_id == newer
+
+
+# -------------------------------------------------------------- CLI
+
+def test_cli_obs_report_and_diff(tmp_path, capsys):
+    from dpsvm_tpu import cli
+
+    _write_solve_run(tmp_path / "solve-a.jsonl",
+                     phase_seconds={"setup": 0.1, "solve": 1.0,
+                                    "observe": 0.01, "finalize": 0.01})
+    _write_solve_run(tmp_path / "solve-b.jsonl",
+                     phase_seconds={"setup": 0.1, "solve": 2.0,
+                                    "observe": 0.01, "finalize": 0.01})
+    rc = cli.main(["obs", "report", str(tmp_path), "--md"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.startswith("| tool |") and "solve" in out
+
+    rc = cli.main(["obs", "diff", str(tmp_path / "solve-a.jsonl"),
+                   str(tmp_path / "solve-b.jsonl"), "--json"])
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["attributed_phase"] == "solve"
+
+    rc = cli.main(["obs", "tail", str(tmp_path / "solve-a.jsonl"),
+                   "-n", "3"])
+    assert rc == 0
+    assert len(capsys.readouterr().out.strip().splitlines()) == 3
+
+    assert cli.main(["obs", "report", str(tmp_path / "nope.jsonl")]) \
+        == 2
+    # A directory where a file is expected is the one-line-error exit-2
+    # contract too, not an IsADirectoryError traceback (review fix).
+    assert cli.main(["obs", "tail", str(tmp_path)]) == 2
+    # ... and a glob matching only a subdirectory reports no-runlog.
+    (tmp_path / "sub.jsonl").mkdir()
+    assert cli.main(["obs", "report", str(tmp_path / "sub.*")]) == 2
+
+
+def test_cli_obs_report_json_lines(tmp_path, capsys):
+    from dpsvm_tpu import cli
+
+    _write_solve_run(tmp_path / "solve-a.jsonl")
+    rc = cli.main(["obs", "report", str(tmp_path), "--json"])
+    assert rc == 0
+    rows = [json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()]
+    assert rows and rows[0]["pairs"] == 300
+
+
+# ----------------------------------------- bench per-phase gate ties
+
+def test_bench_gate_flags_injected_phase_regression(tmp_path):
+    """bench.py's gate extension (ISSUE 8): a per-phase slowdown is
+    FLAGged and named even when the headline metric stays in band."""
+    prev = {"pairs_per_second": 700_000,
+            "session_calibration": {"best_of_5_seconds": 0.5},
+            "phase_seconds": {"setup": 1.0, "solve": 5.0,
+                              "observe": 0.2, "finalize": 0.1}}
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(prev))
+    cur = {"pairs_per_second": 690_000,  # headline well in band
+           "session_calibration": {"best_of_5_seconds": 0.5},
+           "phase_seconds": {"setup": 1.6, "solve": 5.05,
+                             "observe": 0.2, "finalize": 0.1}}
+    out = bench._regression_gate(cur, str(tmp_path))
+    assert out["regression_gate"] == "PASS"
+    assert out["phase_gate"] == "FLAG"
+    assert out["phase_flags"] == ["setup"]
+    assert out["phase_deltas"]["setup"] == pytest.approx(0.6, abs=0.01)
+    assert out["phase_deltas"]["solve"] == pytest.approx(0.01,
+                                                         abs=0.001)
+
+
+def test_bench_gate_phase_normalization_and_noise_floor(tmp_path):
+    prev = {"pairs_per_second": 700_000,
+            "session_calibration": {"best_of_5_seconds": 0.5},
+            "phase_seconds": {"setup": 1.0, "solve": 5.0,
+                              "observe": 0.002, "finalize": 0.1}}
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(prev))
+    # 10% slower session (calibration 0.55): raw +12% solve seconds
+    # normalize back into band -> PASS...
+    cur = {"pairs_per_second": 630_000,
+           "session_calibration": {"best_of_5_seconds": 0.55},
+           "phase_seconds": {"setup": 1.1, "solve": 5.6,
+                             "observe": 0.02, "finalize": 0.11}}
+    out = bench._regression_gate(cur, str(tmp_path))
+    assert out["phase_gate"] == "PASS"
+    # ...observe grew 10x but carried 0.04% of the run: noise floor
+    # keeps it out of the flags (it still shows in the deltas).
+    assert "observe" not in out["phase_flags"]
+    assert out["phase_deltas"]["observe"] > 1.0
+
+
+def test_bench_gate_no_phase_data_is_silent(tmp_path):
+    (tmp_path / "BENCH_r06.json").write_text(json.dumps(
+        {"pairs_per_second": 700_000,
+         "session_calibration": {"best_of_5_seconds": 0.5}}))
+    cur = {"pairs_per_second": 700_000,
+           "session_calibration": {"best_of_5_seconds": 0.5},
+           "phase_seconds": {"setup": 1.0, "solve": 5.0}}
+    out = bench._regression_gate(cur, str(tmp_path))
+    assert "phase_gate" not in out  # pre-PR8 baseline: no phase data

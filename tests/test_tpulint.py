@@ -244,6 +244,43 @@ def test_entry_facts_counts_dispatches():
     assert set(facts["units"]) == {"one", "two"}
 
 
+# ------------------------------------------------- memory accounting
+
+def test_memory_facts_present_and_deterministic():
+    """The ISSUE 8 HBM-accounting facts: unit_facts carries a `memory`
+    family (argument/output/temp/alias bytes from XLA's own
+    memory_analysis) whose values are DETERMINISTIC across
+    re-extraction — the property that lets budgets pin them with zero
+    drift on regeneration."""
+    a = SDS((64, 32), jnp.float32)
+
+    def build():
+        return unit_facts(Unit(
+            "m", lambda: jax.jit(lambda x: (x @ x.T).sum(0)).lower(a)))
+
+    f1, f2 = build(), build()
+    mem = f1["memory"]
+    assert set(mem) == {"argument_bytes", "output_bytes", "temp_bytes",
+                        "alias_bytes"}
+    assert mem["argument_bytes"] == 64 * 32 * 4
+    assert mem["output_bytes"] == 64 * 4
+    assert all(isinstance(v, int) and v >= 0 for v in mem.values())
+    assert f1["memory"] == f2["memory"]  # zero drift on re-extraction
+
+
+def test_memory_facts_see_donation_as_alias_bytes():
+    """A donated carry shows up as alias_bytes — the footprint saving
+    the donation satellite (PR 5) bought, now a pinned number."""
+    a = SDS((256,), jnp.float32)
+    donated = unit_facts(Unit(
+        "d", lambda: jax.jit(lambda x: x * 2.0,
+                             donate_argnums=0).lower(a)))
+    plain = unit_facts(Unit(
+        "p", lambda: jax.jit(lambda x: x * 2.0).lower(a)))
+    assert donated["memory"]["alias_bytes"] == 256 * 4
+    assert plain["memory"]["alias_bytes"] == 0
+
+
 # ------------------------------------- the committed budgets (tier-1)
 
 def test_manifest_budgets_pass_against_committed(monkeypatch):
@@ -254,15 +291,17 @@ def test_manifest_budgets_pass_against_committed(monkeypatch):
     fails here with the entry and fact path in the message.
 
     Runs with the telemetry spine ENABLED (DPSVM_OBS=1 + a live
-    registry — ISSUE 7): observability must change NO compiled HLO
-    fact on any manifest entrypoint, so checking the budgets under obs
-    pins the zero-device-effect contract AND the structural contracts
-    in one pass (obs off is a strict subset: the instrumented code
-    paths simply don't run)."""
+    registry — ISSUE 7) AND a live /metrics exporter thread serving
+    scrapes throughout the extraction (ISSUE 8): observability — run
+    logs, registry metrics, compile sinks, the HTTP endpoint — must
+    change NO compiled HLO fact (including the new `memory.*` family)
+    on any manifest entrypoint (obs off is a strict subset: the
+    instrumented code paths simply don't run)."""
     from dpsvm_tpu.analysis import budget
     from dpsvm_tpu.analysis.extract import extract_entries
     from dpsvm_tpu.analysis.manifest import MANIFEST, require_devices
     from dpsvm_tpu.obs import metrics as obs_metrics
+    from dpsvm_tpu.obs.export import MetricsExporter
 
     monkeypatch.setenv("DPSVM_OBS", "1")
     # Re-resolve the default registry from the patched env; monkeypatch
@@ -279,7 +318,17 @@ def test_manifest_budgets_pass_against_committed(monkeypatch):
             "tpulint job (tier1.yml) is the gate for this check")
 
     require_devices()
-    observed = extract_entries(MANIFEST)
+    with MetricsExporter(lambda: "# EOF\n", port=0) as exporter:
+        # The endpoint answers while the whole manifest traces and
+        # compiles in this process — the "budget check stays at zero
+        # diffs with the exporter running" acceptance pin.
+        import urllib.request
+
+        with urllib.request.urlopen(exporter.url, timeout=10) as r:
+            assert r.status == 200
+        observed = extract_entries(MANIFEST)
+        with urllib.request.urlopen(exporter.url, timeout=10) as r:
+            assert r.read().decode().endswith("# EOF\n")
     results = [budget.check_entry(entry, facts)
                for entry, facts in observed.items()]
     results += [{"entry": e, "verdict": budget.ORPHAN, "diffs": [],

@@ -160,6 +160,37 @@ def _ab_record(m, nb: int, label: str) -> dict:
     }
 
 
+def _scrape_metrics(server) -> dict:
+    """GET the server's own /metrics endpoint and validate the
+    exposition: 200, OpenMetrics-terminated (# EOF), and the
+    request-latency quantiles EQUAL the shared histogram's
+    percentiles() — endpoint and snapshot report one definition."""
+    import urllib.request
+
+    url = server.exporter.url
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        status = resp.status
+        text = resp.read().decode("utf-8")
+    lines = text.splitlines()
+    pct = server.request_seconds.percentiles()
+    quantiles_ok = all(
+        any(ln.startswith("serve_request_seconds{")
+            and f'quantile="{q / 100:g}"' in ln
+            and float(ln.rsplit(" ", 1)[-1]) == pct[f"p{q}"]
+            for ln in lines)
+        for q in (50, 95, 99)) if pct else False
+    return {
+        "url": url,
+        "status": status,
+        "lines": len(lines),
+        "families": sum(1 for ln in lines if ln.startswith("# TYPE ")),
+        "eof_terminated": bool(lines and lines[-1] == "# EOF"),
+        "quantiles_match_snapshot": quantiles_ok,
+        "ok": bool(status == 200 and lines and lines[-1] == "# EOF"
+                   and quantiles_ok),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--pool", type=int, default=2048,
@@ -184,7 +215,12 @@ def main(argv=None) -> int:
     from dpsvm_tpu.config import ObsConfig, ServeConfig
     from dpsvm_tpu.serve import PredictServer, offered_load_sweep
 
-    serve_cfg = ServeConfig(obs=ObsConfig(enabled=args.obs,
+    # metrics_port=0: every sweep server exposes /metrics on an
+    # ephemeral port so the benchmark can SCRAPE ITSELF mid-sweep —
+    # proving the endpoint answers (and parses) under live traffic,
+    # not just on an idle server.
+    serve_cfg = ServeConfig(metrics_port=0,
+                            obs=ObsConfig(enabled=args.obs,
                                           runlog_dir=args.obs_dir))
 
     dev = jax.devices()[0]
@@ -215,6 +251,15 @@ def main(argv=None) -> int:
     server = PredictServer(mnist_ovo, serve_cfg)
     sweep_mnist = offered_load_sweep(server, sizes, args.requests,
                                      group=8, seed=0)
+    # Mid-sweep self-scrape (ISSUE 8): hit the server's own /metrics
+    # endpoint while its histograms are hot and verify the exposition
+    # is OpenMetrics-complete and carries the request-latency summary
+    # the sweep above just reported from the SAME instruments.
+    scrape = _scrape_metrics(server)
+    print(f"[bench_serve] /metrics self-scrape: {scrape['url']} "
+          f"ok={scrape['ok']} ({scrape['lines']} lines, "
+          f"{scrape['families']} families)", file=sys.stderr)
+    assert scrape["ok"], scrape
     server_cov = PredictServer(covtype_ovr, serve_cfg)
     sweep_cov = offered_load_sweep(server_cov, sizes, args.requests,
                                    group=8, seed=0)
@@ -251,6 +296,13 @@ def main(argv=None) -> int:
         # (dpsvm_tpu/obs/runlog.SCHEMA_VERSION via bench).
         "schema_version": bench._schema_version(),
         "session_calibration": calibration,
+        # Mid-sweep /metrics self-scrape (ISSUE 8): the endpoint
+        # answered under live traffic with an OpenMetrics-complete
+        # exposition whose quantiles equal the snapshot's.
+        "metrics_scrape": {k: scrape[k] for k in
+                           ("status", "lines", "families",
+                            "eof_terminated",
+                            "quantiles_match_snapshot", "ok")},
     }
     if server._obs.live:
         result["runlog"] = server._obs.path
