@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets bench bench_predict bench_serve fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets bench bench_ooc_smoke bench_predict bench_serve fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -51,6 +51,14 @@ lint_budgets:
 
 bench:
 	$(PY) bench.py
+
+# Out-of-core smoke (ISSUE 9): the --ooc benchmark leg on the CPU
+# harness with the telemetry spine live — host-resident X, double-
+# buffered tile stream, block cache — producing a gateable
+# ooc_pairs_per_second JSON whose run log carries the tile-fetch and
+# cache-hit counters (commit the output as BENCH_OOC_r<NN>.json).
+bench_ooc_smoke:
+	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) bench.py --ooc --obs
 
 smoke:
 	$(PY) -m dpsvm_tpu.cli smoke

@@ -32,6 +32,10 @@ GAMMA = 0.1
 # Serving / inference shapes: S union rows, K submodel columns, M_PAD
 # padded per-model SV slots, NB query rows per bucket.
 S_UNION, K_MODELS, M_PAD, NB = 256, 10, 64, 64
+# Out-of-core tile shape (ops/ooc.ooc_fold_tile): rows per streamed
+# tile. The entry's shapes are a pure function of (T_TILE, D, Q) —
+# never of total n — which is the contract its budget exists to pin.
+T_TILE = 512
 
 
 def require_devices() -> None:
@@ -194,6 +198,39 @@ def shardlocal_chunk():
             _obs_unit()]
 
 
+def ooc_fold_tile(n_total: int = N):
+    """Out-of-core per-tile fold (ISSUE 9): the ONE program dispatched
+    per streamed tile of the ooc round. Its budget pins the whole
+    out-of-core contract statically:
+
+    * transfers: zero in-program host round-trips — the per-tile H2D
+      is exactly ONE device_put of the (T_TILE, D) tile outside the
+      program, whose size the memory facts' argument_bytes records;
+    * collectives: zero (single-chip by construction);
+    * donation: the gradient slice is donated into the folded output
+      (declared_donated covers f_tile + err_tile) — missed stays 0;
+    * memory: argument/output/temp bytes are a function of
+      (T_TILE, D, Q) ONLY. ``n_total`` is accepted and deliberately
+      never reaches any shape (the tile clamp is its only use) so the
+      n-independence is mutation-testable: tests/test_tpulint.py
+      rebuilds this entry with n_total doubled and asserts the facts
+      are byte-identical to the committed budget.
+    """
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.ops.ooc import ooc_fold_tile as fold
+
+    t = min(T_TILE, n_total)  # a tile never exceeds the data
+    args = (_sds((t, D), jnp.float32), _sds((t,), jnp.float32),
+            _sds((t,), jnp.float32), None,
+            _sds((Q, D), jnp.float32), _sds((Q,), jnp.float32),
+            _sds((Q,), jnp.float32))
+    kw = dict(kp=_kp(), want_dots=True, compensated=False)
+    return [Unit("fold_tile", lambda: fold.lower(*args, **kw),
+                 _jaxpr_of(fold, *args, **kw))]
+
+
 def compacted_decision():
     """Shared-SV compacted multiclass decision (PR 3): ONE feature-dim
     kernel matmul per query block, NO rank-3 stacked product."""
@@ -281,6 +318,7 @@ MANIFEST = {
     "mesh_chunk": mesh_chunk,
     "pipelined_chunk": pipelined_chunk,
     "shardlocal_chunk": shardlocal_chunk,
+    "ooc_fold_tile": ooc_fold_tile,
     "compacted_decision": compacted_decision,
     "serve_bucket": serve_bucket,
     "serve_bucket_bf16": serve_bucket_bf16,

@@ -117,6 +117,21 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "solve/fold rounds between cross-shard syncs "
                         "(Cascade-style; needs --local-working-sets "
                         ">= 2; default 1)")
+    p.add_argument("--ooc", action="store_true",
+                   help="out-of-core training (block engine): X stays "
+                        "in HOST memory and the per-round gradient fold "
+                        "streams over double-buffered host->HBM tiles, "
+                        "so trainable n is bounded by host memory, not "
+                        "HBM (SVMConfig.ooc; solver/ooc.py)")
+    p.add_argument("--ooc-tile-rows", type=int, default=8192,
+                   help="--ooc: rows per streamed X tile (the H2D "
+                        "double-buffer unit; default 8192)")
+    p.add_argument("--ooc-cache-lines", type=int, default=0,
+                   help="--ooc: lines of the HBM kernel-dot-row cache "
+                        "keyed by training-row index (scatter-refresh "
+                        "LRU; a round whose whole working set hits "
+                        "skips the tile stream entirely). 0 = off; "
+                        "must be >= --working-set-size")
     p.add_argument("--active-set-size", type=int, default=0,
                    help="block engine: shrink per-round work to the m "
                         "most-violating rows, reconciling the full "
@@ -481,6 +496,8 @@ def _cmd_train(args) -> int:
             sync_rounds=args.sync_rounds,
             active_set_size=args.active_set_size,
             reconcile_rounds=args.reconcile_rounds,
+            ooc=args.ooc, ooc_tile_rows=args.ooc_tile_rows,
+            ooc_cache_lines=args.ooc_cache_lines,
             dtype=args.dtype, chunk_iters=args.chunk_iters,
             checkpoint_every=args.checkpoint_every,
             retry_faults=args.retry_faults, verbose=not args.quiet,

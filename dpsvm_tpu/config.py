@@ -270,6 +270,36 @@ class SVMConfig:
     compensated: bool = False
     reconstruct_every: int = 0
 
+    # Out-of-core training (solver/ooc.py; the TPU re-derivation of the
+    # reference's storage hierarchy: its cache.cu LRU of kernel dot rows
+    # was what let it scale past device memory). When True, X stays in
+    # HOST memory (np array or np.memmap) and never fully materializes
+    # in HBM: the block engine's per-round (q, d) x (d, n) gradient fold
+    # streams over (ooc_tile_rows, d) tiles with double buffering —
+    # tile t+1's async host->HBM device_put overlaps tile t's
+    # partial-fold matmul on the MXU — so the trainable-n ceiling moves
+    # from "X fits in HBM" to "X fits on the host". Device-resident
+    # state is O(n) vectors (f, alpha, y, x_sq) plus the tile pool plus
+    # the optional block cache below; the (n, d) matrix itself never is.
+    # Engine='block' with selection in {mvp, second_order}; feature
+    # kernels only. Bit-identical to the in-core block engine where
+    # both fit (tests/test_ooc.py pins it).
+    #
+    # ooc_tile_rows: rows per streamed tile (the unit of the H2D
+    # double buffer; n is padded up to a multiple of it).
+    #
+    # ooc_cache_lines: extend the solver/cache.py discipline (static-
+    # shape data/keys/ticks arrays, scatter-refresh LRU) to the block
+    # engine: an (ooc_cache_lines, n) HBM cache of hot kernel DOT rows
+    # keyed by training-row index. A round whose whole working set hits
+    # skips the tile stream AND the recompute entirely — near
+    # convergence the selection concentrates on a stable set of support
+    # vectors, exactly the regime Joachims' shrinking exploits. 0 = off;
+    # must be >= working_set_size so one round's misses always fit.
+    ooc: bool = False
+    ooc_tile_rows: int = 8192
+    ooc_cache_lines: int = 0
+
     # Resident-Gram acceleration for the per-pair engine (no reference
     # equivalent — it is the 100%-hit-rate limit of the reference's LRU
     # row cache, cache.cu). When on, the solver materializes the full
@@ -529,6 +559,71 @@ class SVMConfig:
                     "the active view re-indexes rows but the Gram block "
                     "gather needs global column ids); set "
                     "active_set_size=0")
+        if self.ooc:
+            if self.engine != "block":
+                raise ValueError(
+                    "ooc (out-of-core streaming) is a block-engine path "
+                    "(the per-pair engines would stream the full X per "
+                    "PAIR instead of per round); use engine='block'")
+            if self.kernel == "precomputed":
+                raise ValueError(
+                    "ooc supports feature kernels only (a precomputed "
+                    "(n, n) Gram matrix is the thing that does not fit "
+                    "— recompute kernels from streamed features instead)")
+            if self.selection == "nu":
+                raise ValueError(
+                    "ooc supports selection in {'mvp', 'second_order'} "
+                    "(the nu trainers fall back to the in-core engines)")
+            if self.gram_resident:
+                raise ValueError(
+                    "ooc and gram_resident are opposite regimes (the "
+                    "resident Gram assumes O(n^2) fits HBM; ooc assumes "
+                    "even O(n d) does not) — use one or the other")
+            if self.active_set_size:
+                raise ValueError(
+                    "ooc does not compose with active_set_size (the ooc "
+                    "round already touches only the working set between "
+                    "folds; the active cycle's deferred reconciliation "
+                    "would need a second full stream) — use one or the "
+                    "other")
+            if self.pipeline_rounds:
+                raise ValueError(
+                    "ooc does not compose with pipeline_rounds (the ooc "
+                    "round's overlap is the H2D-vs-MXU double buffer "
+                    "inside the fold; the next round's selection needs "
+                    "the streamed fold complete) — use one or the other")
+            if self.fused_fold:
+                raise ValueError(
+                    "ooc does not compose with fused_fold=True (the "
+                    "fused fold+select pass assumes the full-n fold "
+                    "happens in one kernel; the ooc fold is tiled by "
+                    "design) — leave fused_fold unset")
+            if self.local_working_sets is not None:
+                raise ValueError(
+                    "ooc is single-chip (tiles stream from one host "
+                    "process); leave local_working_sets unset")
+            if self.reconstruct_every:
+                raise ValueError(
+                    "ooc does not compose with reconstruct_every (the "
+                    "f64 reconstruction legs re-gather the full X "
+                    "host-side; run them on the in-core engines)")
+        if self.ooc_tile_rows < 8:
+            raise ValueError("ooc_tile_rows must be >= 8")
+        if self.ooc_cache_lines < 0:
+            raise ValueError("ooc_cache_lines must be >= 0 (0 = off)")
+        if self.ooc_cache_lines and not self.ooc:
+            raise ValueError(
+                "ooc_cache_lines is the ooc block cache's size; set "
+                "ooc=True (the in-core block engine's working set IS "
+                "its reuse mechanism, and the per-pair LRU is "
+                "cache_lines)")
+        if self.ooc_cache_lines and \
+                self.ooc_cache_lines < self.working_set_size:
+            raise ValueError(
+                "ooc_cache_lines must be >= working_set_size (one "
+                "round's scatter-refresh writes up to working_set_size "
+                "rows at once; a smaller cache would evict lines the "
+                "same round wrote) — raise ooc_cache_lines or set 0")
         if self.matmul_precision not in (None, "default", "high", "highest"):
             raise ValueError(
                 "matmul_precision must be None (auto), 'default', 'high' "

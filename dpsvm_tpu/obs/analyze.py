@@ -163,6 +163,15 @@ def summarize_run(run: Run) -> dict:
         "aborted": bool(fin.get("aborted")) if fin else None,
         "finished": run.final is not None,
         "phase_seconds": phases or None,
+        # Kernel-row cache accounting (ISSUE 9 satellite: the solver
+        # caches were invisible here). Both the per-pair LRU and the
+        # ooc block cache report through the same final-record fields;
+        # None when the run carried no cache.
+        "cache_hit_rate": fin.get("cache_hit_rate"),
+        "cache_hits": fin.get("cache_hits"),
+        "cache_lookups": fin.get("cache_lookups"),
+        "cache_evictions": fin.get("cache_evictions"),
+        "tiles_streamed": fin.get("tiles_streamed"),
     }
     return out
 
@@ -261,7 +270,7 @@ _REPORT_COLS = (
     ("n", "n"), ("d", "d"), ("chunks", "chunks"), ("pairs", "pairs"),
     ("device_s", "device_seconds"), ("pairs/s", "pairs_per_second"),
     ("gap last", "gap_last"), ("stalls", None), ("compiles", "compiles"),
-    ("phases", None), ("done", None),
+    ("cache", None), ("phases", None), ("done", None),
 )
 
 
@@ -273,6 +282,7 @@ def _report_row(s: dict) -> list:
     done = ("conv" if s.get("converged")
             else "abort" if s.get("aborted")
             else "open" if not s.get("finished") else "stop")
+    hr = s.get("cache_hit_rate")
     row = []
     for head, key in _REPORT_COLS:
         if key is not None:
@@ -280,6 +290,11 @@ def _report_row(s: dict) -> list:
         elif head == "stalls":
             row.append(f"{stalls['count']}(max {stalls['longest']})"
                        if stalls["count"] else "0")
+        elif head == "cache":
+            # The cache_hit_rate line (ISSUE 9 satellite): hit rate of
+            # whichever kernel-row cache the run carried (per-pair LRU
+            # or the ooc block cache), "-" when none.
+            row.append(f"{100 * hr:.1f}%" if hr is not None else "-")
         elif head == "phases":
             row.append(ph_txt)
         else:

@@ -1,0 +1,72 @@
+"""Out-of-core tile primitives: the per-tile partial gradient fold.
+
+The in-core block engine's fold is ONE (q, d) x (d, n) pass over the
+device-resident X followed by the gradient accumulate
+(solver/block.py run_local_round). Out of core (config.ooc,
+solver/ooc.py), X lives in host memory and the same fold streams over
+(tile_rows, d) tiles: for each tile the driver issues an async
+host->HBM ``device_put`` of tile t+1 and then dispatches THIS kernel
+on tile t, so the H2D DMA overlaps the MXU matmul instead of
+serializing with it (the double buffer).
+
+The kernel is deliberately TILE-LOCAL: every argument is tile-pool- or
+q-sized, never (n, ...)-sized, so the compiled program — and its
+tpulint budget (``ooc_fold_tile``) — is a pure function of
+(tile_rows, d, q). That is the contract that makes the ooc path's
+device footprint independent of total n: tests/test_tpulint.py
+mutation-verifies that doubling n leaves the budget facts unchanged.
+
+Bit-exactness: the gradient accumulate ``f_tile + coef @ K`` lives
+INSIDE this program, exactly as the in-core round fuses its fold into
+the accumulate — XLA's codegen for the exp/matmul/add chain rounds
+identically whether the column extent is n or tile_rows, but NOT
+whether the final add is fused or dispatched separately (measured on
+the CPU backend; the ooc-vs-in-core bit-identity test in
+tests/test_ooc.py is what holds this in place).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots
+
+
+@partial(jax.jit, donate_argnames=("f_tile", "err_tile"),
+         static_argnames=("kp", "want_dots", "compensated"))
+def ooc_fold_tile(x_tile, xsq_tile, f_tile, err_tile, qx, qsq, coef,
+                  kp: KernelParams, want_dots: bool = False,
+                  compensated: bool = False):
+    """One tile's share of the round fold, applied to the tile's slice
+    of the gradient.
+
+    x_tile   (T, d)  streamed tile of X (storage dtype, f32 or bf16)
+    xsq_tile (T,)    the tile rows' squared norms (from the setup pass)
+    f_tile   (T,)    this tile's slice of the carried gradient
+    err_tile (T,)|None  its Kahan residual slice (config.compensated)
+    qx       (q, d)  working-set rows (same storage dtype)
+    qsq      (q,)    working-set squared norms
+    coef     (q,)    fold coefficients (dalpha * y, dead slots zero)
+
+    Returns (f_tile_new, err_tile_new, dots_tile): the folded gradient
+    slice and — when ``want_dots`` (the block cache is live) — the raw
+    (q, T) dot rows, the cache's currency (solver/cache.py stores DOT
+    rows and re-applies the kernel transform per use, the reference
+    cache.cu discipline); None otherwise, so the cache-off program
+    never materializes them.
+    """
+    from dpsvm_tpu.solver.smo import kahan_add
+
+    with jax.named_scope("ooc_fold_tile"):
+        dots = jnp.dot(qx.astype(x_tile.dtype), x_tile.T,
+                       preferred_element_type=jnp.float32)  # (q, T)
+        k = kernel_from_dots(dots, xsq_tile, qsq, kp)  # (q, T) f32
+        delta = coef @ k  # (T,) f32
+        if compensated:
+            f_new, err_new = kahan_add(f_tile, err_tile, delta)
+        else:
+            f_new, err_new = f_tile + delta, None
+    return f_new, err_new, (dots if want_dots else None)

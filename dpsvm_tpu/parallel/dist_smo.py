@@ -399,6 +399,11 @@ def solve_mesh(
         raise ValueError(
             "selection='nu' is internal to the nu duals — call "
             "train_nusvc/train_nusvr (models/nusvm.py) instead")
+    if config.ooc:
+        raise ValueError(
+            "ooc (out-of-core streaming) is single-chip: the tile "
+            "stream is fed by one host process (solver/ooc.py) — use "
+            "backend='single', or drop --ooc for the mesh engines")
     if config.reconstruct_every:
         # f64 reconstruction legs around the mesh solve — same scheme as
         # the single-chip delegation (solver/reconstruct.py).

@@ -450,6 +450,38 @@ def _solve_subproblem(kb_w, kd_w, slot_ok, alpha_w, y_w, f_w, c,
     return alpha_w, f_w, t
 
 
+def dispatch_subproblem(kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c,
+                        eps: float, tau: float, limit, inner_impl: str,
+                        interpret: bool, selection: str,
+                        pair_batch: int = 1):
+    """The subproblem stage of a block round, factored so every round
+    body — in-core (_round_core), pipelined (run_chunk_block_pipelined)
+    and out-of-core (solver/ooc.py) — dispatches the identical inner
+    engine from whatever (q, q) Gram block it assembled. All inputs and
+    outputs are q-sized: this is the piece that makes the round body
+    tile-composable (nothing in it knows where K(W, W) came from — a
+    fresh matmul, a pipelined prefetch, or the ooc block cache).
+
+    Returns (a_w, coef, t): the updated subproblem alphas, the fold
+    coefficients (dalpha * y, dead slots zeroed), and the executed pair
+    count."""
+    if inner_impl == "pallas":
+        from dpsvm_tpu.ops.pallas_subproblem import (
+            solve_subproblem_pallas)
+
+        a_w, t = solve_subproblem_pallas(
+            kb_w, a_w0, y_w, f_w0, kd_w,
+            slot_ok.astype(jnp.float32),
+            limit, c, eps, tau, rule=selection, interpret=interpret,
+            pair_batch=pair_batch)
+    else:
+        a_w, _, t = _solve_subproblem(
+            kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
+            limit, rule=selection, pair_batch=pair_batch)
+    coef = jnp.where(slot_ok, (a_w - a_w0) * y_w, 0.0)  # (q,)
+    return a_w, coef, t
+
+
 def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
                 kp: KernelParams, c, eps: float, tau: float,
                 q: int, inner_iters: int, inner_impl: str,
@@ -510,20 +542,9 @@ def _round_core(x, y, x_sq, k_diag, f, alpha, valid, budget_left,
     limit = jnp.minimum(jnp.int32(inner_iters), budget_left)
     limit = jnp.where(gap_open, limit, 0)
     with jax.named_scope("block_subproblem"):
-        if inner_impl == "pallas":
-            from dpsvm_tpu.ops.pallas_subproblem import (
-                solve_subproblem_pallas)
-
-            a_w, t = solve_subproblem_pallas(
-                kb_w, a_w0, y_w, f_w0, kd_w,
-                slot_ok.astype(jnp.float32),
-                limit, c, eps, tau, rule=selection, interpret=interpret,
-                pair_batch=pair_batch)
-        else:
-            a_w, _, t = _solve_subproblem(
-                kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
-                limit, rule=selection, pair_batch=pair_batch)
-    coef = jnp.where(slot_ok, (a_w - a_w0) * y_w, 0.0)  # (q,)
+        a_w, coef, t = dispatch_subproblem(
+            kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau, limit,
+            inner_impl, interpret, selection, pair_batch=pair_batch)
     return w, slot_ok, b_hi, b_lo, a_w, coef, t, qx, qsq
 
 
@@ -793,20 +814,10 @@ def run_chunk_block_pipelined(x, y, x_sq, k_diag, valid,
         # gates because ITS extrema come from a fresh mid-body
         # selection; this body's extrema ARE the carry).
         limit = jnp.minimum(jnp.int32(inner_iters), max_iter - st.pairs)
-        if inner_impl == "pallas":
-            from dpsvm_tpu.ops.pallas_subproblem import (
-                solve_subproblem_pallas)
-
-            a_w, t = solve_subproblem_pallas(
-                cand.kb, a_w0, y_w, f_w0, cand.kd,
-                slot_ok.astype(jnp.float32), limit, c, eps, tau,
-                rule=selection, interpret=interpret,
-                pair_batch=pair_batch)
-        else:
-            a_w, _, t = _solve_subproblem(
-                cand.kb, cand.kd, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
-                limit, rule=selection, pair_batch=pair_batch)
-        coef = jnp.where(slot_ok, (a_w - a_w0) * y_w, 0.0)
+        a_w, coef, t = dispatch_subproblem(
+            cand.kb, cand.kd, slot_ok, a_w0, y_w, f_w0, c, eps, tau,
+            limit, inner_impl, interpret, selection,
+            pair_batch=pair_batch)
         # ---- next round's prefetch, from the PRE-fold carry: depends
         # only on (f_cur, st.alpha), never on the subproblem above —
         # the overlap the whole engine exists for.

@@ -132,3 +132,68 @@ def lookup_one(
 
 def _read(data: jax.Array, slot: jax.Array) -> jax.Array:
     return lax.dynamic_index_in_dim(data, slot, axis=0, keepdims=False)
+
+
+# --------------------------------------------------------------------
+# Block-engine extension (ISSUE 9): the same static-shape data/keys/
+# ticks discipline, probed and refreshed for a whole q-sized working
+# set at once instead of one pair. Used by the out-of-core driver
+# (solver/ooc.py): an all-hit round reads its fold rows straight from
+# HBM and skips the host->HBM tile stream entirely.
+
+def probe_rows(keys: jax.Array, w: jax.Array, slot_ok: jax.Array):
+    """Batched cache probe: which working-set slots hold a cached row.
+
+    keys (L,) int32; w (q,) int32; slot_ok (q,) bool (dead filler slots
+    never count as hits). Returns (hit (q,) bool, hit_slot (q,) int32 —
+    junk where ~hit)."""
+    hit_mat = keys[None, :] == w[:, None]  # (q, L)
+    hit = jnp.any(hit_mat, axis=1) & slot_ok
+    hit_slot = jnp.argmax(hit_mat, axis=1).astype(jnp.int32)
+    return hit, hit_slot
+
+
+def refresh_rows(cache: CacheState, w: jax.Array, slot_ok: jax.Array,
+                 rows: jax.Array, stamp: jax.Array):
+    """Scatter-refresh the whole working set in one static-shape pass:
+    hits overwrite their own line (and re-stamp), misses claim the q
+    least-recently-used lines (hit lines masked out of the victim
+    pool). Requires L >= q — one round's misses must always fit, which
+    is what SVMConfig.ooc_cache_lines validates.
+
+    rows (q, n): the freshly computed dot rows for every slot (hits
+    included — rewriting a hit with identical values is cheaper than a
+    gather/select dance, and keeps the write static-shape). Dead slots
+    (slot_ok False) never scatter.
+
+    Returns (new_cache, n_hits, n_evictions) with the counters int32 —
+    an eviction is a live miss landing on a line that held a real key.
+    """
+    lines = cache.keys.shape[0]
+    q = w.shape[0]
+    hit, hit_slot = probe_rows(cache.keys, w, slot_ok)
+    # Victim pool: the q least-recently-used lines, never a line a hit
+    # is about to refresh (its row must survive this round's write).
+    line_hit = jnp.zeros((lines,), bool).at[
+        jnp.where(hit, hit_slot, jnp.int32(lines))].set(
+        True, mode="drop")
+    ticks_m = jnp.where(line_hit, _I32_MAX, cache.ticks)
+    _, victims = lax.top_k(-ticks_m, q)  # ascending ticks
+    # 0-based victim rank among LIVE miss slots only: dead filler slots
+    # must not consume victim lines (they never scatter).
+    miss_rank = jnp.cumsum(slot_ok & ~hit) - 1
+    slot = jnp.where(hit, hit_slot,
+                     victims[jnp.clip(miss_rank, 0, q - 1)])
+    slot = slot.astype(jnp.int32)
+    n_evict = jnp.sum((slot_ok & ~hit)
+                      & (jnp.take(cache.keys, slot) >= 0))
+    safe = jnp.where(slot_ok, slot, jnp.int32(lines))
+    new_cache = CacheState(
+        data=cache.data.at[safe].set(
+            jnp.where(slot_ok[:, None], rows, 0.0), mode="drop"),
+        keys=cache.keys.at[safe].set(
+            jnp.where(slot_ok, w, -1), mode="drop"),
+        ticks=cache.ticks.at[safe].set(stamp, mode="drop"),
+    )
+    return new_cache, jnp.sum(hit).astype(jnp.int32), \
+        n_evict.astype(jnp.int32)

@@ -1,0 +1,579 @@
+"""Out-of-core block-engine driver: train with X resident on the HOST.
+
+Every other engine in this repo assumes the full (n, d) training matrix
+fits in HBM, which caps trainable n at a few million rows per chip.
+The reference scaled past device memory with its cache.cu LRU of kernel
+dot rows (SVMlight's decomposition + kernel caching, Joachims 1999;
+ThunderSVM's batched working-set rounds are the modern proof the same
+storage hierarchy amortizes). This driver is that regime re-derived for
+the TPU memory model:
+
+* X stays in host memory — a NumPy array or an np.memmap — and is never
+  fully materialized on device. Device-resident state is the O(n)
+  solver vectors (f, alpha, y, x_sq, k_diag), a static-shape pool of
+  (tile_rows, d) X tiles, and optionally the (L, n) block cache.
+* Each outer round runs the SAME algebra as the in-core block engine
+  (solver/block.py): selection over the device-resident gradient, a
+  (q, q) Gram block, the shared subproblem (block.dispatch_subproblem),
+  and the fold f += coef @ K(W, :). Only the fold's geometry changes:
+  it streams over tiles with DOUBLE BUFFERING — tile t+1's async
+  host->HBM ``device_put`` is issued before tile t's partial-fold
+  matmul dispatch, so the H2D DMA overlaps the MXU work instead of
+  serializing with it (ops/ooc.ooc_fold_tile).
+* On top of the tile pool, ``ooc_cache_lines`` extends the
+  solver/cache.py discipline (static-shape data/keys/ticks arrays,
+  scatter-refresh LRU — cache.refresh_rows) to whole working sets: an
+  (L, n) HBM cache of hot kernel DOT rows keyed by training-row index.
+  A round whose entire live working set hits reads its Gram block AND
+  its fold rows straight from the cache — no host gather, no tile
+  stream, no recompute. Near convergence the selection concentrates on
+  a stable set of support vectors, so all-hit rounds dominate exactly
+  when rounds are cheapest to skip.
+
+The host drives one round per iteration (the stream must be fed from
+host memory, so a fully on-device while_loop is impossible by
+construction — same reason the reference's loop was host-driven). The
+trajectory is bit-identical to the in-core block engine's on shapes
+where both fit: selection, subproblem and fold all reduce over the
+same axes in the same order (tests/test_ooc.py pins exact equality,
+including a memmap-backed X leg).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.ops.kernels import (KernelParams, kernel_diag,
+                                   kernel_from_dots, squared_norms)
+from dpsvm_tpu.ops.ooc import ooc_fold_tile
+from dpsvm_tpu.ops.select import refresh_extrema_host
+from dpsvm_tpu.solver.block import dispatch_subproblem, select_block
+from dpsvm_tpu.solver.cache import (CacheState, init_cache, probe_rows,
+                                    refresh_rows)
+from dpsvm_tpu.solver.result import SolveResult
+from dpsvm_tpu.solver.smo import (_BUDGET_EPS, maybe_kahan,
+                                  run_with_fault_retry)
+
+
+class OocState(NamedTuple):
+    """Host-visible round state handed to callbacks (the chunk-callback
+    contract of solve(); MetricsLogger reads .hits on every backend)."""
+
+    alpha: jax.Array
+    f: jax.Array
+    b_hi: float
+    b_lo: float
+    pairs: int
+    rounds: int
+    hits: int
+
+
+_tile_sq = jax.jit(squared_norms)
+
+
+@partial(jax.jit, static_argnames=("c", "q", "selection"))
+def _ooc_select(f, f_err, alpha, y, valid, keys, c, q: int,
+                selection: str):
+    """One selection pass + (when the cache is live) the batched cache
+    probe, fused into a single dispatch so the host learns everything
+    it needs to route the round — all-hit vs stream — from one pull."""
+    f_cur = f if f_err is None else f - f_err
+    w, slot_ok, b_hi, b_lo = select_block(f_cur, alpha, y, c, q,
+                                          valid=valid, rule=selection)
+    if keys is None:
+        hit = jnp.zeros((q,), bool)
+        hit_slot = jnp.zeros((q,), jnp.int32)
+    else:
+        hit, hit_slot = probe_rows(keys, w, slot_ok)
+    return w, slot_ok, b_hi, b_lo, hit, hit_slot
+
+
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau",
+                                   "inner_iters", "inner_impl",
+                                   "interpret", "selection",
+                                   "pair_batch"))
+def _ooc_subproblem(qx, w, slot_ok, f, f_err, alpha, y, x_sq, k_diag,
+                    b_hi, b_lo, budget_left, kp: KernelParams, c,
+                    eps: float, tau: float, inner_iters: int,
+                    inner_impl: str, interpret: bool, selection: str,
+                    pair_batch: int):
+    """Gram block + subproblem for a STREAM round (rows freshly
+    gathered host-side). Identical algebra to block._round_core's
+    gather/gram/subproblem stages; returns (a_w, coef, t, qsq)."""
+    f_cur = f if f_err is None else f - f_err
+    gap_open = b_lo > b_hi + 2.0 * eps
+    qsq = jnp.take(x_sq, w)
+    kd_w = jnp.take(k_diag, w)
+    a_w0 = jnp.take(alpha, w)
+    y_w = jnp.take(y, w)
+    f_w0 = jnp.take(f_cur, w)
+    dots_w = jnp.dot(qx, qx.T, preferred_element_type=jnp.float32)
+    kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)
+    limit = jnp.minimum(jnp.int32(inner_iters), budget_left)
+    limit = jnp.where(gap_open, limit, 0)
+    a_w, coef, t = dispatch_subproblem(
+        kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau, limit,
+        inner_impl, interpret, selection, pair_batch)
+    return a_w, coef, t, qsq
+
+
+def _apply_core(f_tiles, err_tiles, alpha, w, slot_ok, a_w):
+    """Shared round tail: reassemble the full gradient from the folded
+    tiles (pure data movement — the accumulate itself happened inside
+    ooc_fold_tile, fused with the matmul exactly as the in-core round
+    fuses it) and scatter alpha."""
+    f = jnp.concatenate(f_tiles) if len(f_tiles) > 1 else f_tiles[0]
+    f_err = None
+    if err_tiles is not None:
+        f_err = (jnp.concatenate(err_tiles)
+                 if len(err_tiles) > 1 else err_tiles[0])
+    n_pad = alpha.shape[0]
+    safe_w = jnp.where(slot_ok, w, jnp.int32(n_pad))
+    alpha = alpha.at[safe_w].set(jnp.where(slot_ok, a_w, 0.0),
+                                 mode="drop")
+    return f, f_err, alpha
+
+
+@partial(jax.jit, donate_argnames=("alpha",))
+def _ooc_apply(f_tiles, err_tiles, alpha, w, slot_ok, a_w):
+    """Cache-off round tail. The alpha carry is donated (the
+    run_chunk_block_donated discipline); the old f buffer died when
+    its last tile slice was read."""
+    return _apply_core(f_tiles, err_tiles, alpha, w, slot_ok, a_w)
+
+
+@partial(jax.jit,
+         donate_argnames=("alpha", "data", "keys", "ticks"))
+def _ooc_apply_cached(f_tiles, err_tiles, alpha, data, keys, ticks, w,
+                      slot_ok, a_w, dots, stamp):
+    """Stream-round tail with the block cache live: reassemble +
+    scatter + scatter-refresh of the freshly streamed dot rows into
+    the LRU (solver/cache.refresh_rows). Returns the counters as one
+    packed (2,) int32 pull: (n_hits, n_evictions)."""
+    f, f_err, alpha = _apply_core(f_tiles, err_tiles, alpha, w,
+                                  slot_ok, a_w)
+    dots_full = (jnp.concatenate(dots, axis=1)
+                 if len(dots) > 1 else dots[0])  # (q, n_pad)
+    cache, n_hits, n_evict = refresh_rows(
+        CacheState(data, keys, ticks), w, slot_ok, dots_full, stamp)
+    return (f, f_err, alpha, cache.data, cache.keys, cache.ticks,
+            jnp.stack([n_hits, n_evict]))
+
+
+@partial(jax.jit,
+         donate_argnames=("f", "f_err", "alpha", "ticks"),
+         static_argnames=("kp", "c", "eps", "tau", "inner_iters",
+                          "inner_impl", "interpret", "selection",
+                          "pair_batch"))
+def _ooc_round_cached(f, f_err, alpha, y, x_sq, k_diag, data, ticks,
+                      w, slot_ok, hit_slot, b_hi, b_lo, budget_left,
+                      stamp, kp: KernelParams, c, eps: float, tau: float,
+                      inner_iters: int, inner_impl: str, interpret: bool,
+                      selection: str, pair_batch: int):
+    """ONE complete all-hit round in a single dispatch: Gram block and
+    fold rows both read from the cache — the stream and the recompute
+    are both skipped, which is the whole point of the block cache."""
+    f_cur = f if f_err is None else f - f_err
+    gap_open = b_lo > b_hi + 2.0 * eps
+    qsq = jnp.take(x_sq, w)
+    kd_w = jnp.take(k_diag, w)
+    dots_w = jnp.take(data, hit_slot, axis=0)  # (q, n_pad) dot rows
+    kb_w = kernel_from_dots(jnp.take(dots_w, w, axis=1), qsq, qsq, kp)
+    a_w0 = jnp.take(alpha, w)
+    y_w = jnp.take(y, w)
+    f_w0 = jnp.take(f_cur, w)
+    limit = jnp.minimum(jnp.int32(inner_iters), budget_left)
+    limit = jnp.where(gap_open, limit, 0)
+    a_w, coef, t = dispatch_subproblem(
+        kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau, limit,
+        inner_impl, interpret, selection, pair_batch)
+    k_rows = kernel_from_dots(dots_w, x_sq, qsq, kp)  # (q, n_pad)
+    f, f_err = maybe_kahan(f, f_err, coef @ k_rows)
+    n_pad = alpha.shape[0]
+    safe_w = jnp.where(slot_ok, w, jnp.int32(n_pad))
+    alpha = alpha.at[safe_w].set(jnp.where(slot_ok, a_w, 0.0),
+                                 mode="drop")
+    lines = ticks.shape[0]
+    safe_slot = jnp.where(slot_ok, hit_slot, jnp.int32(lines))
+    ticks = ticks.at[safe_slot].set(stamp, mode="drop")
+    return f, f_err, alpha, ticks, t
+
+
+def solve_ooc(
+    x,
+    y,
+    config: SVMConfig,
+    callback=None,
+    device: Optional[jax.Device] = None,
+    alpha_init=None,
+    f_init=None,
+    pad_to: Optional[int] = None,
+) -> SolveResult:
+    """Train binary C-SVC with host-resident X (config.ooc). Same
+    result contract as solver/smo.solve; `x` may be any array-like the
+    host can slice row-blocks from — np.ndarray or np.memmap.
+
+    Checkpointing is not implemented for this driver (the in-core
+    engines own that path); fault retries ride the shared
+    run_with_fault_retry machinery restarting from scratch."""
+    from dpsvm_tpu.solver.smo import _precision_ctx
+
+    def attempt(cfg_k, _res, _k):
+        return _solve_ooc_impl(x, y, cfg_k, callback, device,
+                               alpha_init, f_init, pad_to)
+
+    with _precision_ctx(config):
+        return run_with_fault_retry(config, None, False, attempt)
+
+
+def _tile_host(x, s: int, t: int, n: int, d: int):
+    """Rows [s, s+t) of host X as a float32 (t, d) block, zero-padded
+    past n. Slicing + np.asarray keeps memmaps lazy until here — this
+    is the ONLY place training reads X's bulk."""
+    blk = np.asarray(x[s:min(s + t, n)], np.float32)
+    if blk.shape[0] < t:
+        pad = np.zeros((t, d), np.float32)
+        pad[:blk.shape[0]] = blk
+        return pad
+    return np.ascontiguousarray(blk)
+
+
+def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
+                    alpha_init, f_init, pad_to) -> SolveResult:
+    t_entry = time.perf_counter()
+    y_np = np.asarray(y, np.int32)
+    n, d = x.shape
+    gamma = config.resolve_gamma(d)
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    if config.dtype == "bfloat16":
+        from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
+        warn_if_bf16_degrades(np.asarray(x[:min(n, 4096)]), config)
+    if device is None:
+        device = jax.devices()[0]
+    interpret = device.platform != "tpu"
+    inner_impl = "xla" if interpret else "pallas"
+
+    tile = min(int(config.ooc_tile_rows), max(n, int(pad_to or 0)))
+    n_min = max(n, min(pad_to, 2 ** 31) if pad_to else n)
+    n_pad = -(-n_min // tile) * tile
+    tiles = n_pad // tile
+    tile_bytes = tile * d * (2 if config.dtype == "bfloat16" else 4)
+
+    gran = 2  # mvp / second_order only (config validates)
+    q = max(gran, min(config.working_set_size, n_pad))
+    q -= q % gran
+    inner = config.inner_iters or 2 * q
+    lines = int(config.ooc_cache_lines)
+    use_cache = lines > 0
+
+    # ---- device-side O(n) state. y/valid pad exactly as the in-core
+    # driver does (solver/smo.py _solve_impl) so selections see the
+    # identical masked problem.
+    if n_pad == n:
+        y_p = y_np.astype(np.float32)
+        valid_dev = None
+    else:
+        y_p = np.ones((n_pad,), np.float32)
+        y_p[:n] = y_np
+        valid_np = np.zeros((n_pad,), bool)
+        valid_np[:n] = True
+        valid_dev = jax.device_put(jnp.asarray(valid_np), device)
+    y_dev = jax.device_put(jnp.asarray(y_p, jnp.float32), device)
+
+    # ---- setup stream: ONE pass over host X computes the squared
+    # norms tile-by-tile on device (each row's reduction is identical
+    # to the in-core full-matrix einsum, so x_sq is bit-identical).
+    # The per-tile norm arrays are kept — the round stream feeds them
+    # back to ooc_fold_tile so the per-tile program never touches an
+    # (n,)-sized operand.
+    from dpsvm_tpu.obs import run_obs
+
+    obs = run_obs("solve", config,
+                  meta={"n": n, "d": d, "n_pad": n_pad,
+                        "engine": config.engine, "kernel": config.kernel,
+                        "selection": config.selection, "ooc": True,
+                        "ooc_tile_rows": tile, "ooc_tiles": tiles,
+                        "ooc_cache_lines": lines})
+
+    with obs.span("solver/ooc_setup_stream"):
+        xsq_tiles = []
+        for i in range(tiles):
+            xt = jax.device_put(
+                jnp.asarray(_tile_host(x, i * tile, tile, n, d), dtype),
+                device)
+            xsq_tiles.append(_tile_sq(xt))
+        x_sq = jnp.concatenate(xsq_tiles) if tiles > 1 else xsq_tiles[0]
+        k_diag = jax.jit(kernel_diag,
+                         static_argnames="params")(x_sq, params=kp)
+
+    f = jnp.asarray(-y_p, jnp.float32)
+    alpha = jnp.zeros((n_pad,), jnp.float32)
+    if alpha_init is not None:
+        a_p = np.zeros((n_pad,), np.float32)
+        a_p[:n] = np.asarray(alpha_init, np.float32)
+        alpha = jnp.asarray(a_p)
+    if f_init is not None:
+        f_p = np.asarray(-y_p, np.float32)
+        f_p[:n] = np.asarray(f_init, np.float32)
+        f = jnp.asarray(f_p)
+    f = jax.device_put(f, device)
+    alpha = jax.device_put(alpha, device)
+    f_err = jnp.zeros_like(f) if config.compensated else None
+    cache = init_cache(lines, n_pad) if use_cache else None
+    cache = jax.device_put(cache, device) if use_cache else None
+
+    c = config.c_bounds()
+    eps_run = _BUDGET_EPS if config.budget_mode else float(config.epsilon)
+    max_iter = int(config.max_iter)
+    sub_kw = dict(kp=kp, c=c, eps=eps_run, tau=float(config.tau),
+                  inner_iters=inner, inner_impl=inner_impl,
+                  interpret=interpret, selection=config.selection,
+                  pair_batch=int(config.pair_batch))
+
+    jax.block_until_ready((x_sq, k_diag, f, alpha))
+    phase_seconds = {"setup": time.perf_counter() - t_entry,
+                     "solve": 0.0, "observe": 0.0, "finalize": 0.0}
+
+    pairs = 0
+    rounds = 0
+    dispatches = 0
+    tiles_streamed = 0
+    bytes_h2d = 0
+    cache_hits = 0
+    cache_lookups = 0
+    cache_evictions = 0
+    cached_rounds = 0
+    b_hi = float("-inf")
+    b_lo = float("inf")
+    converged = False
+    train_seconds = 0.0
+    keys_arg = cache.keys if use_cache else None
+
+    if obs.live:
+        c_tiles = obs.registry.counter("solve.ooc_tiles_total")
+        c_bytes = obs.registry.counter("solve.ooc_tile_bytes_total")
+        c_hits = obs.registry.counter("solve.cache_hits_total")
+        c_looks = obs.registry.counter("solve.cache_lookups_total")
+        c_evict = obs.registry.counter("solve.cache_evictions_total")
+        c_saved = obs.registry.counter("solve.ooc_cached_rounds_total")
+
+    while True:
+        _sp = obs.span("solver/ooc_round")
+        _sp.__enter__()
+        try:
+            t0 = time.perf_counter()
+            dispatches += 1
+            w_d, ok_d, bh_d, bl_d, hit_d, slot_d = _ooc_select(
+                f, f_err, alpha, y_dev, valid_dev, keys_arg,
+                c=c, q=q, selection=config.selection)
+            b_hi = float(np.asarray(bh_d))
+            b_lo = float(np.asarray(bl_d))
+            converged = not (b_lo > b_hi + 2.0 * eps_run)
+            if converged or pairs >= max_iter:
+                round_dt = time.perf_counter() - t0
+                train_seconds += round_dt
+                break
+
+            round_hits = 0
+            round_evicts = 0
+            round_tiles = 0
+            ok_np = np.asarray(ok_d)
+            live = int(ok_np.sum())
+            hit_np = np.asarray(hit_d)
+            all_hit = use_cache and live > 0 \
+                and bool(np.all(hit_np[ok_np]))
+            budget_left = jnp.int32(max_iter - pairs)
+            stamp = jnp.int32(rounds + 1)
+            if all_hit:
+                # All live slots cached: one dispatch, zero stream.
+                dispatches += 1
+                f, f_err, alpha, ticks, t_d = _ooc_round_cached(
+                    f, f_err, alpha, y_dev, x_sq, k_diag, cache.data,
+                    cache.ticks, w_d, ok_d, slot_d, bh_d, bl_d,
+                    budget_left, stamp, **sub_kw)
+                cache = CacheState(cache.data, cache.keys, ticks)
+                round_hits = live
+                cached_rounds += 1
+                t = int(np.asarray(t_d))
+            else:
+                # Stream round: host-gather the working-set rows, run
+                # the subproblem, then fold over double-buffered tiles.
+                w_np = np.clip(np.asarray(w_d), 0, n - 1)
+                # Fancy row indexing reads exactly q rows from host X
+                # (ndarray and memmap alike — this plus _tile_host are
+                # the only reads of X's bulk).
+                qx = jax.device_put(
+                    jnp.asarray(np.ascontiguousarray(
+                        np.asarray(x[w_np], np.float32)), dtype),
+                    device)
+                dispatches += 1
+                a_w, coef, t_d, qsq = _ooc_subproblem(
+                    qx, w_d, ok_d, f, f_err, alpha, y_dev, x_sq, k_diag,
+                    bh_d, bl_d, budget_left, **sub_kw)
+                # Double-buffered tile stream: issue tile i+1's async
+                # H2D put BEFORE dispatching tile i's fold so the DMA
+                # overlaps the matmul (the two-slot tile pool — all
+                # tiles share one shape, so the allocator recycles the
+                # freed slots). Each fold consumes its slice of the
+                # carried gradient and returns the folded slice — the
+                # accumulate stays fused with the matmul, which is
+                # what keeps the trajectory bit-identical to the
+                # in-core engine.
+                f_tiles = []
+                err_tiles = [] if f_err is not None else None
+                dots = []
+                nxt = jax.device_put(
+                    jnp.asarray(_tile_host(x, 0, tile, n, d), dtype),
+                    device)
+                for i in range(tiles):
+                    cur, nxt = nxt, (
+                        jax.device_put(
+                            jnp.asarray(_tile_host(x, (i + 1) * tile,
+                                                   tile, n, d), dtype),
+                            device)
+                        if i + 1 < tiles else None)
+                    dispatches += 1
+                    s = i * tile
+                    ft, et, dots_i = ooc_fold_tile(
+                        cur, xsq_tiles[i], f[s:s + tile],
+                        f_err[s:s + tile] if f_err is not None else None,
+                        qx, qsq, coef, kp=kp, want_dots=use_cache,
+                        compensated=f_err is not None)
+                    f_tiles.append(ft)
+                    if err_tiles is not None:
+                        err_tiles.append(et)
+                    if use_cache:
+                        dots.append(dots_i)
+                # Tile-stream bytes only (the q*d working-set gather is
+                # separate, small, and not part of the stream) — keeps
+                # this stat and the solve.ooc_tile_bytes_total registry
+                # counter the same sum.
+                round_tiles = tiles
+                tiles_streamed += tiles
+                bytes_h2d += tiles * tile_bytes
+                dispatches += 1
+                if use_cache:
+                    (f, f_err, alpha, data, keys, ticks,
+                     stats_d) = _ooc_apply_cached(
+                        tuple(f_tiles),
+                        tuple(err_tiles) if err_tiles is not None
+                        else None,
+                        alpha, cache.data, cache.keys, cache.ticks,
+                        w_d, ok_d, a_w, tuple(dots), stamp)
+                    cache = CacheState(data, keys, ticks)
+                    keys_arg = keys
+                    stats_np = np.asarray(stats_d)
+                    round_hits = int(stats_np[0])
+                    round_evicts = int(stats_np[1])
+                else:
+                    f, f_err, alpha = _ooc_apply(
+                        tuple(f_tiles),
+                        tuple(err_tiles) if err_tiles is not None
+                        else None,
+                        alpha, w_d, ok_d, a_w)
+                t = int(np.asarray(t_d))
+            pairs += t
+            rounds += 1
+            if use_cache:
+                cache_lookups += live
+                cache_hits += round_hits
+                cache_evictions += round_evicts
+            round_dt = time.perf_counter() - t0
+            train_seconds += round_dt
+        finally:
+            _sp.__exit__(None, None, None)
+
+        t_obs0 = time.perf_counter()
+        # The chunk record's device_seconds is EXACTLY the round time
+        # train_seconds accumulated — the bench runlog reconciliation
+        # (<= 1%) depends on the two being the same sum.
+        obs.chunk(pairs=pairs, b_hi=b_hi, b_lo=b_lo,
+                  device_seconds=round_dt,
+                  dispatch=dispatches, tiles=round_tiles,
+                  cached_round=bool(all_hit), cache_hits=round_hits)
+        if obs.live:
+            c_tiles.add(round_tiles)
+            c_bytes.add(tile_bytes * round_tiles)
+            if use_cache:
+                c_hits.add(round_hits)
+                c_looks.add(live)
+                c_evict.add(round_evicts)
+                if all_hit:
+                    c_saved.add(1)
+        abort = False
+        if callback is not None:
+            state = OocState(alpha, f, b_hi, b_lo, pairs, rounds,
+                             cache_hits)
+            abort = bool(callback(pairs, b_hi, b_lo, state))
+        if config.check_numerics:
+            from dpsvm_tpu.solver.smo import assert_finite_state
+            assert_finite_state(OocState(alpha, f, b_hi, b_lo, pairs,
+                                         rounds, cache_hits),
+                                pairs, "ooc")
+        if config.verbose:
+            print(f"[ooc] round={rounds} pairs={pairs} "
+                  f"gap={b_lo - b_hi:.6f} tiles={round_tiles} "
+                  f"hits={round_hits}")
+        phase_seconds["observe"] += time.perf_counter() - t_obs0
+        if abort:
+            break
+
+    t_fin0 = time.perf_counter()
+    alpha_np = np.asarray(alpha)[:n]
+    f_eff = f if f_err is None else f - f_err
+    f_final = np.asarray(f_eff)[:n]
+    if not converged:
+        b_hi, b_lo, converged = refresh_extrema_host(
+            f_final, alpha_np, y_np, c, config.epsilon,
+            rule=config.selection)
+    phase_seconds["solve"] = train_seconds
+    phase_seconds["finalize"] = time.perf_counter() - t_fin0
+    phase_seconds = {k: round(v, 6) for k, v in phase_seconds.items()}
+    hit_rate = (cache_hits / cache_lookups) if cache_lookups else 0.0
+    stats = {
+        "f": f_final,
+        "outer_rounds": rounds,
+        "ooc": True,
+        "ooc_tile_rows": tile,
+        "tiles_streamed": tiles_streamed,
+        "tile_bytes_h2d": bytes_h2d,
+        "cached_rounds": cached_rounds,
+        "cache_hits": cache_hits,
+        "cache_lookups": cache_lookups,
+        "cache_hit_rate": hit_rate,
+        "cache_evictions": cache_evictions,
+        "phase_seconds": phase_seconds,
+    }
+    if obs.live:
+        stats["obs_run_id"] = obs.run_id
+        stats["obs_runlog"] = obs.path
+    obs.finish(iterations=pairs, converged=bool(converged),
+               train_seconds=round(train_seconds, 6),
+               dispatches=dispatches, b_hi=b_hi, b_lo=b_lo,
+               n_sv=int(np.count_nonzero(alpha_np > 0)),
+               tiles_streamed=tiles_streamed,
+               tile_bytes_h2d=bytes_h2d,
+               cached_rounds=cached_rounds,
+               cache_hits=cache_hits, cache_lookups=cache_lookups,
+               cache_hit_rate=round(hit_rate, 6),
+               cache_evictions=cache_evictions,
+               phase_seconds=phase_seconds)
+    return SolveResult(
+        alpha=alpha_np,
+        b=float((b_lo + b_hi) / 2.0),
+        b_hi=b_hi,
+        b_lo=b_lo,
+        iterations=pairs,
+        converged=converged,
+        train_seconds=train_seconds,
+        dispatches=dispatches,
+        stats=stats,
+    )

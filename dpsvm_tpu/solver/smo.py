@@ -962,6 +962,21 @@ def solve(
         raise ValueError(
             "selection='nu' is internal to the nu duals — call "
             "train_nusvc/train_nusvr (models/nusvm.py) instead")
+    if config.ooc:
+        # Out-of-core streaming driver (solver/ooc.py): X stays in host
+        # memory; the block engine's fold streams over double-buffered
+        # tiles. Its own host loop (the stream must be fed per round),
+        # same result contract.
+        if checkpoint_path or resume:
+            raise ValueError(
+                "ooc does not implement checkpoint/resume yet; run "
+                "without --checkpoint (fault retries restart from "
+                "scratch)")
+        from dpsvm_tpu.solver.ooc import solve_ooc
+
+        return solve_ooc(x, y, config, callback=callback, device=device,
+                         alpha_init=alpha_init, f_init=f_init,
+                         pad_to=pad_to)
     if config.reconstruct_every:
         # Exact-f64 reconstruction legs around the device solve: the
         # productized form of the extreme-C recipe (solver/reconstruct.py;
@@ -1422,13 +1437,23 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
             config.epsilon, rule=config.selection)
     # Hit-rate denominator covers only THIS run's lookups (post-resume).
     total_lookups = 2 * (it - start_iter) if use_cache else 0
+    cache_hits = int(state.hits)
+    hit_rate = (cache_hits / total_lookups) if total_lookups else 0.0
+    # Evictions, derived host-side with no extra carry state: every
+    # miss writes a line, a line leaves key=-1 at most once (keys never
+    # return to -1), so evictions = misses - lines-filled-from-empty.
+    cache_evictions = 0
+    if use_cache:
+        filled = int(np.count_nonzero(np.asarray(state.cache.keys) >= 0))
+        cache_evictions = max(0, (total_lookups - cache_hits) - filled)
     phase_seconds["solve"] = train_seconds
     phase_seconds["finalize"] = time.perf_counter() - t_fin0
     phase_seconds = {k: round(v, 6) for k, v in phase_seconds.items()}
     stats = {
-        "cache_hits": int(state.hits),
+        "cache_hits": cache_hits,
         "cache_lookups": total_lookups,
-        "cache_hit_rate": (int(state.hits) / total_lookups) if total_lookups else 0.0,
+        "cache_hit_rate": hit_rate,
+        "cache_evictions": cache_evictions,
         "f": f_final,
         # Honest per-phase wall clock; sync discipline documented at
         # the phase-clock block above (one block_until_ready per
@@ -1439,11 +1464,26 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     if obs.live:
         stats["obs_run_id"] = obs.run_id
         stats["obs_runlog"] = obs.path
+        # The per-pair LRU's registry instruments (ISSUE 9 satellite:
+        # the cache was invisible to `cli obs report`). Counters ride
+        # the same host-held values the stats dict reports — zero
+        # device effect, like every obs record.
+        if use_cache:
+            obs.registry.counter("solve.cache_hits_total").add(cache_hits)
+            obs.registry.counter(
+                "solve.cache_lookups_total").add(total_lookups)
+            obs.registry.counter(
+                "solve.cache_evictions_total").add(cache_evictions)
     obs.finish(iterations=it, converged=bool(converged),
                train_seconds=round(train_seconds, 6),
                dispatches=dispatches, b_hi=b_hi, b_lo=b_lo,
                n_sv=int(np.count_nonzero(alpha > 0)),
-               phase_seconds=phase_seconds)
+               phase_seconds=phase_seconds,
+               **({"cache_hits": cache_hits,
+                   "cache_lookups": total_lookups,
+                   "cache_hit_rate": round(hit_rate, 6),
+                   "cache_evictions": cache_evictions}
+                  if use_cache else {}))
     return SolveResult(
         alpha=alpha,
         b=float((b_lo + b_hi) / 2.0),  # svmTrainMain.cpp:329

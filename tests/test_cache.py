@@ -1,13 +1,18 @@
 """Unit tests for the functional LRU kernel-row cache (solver/cache.py),
 exercising every hit/miss combination directly — the reference's cache
-(cache.cu) has no tests at all."""
+(cache.cu) has no tests at all — plus the eviction/refresh FUZZ suite
+(ISSUE 9): both the per-pair ``lookup_pair`` and the block-engine
+``refresh_rows`` are replayed against a host-side reference LRU model
+over randomized access sequences, so tie-breaking, victim exclusion
+and the eviction counter are pinned, not just the happy paths."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dpsvm_tpu.solver.cache import init_cache, lookup_pair
+from dpsvm_tpu.solver.cache import (init_cache, lookup_pair, probe_rows,
+                                    refresh_rows)
 
 
 @pytest.fixture(scope="module")
@@ -90,3 +95,122 @@ def test_cached_row_contents_survive_eviction_pressure(x):
         r_hi, r_lo, cache, _ = _lookup(cache, x, a, b, it)
         np.testing.assert_allclose(r_hi, _expect_row(x, a), rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(r_lo, _expect_row(x, b), rtol=1e-5, atol=1e-6)
+
+
+# ----------------------------------------------- eviction/refresh fuzz
+
+class _ModelLRU:
+    """Host-side reference LRU with the device cache's exact
+    semantics: lines carry (key, tick); victims are chosen by
+    (tick, line-index) ascending — matching argmin/top_k's stable
+    lowest-index tie-break — and initial ticks are the negative
+    slot-ordered fill stamps of init_cache."""
+
+    def __init__(self, lines: int):
+        self.keys = [-1] * lines
+        self.ticks = list(range(-lines, 0))
+
+    def slot_of(self, key):
+        return self.keys.index(key) if key in self.keys else None
+
+    def lru_order(self, exclude=()):
+        return sorted((i for i in range(len(self.keys))
+                       if i not in exclude),
+                      key=lambda i: (self.ticks[i], i))
+
+
+def test_refresh_rows_fuzz_against_model(x):
+    """Randomized working-set refreshes vs the reference model: per-
+    slot hit flags, the eviction counter, the surviving key set and
+    the tick stamps must all match, and every cached data row must
+    hold its row's true dot products after every step."""
+    rng = np.random.default_rng(0)
+    lines, q, n = 8, 4, 20
+    xs = np.asarray(x)
+    for _ in range(3):  # a few independent sequences
+        cache = init_cache(lines, n)
+        model = _ModelLRU(lines)
+        for step in range(1, 41):
+            w = rng.choice(n, size=q, replace=False).astype(np.int32)
+            ok = rng.random(q) > 0.2  # some dead filler slots
+            rows = xs[w] @ xs.T  # (q, n) fresh dot rows
+            new_cache, n_hits, n_evict = jax.jit(refresh_rows)(
+                cache, jnp.asarray(w), jnp.asarray(ok),
+                jnp.asarray(rows, jnp.float32), jnp.int32(step))
+            # -- model step
+            hits = [bool(o) and model.slot_of(int(k)) is not None
+                    for k, o in zip(w, ok)]
+            hit_slots = {model.slot_of(int(k))
+                         for k, h in zip(w, hits) if h}
+            victims = model.lru_order(exclude=hit_slots)
+            m_evict = 0
+            vi = 0
+            for k, o, h in zip(w, ok, hits):
+                if not o:
+                    continue
+                if h:
+                    s = model.slot_of(int(k))
+                else:
+                    s = victims[vi]
+                    vi += 1
+                    if model.keys[s] != -1:
+                        m_evict += 1
+                    model.keys[s] = int(k)
+                model.ticks[s] = step
+            # -- compare
+            assert int(n_hits) == sum(hits)
+            assert int(n_evict) == m_evict
+            np.testing.assert_array_equal(
+                np.asarray(new_cache.keys), np.asarray(model.keys))
+            np.testing.assert_array_equal(
+                np.asarray(new_cache.ticks), np.asarray(model.ticks))
+            for s, k in enumerate(model.keys):
+                if k >= 0:
+                    np.testing.assert_allclose(
+                        np.asarray(new_cache.data)[s], xs[k] @ xs.T,
+                        rtol=1e-5, atol=1e-6)
+            cache = new_cache
+
+
+def test_probe_rows_matches_membership(x):
+    cache = init_cache(4, 20)
+    *_, cache, _ = _lookup(cache, x, 3, 7, 0)
+    w = jnp.asarray([3, 7, 9, 3], jnp.int32)
+    ok = jnp.asarray([True, True, True, False])
+    hit, slot = jax.jit(probe_rows)(cache.keys, w, ok)
+    np.testing.assert_array_equal(np.asarray(hit),
+                                  [True, True, False, False])
+    keys = np.asarray(cache.keys)
+    assert keys[int(slot[0])] == 3 and keys[int(slot[1])] == 7
+
+
+def test_lookup_pair_fuzz_against_model(x):
+    """The per-pair LRU replayed against the same reference model over
+    randomized (i_hi, i_lo) sequences: per-step hit counts and the
+    full per-line key/tick state must match. Model semantics mirror
+    lookup_pair exactly — both probes and both victim choices read the
+    PRE-update keys/ticks, the lo victim excludes the hi slot, and the
+    lo write wins a same-slot conflict (stamps 2*it+1 / 2*it+2)."""
+    rng = np.random.default_rng(1)
+    lines, n = 4, 20
+    cache = init_cache(lines, n)
+    model = _ModelLRU(lines)
+    for it in range(60):
+        i_hi, i_lo = (int(v) for v in rng.choice(n, size=2))
+        *_, cache, hits = _lookup(cache, x, i_hi, i_lo, it)
+        # -- model step, all choices from the pre-update state
+        pre_hit_hi = model.slot_of(i_hi) is not None
+        pre_hit_lo = model.slot_of(i_lo) is not None
+        s_hi = (model.slot_of(i_hi) if pre_hit_hi
+                else model.lru_order()[0])
+        s_lo = (model.slot_of(i_lo) if pre_hit_lo
+                else model.lru_order(exclude={s_hi})[0])
+        model.keys[s_hi] = i_hi
+        model.keys[s_lo] = i_lo  # lo wins a same-slot conflict
+        model.ticks[s_hi] = 2 * it + 1
+        model.ticks[s_lo] = 2 * it + 2
+        assert int(hits) == pre_hit_hi + pre_hit_lo
+        np.testing.assert_array_equal(np.asarray(cache.keys),
+                                      np.asarray(model.keys))
+        np.testing.assert_array_equal(np.asarray(cache.ticks),
+                                      np.asarray(model.ticks))

@@ -173,3 +173,46 @@ def test_nusvc_checkpoint_resume(tmp_path, blobs):
     m0, r0 = train_nusvc(x, y, nu=0.3, config=CFG, backend="single")
     np.testing.assert_allclose(decision_function(m2, x),
                                decision_function(m0, x), atol=5e-3)
+
+
+def test_nu_fallback_warning_names_requested_and_effective(blobs):
+    """ROADMAP item 4 / ISSUE 9 satellite: the nu trainers must NAME
+    the fast paths they fall back from instead of silently training on
+    the plain engine. The message carries both the requested engine
+    and each dropped knob."""
+    x, y = blobs
+    cfg = CFG.replace(engine="block", pair_batch=2, max_iter=20_000)
+    with pytest.warns(UserWarning,
+                      match=r"train_nusvc runs selection='nu' .* "
+                            r"requested engine='block'.*falls back "
+                            r"from: pair_batch=2"):
+        m, res = train_nusvc(x, y, nu=0.3, config=cfg, backend="single")
+    assert res.converged  # the fallback still trains correctly
+
+    cfg_ooc = CFG.replace(engine="block", ooc=True, ooc_tile_rows=256,
+                          max_iter=20_000)
+    with pytest.warns(UserWarning, match=r"falls back from: ooc"):
+        train_nusvc(x, y, nu=0.3, config=cfg_ooc, backend="single")
+
+    z = x[:, 0].astype(np.float32)
+    with pytest.warns(UserWarning,
+                      match=r"train_nusvr .*falls back from: "
+                            r"pipeline_rounds"):
+        train_nusvr(x, z, nu=0.4, c=2.0,
+                    config=CFG.replace(engine="block",
+                                       pipeline_rounds=True,
+                                       max_iter=20_000),
+                    backend="single")
+
+
+def test_nu_no_warning_when_nothing_dropped(blobs):
+    """A plain config trains silently — the warning is for genuinely
+    requested-and-dropped fast paths only."""
+    import warnings
+
+    x, y = blobs
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        train_nusvc(x, y, nu=0.3,
+                    config=CFG.replace(max_iter=20_000),
+                    backend="single")

@@ -1,0 +1,207 @@
+"""Out-of-core block engine (config.ooc; solver/ooc.py — ISSUE 9).
+
+The load-bearing claim is BIT-IDENTITY: on shapes where both fit, the
+ooc solve — host-resident X, tile-streamed fold, host-driven rounds —
+must reproduce the in-core block engine's trajectory exactly (same
+alpha bits, same gradient bits, same pair count), including through a
+memmap-backed X and the padded tail tile. Everything else (the block
+cache's all-hit fast path, the budget contract, the obs counters) is
+layered on top of that anchor.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import ObsConfig, SVMConfig
+from dpsvm_tpu.data.synth import make_blobs_binary
+from dpsvm_tpu.solver.smo import solve
+
+CFG = SVMConfig(c=2.0, epsilon=1e-3, engine="block",
+                working_set_size=64, max_iter=50_000)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return make_blobs_binary(n=1024, d=24, seed=11, sep=1.0)
+
+
+@pytest.fixture(scope="module")
+def incore(data):
+    x, y = data
+    return solve(x, y, CFG)
+
+
+def _assert_bitwise(a, b):
+    assert a.iterations == b.iterations
+    assert a.converged == b.converged
+    assert a.b_hi == b.b_hi and a.b_lo == b.b_lo
+    np.testing.assert_array_equal(a.alpha, b.alpha)
+    np.testing.assert_array_equal(a.stats["f"], b.stats["f"])
+
+
+def test_ooc_bit_identical_to_incore(data, incore):
+    x, y = data
+    res = solve(x, y, CFG.replace(ooc=True, ooc_tile_rows=256))
+    _assert_bitwise(incore, res)
+    st = res.stats
+    assert st["ooc"] and st["tiles_streamed"] > 0
+    assert st["tile_bytes_h2d"] > 0
+    assert st["outer_rounds"] > 1
+    # Stream accounting: every stream round moves exactly n_pad rows.
+    assert st["tiles_streamed"] % (1024 // 256) == 0
+
+
+def test_ooc_memmap_backed_x(data, incore, tmp_path):
+    """X as an np.memmap — the shape the ooc path exists for: the
+    training matrix never fully materializes in host RAM either."""
+    x, y = data
+    path = tmp_path / "x.dat"
+    mm = np.memmap(path, dtype=np.float32, mode="w+", shape=x.shape)
+    mm[:] = x
+    mm.flush()
+    ro = np.memmap(path, dtype=np.float32, mode="r", shape=x.shape)
+    res = solve(ro, y, CFG.replace(ooc=True, ooc_tile_rows=256))
+    _assert_bitwise(incore, res)
+
+
+def test_ooc_padded_tail_tile(data):
+    """n not a multiple of tile_rows: the tail tile zero-pads and the
+    padding is masked out of selection — trajectory still bit-matches
+    the (unpadded) in-core engine on the same rows."""
+    x, y = data
+    x, y = x[:1000], y[:1000]
+    ic = solve(x, y, CFG)
+    res = solve(x, y, CFG.replace(ooc=True, ooc_tile_rows=256))
+    _assert_bitwise(ic, res)
+
+
+def test_ooc_compensated_bit_identical(data):
+    x, y = data
+    cfg = CFG.replace(compensated=True)
+    ic = solve(x, y, cfg)
+    res = solve(x, y, cfg.replace(ooc=True, ooc_tile_rows=256))
+    _assert_bitwise(ic, res)
+
+
+def test_ooc_block_cache_all_hit_rounds(data, incore):
+    """With enough lines to hold every hot row, the selection's
+    near-convergence concentration produces ALL-HIT rounds that skip
+    the tile stream entirely — the cache's reason to exist. The
+    trajectory must still land on the in-core optimum."""
+    x, y = data
+    res = solve(x, y, CFG.replace(ooc=True, ooc_tile_rows=256,
+                                  ooc_cache_lines=1024))
+    nostream = solve(x, y, CFG.replace(ooc=True, ooc_tile_rows=256))
+    assert res.stats["cached_rounds"] > 0
+    assert res.stats["cache_hits"] > 0
+    assert res.stats["cache_hit_rate"] > 0.5
+    # All-hit rounds each save a full-n stream.
+    assert res.stats["tiles_streamed"] < nostream.stats["tiles_streamed"]
+    assert res.converged
+    # The cached Gram/fold rows are the same dot products the stream
+    # would recompute, so the trajectory stays on the same optimum.
+    np.testing.assert_allclose(res.alpha, incore.alpha, atol=2e-4)
+    assert abs(res.b - incore.b) < 5e-3
+
+
+def test_ooc_cache_eviction_pressure(data):
+    """Lines < distinct hot rows: evictions must be counted and the
+    solve must stay exact (an evicted row is recomputed by the next
+    stream, never served stale)."""
+    x, y = data
+    res = solve(x, y, CFG.replace(ooc=True, ooc_tile_rows=256,
+                                  ooc_cache_lines=128))
+    assert res.stats["cache_evictions"] > 0
+    assert res.stats["cache_lookups"] >= res.stats["cache_hits"]
+    assert res.converged
+
+
+def test_ooc_budget_mode_exact_pairs(data):
+    x, y = data
+    res = solve(x, y, CFG.replace(ooc=True, ooc_tile_rows=256,
+                                  budget_mode=True, max_iter=2000))
+    assert res.iterations == 2000
+
+
+def test_ooc_runlog_carries_tile_and_cache_counters(data, tmp_path,
+                                                    monkeypatch):
+    """The ISSUE 9 CI leg: a small ooc solve under DPSVM_OBS=1 writes
+    a run log whose chunk records carry the per-round tile counters
+    and whose final record carries the stream/cache totals the
+    Registry accumulated."""
+    from dpsvm_tpu.obs.runlog import read_runlog, records_for
+
+    monkeypatch.setenv("DPSVM_OBS", "1")
+    monkeypatch.setenv("DPSVM_OBS_DIR", str(tmp_path))
+    x, y = data
+    res = solve(x, y, CFG.replace(
+        ooc=True, ooc_tile_rows=256, ooc_cache_lines=1024,
+        obs=ObsConfig(enabled=True, runlog_dir=str(tmp_path))))
+    path = res.stats["obs_runlog"]
+    assert os.path.dirname(path) == str(tmp_path)
+    recs = read_runlog(path)
+    run_id = res.stats["obs_run_id"]
+    man = records_for(recs, run_id, "manifest")[0]
+    assert man["ooc"] and man["ooc_tile_rows"] == 256
+    chunks = records_for(recs, run_id, "chunk")
+    assert chunks and all("tiles" in c and "cache_hits" in c
+                          for c in chunks)
+    assert sum(c["tiles"] for c in chunks) == res.stats["tiles_streamed"]
+    fin = records_for(recs, run_id, "final")[0]
+    for key in ("tiles_streamed", "tile_bytes_h2d", "cache_hits",
+                "cache_lookups", "cache_hit_rate", "cache_evictions",
+                "cached_rounds"):
+        assert key in fin, key
+    assert fin["tiles_streamed"] == res.stats["tiles_streamed"]
+    m = fin["metrics"]
+    assert m["solve.ooc_tiles_total"] == res.stats["tiles_streamed"]
+    assert m["solve.cache_hits_total"] == res.stats["cache_hits"]
+    assert m["solve.cache_lookups_total"] == res.stats["cache_lookups"]
+    # ... and `cli obs report` surfaces the cache_hit_rate line.
+    from dpsvm_tpu.obs.analyze import (load_runs, render_report,
+                                       summarize_run)
+    summary = [summarize_run(r) for r in load_runs([path])
+               if r.run_id == run_id]
+    assert summary and summary[0]["cache_hit_rate"] == pytest.approx(
+        res.stats["cache_hit_rate"], abs=1e-6)
+    table = render_report(summary)
+    assert "cache" in table.splitlines()[0]
+    assert f"{100 * res.stats['cache_hit_rate']:.1f}%" in table
+
+
+def test_ooc_config_validation():
+    with pytest.raises(ValueError, match="engine='block'"):
+        SVMConfig(ooc=True, engine="xla")
+    with pytest.raises(ValueError, match="feature kernels"):
+        SVMConfig(ooc=True, engine="block", kernel="precomputed")
+    with pytest.raises(ValueError, match="gram_resident"):
+        SVMConfig(ooc=True, engine="block", gram_resident=True)
+    with pytest.raises(ValueError, match="active_set_size"):
+        SVMConfig(ooc=True, engine="block", active_set_size=256)
+    with pytest.raises(ValueError, match="pipeline_rounds"):
+        SVMConfig(ooc=True, engine="block", pipeline_rounds=True)
+    with pytest.raises(ValueError, match="ooc_cache_lines"):
+        SVMConfig(ooc=True, engine="block", working_set_size=128,
+                  ooc_cache_lines=64)
+    with pytest.raises(ValueError, match="ooc=True"):
+        SVMConfig(engine="block", ooc_cache_lines=256)
+    with pytest.raises(ValueError, match="single-chip"):
+        SVMConfig(ooc=True, engine="block", local_working_sets=2)
+
+
+def test_ooc_mesh_backend_rejected(data):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = data
+    with pytest.raises(ValueError, match="single-chip"):
+        solve_mesh(x, y, SVMConfig(engine="block", ooc=True),
+                   num_devices=2)
+
+
+def test_ooc_checkpoint_rejected(data, tmp_path):
+    x, y = data
+    with pytest.raises(ValueError, match="checkpoint"):
+        solve(x, y, CFG.replace(ooc=True),
+              checkpoint_path=str(tmp_path / "ck.npz"))

@@ -247,14 +247,18 @@ def test_pipeline_rounds_validation():
 def test_pipelined_nusvc_falls_back_cleanly(blobs_small):
     """A user config with pipeline_rounds=True must not crash the nu
     trainers (they switch to the per-class selection rule, which the
-    pipelined engine does not implement — same fallback contract as
-    pair_batch)."""
+    pipelined engine does not implement), and since ISSUE 9 the
+    fallback is NAMED: the trainer warns with the dropped knob."""
+    import pytest
+
     from dpsvm_tpu.models.nusvm import train_nusvc
 
     x, y = blobs_small
-    model = train_nusvc(x, y, nu=0.3,
-                        config=BASE.replace(pipeline_rounds=True,
-                                            gamma=0.1))
+    with pytest.warns(UserWarning,
+                      match=r"falls back from: pipeline_rounds"):
+        model = train_nusvc(x, y, nu=0.3,
+                            config=BASE.replace(pipeline_rounds=True,
+                                                gamma=0.1))
     assert model is not None
 
 

@@ -149,14 +149,17 @@ def test_shardlocal_with_reconstruction_legs(blobs_small):
 
 def test_shardlocal_nusvc_falls_back_cleanly(blobs_small):
     """A user config with local_working_sets=2 must not crash the nu
-    trainers (per-class selection keeps the plain mesh runner — the
-    same silent-fallback contract as pair_batch)."""
+    trainers (per-class selection keeps the plain mesh runner), and
+    since ISSUE 9 the fallback is NAMED, not silent: the trainer warns
+    with the requested engine and the dropped knob."""
     from dpsvm_tpu.models.nusvm import train_nusvc
 
     x, y = blobs_small
-    model, res = train_nusvc(x, y, nu=0.3,
-                             config=_sl(BASE.replace(gamma=0.1)),
-                             backend="mesh", num_devices=2)
+    with pytest.warns(UserWarning,
+                      match=r"falls back from: local_working_sets"):
+        model, res = train_nusvc(x, y, nu=0.3,
+                                 config=_sl(BASE.replace(gamma=0.1)),
+                                 backend="mesh", num_devices=2)
     assert res.converged
 
 

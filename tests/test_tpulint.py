@@ -281,6 +281,36 @@ def test_memory_facts_see_donation_as_alias_bytes():
     assert plain["memory"]["alias_bytes"] == 0
 
 
+def test_ooc_fold_tile_budget_independent_of_n():
+    """The out-of-core contract, mutation-verified (ISSUE 9): the
+    per-tile fold program's facts — including the memory family's
+    argument/output/temp bytes — are a pure function of
+    (tile_rows, d, q). Rebuilding the manifest entry with total n
+    DOUBLED must produce byte-identical facts: if anyone threads an
+    (n, ...)-shaped operand into the tile program (full X, full f, the
+    whole cache), argument_bytes moves and this fails."""
+    from dpsvm_tpu.analysis.manifest import (N, T_TILE, ooc_fold_tile,
+                                             require_devices)
+
+    require_devices()
+    base = entry_facts(ooc_fold_tile(N))
+    doubled = entry_facts(ooc_fold_tile(2 * N))
+    assert base == doubled
+    mem = base["units"]["fold_tile"]["memory"]
+    # Tile-pool-scale arguments only: the (T, d) tile + its norms +
+    # the gradient slice + the q-sized working-set operands.
+    from dpsvm_tpu.analysis.manifest import D, Q
+    assert mem["argument_bytes"] == (
+        T_TILE * D * 4 + T_TILE * 4 + T_TILE * 4
+        + Q * D * 4 + Q * 4 + Q * 4)
+    coll = base["units"]["fold_tile"]["collectives"]
+    assert all(v["count"] == 0 for v in coll.values())
+    tr = base["units"]["fold_tile"]["transfers"]
+    assert all(v == 0 for v in tr.values())
+    don = base["units"]["fold_tile"]["donation"]
+    assert don["missed"] == 0 and don["declared_donated"] == 1
+
+
 # ------------------------------------- the committed budgets (tier-1)
 
 def test_manifest_budgets_pass_against_committed(monkeypatch):
