@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets bench bench_ooc_smoke bench_predict bench_serve fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets bench bench_ooc_smoke bench_predict bench_serve bench_serve_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -106,6 +106,19 @@ bench_predict:
 # through the drift-normalized cross-session regression gate.
 bench_serve:
 	$(PY) tools/bench_serve.py
+
+# Serving v2 closed-loop load generator (ISSUE 10): registry hot swap
+# under live traffic, deadline-aware batching, latency/throughput
+# frontier -> BENCH_SERVE_r<NN>.json (commit it) + BENCH_SERVE.md.
+loadgen:
+	$(PY) tools/loadgen.py --obs
+
+# Short CI leg of the same sweep on the CPU harness: run log live,
+# rows runlog-reconciled, mid-sweep hot swap asserted zero-loss,
+# through the regression gate — the smoke artifact goes to a temp
+# file, never the committed r<NN> series (tier1.yml runs this).
+bench_serve_smoke:
+	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) tools/loadgen.py --smoke --obs
 
 # Real-dataset recipe (MNIST / covtype / Adult a9a): download, verify
 # sha256, run the converters into data/*.csv. Exits 0 with a SKIP note

@@ -32,6 +32,11 @@ GAMMA = 0.1
 # Serving / inference shapes: S union rows, K submodel columns, M_PAD
 # padded per-model SV slots, NB query rows per bucket.
 S_UNION, K_MODELS, M_PAD, NB = 256, 10, 64, 64
+# Coalesced multi-model bucket (serving v2, ISSUE 10): total decision
+# columns when several registered models sharing one union answer from
+# a single dispatch (e.g. a 10-column OvO head + a 5-column OvR head +
+# a binary column stacked side by side).
+K_COALESCED = 16
 # Out-of-core tile shape (ops/ooc.ooc_fold_tile): rows per streamed
 # tile. The entry's shapes are a pure function of (T_TILE, D, Q) —
 # never of total n — which is the contract its budget exists to pin.
@@ -249,7 +254,7 @@ def compacted_decision():
                  _jaxpr_of(batch, *args, **kw))]
 
 
-def _serve_bucket_units(dtype_str):
+def _serve_bucket_units(dtype_str, k=K_MODELS):
     import jax.numpy as jnp
 
     from dpsvm_tpu.analysis.extract import Unit
@@ -259,8 +264,8 @@ def _serve_bucket_units(dtype_str):
     sv_dt = jnp.bfloat16 if dtype_str == "bfloat16" else jnp.float32
     args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), sv_dt),
             _sds((S_UNION,), jnp.float32),
-            _sds((S_UNION, K_MODELS), jnp.float32),
-            _sds((K_MODELS,), jnp.float32))
+            _sds((S_UNION, k), jnp.float32),
+            _sds((k,), jnp.float32))
     kw = dict(kp=_kp())
     return [Unit("batch", lambda: batch.lower(*args, **kw),
                  _jaxpr_of(batch, *args, **kw))]
@@ -278,6 +283,21 @@ def serve_bucket_bf16():
     dtype once; norms re-widen once) — any additional f32<->bf16
     convert is a drift."""
     return _serve_bucket_units("bfloat16")
+
+
+def serve_coalesced_bucket():
+    """Serving v2 coalesced multi-model bucket (ISSUE 10): the SAME
+    dense executor as serve_bucket, lowered at the stacked
+    (S, K_COALESCED) coefficient shape a union group dispatches when
+    several registered models share one compacted union / kernel
+    family (serving/dispatch.py UnionGroup). The budget pins the
+    engine-side contract statically: ONE (nb, S) kernel matmul
+    regardless of how many models' columns ride the dispatch, zero
+    collectives, zero host-callback transfers, and memory facts that
+    scale only with K_total — a scheduler change that snuck a
+    per-model matmul (or a host round-trip) into the coalesced path
+    would drift this budget."""
+    return _serve_bucket_units("float32", k=K_COALESCED)
 
 
 def serve_mesh_bucket():
@@ -322,6 +342,7 @@ MANIFEST = {
     "compacted_decision": compacted_decision,
     "serve_bucket": serve_bucket,
     "serve_bucket_bf16": serve_bucket_bf16,
+    "serve_coalesced_bucket": serve_coalesced_bucket,
     "serve_mesh_bucket": serve_mesh_bucket,
     "mesh_predict": mesh_predict,
 }

@@ -253,9 +253,24 @@ def _build_serve_parser(sub) -> argparse.ArgumentParser:
         "serve",
         help="persistent prediction server (compacted SV union resident "
              "on device, bucketed micro-batching; serve.py)")
-    p.add_argument("-m", "--model", required=True,
+    p.add_argument("-m", "--model", default=None,
                    help="model path (.npz multiclass bundle or binary "
-                        "model, .txt binary)")
+                        "model, .txt binary); v1 single-model server — "
+                        "use --registry for the v2 multi-model engine")
+    p.add_argument("--registry", action="append", metavar="NAME=PATH",
+                   default=None,
+                   help="register NAME -> model file on the v2 serving "
+                        "engine (dpsvm_tpu/serving: model registry "
+                        "with zero-downtime hot swap, deadline-aware "
+                        "continuous batching, async dispatch); "
+                        "repeatable. stdin rows may prefix 'NAME|' to "
+                        "route; a line 'swap NAME=PATH' hot-swaps a "
+                        "model mid-stream")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="v2 engine: default per-request deadline — "
+                        "requests finishing past it count as deadline "
+                        "misses, requests expiring in queue are shed "
+                        "with an explicit verdict (default: none)")
     p.add_argument("--buckets", default="16,64,256,1024,4096",
                    help="comma-separated power-of-two query buckets "
                         "(pre-compiled at startup)")
@@ -1027,6 +1042,12 @@ def _cmd_serve(args) -> int:
     from dpsvm_tpu.config import ServeConfig
     from dpsvm_tpu.serve import PredictServer, offered_load_sweep
 
+    if args.registry:
+        return _cmd_serve_v2(args)
+    if not args.model:
+        print("error: -m/--model is required (or --registry NAME=PATH "
+              "for the v2 engine)", file=sys.stderr)
+        return 2
     model_type = "classifier"
     if args.model.endswith(".npz"):
         z = np.load(args.model, allow_pickle=False)
@@ -1131,6 +1152,141 @@ def _cmd_serve(args) -> int:
         print(f"served {st['rows']} rows in {st['dispatches']} "
               f"dispatches (bucket counts {st['bucket_counts']}, "
               f"{st['padded_rows']} padded rows)", file=sys.stderr)
+    return 0
+
+
+def _cmd_serve_v2(args) -> int:
+    """`cli serve --registry NAME=PATH [...]`: the v2 multi-model
+    serving engine (dpsvm_tpu/serving). stdin protocol: one
+    comma-separated feature row per line, optionally prefixed
+    ``NAME|`` to route (bare rows need exactly one registered model);
+    ``swap NAME=PATH`` hot-swaps a model mid-stream with zero downtime;
+    a blank line (or EOF) drains and prints one ``NAME label`` line per
+    request in submit order (``NAME MISS`` for work shed past its
+    deadline)."""
+    from dpsvm_tpu.config import ObsConfig, ServeConfig
+    from dpsvm_tpu.serving import ModelLoadError, ServingEngine
+
+    if args.model:
+        print("error: use either -m (v1 single-model server) or "
+              "--registry (v2 engine), not both", file=sys.stderr)
+        return 2
+    if args.server_bench:
+        print("error: --server-bench drives the v1 server; the v2 "
+              "engine's closed-loop benchmark is tools/loadgen.py",
+              file=sys.stderr)
+        return 2
+    if args.precision != "auto":
+        print("error: the v2 engine always risk-routes per submodel "
+              "(--precision auto semantics); the forced modes are the "
+              "v1 server's", file=sys.stderr)
+        return 2
+    if args.num_devices != 1:
+        print("error: the v2 engine is single-device (union sharding "
+              "over a mesh is the v1 server's --num-devices)",
+              file=sys.stderr)
+        return 2
+    specs = []
+    for spec in args.registry:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            print(f"error: --registry wants NAME=PATH, got {spec!r}",
+                  file=sys.stderr)
+            return 2
+        specs.append((name, path))
+
+    try:
+        buckets = tuple(int(t) for t in args.buckets.split(",") if t)
+        config = ServeConfig(
+            buckets=buckets, dtype=args.dtype,
+            deadline_ms=args.deadline_ms,
+            metrics_port=args.metrics_port,
+            metrics_host=args.metrics_host, slo_ms=args.slo_ms,
+            obs=ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir))
+        t0 = time.perf_counter()
+        engine = ServingEngine(config)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        for name, path in specs:
+            entry = engine.register(name, path)
+            if not args.quiet:
+                print(f"registered {name} v{entry.version}: {entry.k} "
+                      f"decision columns over a "
+                      f"{int(entry.ens.n_union)}-row SV union "
+                      f"({entry.strategy}, d={entry.d})",
+                      file=sys.stderr)
+    except ModelLoadError as e:
+        print(f"error: {e}", file=sys.stderr)
+        engine.close()
+        return 2
+    if engine.exporter is not None and not args.quiet:
+        print(f"metrics: {engine.exporter.url} (OpenMetrics)",
+              file=sys.stderr)
+    if not args.quiet:
+        print(f"engine ready in {time.perf_counter() - t0:.2f}s: "
+              f"{len(specs)} models, deadline "
+              f"{config.deadline_ms or 'none'} ms", file=sys.stderr)
+
+    order: list = []
+
+    def _drain_print() -> None:
+        done = engine.drain()
+        nonlocal order
+        for ticket in order:
+            if ticket not in done:
+                continue
+            res = done[ticket]
+            lab = res.labels()  # the SERVING version's fold — after a
+            if lab is None:     # swap, queued requests were answered
+                # expired       # by the OLD entry's columns
+                print(f"{res.model} MISS")
+            else:
+                print(f"{res.model} {int(lab[0])}")
+        order = []
+        sys.stdout.flush()  # piped clients wait on these labels
+
+    for line in sys.stdin:
+        ln = line.strip()
+        if not ln:
+            _drain_print()
+            continue
+        if ln.startswith("swap "):
+            name, sep, path = ln[5:].strip().partition("=")
+            if not sep:
+                print("error: swap wants NAME=PATH", file=sys.stderr)
+                continue
+            try:
+                entry = engine.swap(name, path)
+                print(f"swapped {name} -> v{entry.version}",
+                      file=sys.stderr)
+            except (ModelLoadError, KeyError) as e:
+                # The hot-swap contract: a bad file/name is refused
+                # loudly; the prior version keeps serving.
+                print(f"error: {e}", file=sys.stderr)
+            continue
+        name, sep, row = ln.partition("|")
+        if not sep:
+            name, row = None, ln
+        # Per-line failure containment (the swap path's discipline): a
+        # malformed row or unknown model name must not tear down the
+        # session and discard every queued request's output.
+        try:
+            rows = np.asarray([[float(v) for v in row.split(",")]],
+                              np.float32)
+            order.append(engine.submit(rows, model=name))
+        except (ValueError, KeyError) as e:
+            print(f"error: skipped bad query line ({e})",
+                  file=sys.stderr)
+    _drain_print()
+    engine.close()
+    if not args.quiet:
+        snap = engine.snapshot()
+        print(f"served {snap['rows']} rows in {snap['dispatches']} "
+              f"dispatches ({snap['coalesced_dispatches']} coalesced; "
+              f"{snap['deadline_misses']} deadline misses, "
+              f"{snap['hot_swaps']} hot swaps)", file=sys.stderr)
     return 0
 
 

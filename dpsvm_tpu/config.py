@@ -695,6 +695,15 @@ class ServeConfig:
       ``serve_slo_attainment`` gauge: the fraction of the recent
       request-latency window at or under this bound (1.0 when the
       window is empty — vacuously attained).
+    deadline_ms: default per-request deadline for the v2 serving
+      engine (dpsvm_tpu/serving) — requests completed past submit +
+      deadline_ms count as deadline misses, and requests whose
+      deadline already passed at batch-forming time are SHED with an
+      explicit ``expired`` verdict instead of growing the queue.
+      None (default) = no deadline discipline; per-request
+      ``submit(..., deadline_ms=...)`` overrides. Distinct from
+      slo_ms, which is purely an observability threshold and never
+      changes scheduling.
     """
 
     buckets: tuple = (16, 64, 256, 1024, 4096)
@@ -706,6 +715,7 @@ class ServeConfig:
     metrics_port: Optional[int] = None
     metrics_host: str = "127.0.0.1"
     slo_ms: float = 50.0
+    deadline_ms: Optional[float] = None
     # Observability (dpsvm_tpu/obs): serve run logs + trace spans.
     # Bucket latency HISTOGRAMS are always on (they replaced the old
     # bounded timing deques at identical cost); this only gates the
@@ -746,6 +756,9 @@ class ServeConfig:
                 "127.0.0.1; use 0.0.0.0 for remote scrapes)")
         if self.slo_ms <= 0:
             raise ValueError("slo_ms must be > 0")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                "deadline_ms must be > 0 (None = no deadlines)")
 
     def replace(self, **kw) -> "ServeConfig":
         return dataclasses.replace(self, **kw)

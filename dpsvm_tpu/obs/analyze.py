@@ -172,6 +172,16 @@ def summarize_run(run: Run) -> dict:
         "cache_lookups": fin.get("cache_lookups"),
         "cache_evictions": fin.get("cache_evictions"),
         "tiles_streamed": fin.get("tiles_streamed"),
+        # Serving-engine accounting (ISSUE 10 satellite): the v2
+        # engine's final record carries its scheduler counters; None
+        # for solver runs (and v1 serve runs, which predate them).
+        "deadline_misses": fin.get("deadline_misses"),
+        "expired": fin.get("expired"),
+        "hot_swaps": fin.get("hot_swaps"),
+        "serve_requests": fin.get("requests") if man.get(
+            "tool") == "serve" else None,
+        "batch_occupancy_mean": ((fin.get("batch_occupancy") or {})
+                                 .get("mean")),
     }
     return out
 
@@ -270,7 +280,7 @@ _REPORT_COLS = (
     ("n", "n"), ("d", "d"), ("chunks", "chunks"), ("pairs", "pairs"),
     ("device_s", "device_seconds"), ("pairs/s", "pairs_per_second"),
     ("gap last", "gap_last"), ("stalls", None), ("compiles", "compiles"),
-    ("cache", None), ("phases", None), ("done", None),
+    ("cache", None), ("serve", None), ("phases", None), ("done", None),
 )
 
 
@@ -295,6 +305,18 @@ def _report_row(s: dict) -> list:
             # whichever kernel-row cache the run carried (per-pair LRU
             # or the ooc block cache), "-" when none.
             row.append(f"{100 * hr:.1f}%" if hr is not None else "-")
+        elif head == "serve":
+            # Serving-engine column (ISSUE 10 satellite): deadline
+            # misses / hot swaps / mean batch occupancy for v2 serve
+            # runs, "-" for everything else.
+            if s.get("deadline_misses") is None:
+                row.append("-")
+            else:
+                occ = s.get("batch_occupancy_mean")
+                row.append(
+                    f"miss={s['deadline_misses']} "
+                    f"swap={s.get('hot_swaps') or 0}"
+                    + (f" occ={occ:.2f}" if occ is not None else ""))
         elif head == "phases":
             row.append(ph_txt)
         else:

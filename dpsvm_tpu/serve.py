@@ -61,6 +61,41 @@ _TILE_BUDGET_ELEMS = 1 << 28
 _DENSE_BATCH = None
 
 
+def effective_buckets(buckets, s_rows: int) -> tuple:
+    """Trim the configured power-of-two buckets so the per-dispatch
+    (bucket, S) kernel tile stays under the ~1 GB budget — a
+    covtype-scale union must shrink its large buckets instead of
+    OOMing during warm-up. Shared by PredictServer and the v2 engine's
+    union groups (serving/dispatch.py)."""
+    cap = max(1, _TILE_BUDGET_ELEMS // max(1, s_rows))
+    cap = 1 << (cap.bit_length() - 1)  # floor to a power of two
+    return tuple(b for b in buckets if b <= cap) or (cap,)
+
+
+def warn_if_bf16_serving_risky(ens, kp, stacklevel: int = 4) -> None:
+    """The serving analog of ops/kernels.warn_if_bf16_degrades: the
+    decision-sum perturbation from bf16 feature rounding is bounded by
+    ||coef||_1 * |dK| per column, so the risk scale is the max column
+    L1 norm times the sampled p90 kernel perturbation (the training
+    guard's C plays the same amplifier role there). Shared by
+    PredictServer and the v2 engine's registration path."""
+    sv = np.asarray(ens.sv_union, np.float32)
+    if kp.kind != "rbf" or sv.shape[0] == 0:
+        return
+    from dpsvm_tpu.ops.kernels import (BF16_RISK_THRESHOLD,
+                                       bf16_rbf_perturbation)
+    l1 = float(np.abs(ens.coef).sum(axis=0).max())
+    risk = l1 * bf16_rbf_perturbation(sv, kp.gamma)
+    if risk > BF16_RISK_THRESHOLD:
+        warnings.warn(
+            f"dtype='bfloat16' is likely to perturb decision values "
+            f"for this model: max-column ||coef||_1 * p90|dK| = "
+            f"{risk:.3f} > {BF16_RISK_THRESHOLD} (same amplification "
+            f"mechanism as training's bf16 guard, ops/kernels.py). "
+            f"Use dtype='float32' for this ensemble.",
+            stacklevel=stacklevel)
+
+
 def _dense_batch_factory():
     """Single-device jitted serving executor (lazy jax import; cached on
     the wrapper object so predict calls never retrace — the
@@ -176,10 +211,7 @@ class PredictServer:
         # covtype-scale union must trim the large default buckets
         # instead of OOMing during warm-up.
         s_rows = int(self.ens.sv_union.shape[0])
-        cap = max(1, _TILE_BUDGET_ELEMS // max(1, s_rows))
-        cap = 1 << (cap.bit_length() - 1)  # floor to a power of two
-        self.buckets = (tuple(b for b in config.buckets if b <= cap)
-                        or (cap,))
+        self.buckets = effective_buckets(config.buckets, s_rows)
 
         # --- device staging (once; resident for the server lifetime) -
         self._stage()
@@ -246,6 +278,7 @@ class PredictServer:
         self._pending_rows = 0
         self._done: dict = {}
         self._next_ticket = 0
+        self._closing = False
         if config.warm_start:
             self.warm()
         # OpenMetrics endpoint (obs/export.py) — started LAST so a
@@ -258,8 +291,11 @@ class PredictServer:
         if config.metrics_port is not None:
             def _render(_ref=ref):
                 srv = _ref()
-                return (srv.render_openmetrics() if srv is not None
-                        else "# EOF\n")
+                if srv is None or srv._closing:
+                    # A scrape racing close(): answer the minimal valid
+                    # exposition instead of reading state mid-teardown.
+                    return "# EOF\n"
+                return srv.render_openmetrics()
 
             self.exporter = openmetrics.MetricsExporter(
                 _render, port=config.metrics_port,
@@ -319,26 +355,10 @@ class PredictServer:
         self._call = call
 
     def _bf16_guard(self, sv: np.ndarray) -> None:
-        """The serving analog of ops/kernels.warn_if_bf16_degrades: the
-        decision-sum perturbation from bf16 feature rounding is bounded
-        by ||coef||_1 * |dK| per column, so the risk scale is the max
-        column L1 norm times the sampled p90 kernel perturbation (the
-        training guard's C plays the same amplifier role there)."""
-        if self.kp.kind != "rbf" or sv.shape[0] == 0:
-            return
-        from dpsvm_tpu.ops.kernels import (BF16_RISK_THRESHOLD,
-                                           bf16_rbf_perturbation)
-        l1 = float(np.abs(self.ens.coef).sum(axis=0).max())
-        risk = l1 * bf16_rbf_perturbation(sv, self.kp.gamma)
-        if risk > BF16_RISK_THRESHOLD:
-            warnings.warn(
-                f"ServeConfig(dtype='bfloat16') is likely to perturb "
-                f"decision values for this model: max-column "
-                f"||coef||_1 * p90|dK| = {risk:.3f} > "
-                f"{BF16_RISK_THRESHOLD} (same amplification mechanism "
-                f"as training's bf16 guard, ops/kernels.py). Use "
-                f"dtype='float32' for this ensemble.",
-                stacklevel=4)
+        """Delegates to the shared serving bf16 guard (module level —
+        the v2 engine's registration path runs the same check)."""
+        del sv  # the shared guard reads the ensemble's own union rows
+        warn_if_bf16_serving_risky(self.ens, self.kp, stacklevel=5)
 
     # ------------------------------------------------------------- warmup
     def warm(self) -> dict:
@@ -594,10 +614,20 @@ class PredictServer:
     def close(self) -> None:
         """Finish the serve run log (no-op when obs is disabled or
         already closed), stop the /metrics endpoint and detach the
-        compile sink; the device-resident operands stay usable."""
-        compilelog.remove_sink(self._compile_sink)
+        compile sink; the device-resident operands stay usable.
+
+        Ordering contract (ISSUE 10 satellite): the /metrics endpoint
+        shuts down FIRST — before any state the render callback reads
+        is torn down — and ``_closing`` makes a scrape already in
+        flight on a handler thread answer the minimal valid exposition
+        instead of racing the teardown. A scrape concurrent with
+        close() therefore sees either a full exposition, the ``# EOF``
+        stub, or a clean connection refusal — never a half-torn-down
+        read (pinned by the scrape-during-close test)."""
+        self._closing = True
         if self.exporter is not None:
             self.exporter.close()
+        compilelog.remove_sink(self._compile_sink)
         self._obs.finish(**self.snapshot())
 
 

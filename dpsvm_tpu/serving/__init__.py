@@ -1,0 +1,36 @@
+"""dpsvm_tpu.serving — serving engine v2 (ISSUE 10).
+
+The PredictServer (serve.py) proved the serving shape: a compacted SV
+union resident on device, power-of-two bucket executors, micro-batch
+merging. This package grows that into a multi-model engine:
+
+* :mod:`dpsvm_tpu.serving.registry`  — named, versioned models loaded
+  from format_version-2 npz with atomic zero-downtime hot swap: the
+  incoming version is fully validated, staged and warmed BEFORE the
+  routing pointer flips; a corrupted/partial file never disturbs the
+  live version.
+* :mod:`dpsvm_tpu.serving.scheduler` — deadline-aware continuous
+  batching: per-request deadlines, EDF-ordered batch forming that
+  coalesces requests across models sharing one compacted union /
+  kernel family into a single bucket dispatch, and backpressure that
+  sheds expired work with an explicit deadline-miss verdict.
+* :mod:`dpsvm_tpu.serving.dispatch`  — union-group device staging and
+  the double-buffered async dispatcher (host-side batch forming for
+  batch t+1 overlaps device compute for batch t — the ops/ooc.py
+  double-buffer discipline applied to serving), plus the
+  :class:`ServingEngine` frontend that ties the three together and
+  exports the whole thing on /metrics and the serve run log.
+
+The closed-loop load generator driving this engine through the bench
+regression gate is ``tools/loadgen.py``.
+"""
+
+from dpsvm_tpu.serving.dispatch import ServeResult, ServingEngine
+from dpsvm_tpu.serving.registry import (LoadedModel, ModelLoadError,
+                                        ModelRegistry, load_model_file)
+from dpsvm_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = [
+    "ServingEngine", "ServeResult", "ModelRegistry", "LoadedModel",
+    "ModelLoadError", "load_model_file", "Scheduler", "Request",
+]

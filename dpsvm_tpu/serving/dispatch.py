@@ -1,0 +1,801 @@
+"""Union-group device staging, double-buffered async dispatch, and the
+ServingEngine frontend.
+
+Three mechanisms, each attacking one cost the v1 PredictServer pays:
+
+* :class:`UnionGroup` — the staged device operands for one coalescing
+  family (registry.LoadedModel.group_key): ONE resident SV union +
+  sv_sq, and the member models' dual-coefficient matrices stacked
+  side by side into one (S, K_total) operand. A bucket dispatch then
+  answers requests for EVERY member model with a single kernel matmul
+  — the kernel work (the dominant term, serve.py's own motivation) is
+  shared; each request slices its model's columns from the result.
+  Groups restage only on registry mutations, never on the request
+  path, and reuse serve._dense_batch_factory, so the compiled bucket
+  executors are the SAME programs tpulint budgets
+  (serve_bucket/serve_coalesced_bucket).
+* :class:`AsyncDispatcher` — at most one device batch in flight; the
+  next batch is FORMED AND DISPATCHED before the previous batch's
+  result is materialized, so host-side batch forming for batch t+1
+  overlaps device compute for batch t (jax dispatch is asynchronous;
+  ``np.asarray`` is the only blocking point — the ops/ooc.py
+  double-buffer discipline applied to serving).
+* :class:`ServingEngine` — registry + scheduler + dispatcher behind a
+  submit/pump/drain API, with always-on instruments (queue depth,
+  deadline misses, hot swaps, batch occupancy), the serve run log
+  (one chunk record per dispatch), and the /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import ServeConfig
+from dpsvm_tpu.obs import compilelog, run_obs
+from dpsvm_tpu.obs import export as openmetrics
+from dpsvm_tpu.obs.metrics import Registry
+from dpsvm_tpu.obs.trace import span
+from dpsvm_tpu.serve import (_dense_batch_factory, effective_buckets,
+                             warn_if_bf16_serving_risky)
+from dpsvm_tpu.serving.registry import LoadedModel, ModelRegistry
+from dpsvm_tpu.serving.scheduler import Request, Scheduler
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One completed request. ``verdict``:
+      "ok"      — served, completed within its deadline (or none set);
+      "late"    — served, but completed PAST its deadline: the decision
+                  rows are real, and the request counts as a deadline
+                  miss (admitted-past-deadline work is counted, never
+                  silently served late);
+      "expired" — shed at batch-forming time (deadline already passed
+                  before any device work): no decision rows, counted.
+
+    ``entry`` is the LoadedModel THAT SERVED the request (the version
+    resolved at submit) — label folding must use it, not a fresh
+    registry lookup: after a hot swap the live entry may have a
+    different class set/strategy than the one whose columns these are.
+    """
+
+    ticket: int
+    model: str
+    version: int
+    decision: Optional[np.ndarray]
+    verdict: str
+    latency_s: float
+    entry: object = dataclasses.field(default=None, repr=False)
+
+    def labels(self) -> Optional[np.ndarray]:
+        """Predicted labels via the SERVING version's fold (None for
+        expired requests)."""
+        if self.decision is None:
+            return None
+        return self.entry.labels(self.decision)
+
+    @property
+    def ok(self) -> bool:
+        return self.verdict == "ok"
+
+    @property
+    def deadline_missed(self) -> bool:
+        return self.verdict in ("late", "expired")
+
+
+class UnionGroup:
+    """Staged device operands for one coalescing family.
+
+    ``members`` is ordered; ``slices[entry]`` is entry's column range in
+    the stacked coefficient operand. Built OFF the request path (at
+    registration / swap prepare, before the routing flip) and warmed so
+    post-build traffic never traces or uploads."""
+
+    def __init__(self, key, members, config: ServeConfig):
+        import jax.numpy as jnp
+
+        self.key = key
+        self.members = list(members)
+        base = self.members[0].ens
+        self.kp = base.kernel
+        self.d = int(base.sv_union.shape[1])
+        self.s_rows = int(base.sv_union.shape[0])
+        self.buckets = effective_buckets(config.buckets, self.s_rows)
+        self.slices: dict = {}
+        lo = 0
+        coefs, bs = [], []
+        for m in self.members:
+            self.slices[m] = slice(lo, lo + m.k)
+            coefs.append(np.ascontiguousarray(m.ens.coef, np.float32))
+            bs.append(np.ascontiguousarray(m.ens.b, np.float32))
+            lo += m.k
+        self.k_total = lo
+        self.b_host = np.concatenate(bs)
+        if self.s_rows == 0:
+            # Degenerate all-empty union: the decision is exactly -b;
+            # no device operands, no executor.
+            self._call = None
+            return
+        sv = np.ascontiguousarray(base.sv_union, np.float32)
+        if config.dtype == "bfloat16":
+            import ml_dtypes
+            sv_store = sv.astype(ml_dtypes.bfloat16)
+            # Norms from the ROUNDED rows — the dot operands' values
+            # (the serve.py _stage discipline).
+            sv_sq = (sv_store.astype(np.float32) ** 2).sum(
+                1, dtype=np.float32)
+        else:
+            sv_store = sv
+            sv_sq = (sv * sv).sum(1, dtype=np.float32)
+        batch = _dense_batch_factory()
+        sv_d = jnp.asarray(sv_store)
+        sv_sq_d = jnp.asarray(sv_sq)
+        coef_d = jnp.asarray(np.hstack(coefs))
+        b_d = jnp.asarray(self.b_host)
+
+        def call(qb, _kp=self.kp):
+            return batch(jnp.asarray(qb), sv_d, sv_sq_d, coef_d, b_d,
+                         _kp)
+
+        self._call = call
+
+    def member_set(self) -> set:
+        return set(self.members)
+
+    def warm(self) -> None:
+        """Compile + touch every bucket executor on zero queries so the
+        first live request after a (re)stage pays neither."""
+        for bucket in self.buckets:
+            np.asarray(self.dispatch(
+                np.zeros((bucket, self.d), np.float32), bucket))
+
+    def dispatch(self, qb: np.ndarray, bucket: int):
+        """One async bucket dispatch of a (bucket, d) padded batch ->
+        (bucket, K_total) decision columns (device array — NOT yet
+        materialized; np.asarray is the caller's blocking point)."""
+        if self._call is None:
+            return np.broadcast_to(
+                -self.b_host, (qb.shape[0], self.k_total)).astype(
+                np.float32)
+        with compilelog.label(f"serve/bucket{bucket}",
+                              f"({bucket},{self.d})"), \
+                span(f"serve/bucket{bucket}"):
+            return self._call(qb)
+
+
+class AsyncDispatcher:
+    """At most one in-flight device batch; issuing the next collects
+    the previous. The issue->collect interval spans the NEXT batch's
+    host-side forming — that overlap is the point — so the honest
+    per-dispatch cost recorded is the time actually spent BLOCKING on
+    materialization (``wait_s``), not the interval."""
+
+    def __init__(self):
+        self._inflight = None  # (device result, meta, t_issue)
+
+    @property
+    def busy(self) -> bool:
+        return self._inflight is not None
+
+    def issue(self, group: UnionGroup, qb: np.ndarray, bucket: int,
+              meta) -> list:
+        """Dispatch (async), then materialize the PREVIOUS in-flight
+        batch. Returns [(meta, out_rows, wait_s, window_s)] for every
+        batch completed by this call (0 or 1)."""
+        prev = self._inflight
+        self._inflight = (group.dispatch(qb, bucket), meta,
+                          time.perf_counter())
+        return self._materialize(prev)
+
+    def drain(self) -> list:
+        out = self._materialize(self._inflight)
+        self._inflight = None
+        return out
+
+    @staticmethod
+    def _materialize(item) -> list:
+        if item is None:
+            return []
+        dev, meta, t_issue = item
+        t0 = time.perf_counter()
+        rows = np.asarray(dev)
+        t1 = time.perf_counter()
+        return [(meta, rows, t1 - t0, t1 - t_issue)]
+
+
+class ServingEngine:
+    """Multi-model serving engine v2: model registry with zero-downtime
+    hot swap, deadline-aware continuous batching, async dispatch.
+
+    Request path: ``submit(rows, model=..., deadline_ms=...) ->
+    ticket``; ``pump()`` runs one scheduling step (form the earliest-
+    deadline batch, dispatch it async, complete whatever finished);
+    ``drain()`` pumps until idle and returns every completed
+    {ticket: ServeResult}; ``results()`` pops completions without
+    blocking. Single-device (the mesh union sharding stays on
+    PredictServer)."""
+
+    def __init__(self, config: ServeConfig = ServeConfig()):
+        if config.num_devices != 1:
+            raise ValueError(
+                "ServingEngine is single-device (the union-sharded "
+                "mesh path is PredictServer's num_devices>1 mode); "
+                "set num_devices=1")
+        self.config = config
+        self.scheduler = Scheduler()
+        self.registry = ModelRegistry(prepare=self._prepare_entry,
+                                      on_swap=self._on_swap)
+        self._groups: dict = {}
+        self._dispatcher = AsyncDispatcher()
+        self._done: dict = {}
+        self._next_ticket = 0
+        self._dispatches = 0
+        self._rows_total = 0
+        self._closing = False
+        self._closed = False
+
+        # Always-on instruments (the PredictServer discipline): one
+        # Registry per engine; percentiles everywhere come from THESE
+        # histograms — loadgen, /metrics and the run log cannot
+        # disagree.
+        self.metrics = Registry(enabled=True)
+        self.request_seconds = self.metrics.histogram(
+            "serve.request_seconds")
+        self.dispatch_seconds = self.metrics.histogram(
+            "serve.dispatch_seconds")
+        self.batch_occupancy = self.metrics.histogram(
+            "serve.batch_occupancy")
+        self.deadline_misses = self.metrics.counter(
+            "serve.deadline_misses_total")
+        self.expired = self.metrics.counter("serve.expired_total")
+        self.hot_swaps = self.metrics.counter("serve.hot_swaps_total")
+        self.coalesced = self.metrics.counter(
+            "serve.coalesced_dispatches_total")
+        self.compiles = self.metrics.counter("serve.compiles_total")
+        self._per_model: dict = {}
+
+        # Compile accounting, scoped to THIS engine's own dispatches
+        # (the serve.py weakref-sink pattern: close() was never
+        # mandatory, so the sink must not pin the engine). The scope
+        # flag is THREAD-LOCAL: an admin thread warming a swap's group
+        # runs concurrently with the serving thread's dispatches, and
+        # compiles fire synchronously on the compiling thread — a
+        # shared bool would let one thread's finally-reset hide the
+        # other thread's compile from the counter.
+        import threading
+        import weakref
+
+        self._tl = threading.local()
+        self._prep_lock = threading.Lock()
+        self._preparing = 0  # in-flight swap preparations (admin thread)
+        ref = weakref.ref(self)
+
+        def _compile_sink(name, shape, secs, _ref=ref):
+            eng = _ref()
+            if eng is None:
+                compilelog.remove_sink(_compile_sink)
+                return
+            if getattr(eng._tl, "in_dispatch", False) \
+                    and name.startswith("serve/"):
+                eng.compiles.add(1)
+
+        self._compile_sink = _compile_sink
+        compilelog.add_sink(self._compile_sink)
+
+        self._obs = run_obs("serve", config,
+                            meta={"engine": "serving_v2",
+                                  "buckets": list(config.buckets),
+                                  "dtype": config.dtype,
+                                  "deadline_ms": config.deadline_ms})
+        self.exporter = None
+        if config.metrics_port is not None:
+            def _render(_ref=ref):
+                eng = _ref()
+                if eng is None or eng._closing:
+                    # A scrape racing close(): the minimal valid
+                    # exposition, never a half-torn-down read.
+                    return "# EOF\n"
+                return eng.render_openmetrics()
+
+            self.exporter = openmetrics.MetricsExporter(
+                _render, port=config.metrics_port,
+                host=config.metrics_host)
+
+    # ------------------------------------------------------ registration
+    def _members_for(self, key, extra=None) -> list:
+        """Current membership of a union group: live registry entries
+        plus entries still holding queued work (an old version keeps
+        its columns staged across a swap until its queue drains), plus
+        the incoming entry when preparing a swap."""
+        seen: list = []
+        for e in self.registry.entries():
+            if e.group_key(self.config.dtype) == key and e not in seen:
+                seen.append(e)
+        for e in self.scheduler.pending_entries():
+            if e.group_key(self.config.dtype) == key and e not in seen:
+                seen.append(e)
+        if extra is not None and extra not in seen:
+            seen.append(extra)
+        return seen
+
+    def _prepare_entry(self, entry: LoadedModel) -> None:
+        """Registry prepare hook: stage + warm the incoming version's
+        union group BEFORE the routing pointer flips — the
+        zero-downtime half of the hot-swap contract. Runs the bf16
+        quality guard when the engine stores unions in bfloat16."""
+        if self.config.dtype == "bfloat16":
+            warn_if_bf16_serving_risky(entry.ens, entry.kp,
+                                       stacklevel=6)
+        with self._prep_lock:
+            self._preparing += 1  # parks _gc_groups: the GC must not
+        try:                      # shrink away a group being prepared
+            key = entry.group_key(self.config.dtype)
+            group = UnionGroup(key,
+                               self._members_for(key, extra=entry),
+                               self.config)
+            self._tl.in_dispatch = True
+            try:
+                group.warm()
+            finally:
+                self._tl.in_dispatch = False
+            # Publish the staged group. In-flight dispatches captured
+            # their group object; queued requests of existing members
+            # route here (a superset staging — their column slices are
+            # present).
+            self._groups[key] = group
+        finally:
+            with self._prep_lock:
+                self._preparing -= 1
+
+    def _on_swap(self, prev: LoadedModel, new: LoadedModel) -> None:
+        self.hot_swaps.add(1)
+        self._model_metrics(new.name)["swaps"].add(1)
+        self._obs.event("hot_swap", model=new.name,
+                        from_version=prev.version,
+                        to_version=new.version,
+                        union_changed=prev.union_fp != new.union_fp)
+
+    def register(self, name: str, source) -> LoadedModel:
+        entry = self.registry.register(name, source)
+        self._obs.event("register", model=name, version=entry.version,
+                        k=entry.k, d=entry.d,
+                        n_union=int(entry.ens.n_union))
+        self._model_metrics(name)  # instruments exist before traffic
+        return entry
+
+    def swap(self, name: str, source) -> LoadedModel:
+        return self.registry.swap(name, source)
+
+    def unregister(self, name: str) -> LoadedModel:
+        return self.registry.unregister(name)
+
+    # ----------------------------------------------------------- metrics
+    def _model_metrics(self, name: str) -> dict:
+        m = self._per_model.get(name)
+        if m is None:
+            m = {
+                "requests": self.metrics.counter(
+                    f"serve.requests.{name}"),
+                "rows": self.metrics.counter(f"serve.rows.{name}"),
+                "misses": self.metrics.counter(
+                    f"serve.deadline_misses.{name}"),
+                "expired": self.metrics.counter(
+                    f"serve.expired.{name}"),
+                "swaps": self.metrics.counter(f"serve.swaps.{name}"),
+                "latency": self.metrics.histogram(
+                    f"serve.request_seconds.{name}"),
+            }
+            self._per_model[name] = m
+        return m
+
+    # ------------------------------------------------------------ submit
+    _DEADLINE_DEFAULT = object()  # sentinel: "use the config default"
+
+    def submit(self, rows, model: Optional[str] = None,
+               deadline_ms=_DEADLINE_DEFAULT) -> int:
+        """Admit one request. ``model`` may be omitted when exactly one
+        model is registered. ``deadline_ms``: omitted = the config
+        default; an explicit number overrides it; an explicit ``None``
+        means NO deadline for this request even when the config sets
+        one (the synchronous decision()/predict() conveniences use
+        this — they must never have their answer shed). Returns the
+        ticket whose ServeResult a later pump/drain completes.
+        Crossing ``max_pending`` queued rows forces scheduling steps
+        until the queue is back under the bound (backpressure)."""
+        entry = self.registry.get(model)
+        q = np.asarray(rows)
+        if q.ndim != 2 or q.shape[1] != entry.d:
+            raise ValueError(
+                f"queries for model {entry.name!r} must be "
+                f"(n, {entry.d}); got {q.shape}")
+        if deadline_ms is self._DEADLINE_DEFAULT:
+            deadline_ms = self.config.deadline_ms
+        now = time.perf_counter()
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self.scheduler.submit(
+            entry, q, now,
+            None if deadline_ms is None else deadline_ms / 1e3,
+            ticket, self.config.dtype)
+        mm = self._model_metrics(entry.name)
+        mm["requests"].add(1)
+        mm["rows"].add(q.shape[0])
+        while self.scheduler.queue_rows >= self.config.max_pending:
+            self.pump()
+        return ticket
+
+    # -------------------------------------------------------- scheduling
+    def pump(self) -> int:
+        """One scheduling step: form the earliest-deadline group's next
+        batch (shedding expired requests), dispatch it asynchronously,
+        and complete whatever previous dispatch finished. Returns the
+        number of requests completed by this call; results accumulate
+        for :meth:`results`/:meth:`drain`."""
+        completed = 0
+        now = time.perf_counter()
+        key = self.scheduler.next_key()
+        if key is None:
+            for item in self._dispatcher.drain():
+                completed += self._complete_batch(item)
+            # Idle moment: retire drained-out union groups here too —
+            # a pump()/results()-driven server under sustained traffic
+            # may never call drain(), and staged unions must not
+            # accumulate across hot swaps.
+            if not self._dispatcher.busy:
+                self._gc_groups()
+            return completed
+        group = self._group_for(key)
+        batch, expired = self.scheduler.form(key, now,
+                                             group.buckets[-1])
+        for req in expired:
+            self._finish_expired(req)
+            completed += 1
+        if batch:
+            completed += self._dispatch_batch(group, batch)
+        elif not self.scheduler.queue_depth:
+            for item in self._dispatcher.drain():
+                completed += self._complete_batch(item)
+        return completed
+
+    def drain(self) -> dict:
+        """Pump until every queued request and in-flight batch is
+        complete; returns (and pops) all completed results."""
+        while self.scheduler.queue_depth or self._dispatcher.busy:
+            self.pump()
+        self._gc_groups()
+        return self.results()
+
+    def results(self) -> dict:
+        """Pop everything completed so far: {ticket: ServeResult}."""
+        done = self._done
+        self._done = {}
+        return done
+
+    def _group_for(self, key) -> UnionGroup:
+        """The staged group for a key — normally staged by the prepare
+        hook; restaged here only if a queued request's entry is not in
+        the staged member set (possible after an unregister)."""
+        group = self._groups.get(key)
+        needed = {e for e in self.scheduler.pending_entries()
+                  if e.group_key(self.config.dtype) == key}
+        if group is None or not needed <= group.member_set():
+            group = UnionGroup(key, self._members_for(key), self.config)
+            self._tl.in_dispatch = True
+            try:
+                group.warm()
+            finally:
+                self._tl.in_dispatch = False
+            self._groups[key] = group
+        return group
+
+    def _gc_groups(self) -> None:
+        """Idle-time retirement (queues are empty here): drop groups
+        with no live member, and restage groups still carrying a
+        drained old version's columns — staged unions must not
+        accumulate across many swaps. No-op while an admin thread is
+        preparing a swap (its superset group must not be shrunk from
+        under it before the routing flip)."""
+        with self._prep_lock:
+            if self._preparing:
+                return
+        live_keys: dict = {}
+        for e in self.registry.entries():
+            live_keys.setdefault(
+                e.group_key(self.config.dtype), []).append(e)
+        for key in list(self._groups):
+            members = live_keys.get(key)
+            if members is None:
+                del self._groups[key]
+            elif set(members) != self._groups[key].member_set():
+                group = UnionGroup(key, members, self.config)
+                self._tl.in_dispatch = True
+                try:
+                    group.warm()
+                finally:
+                    self._tl.in_dispatch = False
+                self._groups[key] = group
+
+    # ---------------------------------------------------------- dispatch
+    def _dispatch_batch(self, group: UnionGroup, batch) -> int:
+        """Merge an EDF-formed batch into one padded bucket dispatch
+        (a single oversized request loops over the top bucket — the v1
+        discipline). Completion of the PREVIOUS in-flight batch happens
+        inside issue(), after this batch's async dispatch."""
+        rows = sum(r.n for r in batch)
+        merged = np.concatenate(
+            [np.asarray(r.rows, np.float32) for r in batch])
+        top = group.buckets[-1]
+        completed = 0
+        if rows <= top:
+            bucket = next(b for b in group.buckets if rows <= b)
+            qb = merged
+            if rows != bucket:
+                qb = np.zeros((bucket, group.d), np.float32)
+                qb[:rows] = merged
+            completed += self._issue(group, qb, bucket, batch, rows,
+                                     segments=None)
+        else:
+            # One oversized request (form() guarantees multi-request
+            # batches fit the top bucket): loop the top bucket,
+            # assembling segments into one output before completion.
+            segments = []
+            s = 0
+            while s < rows:
+                take = min(rows - s, top)
+                qb = merged[s:s + take]
+                if take != top:
+                    qp = np.zeros((top, group.d), np.float32)
+                    qp[:take] = qb
+                    qb = qp
+                last = s + take >= rows
+                completed += self._issue(
+                    group, qb, top, batch if last else None, take,
+                    segments=(segments, s, rows))
+                s += take
+        return completed
+
+    def _issue(self, group, qb, bucket, batch, used_rows,
+               segments) -> int:
+        # Counters advance BEFORE the dispatch and ride the meta as a
+        # snapshot: the chunk record for THIS batch must carry ITS OWN
+        # cumulative (pairs, dispatch) — the completion callback fires
+        # one batch later (double buffer), when the live counters
+        # already describe the next batch.
+        self._dispatches += 1
+        self._rows_total += used_rows
+        meta = (group, batch, used_rows, segments,
+                self._rows_total, self._dispatches)
+        self._tl.in_dispatch = True
+        try:
+            items = self._dispatcher.issue(group, qb, bucket, meta)
+        finally:
+            self._tl.in_dispatch = False
+        self.batch_occupancy.observe(used_rows / bucket)
+        if batch is not None and \
+                len({r.entry.name for r in batch}) > 1:
+            self.coalesced.add(1)
+        completed = 0
+        for item in items:
+            completed += self._complete_batch(item)
+        return completed
+
+    def _complete_batch(self, item) -> int:
+        (group, batch, used_rows, segments, rows_cum, dispatch_no), \
+            out, wait_s, window_s = item
+        self.dispatch_seconds.observe(wait_s)
+        self._obs.chunk(pairs=rows_cum, b_hi=0.0, b_lo=0.0,
+                        device_seconds=wait_s,
+                        dispatch=dispatch_no,
+                        rows=int(used_rows), window_seconds=
+                        round(window_s, 6))
+        if segments is not None:
+            seg_list, offset, total_rows = segments
+            seg_list.append(out[:used_rows])
+            if batch is None:  # not the final segment yet
+                return 0
+            out = np.concatenate(seg_list)
+            used_rows = total_rows
+        if batch is None:
+            return 0
+        now = time.perf_counter()
+        lo = 0
+        for req in batch:
+            dec = np.array(out[lo:lo + req.n, group.slices[req.entry]])
+            lo += req.n
+            if req.entry.f64_cols.size:
+                _overwrite_f64(req.entry, req.rows, dec)
+            self._finish_served(req, dec, now)
+        return len(batch)
+
+    # -------------------------------------------------------- completion
+    def _finish_served(self, req: Request, dec: np.ndarray,
+                       now: float) -> None:
+        late = now > req.deadline
+        latency = now - req.t_submit
+        mm = self._model_metrics(req.entry.name)
+        self.request_seconds.observe(latency)
+        mm["latency"].observe(latency)
+        if late:
+            self.deadline_misses.add(1)
+            mm["misses"].add(1)
+        self._done[req.ticket] = ServeResult(
+            ticket=req.ticket, model=req.entry.name,
+            version=req.entry.version, decision=dec,
+            verdict="late" if late else "ok", latency_s=latency,
+            entry=req.entry)
+
+    def _finish_expired(self, req: Request) -> None:
+        now = time.perf_counter()
+        mm = self._model_metrics(req.entry.name)
+        self.deadline_misses.add(1)
+        self.expired.add(1)
+        mm["misses"].add(1)
+        mm["expired"].add(1)
+        self._done[req.ticket] = ServeResult(
+            ticket=req.ticket, model=req.entry.name,
+            version=req.entry.version, decision=None,
+            verdict="expired", latency_s=now - req.t_submit,
+            entry=req.entry)
+
+    # -------------------------------------------------------- convenience
+    def decision(self, rows, model: Optional[str] = None) -> np.ndarray:
+        """Synchronous one-request convenience: submit + drain + slice
+        (the v1 decision() shape)."""
+        ticket = self.submit(rows, model=model, deadline_ms=None)
+        done = self.drain()
+        res = done.pop(ticket)
+        self._done.update(done)  # other tickets stay claimable
+        return res.decision
+
+    def predict(self, rows, model: Optional[str] = None) -> np.ndarray:
+        ticket = self.submit(rows, model=model, deadline_ms=None)
+        done = self.drain()
+        res = done.pop(ticket)
+        self._done.update(done)
+        return res.labels()  # the SERVING version's fold, swap-safe
+
+    # --------------------------------------------------------- telemetry
+    def snapshot(self) -> dict:
+        """JSON-able engine state: counters, queue state, histogram
+        snapshots, per-model breakdown — the serve run log's final
+        record and the loadgen artifact both consume this shape."""
+        per_model = {}
+        for name, mm in sorted(self._per_model.items()):
+            per_model[name] = {
+                "requests": mm["requests"].value,
+                "rows": mm["rows"].value,
+                "deadline_misses": mm["misses"].value,
+                "expired": mm["expired"].value,
+                "swaps": mm["swaps"].value,
+                "request_seconds": mm["latency"].snapshot(),
+            }
+        return {
+            "models": self.registry.names(),
+            "versions": {e.name: e.version
+                         for e in self.registry.entries()},
+            "dispatches": self._dispatches,
+            "rows": self._rows_total,
+            "requests": self._next_ticket,
+            "queue_depth": self.scheduler.queue_depth,
+            "queue_rows": self.scheduler.queue_rows,
+            "deadline_misses": self.deadline_misses.value,
+            "expired": self.expired.value,
+            "hot_swaps": self.hot_swaps.value,
+            "coalesced_dispatches": self.coalesced.value,
+            "compiles": self.compiles.value,
+            "batch_occupancy": self.batch_occupancy.snapshot(),
+            "dispatch_seconds": self.dispatch_seconds.snapshot(),
+            "request_seconds": self.request_seconds.snapshot(),
+            "per_model": per_model,
+        }
+
+    def render_openmetrics(self) -> str:
+        """The /metrics exposition: per-model labelled counters and
+        latency summaries, queue-depth gauges, deadline-miss and
+        hot-swap counters, batch-occupancy summary — quantiles ARE
+        Histogram.percentiles() (scrape == snapshot). Host reads only;
+        a scrape can never add a device dispatch."""
+        om = openmetrics
+        depth = self.scheduler.depth_by_model()
+        versions = {e.name: e.version for e in self.registry.entries()}
+        req_s, row_s, miss_s, exp_s, swap_s = [], [], [], [], []
+        lat_samples = []
+        for name, mm in sorted(self._per_model.items()):
+            lb = {"model": name}
+            req_s.append(("_total", lb, mm["requests"].value))
+            row_s.append(("_total", lb, mm["rows"].value))
+            miss_s.append(("_total", lb, mm["misses"].value))
+            exp_s.append(("_total", lb, mm["expired"].value))
+            swap_s.append(("_total", lb, mm["swaps"].value))
+            if len(mm["latency"]):
+                lat_samples.extend(om.summary_samples(
+                    mm["latency"], labels=lb))
+        fams = [
+            om.metric("serving_requests", "counter",
+                      "requests admitted", req_s),
+            om.metric("serving_rows", "counter", "query rows admitted",
+                      row_s),
+            om.metric("serving_deadline_misses", "counter",
+                      "requests that missed their deadline (served "
+                      "late or shed)", miss_s),
+            om.metric("serving_expired", "counter",
+                      "requests shed at batch forming (deadline "
+                      "already passed)", exp_s),
+            om.metric("serving_hot_swaps", "counter",
+                      "zero-downtime model version swaps", swap_s),
+            om.gauge("serving_model_version",
+                     "live registered version per model",
+                     [({"model": n}, v)
+                      for n, v in sorted(versions.items())]),
+            om.gauge("serving_queue_depth",
+                     "queued requests awaiting dispatch",
+                     [({"model": n}, v)
+                      for n, v in sorted(depth.items())]),
+            om.gauge("serving_queue_rows",
+                     "queued query rows awaiting dispatch",
+                     [({}, self.scheduler.queue_rows)]),
+            om.counter("serving_dispatches", "device bucket dispatches",
+                       self._dispatches),
+            om.counter("serving_coalesced_dispatches",
+                       "dispatches answering more than one model from "
+                       "one union matmul", self.coalesced.value),
+            om.counter("serving_compiles",
+                       "bucket executors compiled while serving",
+                       self.compiles.value),
+        ]
+        if lat_samples:
+            fams.append(om.metric(
+                "serving_request_seconds", "summary",
+                "request latency (submit->complete), recent-window "
+                "quantiles", lat_samples))
+        if len(self.batch_occupancy):
+            fams.append(om.summary(
+                "serving_batch_occupancy",
+                "rows dispatched / bucket capacity, recent window",
+                self.batch_occupancy))
+        if len(self.dispatch_seconds):
+            fams.append(om.summary(
+                "serving_dispatch_seconds",
+                "host blocking wait per dispatch (overlap residual), "
+                "recent window", self.dispatch_seconds))
+        return om.render(fams)
+
+    def close(self) -> None:
+        """Drain outstanding work, stop /metrics FIRST (the ordering
+        contract: a racing scrape sees a full exposition, the # EOF
+        stub, or a clean refusal — never a half-torn-down read),
+        detach the compile sink and finish the serve run log."""
+        if self._closed:
+            return
+        self._closing = True
+        if self.exporter is not None:
+            self.exporter.close()
+        self.drain()
+        compilelog.remove_sink(self._compile_sink)
+        self._obs.finish(**self.snapshot())
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _overwrite_f64(entry: LoadedModel, q, dec: np.ndarray) -> None:
+    """Exact host float64 evaluation of an entry's risk-routed columns
+    (the serve.py _overwrite_f64 algebra via the one shared f64 kernel
+    definition). ``q`` is the CALLER'S rows — float64 requests stay
+    exact (unquantized) on these columns."""
+    from dpsvm_tpu.solver.reconstruct import gram_matvec_f64
+
+    q64 = np.asarray(q, np.float64)
+    for j in entry.f64_cols:
+        dec[:, j] = (gram_matvec_f64(entry.ens.sv_union,
+                                     entry.ens.coef[:, j], entry.kp,
+                                     queries=q64)
+                     - float(entry.ens.b[j])).astype(np.float32)

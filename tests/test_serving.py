@@ -1,0 +1,594 @@
+"""Serving engine v2 tests (dpsvm_tpu/serving — ISSUE 10): registry
+versioning + atomic hot swap under sustained enqueue, corrupted-npz
+rejection, EDF scheduling + deadline-miss accounting, union-group
+coalescing across models, async-dispatch parity with the model layer,
+observability surfaces, and the scrape-during-close ordering contract."""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import ObsConfig, ServeConfig, SVMConfig
+from dpsvm_tpu.models.multiclass import (MulticlassSVM, decision_matrix,
+                                         predict_multiclass,
+                                         train_multiclass)
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.serving import (ModelLoadError, ModelRegistry,
+                               ServingEngine, load_model_file)
+
+CFG = SVMConfig(c=5.0, gamma=0.25, epsilon=1e-3, chunk_iters=256)
+
+
+@pytest.fixture(scope="module")
+def three_class():
+    rng = np.random.default_rng(31)
+    xs, ys = [], []
+    for k in range(3):
+        c = np.zeros(5, np.float32)
+        c[k] = 2.5
+        xs.append(rng.normal(size=(70, 5)).astype(np.float32) * 0.7 + c)
+        ys.append(np.full(70, k))
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+@pytest.fixture(scope="module")
+def two_versions(three_class):
+    """(v1 model, v2 model, x): same problem, different C — different
+    SVs, so v1/v2 have DIFFERENT unions (the realistic retrain swap)."""
+    x, y = three_class
+    m1, _ = train_multiclass(x, y, CFG, strategy="ovr")
+    m2, _ = train_multiclass(x, y, CFG.replace(c=1.5), strategy="ovr")
+    return m1, m2, x
+
+
+@pytest.fixture()
+def model_files(two_versions, tmp_path):
+    m1, m2, _ = two_versions
+    p1, p2 = str(tmp_path / "v1.npz"), str(tmp_path / "v2.npz")
+    m1.save(p1)
+    m2.save(p2)
+    return p1, p2
+
+
+def _engine(**kw):
+    kw.setdefault("buckets", (16, 64))
+    return ServingEngine(ServeConfig(**kw))
+
+
+# ------------------------------------------------------------- registry
+
+def test_engine_parity_with_model_layer(two_versions):
+    m1, _, x = two_versions
+    eng = _engine()
+    eng.register("m", m1)
+    q = np.asarray(x[:50], np.float32)
+    np.testing.assert_allclose(eng.decision(q), decision_matrix(m1, q),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(eng.predict(q),
+                                  predict_multiclass(m1, q))
+    eng.close()
+
+
+def test_registry_versioning_and_routing(model_files, two_versions):
+    p1, p2 = model_files
+    m1, m2, x = two_versions
+    eng = _engine()
+    e1 = eng.register("m", p1)
+    assert (e1.version, e1.source) == (1, p1)
+    e2 = eng.swap("m", p2)
+    assert e2.version == 2
+    assert eng.registry.get("m") is e2
+    # post-swap requests answer from v2
+    q = np.asarray(x[:20], np.float32)
+    np.testing.assert_allclose(eng.decision(q), decision_matrix(m2, q),
+                               rtol=1e-5, atol=1e-5)
+    assert eng.hot_swaps.value == 1
+    with pytest.raises(KeyError, match="no model"):
+        eng.swap("typo", p1)
+    with pytest.raises(KeyError, match="name required"):
+        eng.register("second", p1) and eng.registry.get(None)
+    eng.close()
+
+
+def test_hot_swap_under_sustained_enqueue(model_files, two_versions):
+    """The acceptance contract: requests keep arriving while the swap
+    happens — zero failed/dropped across it, every pre-swap request
+    answered by v1 (no stale-model reads the OTHER way either: nothing
+    submitted after the flip may see v1)."""
+    p1, p2 = model_files
+    m1, m2, x = two_versions
+    eng = _engine()
+    eng.register("m", p1)
+    q = np.asarray(x, np.float32)
+    ref1, ref2 = decision_matrix(m1, q), decision_matrix(m2, q)
+
+    tickets = {}
+    for i in range(10):  # sustained enqueue, interleaved with pumping
+        tickets[eng.submit(q[i * 4:(i + 1) * 4])] = ("v1", i)
+        if i % 3 == 0:
+            eng.pump()
+    eng.swap("m", p2)  # atomic flip mid-stream
+    for i in range(10, 20):
+        tickets[eng.submit(q[i * 4:(i + 1) * 4])] = ("v2", i)
+        if i % 3 == 0:
+            eng.pump()
+    done = eng.drain()
+
+    assert sorted(done) == sorted(tickets)  # zero dropped
+    for ticket, (want, i) in tickets.items():
+        res = done[ticket]
+        assert res.verdict == "ok"  # zero failed
+        ref = ref1 if want == "v1" else ref2
+        assert res.version == (1 if want == "v1" else 2)
+        np.testing.assert_allclose(res.decision,
+                                   ref[i * 4:(i + 1) * 4],
+                                   rtol=1e-5, atol=1e-5)
+    eng.close()
+
+
+def test_labels_use_serving_version_across_swap(two_versions):
+    """Requests queued before a swap were answered by the OLD entry's
+    columns; their labels must fold through THAT entry — a fresh
+    registry lookup would apply the new version's class set/strategy
+    to the wrong column count (here: 3-class OvR -> binary)."""
+    m1, _, x = two_versions
+    y_pm = np.where(np.arange(len(x)) % 2 == 0, 1, -1).astype(np.int32)
+    rng = np.random.default_rng(5)
+    binary = SVMModel(
+        sv_x=np.asarray(x[:40], np.float32),
+        sv_alpha=rng.random(40).astype(np.float32) + 0.01,
+        sv_y=y_pm[:40], b=0.1, kernel=KernelParams("rbf", 0.3))
+    eng = _engine()
+    eng.register("m", m1)
+    q = np.asarray(x[:6], np.float32)
+    want = predict_multiclass(m1, q)
+    t_old = eng.submit(q)          # queued against the 3-column v1
+    eng.swap("m", binary)          # live model is now 1-column binary
+    t_new = eng.submit(q)
+    done = eng.drain()
+    assert done[t_old].decision.shape == (6, 3)
+    np.testing.assert_array_equal(done[t_old].labels(), want)
+    assert done[t_new].decision.shape == (6, 1)
+    assert set(np.unique(done[t_new].labels())) <= {-1, 1}
+    eng.close()
+
+
+def test_corrupted_npz_leaves_prior_version_serving(model_files,
+                                                    two_versions,
+                                                    tmp_path):
+    p1, _ = model_files
+    m1, _, x = two_versions
+    eng = _engine()
+    eng.register("m", p1)
+    q = np.asarray(x[:20], np.float32)
+    ref = decision_matrix(m1, q)
+
+    # Truncated zip (driver killed mid-write).
+    raw = open(p1, "rb").read()
+    p_trunc = str(tmp_path / "trunc.npz")
+    with open(p_trunc, "wb") as fh:
+        fh.write(raw[:len(raw) // 2])
+    with pytest.raises(ModelLoadError):
+        eng.swap("m", p_trunc)
+
+    # Partial npz: loadable zip, missing member arrays.
+    p_partial = str(tmp_path / "partial.npz")
+    np.savez(p_partial, model_type="multiclass", strategy="ovr",
+             classes=np.arange(3), n_models=3)  # no m{i}_* payloads
+    with pytest.raises(ModelLoadError):
+        eng.swap("m", p_partial)
+
+    # Garbage bytes.
+    p_junk = str(tmp_path / "junk.npz")
+    with open(p_junk, "wb") as fh:
+        fh.write(b"not a zip at all")
+    with pytest.raises(ModelLoadError):
+        eng.swap("m", p_junk)
+
+    # The prior version never stopped serving, and stayed v1.
+    assert eng.registry.get("m").version == 1
+    np.testing.assert_allclose(eng.decision(q), ref, rtol=1e-5,
+                               atol=1e-5)
+    assert eng.hot_swaps.value == 0
+    eng.close()
+
+
+def test_load_model_file_rejects_unservable(tmp_path):
+    p = str(tmp_path / "svr.npz")
+    np.savez(p, model_type="svr")
+    with pytest.raises(ModelLoadError, match="svr"):
+        load_model_file(p)
+
+
+def test_registry_prepare_failure_is_atomic(model_files):
+    """A prepare hook that raises (staging OOM, warm-up failure) must
+    leave the registry untouched."""
+    p1, p2 = model_files
+    calls = []
+    fail_next = [False]
+
+    def prepare(entry):
+        calls.append(entry.version)
+        if fail_next[0]:
+            fail_next[0] = False
+            raise RuntimeError("synthetic staging failure")
+
+    reg = ModelRegistry(prepare=prepare)
+    reg.register("m", p1)
+    fail_next[0] = True
+    with pytest.raises(RuntimeError):
+        reg.register("m", p2)
+    assert reg.get("m").version == 1
+    assert calls == [1, 2]
+    # The failed attempt did not burn the version: retry lands on 2.
+    assert reg.register("m", p2).version == 2
+
+
+# ---------------------------------------------------- deadlines and EDF
+
+def test_expired_request_counted_not_silently_served(two_versions):
+    """A request admitted past its deadline is shed with an explicit
+    verdict and counted — never silently served late."""
+    m1, _, x = two_versions
+    eng = _engine()
+    eng.register("m", m1)
+    t = eng.submit(np.asarray(x[:4], np.float32), deadline_ms=1e-4)
+    time.sleep(0.005)  # deadline passes while queued
+    done = eng.drain()
+    assert done[t].verdict == "expired"
+    assert done[t].decision is None
+    assert done[t].deadline_missed
+    assert eng.deadline_misses.value == 1
+    assert eng.expired.value == 1
+    assert eng.snapshot()["per_model"]["m"]["expired"] == 1
+    eng.close()
+
+
+def test_late_completion_counts_as_miss(two_versions, monkeypatch):
+    """A request dispatched in time but COMPLETED past its deadline is
+    served (real decision rows) and still counted as a miss."""
+    m1, _, x = two_versions
+    eng = _engine()
+    eng.register("m", m1)
+    q = np.asarray(x[:4], np.float32)
+    ref = decision_matrix(m1, q)
+    t = eng.submit(q, deadline_ms=50.0)
+    # Make completion observably late without racing the dispatch:
+    # stall between forming and completing.
+    orig = eng._dispatcher._materialize
+
+    def slow(item, _orig=orig):
+        time.sleep(0.08)
+        return _orig(item)
+
+    monkeypatch.setattr(eng._dispatcher, "_materialize", slow)
+    done = eng.drain()
+    assert done[t].verdict == "late"
+    np.testing.assert_allclose(done[t].decision, ref, rtol=1e-5,
+                               atol=1e-5)
+    assert eng.deadline_misses.value == 1
+    assert eng.expired.value == 0  # served, not shed
+    eng.close()
+
+
+def test_edf_orders_batch_forming(two_versions):
+    """Tight-deadline requests ride the next dispatch even when they
+    arrived last (earliest-deadline-first forming)."""
+    m1, _, x = two_versions
+    eng = _engine(buckets=(16,))  # one 16-row bucket: forming must pick
+    eng.register("m", m1)
+    q = np.asarray(x, np.float32)
+    loose = [eng.submit(q[i * 8:(i + 1) * 8], deadline_ms=10_000.0)
+             for i in range(2)]  # 16 rows: fills the bucket alone
+    tight = eng.submit(q[16:24], deadline_ms=500.0)  # arrives LAST
+    eng.pump()  # forms exactly one bucket
+    eng.pump()  # completes it (double-buffer: collect on next step)
+    done = eng.results()
+    assert tight in done  # the tight request rode the first dispatch
+    assert not all(t in done for t in loose)
+    eng.drain()
+    eng.close()
+
+
+def test_backpressure_bounds_queue(two_versions):
+    m1, _, x = two_versions
+    eng = _engine(buckets=(16,), max_pending=32)
+    eng.register("m", m1)
+    q = np.asarray(x[:8], np.float32)
+    for _ in range(12):  # 96 rows >> max_pending
+        eng.submit(q)
+        assert eng.scheduler.queue_rows < 32 + q.shape[0]
+    eng.drain()
+    eng.close()
+
+
+# ----------------------------------------------------------- coalescing
+
+def test_union_sharing_models_coalesce(two_versions):
+    """Two registered models with byte-identical unions answer from ONE
+    bucket dispatch — and each request still gets its own model's
+    columns exactly."""
+    m1, _, x = two_versions
+    eng = _engine()
+    eng.register("a", m1)
+    eng.register("b", m1)  # same union bytes -> same group
+    q = np.asarray(x[:30], np.float32)
+    ref = decision_matrix(m1, q)
+    d0 = eng._dispatches
+    ta = eng.submit(q[:10], model="a")
+    tb = eng.submit(q[10:30], model="b")
+    done = eng.drain()
+    assert eng._dispatches == d0 + 1  # ONE coalesced dispatch
+    assert eng.coalesced.value == 1
+    np.testing.assert_allclose(done[ta].decision, ref[:10],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(done[tb].decision, ref[10:30],
+                               rtol=1e-5, atol=1e-5)
+    eng.close()
+
+
+def test_distinct_unions_do_not_coalesce(two_versions):
+    m1, m2, x = two_versions
+    eng = _engine()
+    eng.register("a", m1)
+    eng.register("b", m2)
+    q = np.asarray(x[:8], np.float32)
+    d0 = eng._dispatches
+    ta = eng.submit(q, model="a")
+    tb = eng.submit(q, model="b")
+    done = eng.drain()
+    assert eng._dispatches == d0 + 2
+    assert eng.coalesced.value == 0
+    np.testing.assert_allclose(done[ta].decision, decision_matrix(m1, q),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(done[tb].decision, decision_matrix(m2, q),
+                               rtol=1e-5, atol=1e-5)
+    eng.close()
+
+
+def test_oversized_request_loops_top_bucket(two_versions):
+    m1, _, x = two_versions
+    eng = _engine(buckets=(16,))
+    eng.register("m", m1)
+    q = np.asarray(np.tile(x[:30], (2, 1)), np.float32)  # 60 rows > 16
+    ref = decision_matrix(m1, q)
+    t = eng.submit(q)
+    done = eng.drain()
+    np.testing.assert_allclose(done[t].decision, ref, rtol=1e-5,
+                               atol=1e-5)
+    eng.close()
+
+
+def test_binary_model_and_f64_routing(three_class):
+    """Binary models serve through the engine; a risk-routed model's
+    columns come from the exact host float64 path — float64 queries
+    stay unquantized (the serve.py exact-path contract)."""
+    from dpsvm_tpu.predict import decision_function
+
+    rng = np.random.default_rng(2)
+    big = SVMModel(
+        sv_x=rng.normal(size=(600, 8)).astype(np.float32),
+        sv_alpha=(rng.random(600).astype(np.float32) + 0.01) * 6e5,
+        sv_y=np.where(rng.random(600) < 0.5, 1, -1).astype(np.int32),
+        b=0.05, kernel=KernelParams("rbf", 0.3))
+    eng = _engine(buckets=(32,))
+    entry = eng.register("big", big)
+    assert entry.f64_cols.size == 1
+    q64 = (rng.normal(size=(16, 8)) * (1 + 1e-9)).astype(np.float64)
+    want = decision_function(big, q64, precision="float64")
+    t = eng.submit(q64)
+    done = eng.drain()
+    np.testing.assert_allclose(done[t].decision[:, 0], want, rtol=1e-6)
+    eng.close()
+
+
+def test_empty_union_served():
+    kp = KernelParams("rbf", 0.25)
+    models = [SVMModel(sv_x=np.zeros((0, 4), np.float32),
+                       sv_alpha=np.zeros((0,), np.float32),
+                       sv_y=np.zeros((0,), np.int32), b=b0, kernel=kp)
+              for b0 in (0.5, -0.25)]
+    m = MulticlassSVM(classes=np.arange(2), models=models,
+                      strategy="ovr")
+    eng = _engine(buckets=(16,))
+    eng.register("empty", m)
+    dec = eng.decision(np.zeros((3, 4), np.float32))
+    np.testing.assert_array_equal(
+        dec, np.broadcast_to([-0.5, 0.25], (3, 2)).astype(np.float32))
+    eng.close()
+
+
+def test_engine_rejects_mesh_and_bad_width(two_versions):
+    m1, _, x = two_versions
+    with pytest.raises(ValueError, match="single-device"):
+        ServingEngine(ServeConfig(num_devices=2))
+    eng = _engine()
+    eng.register("m", m1)
+    with pytest.raises(ValueError, match="must be"):
+        eng.submit(np.zeros((4, 3), np.float32))
+    eng.close()
+
+
+# -------------------------------------------------------- observability
+
+def test_metrics_and_openmetrics_labels(two_versions):
+    m1, m2, x = two_versions
+    eng = _engine(metrics_port=0)
+    eng.register("a", m1)
+    eng.register("b", m2)
+    q = np.asarray(x[:12], np.float32)
+    eng.submit(q, model="a")
+    eng.submit(q, model="b", deadline_ms=1e-4)
+    time.sleep(0.002)
+    eng.drain()
+    eng.swap("a", m2)
+    snap = eng.snapshot()
+    assert snap["hot_swaps"] == 1
+    assert snap["per_model"]["b"]["deadline_misses"] == 1
+    assert snap["batch_occupancy"]["count"] >= 1
+    assert snap["queue_depth"] == 0
+
+    with urllib.request.urlopen(eng.exporter.url, timeout=10) as resp:
+        text = resp.read().decode()
+    assert text.endswith("# EOF\n")
+    assert 'serving_requests_total{model="a"} 1' in text
+    assert 'serving_deadline_misses_total{model="b"} 1' in text
+    assert 'serving_hot_swaps_total{model="a"} 1' in text
+    assert 'serving_model_version{model="a"} 2' in text
+    assert "serving_batch_occupancy" in text
+    # queue-depth gauge appears once work is queued
+    eng.submit(q, model="b")
+    with urllib.request.urlopen(eng.exporter.url, timeout=10) as resp:
+        text = resp.read().decode()
+    assert 'serving_queue_depth{model="b"} 1' in text
+    eng.drain()
+    eng.close()
+
+
+def test_serve_runlog_and_report_columns(two_versions, tmp_path):
+    """The serve run log records per-dispatch chunk records plus the
+    hot-swap event, and `cli obs report` surfaces the engine columns
+    (deadline misses / swaps / occupancy)."""
+    from dpsvm_tpu.obs.analyze import load_runs, render_report, summarize_run
+
+    m1, m2, x = two_versions
+    eng = _engine(obs=ObsConfig(enabled=True,
+                                runlog_dir=str(tmp_path)))
+    eng.register("m", m1)
+    q = np.asarray(x[:20], np.float32)
+    eng.submit(q)
+    eng.drain()
+    eng.swap("m", m2)
+    eng.submit(q, deadline_ms=1e-4)
+    time.sleep(0.002)
+    eng.drain()
+    path = eng._obs.path
+    eng.close()
+
+    runs = load_runs([path])
+    assert len(runs) == 1
+    s = summarize_run(runs[0])
+    assert s["tool"] == "serve"
+    assert s["deadline_misses"] == 1
+    assert s["hot_swaps"] == 1
+    assert s["pairs"] == 20  # chunk rows ride the pairs fields
+    assert s["batch_occupancy_mean"] is not None
+    assert [e for e in s["events"] if e == "hot_swap"]
+    txt = render_report([s])
+    assert "miss=1 swap=1" in txt
+    # solver-run rows render "-" in the serve column (no crash)
+    assert "serve" in txt.splitlines()[0]
+
+
+# ------------------------------------------------- scrape-during-close
+
+def _hammer_scrapes(url, stop, errors, bodies):
+    while not stop.is_set():
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                body = resp.read().decode()
+                if resp.status != 200 or not body.endswith("# EOF\n"):
+                    errors.append(("bad response", resp.status,
+                                   body[-50:]))
+                bodies.append(len(body))
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass  # clean refusal after shutdown — the contract
+
+
+def test_scrape_racing_engine_close(two_versions):
+    """A scrape concurrent with ServingEngine.close() gets a full
+    exposition, the # EOF stub, or a clean connection error — never a
+    half-torn-down read or a 500."""
+    m1, _, x = two_versions
+    eng = _engine(metrics_port=0)
+    eng.register("m", m1)
+    eng.submit(np.asarray(x[:8], np.float32))
+    eng.drain()
+    url = eng.exporter.url
+    stop, errors, bodies = threading.Event(), [], []
+    threads = [threading.Thread(target=_hammer_scrapes,
+                                args=(url, stop, errors, bodies))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # scrapes in flight
+    eng.close()
+    time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+    assert bodies  # the hammer actually scraped while live
+
+
+def test_scrape_racing_predict_server_close(two_versions):
+    """The same ordering contract on the v1 PredictServer (the ISSUE 10
+    close()-vs-exporter satellite): endpoint down FIRST, in-flight
+    renders answer the stub, never a half-torn-down registry read."""
+    from dpsvm_tpu.serve import PredictServer
+
+    m1, _, x = two_versions
+    srv = PredictServer(m1, ServeConfig(buckets=(16,), metrics_port=0))
+    srv.decision(np.asarray(x[:8], np.float32))
+    url = srv.exporter.url
+    stop, errors, bodies = threading.Event(), [], []
+    threads = [threading.Thread(target=_hammer_scrapes,
+                                args=(url, stop, errors, bodies))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    srv.close()
+    time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+    assert bodies
+
+
+# ----------------------------------------------------------- config/CLI
+
+def test_deadline_config_validation():
+    with pytest.raises(ValueError, match="deadline_ms"):
+        ServeConfig(deadline_ms=0.0)
+    with pytest.raises(ValueError, match="deadline_ms"):
+        ServeConfig(deadline_ms=-5.0)
+    assert ServeConfig(deadline_ms=100.0).deadline_ms == 100.0
+
+
+def test_cli_serve_registry_roundtrip(model_files, two_versions,
+                                      capsys, monkeypatch, tmp_path):
+    """`cli serve --registry` end to end in-process: route-prefixed
+    rows, a mid-stream swap line, labels out in submit order."""
+    import io
+
+    from dpsvm_tpu import cli
+
+    p1, p2 = model_files
+    m1, _, x = two_versions
+    want = predict_multiclass(m1, np.asarray(x[:3], np.float32))
+    lines = ["m|" + ",".join(f"{v:.5f}" for v in row) for row in x[:3]]
+    lines += ["", f"swap m={p2}", lines[0]]
+    monkeypatch.setattr("sys.stdin", io.StringIO("\n".join(lines) + "\n"))
+    rc = cli.main(["serve", "--registry", f"m={p1}",
+                   "--deadline-ms", "5000", "--buckets", "16,64", "-q"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    assert out[:3] == [f"m {int(v)}" for v in want]
+    assert len(out) == 4  # the post-swap row answered too
+
+
+def test_cli_serve_registry_bad_spec(capsys):
+    from dpsvm_tpu import cli
+
+    rc = cli.main(["serve", "--registry", "noequals"])
+    assert rc == 2
+    assert "NAME=PATH" in capsys.readouterr().err
