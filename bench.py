@@ -386,8 +386,43 @@ def mesh_main(args=None) -> int:
         "session_calibration": calibration,
     }
     result.update(_runlog_reconciliation(best, pps))
-    gate = _regression_gate(result,
-                            os.path.dirname(os.path.abspath(__file__)),
+    # Ring-exchange and bf16-Gram columns (ISSUE 11): the same budget
+    # run through the DMA-ring exchange and through the gated bf16
+    # storage flip, so MULTICHIP artifacts carry all three numbers and
+    # the regression gate can adjudicate ring/bf16 throughput across
+    # device sessions the moment the first device artifact lands (each
+    # column gates independently; NO_BASELINE until then). One run each
+    # — the variance-critical headline keeps its best-of-3.
+    root = os.path.dirname(os.path.abspath(__file__))
+    for col, vcfg in (
+            ("ring", cfg.replace(ring_exchange=True)),
+            ("bf16", cfg.replace(bf16_gram=True))):
+        rv = solve_mesh(x, y, vcfg, num_devices=n_dev)
+        if rv.iterations < budget:
+            print(f"[bench --mesh] ERROR: {col} budget run executed "
+                  f"{rv.iterations} < {budget} pairs", file=sys.stderr)
+            return 1
+        v_pps = rv.iterations / max(rv.train_seconds, 1e-9)
+        key = f"{col}_pairs_per_second"
+        result[key] = round(v_pps)
+        result[f"{col}_seconds"] = round(rv.train_seconds, 3)
+        if col == "ring":
+            # Honesty flag: on a 1-device harness use_ring disengages
+            # (no hops) and this column measured the gather path — a
+            # device-session gate must not compare real ring numbers
+            # against a mislabeled single-chip baseline.
+            result["ring_exchange_active"] = bool(
+                rv.stats.get("ring_exchange"))
+        else:
+            result["bf16_gram_active"] = bool(
+                rv.stats.get("bf16_gram", {}).get("active"))
+        vgate = _regression_gate({**result, key: round(v_pps)}, root,
+                                 pattern="MULTICHIP_r*.json", key=key)
+        result[f"{col}_gate"] = vgate.get("regression_gate")
+        print(f"[bench --mesh] {col}: {rv.iterations} pairs in "
+              f"{rv.train_seconds:.3f}s ({v_pps:.0f}/s); gate: "
+              f"{vgate.get('regression_gate')}", file=sys.stderr)
+    gate = _regression_gate(result, root,
                             pattern="MULTICHIP_r*.json",
                             key="mesh_pairs_per_second")
     result.update(gate)

@@ -26,11 +26,20 @@ class Unit:
     make_jaxpr -- optional () -> ClosedJaxpr of the same call (for the
                   jaxpr-walk facts; skipped when tracing is the thing
                   under test)
+    device_jaxpr -- optional () -> ClosedJaxpr of the DEVICE form of a
+                  Pallas-ring entrypoint (interpret=False — traceable
+                  anywhere, compilable only on TPU): adds the
+                  ``device_form`` fact family (hlo_facts
+                  device_form_facts) pinning zero XLA collective
+                  primitives + the DMA-hop structure. Entries without
+                  it keep their exact pre-existing fact set, so adding
+                  this field changed no committed budget.
     """
 
     name: str
     lower: Callable
     make_jaxpr: Optional[Callable] = None
+    device_jaxpr: Optional[Callable] = None
 
 
 def _declared_donated(lowered) -> Optional[int]:
@@ -79,6 +88,9 @@ def unit_facts(unit: Unit) -> dict:
     if unit.make_jaxpr is not None:
         jx = unit.make_jaxpr()
         facts["hazards"].update(hlo_facts.jaxpr_facts(jx))
+    if unit.device_jaxpr is not None:
+        facts["device_form"] = hlo_facts.device_form_facts(
+            unit.device_jaxpr())
     return facts
 
 

@@ -240,6 +240,53 @@ def memory_facts(compiled) -> dict:
         return {"unavailable": True}
 
 
+#: jaxpr-level XLA collective primitives the device-form contract
+#: counts (ISSUE 11): these are the ops a ring regression would
+#: reintroduce per hop. Spelled as jax primitive names (the jaxpr view,
+#: not HLO op names — the device form of a Pallas-ring program never
+#: compiles on the CPU backend, so its contract is pinned at trace
+#: level).
+COLLECTIVE_PRIMITIVES = ("psum", "all_gather", "ppermute", "all_to_all",
+                         "pmax", "pmin", "psum_scatter")
+
+
+def device_form_facts(closed_jaxpr) -> dict:
+    """Facts of the DEVICE form (interpret=False trace) of a
+    Pallas-ring entrypoint, from a jaxpr walk recursing through
+    while/cond/pjit AND pallas kernel jaxprs.
+
+    xla_collectives -- per-primitive counts over COLLECTIVE_PRIMITIVES
+        (explicit zeros, like collective_facts): the ring contract pins
+        the exchange at zero XLA collectives per round — a stray
+        per-hop psum/ppermute/all_gather smuggled back into the body
+        DRIFTS here even though the interpret-mode compile (whose HLO
+        facts necessarily contain the interpreter's DMA-emulation
+        gathers) cannot see it;
+    xla_collective_total -- their sum (the headline number);
+    dma_starts -- dma_start primitives (local + remote ring hops): a
+        hop converted to a collective, or an extra staging copy, moves
+        this count.
+
+    Counts are per-EQUATION (a DMA inside a fori body counts once) —
+    static program structure, the thing budgets can pin."""
+    counts = {k: 0 for k in COLLECTIVE_PRIMITIVES}
+    dma = [0]
+    seen: set = set()
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            nm = eqn.primitive.name
+            if nm in counts:
+                counts[nm] += 1
+            elif nm == "dma_start":
+                dma[0] += 1
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, seen, visit)
+    return {"xla_collectives": counts,
+            "xla_collective_total": sum(counts.values()),
+            "dma_starts": dma[0]}
+
+
 def _walk_jaxpr(jaxpr, seen, visit):
     if id(jaxpr) in seen:
         return

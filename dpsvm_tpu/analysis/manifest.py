@@ -1,10 +1,13 @@
 """The manifest of hot entrypoints tpulint lowers and budgets.
 
 One entry per compiled program whose STRUCTURE the reproduction's wins
-depend on (ISSUE 5): the single-chip block round, the fleet chain, the
-three mesh chunk runners (global / pipelined / shard-local), compacted
-multiclass decision, the serving bucket executors (f32 and the bf16
-storage variant), and mesh prediction. Shapes are canonical-small —
+depend on (ISSUE 5): the single-chip block round (f32 and the
+bf16-Gram storage variant), the fleet chain, the three mesh chunk
+runners (global / pipelined / shard-local) plus the ring-exchange
+forms of the global and shard-local runners (ISSUE 11 — dual
+interpret/device_form views), compacted multiclass decision, the
+serving bucket executors (f32 and the bf16 storage variant), and mesh
+prediction. Shapes are canonical-small —
 op structure is shape-independent (the test_pipelined.py discipline) —
 so the whole manifest traces+compiles in seconds on the CPU backend.
 
@@ -203,6 +206,93 @@ def shardlocal_chunk():
             _obs_unit()]
 
 
+def mesh_chunk_ring():
+    """Ring-exchange global mesh chunk (ISSUE 11, config.ring_exchange):
+    candidate exchange AND working-set recovery ride P-1 remote DMAs
+    inside one Pallas kernel (ops/ring.py ring_gather), replacing the
+    plain runner's 2 all_gathers + 2 psums per round.
+
+    TWO fact views pin the contract: the compiled facts come from the
+    INTERPRET lowering (the CPU-testable form — its HLO necessarily
+    contains the jax interpreter's DMA-emulation collectives, recorded
+    as such), while the ``device_form`` facts trace the interpret=False
+    program and pin ZERO XLA collective primitives in the round body —
+    a stray per-hop collective reintroduced by a refactor DRIFTS there
+    (mutation-verified in tests/test_tpulint.py)."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.parallel.dist_block import make_block_chunk_runner
+
+    kw = dict(rounds_per_chunk=1, inner_impl="xla", donate_state=True,
+              ring_exchange=True)
+    runner_i = make_block_chunk_runner(
+        _mesh(), _kp(), C_BOUNDS, EPS, TAU, Q, INNER, interpret=True,
+        **kw)
+    runner_d = make_block_chunk_runner(
+        _mesh(), _kp(), C_BOUNDS, EPS, TAU, Q, INNER, interpret=False,
+        **kw)
+    args = _chunk_args(N)
+    return [Unit("chunk", lambda: runner_i.lower(*args),
+                 _jaxpr_of(runner_i, *args),
+                 device_jaxpr=_jaxpr_of(runner_d, *args)),
+            _obs_unit()]
+
+
+def shardlocal_chunk_ring():
+    """Ring-exchange shard-local sync (ISSUE 11): the (R*q, d+3)
+    touched-row window travels the ICI ring with each arriving hop
+    folded IN-KERNEL (ops/ring.py ring_fold_window) — the device form
+    keeps exactly ONE XLA collective per sync window (the (2,) stopping
+    pmax handoff) and zero gathers; same interpret-vs-device dual view
+    as mesh_chunk_ring."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_shardlocal_chunk_runner)
+
+    kw = dict(rounds_per_chunk=R_SYNC, sync_rounds=R_SYNC,
+              inner_impl="xla", donate_state=True, ring_exchange=True)
+    runner_i = make_block_shardlocal_chunk_runner(
+        _mesh(), _kp(), C_BOUNDS, EPS, TAU, Q, INNER, interpret=True,
+        **kw)
+    runner_d = make_block_shardlocal_chunk_runner(
+        _mesh(), _kp(), C_BOUNDS, EPS, TAU, Q, INNER, interpret=False,
+        **kw)
+    args = _chunk_args(N)
+    return [Unit("chunk", lambda: runner_i.lower(*args),
+                 _jaxpr_of(runner_i, *args),
+                 device_jaxpr=_jaxpr_of(runner_d, *args)),
+            _obs_unit()]
+
+
+def block_chunk_bf16gram():
+    """bf16-Gram single-chip block chunk (ISSUE 11, config.bf16_gram
+    with the perturbation bound accepting): the SAME donated runner as
+    block_chunk_single lowered with X stored bfloat16. The budget pins
+    the exact intended quantization structure — the bf16<->f32 convert
+    counts (working-set rows widen for the replicated scalars exactly
+    once per use site; dots accumulate f32 on the MXU) — so any NEW
+    convert a refactor sneaks into the round body is a drift, the
+    serve_bucket_bf16 discipline applied to training."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.solver.block import run_chunk_block_donated
+
+    kw = dict(kp=_kp(), c=C_BOUNDS, eps=EPS, tau=TAU, q=Q,
+              inner_iters=INNER, rounds_per_chunk=ROUNDS_PER_CHUNK,
+              inner_impl="xla")
+    n = N
+    state = _block_state(n)
+    args = (_sds((n, D), jnp.bfloat16), _sds((n,), jnp.float32),
+            _sds((n,), jnp.float32), _sds((n,), jnp.float32),
+            _sds((n,), jnp.bool_), state, _sds((), jnp.int32))
+    return [
+        Unit("chunk",
+             lambda: run_chunk_block_donated.lower(*args, **kw),
+             _jaxpr_of(run_chunk_block_donated, *args, **kw)),
+        _obs_unit(),
+    ]
+
+
 def ooc_fold_tile(n_total: int = N):
     """Out-of-core per-tile fold (ISSUE 9): the ONE program dispatched
     per streamed tile of the ooc round. Its budget pins the whole
@@ -338,6 +428,9 @@ MANIFEST = {
     "mesh_chunk": mesh_chunk,
     "pipelined_chunk": pipelined_chunk,
     "shardlocal_chunk": shardlocal_chunk,
+    "mesh_chunk_ring": mesh_chunk_ring,
+    "shardlocal_chunk_ring": shardlocal_chunk_ring,
+    "block_chunk_bf16gram": block_chunk_bf16gram,
     "ooc_fold_tile": ooc_fold_tile,
     "compacted_decision": compacted_decision,
     "serve_bucket": serve_bucket,

@@ -117,6 +117,23 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "solve/fold rounds between cross-shard syncs "
                         "(Cascade-style; needs --local-working-sets "
                         ">= 2; default 1)")
+    p.add_argument("--ring-exchange", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="mesh block engine: route the per-round/per-"
+                        "window candidate exchange through a Pallas "
+                        "ICI ring of remote DMAs instead of all_gather "
+                        "+ psum — bit-identical trajectories, zero XLA "
+                        "collectives in the device-form round body "
+                        "(SVMConfig.ring_exchange; ops/ring.py). auto "
+                        "= the measured gate (solver/block.py "
+                        "ring_pays, currently off)")
+    p.add_argument("--bf16-gram", action="store_true",
+                   help="store X in bfloat16 (f32 MXU accumulation — "
+                        "half the Gram-pass HBM reads) ONLY when the "
+                        "per-problem perturbation bound accepts "
+                        "(C * p90|dK| <= 0.1); refusals stay float32 "
+                        "and say so loudly in stats "
+                        "(SVMConfig.bf16_gram)")
     p.add_argument("--ooc", action="store_true",
                    help="out-of-core training (block engine): X stays "
                         "in HOST memory and the per-round gradient fold "
@@ -509,6 +526,9 @@ def _cmd_train(args) -> int:
             local_working_sets=(None if args.local_working_sets == 0
                                 else args.local_working_sets),
             sync_rounds=args.sync_rounds,
+            ring_exchange={"auto": None, "on": True,
+                           "off": False}[args.ring_exchange],
+            bf16_gram=args.bf16_gram,
             active_set_size=args.active_set_size,
             reconcile_rounds=args.reconcile_rounds,
             ooc=args.ooc, ooc_tile_rows=args.ooc_tile_rows,

@@ -237,6 +237,44 @@ class SVMConfig:
     local_working_sets: Optional[int] = None
     sync_rounds: int = 1
 
+    # Ring-overlapped mesh candidate exchange (ops/ring.py; ISSUE 11 /
+    # ROADMAP item 1). The mesh block runners' per-round/per-window
+    # candidate all_gather (+ working-set recovery psums) becomes a ring
+    # of pltpu.make_async_remote_copy ICI DMAs inside one Pallas kernel:
+    # the global/pipelined runners' candidates travel WITH their rows
+    # and scalars (zero XLA collectives left in the device-form round
+    # body), and the shard-local sync folds each arriving hop in-kernel
+    # while later hops' DMAs fly — candidate exchange costs
+    # max(DMA, fold matmul) instead of gather-then-compute. Trajectories
+    # are BIT-IDENTICAL to the all_gather path (tests/test_ring.py pins
+    # it; interpret-mode kernels on the CPU mesh).
+    #   None  -- auto: solver/block.py ring_pays — currently OFF
+    #            everywhere pending the device-session measurement (the
+    #            pipeline_rounds / shardlocal_pays discipline);
+    #   True  -- force on (CPU tests/A-B probes run interpret mode);
+    #   False -- force the all_gather path.
+    # Mesh block-engine knob (>= 2 devices); the single-chip solver has
+    # no exchange and ignores it. Composes with pipeline_rounds and
+    # local_working_sets; not with active_set_size / fused_fold /
+    # precomputed kernels (validated below); the nu trainers fall back
+    # to the all_gather path with a warning (models/nusvm.py).
+    ring_exchange: Optional[bool] = None
+
+    # bf16 Gram training path (ISSUE 11): store X in bfloat16 with f32
+    # MXU accumulation — halving Gram-pass HBM read traffic — but ONLY
+    # when the per-problem perturbation analysis says the trajectory is
+    # safe: the solver samples C * p90|K_exact - K_bf16| on THIS data
+    # (ops/kernels.py bf16_kernel_perturbation, the measured-failure-
+    # calibrated bound behind the existing dtype='bfloat16' warning and
+    # the serving engine's bf16 union guard) and flips storage to bf16
+    # only under BF16_RISK_THRESHOLD. When the bound refuses, the solve
+    # stays float32 and says so loudly (stats['bf16_gram'] carries the
+    # risk + a fallback note, plus a warning). Unlike dtype='bfloat16'
+    # (which always quantizes and merely warns), this is the gated
+    # variant — safe to leave on across a sweep. Feature kernels,
+    # in-core engines (validated below).
+    bf16_gram: bool = False
+
     # Active-set shrinking for the block engine (0 = off). When > 0, the
     # solver runs cycles of `reconcile_rounds` block rounds whose
     # selection and fold touch only the `active_set_size` most-violating
@@ -488,6 +526,55 @@ class SVMConfig:
                     "budget_mode: P shards spend the pair budget "
                     "concurrently, so the exact-max_iter contract "
                     "cannot hold — use the global working set there")
+        if self.ring_exchange:
+            if self.engine != "block":
+                raise ValueError(
+                    "ring_exchange is a mesh block-engine knob (the "
+                    "per-pair mesh engine has no block exchange to "
+                    "ring); use engine='block'")
+            if self.kernel == "precomputed":
+                raise ValueError(
+                    "ring_exchange supports feature kernels only (a "
+                    "precomputed Gram has no rows for the candidate "
+                    "ring to carry; its symmetric round is already "
+                    "collective-light)")
+            if self.ooc:
+                raise ValueError(
+                    "ring_exchange does not compose with ooc (ooc is "
+                    "single-chip — tiles stream from one host process; "
+                    "there is no mesh exchange to ring)")
+            if self.active_set_size:
+                raise ValueError(
+                    "ring_exchange does not compose with "
+                    "active_set_size (the active cycle's replicated "
+                    "inner rounds are already collective-free; its "
+                    "per-cycle recovery keeps the psum path) — use one "
+                    "or the other")
+            if self.fused_fold:
+                raise ValueError(
+                    "ring_exchange does not compose with "
+                    "fused_fold=True (the fused runner's per-row "
+                    "candidate kernel feeds its own all_gather "
+                    "epilogue) — use one or the other")
+        if self.bf16_gram:
+            if self.kernel == "precomputed":
+                raise ValueError(
+                    "bf16_gram supports feature kernels only (a "
+                    "precomputed Gram carries kernel VALUES — rounding "
+                    "those is a different contract from rounding "
+                    "features; quantize the matrix yourself if that is "
+                    "what you want)")
+            if self.dtype == "bfloat16":
+                raise ValueError(
+                    "dtype='bfloat16' already stores X in bfloat16 "
+                    "(ungated, warning-only); bf16_gram is the "
+                    "perturbation-gated variant — use one or the other")
+            if self.ooc:
+                raise ValueError(
+                    "bf16_gram does not compose with ooc (the ooc tile "
+                    "stream stages float32 host tiles; quantized "
+                    "streaming is its own contract) — use one or the "
+                    "other")
         if self.sync_rounds < 1:
             raise ValueError("sync_rounds must be >= 1")
         if self.sync_rounds > 1 and (self.local_working_sets is None

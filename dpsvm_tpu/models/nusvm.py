@@ -81,6 +81,9 @@ def _warn_nu_fallbacks(config: SVMConfig, trainer: str) -> None:
     if config.local_working_sets is not None \
             and config.local_working_sets >= 2:
         dropped.append("local_working_sets (global working set)")
+    if config.ring_exchange:
+        dropped.append("ring_exchange (all_gather exchange — the nu "
+                       "rule's per-class quarters keep the psum path)")
     if dropped:
         import warnings
 

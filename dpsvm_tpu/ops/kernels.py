@@ -202,10 +202,91 @@ def bf16_rbf_perturbation(x, gamma: float, sample: int = 2048,
     return float(np.percentile(np.abs(kvals(s) - kvals(sb)), 90))
 
 
+def bf16_kernel_perturbation(x, params: KernelParams, sample: int = 2048,
+                             pairs: int = 4096, seed: int = 0) -> float:
+    """p90 of |K_exact - K_bf16-stored| over sampled pairs for ANY
+    feature kernel — the generalization of bf16_rbf_perturbation the
+    training bf16-Gram gate needs (ISSUE 11): rbf delegates to the
+    measured-failure-calibrated original; linear/poly/sigmoid sample
+    the same pair population through their own dot-product algebra
+    (f64 exact vs bf16-rounded features, f64 accumulation — the
+    rounding under test is STORAGE rounding, matching how the solver's
+    f32-accumulating MXU passes see bf16 X). Host NumPy on a seeded
+    sample; ~ms cost; deterministic for fixed (x, params, seed)."""
+    if params.kind == "rbf":
+        return bf16_rbf_perturbation(x, params.gamma, sample=sample,
+                                     pairs=pairs, seed=seed)
+    if params.kind == "precomputed":
+        raise ValueError(
+            "precomputed kernels carry values, not features; there is "
+            "no storage-rounding perturbation to sample")
+    import ml_dtypes
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    rng = np.random.default_rng(seed)
+    n = x.shape[0]
+    idx = rng.choice(n, min(sample, n), replace=False)
+    s = x[idx].astype(np.float64)
+    sb = x[idx].astype(ml_dtypes.bfloat16).astype(np.float64)
+    i = rng.integers(0, len(s), pairs)
+    j = rng.integers(0, len(s), pairs)
+
+    def kvals(a):
+        dots = np.einsum("nd,nd->n", a[i], a[j])
+        if params.kind == "linear":
+            return dots
+        if params.kind == "poly":
+            return (params.gamma * dots + params.coef0) ** params.degree
+        if params.kind == "sigmoid":
+            return np.tanh(params.gamma * dots + params.coef0)
+        raise ValueError(f"unknown kernel kind {params.kind!r}")
+
+    return float(np.percentile(np.abs(kvals(s) - kvals(sb)), 90))
+
+
 # C * p90|dK| above this warns (see bf16_rbf_perturbation): calibrated
 # between the measured-failing covtype-stress value (0.46) and the
 # passing headline/adult configs (<= 0.001).
 BF16_RISK_THRESHOLD = 0.1
+
+
+def resolve_bf16_gram(x, config, gamma: float, c_max: float = None,
+                      scope: str = ""):
+    """The per-problem bf16-Gram gate (config.bf16_gram, ISSUE 11):
+    decide whether storing X in bfloat16 (f32 MXU accumulation — half
+    the Gram-pass HBM read traffic) is safe for THIS (data, config), by
+    the same risk scale the ungated dtype='bfloat16' warning and the
+    serving engine's bf16 union guard use: C * p90|dK| against
+    BF16_RISK_THRESHOLD.
+
+    `c_max` overrides the box bound the risk is scaled by (the fleet
+    executor passes the largest bound across its problems — one shared
+    X, one storage dtype, the conservative reading); `scope` is spliced
+    into the refusal note (e.g. "for the fleet"). THE one definition of
+    the gate — solve(), solve_mesh() and solve_fleet() all call here so
+    a calibration change can never diverge them.
+
+    Returns (active, risk, stats_entry): `active` says the solve should
+    flip storage to bf16; `stats_entry` is the dict the solver merges
+    into SolveResult.stats either way, carrying a LOUD `note` when the
+    bound refuses (the trajectory would likely degrade — measured 0.97
+    -> 0.59 train accuracy on the covtype stress config,
+    BENCH_COVTYPE.md) so a refused gate is never silent."""
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    c_ref = max(config.c_bounds()) if c_max is None else float(c_max)
+    risk = c_ref * bf16_kernel_perturbation(x, kp)
+    active = risk <= BF16_RISK_THRESHOLD
+    entry = {"active": active, "risk": round(risk, 6),
+             "threshold": BF16_RISK_THRESHOLD}
+    if not active:
+        where = f" {scope}" if scope else ""
+        entry["note"] = (
+            f"bf16_gram REFUSED{where}: C * p90|dK| = {risk:.4g} > "
+            f"{BF16_RISK_THRESHOLD} — storage rounding at this (C, "
+            f"kernel, data) risks O(1) decision changes; Gram stays "
+            f"float32 (lower C / raise gamma to re-qualify)")
+    return active, risk, entry
 
 
 def warn_if_bf16_degrades(x, config) -> None:

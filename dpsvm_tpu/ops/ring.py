@@ -1,0 +1,315 @@
+"""Ring-overlapped mesh candidate exchange (ROADMAP item 1, ISSUE 11).
+
+The mesh engines' per-round/per-window sync is a serial ``lax.all_gather``
+dispatch sequence sitting on the critical path between selection and the
+Gram matmul (parallel/dist_block.py) — exactly the exposed-communication
+structure Cao et al.'s parallel SMO and Catanzaro et al.'s GPU SMO
+(PAPERS.md) name as the scaling limiter once per-chip compute is fast.
+This module re-expresses that exchange as a ring of
+``pltpu.make_async_remote_copy`` ICI DMAs inside ONE Pallas kernel
+(SNIPPETS.md [1]/[2], the jax distributed-Pallas pattern):
+
+``ring_gather``
+    The candidate exchange for the global/pipelined runners: each shard's
+    per-side top-h candidate block — rows, per-row scalars, score and
+    global id packed into (L, lanes) f32 — travels P-1 leftward hops,
+    every arrival landing directly in its absolute-device-id slot of the
+    (P, L, lanes) output. The output is ordered exactly like
+    ``lax.all_gather``'s leading axis, so the downstream global top-h /
+    dedup epilogue is the SAME code as the all_gather path and the
+    training trajectory is bit-identical (pinned in tests/test_ring.py).
+    Because the candidate block carries the rows and scalars themselves,
+    the round's separate (q, d) + (q, S) working-set recovery psums
+    disappear entirely — the device-form round body has ZERO XLA
+    collectives (the tpulint ``mesh_chunk_ring`` budget pins it).
+
+``ring_fold_window``
+    The shard-local engine's sync: the (R*q, d+3) touched-row window
+    rides the same ring, and each arriving hop is folded into the local
+    gradient IN-KERNEL — the grid is (P-1 hops, n_loc/tile tiles), hop
+    h's fold matmuls run while nothing blocks the already-started DMAs
+    of later hops' upstream senders, so on device the sync costs
+    max(DMA, fold matmul) per hop instead of gather-then-fold. The fold
+    order matches dist_block.py's rotation (right neighbor first), the
+    per-tile fold splits only the OUTPUT dim of the (R*q, n_loc) fold
+    matmul, and the Kahan step is solver/smo.py's kahan_add — so the
+    folded gradient is bit-identical to the all_gather path's
+    (tests/test_ring.py pins exact equality).
+
+Correctness/portability contract (the established pattern of the three
+existing Pallas kernels): ``interpret=True`` runs the kernels on the CPU
+vdev mesh for tier-1 tests. jax 0.4.37's interpreter DISCHARGES each
+remote DMA into an ``all_gather``-based exchange (jax
+pallas/mosaic/primitives.py dma_start_discharge_rule) — pure data
+movement, so trajectories stay bit-identical, but the interpret-mode HLO
+necessarily contains emulation collectives. The "ring hops are DMAs, not
+XLA collectives" contract is therefore pinned on the DEVICE form: tpulint
+traces the runners with ``interpret=False`` and budgets the jaxpr-level
+collective-primitive and dma_start counts (analysis/hlo_facts.py
+device_form_facts). Slot discipline: every block lands in its own
+device-id-indexed output slot, written exactly once per device — no slot
+reuse, hence no overwrite hazard however far upstream senders run ahead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dpsvm_tpu.parallel.mesh import DATA_AXIS
+
+#: tile-row candidates for the in-kernel fold (largest divisor wins; a
+#: shard whose n_loc none of these divide folds in one tile). 128-lane
+#: multiples keep the (1, tile) f blocks on the TPU vreg grid.
+_FOLD_TILES = (2048, 1024, 512, 256, 128)
+
+
+def fold_tile_rows(n_loc: int) -> int:
+    """Rows per in-kernel fold tile: the largest _FOLD_TILES divisor of
+    n_loc, else n_loc itself (single-tile fold — the small-shard/test
+    regime)."""
+    for t in _FOLD_TILES:
+        if n_loc % t == 0 and n_loc >= t:
+            return t
+    return n_loc
+
+
+def _neighbor_barrier(ndev: int, axis_name: str):
+    """Device-only entry barrier: a remote write may not land before its
+    target has entered the kernel, so signal both neighbors and wait for
+    both signals (the jax distributed-Pallas guide's local barrier).
+    Never traced under interpret mode — the interpreter's lockstep
+    discharge makes it unnecessary (and its barrier semaphore has no
+    interpret path on this jax)."""
+    my = lax.axis_index(axis_name)
+    barrier = pltpu.get_barrier_semaphore()
+    for nb in (lax.rem(my + 1, ndev), lax.rem(my + ndev - 1, ndev)):
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id=nb,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+
+def _compiler_params():
+    """Mosaic params for the device path: the barrier semaphore needs a
+    collective_id. Name skew guard: jax 0.4.37 spells it
+    TPUCompilerParams (newer jax renames it CompilerParams); DCE safety
+    comes from the kernels' real outputs, not a side-effect flag (this
+    jax's params have none)."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(collective_id=0)
+
+
+def _ring_gather_kernel(blk_ref, out_ref, local_sem, send_sem, recv_sem,
+                        *, ndev: int, axis_name: str, interpret: bool):
+    my = lax.axis_index(axis_name)
+    left = lax.rem(my + ndev - 1, ndev)
+    if not interpret:
+        _neighbor_barrier(ndev, axis_name)
+    # Own block into its absolute slot first: hop 0 forwards it.
+    cp = pltpu.make_async_copy(blk_ref, out_ref.at[my], local_sem)
+    cp.start()
+    cp.wait()
+
+    def hop(h, carry):
+        # Forward the slot that arrived at hop h-1 (h=0: our own block)
+        # to the left neighbor's SAME absolute slot; .wait() covers our
+        # send AND the symmetric arrival from the right neighbor, which
+        # lands hop h's block in out[(my + h + 1) % ndev]. Each slot is
+        # written exactly once per device — no reuse, no overwrite race.
+        src = lax.rem(my + h, ndev)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[src], dst_ref=out_ref.at[src],
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=left, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()
+        return carry
+
+    lax.fori_loop(0, ndev - 1, hop, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("ndev", "axis_name", "interpret"))
+def ring_gather(block, ndev: int, axis_name: str = DATA_AXIS,
+                interpret: bool = False):
+    """Ring all-gather of one (L, lanes) f32 block per shard.
+
+    Returns (ndev, L, lanes) ordered by absolute device id — the same
+    layout (and, being pure data movement, the same bits) as
+    ``lax.all_gather(block, axis_name)`` — via P-1 leftward
+    ``make_async_remote_copy`` hops instead of an XLA collective.
+    Must be called inside a shard_map over ``axis_name``.
+    """
+    l, lanes = block.shape
+    kern = functools.partial(_ring_gather_kernel, ndev=ndev,
+                             axis_name=axis_name, interpret=interpret)
+    kw = {} if interpret else {"compiler_params": _compiler_params()}
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((ndev, l, lanes), block.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 3,
+        interpret=interpret,
+        **kw,
+    )(block)
+
+
+def _ring_fold_kernel(pend_ref, x_ref, xsq_ref, f_ref, err_ref,
+                      out_ref, fout_ref, errout_ref,
+                      facc, eacc, blk, local_sem, copy_sem, send_sem,
+                      recv_sem, *, ndev: int, axis_name: str, d: int,
+                      kp, compensated: bool, interpret: bool):
+    """One (hop, tile) grid step of the shard-local sync.
+
+    Refs (compensated=False drops err_ref/errout_ref/eacc):
+      pend_ref (R*q, d+3) ANY   — this shard's window block
+      x_ref    (tile, d) VMEM   — x_loc rows of tile t (auto-pipelined)
+      xsq_ref  (1, tile) VMEM   — squared norms of tile t
+      f_ref    (1, tile) VMEM   — pre-sync gradient of tile t
+      out_ref  (P, R*q, d+3) ANY — gathered windows (DMA landing slots)
+      fout_ref (1, tile) VMEM   — folded gradient of tile t
+      facc     (T, tile) VMEM scratch — running fold across hops
+      blk      (R*q, d+3) VMEM scratch — the hop's arrived window
+    """
+    from dpsvm_tpu.ops.kernels import kernel_from_dots
+    from dpsvm_tpu.solver.smo import kahan_add
+
+    h = pl.program_id(0)
+    t = pl.program_id(1)
+    my = lax.axis_index(axis_name)
+    left = lax.rem(my + ndev - 1, ndev)
+
+    @pl.when(t == 0)
+    def _exchange():
+        # One ring hop per h (same slot discipline as ring_gather), then
+        # stage the arrived window in VMEM for this hop's fold tiles.
+        @pl.when(h == 0)
+        def _own():
+            if not interpret:
+                _neighbor_barrier(ndev, axis_name)
+            cp = pltpu.make_async_copy(pend_ref, out_ref.at[my], local_sem)
+            cp.start()
+            cp.wait()
+
+        src = lax.rem(my + h, ndev)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[src], dst_ref=out_ref.at[src],
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=left, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()
+        arrived = lax.rem(my + h + 1, ndev)
+        cp2 = pltpu.make_async_copy(out_ref.at[arrived], blk, copy_sem)
+        cp2.start()
+        cp2.wait()
+
+    # ---- the fold of the arrived window into tile t: EXACTLY
+    # dist_block.py's fold_one on a tile-sized output slice (tiling
+    # splits only the output dim of the (R*q, n_loc) fold matmul, so
+    # per-element results are unchanged), in rotation order (right
+    # neighbor first — the arrival order of a leftward ring).
+    x_t = x_ref[...]                  # (tile, d), x storage dtype
+    rows = blk[:, :d].astype(x_t.dtype)
+    qsq = blk[:, d]
+    coef = blk[:, d + 1]
+    dots = jnp.dot(rows, x_t.T, preferred_element_type=jnp.float32)
+    kr = kernel_from_dots(dots, xsq_ref[0], qsq, kp)   # (R*q, tile)
+    delta = coef @ kr                                  # (tile,)
+    first = h == 0
+    if compensated:
+        base_f = jnp.where(first, f_ref[0], facc[t])
+        base_e = jnp.where(first, err_ref[0], eacc[t])
+        f_new, e_new = kahan_add(base_f, base_e, delta)
+        eacc[t] = e_new
+        errout_ref[0] = e_new
+    else:
+        f_new = jnp.where(first, f_ref[0], facc[t]) + delta
+    facc[t] = f_new
+    fout_ref[0] = f_new
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("ndev", "axis_name", "kp",
+                                    "compensated", "interpret"))
+def ring_fold_window(pend, x_loc, x_sq_loc, f, f_err, kp,
+                     ndev: int, axis_name: str = DATA_AXIS,
+                     compensated: bool = False,
+                     interpret: bool = False):
+    """Shard-local sync as a ring: gather every peer's (R*q, d+3) window
+    AND fold each arrival into the local gradient inside one kernel.
+
+    Returns (gathered (P, R*q, d+3), f_new (n_loc,), err_new or None).
+    ``gathered`` is ordered by absolute device id (lax.all_gather
+    layout — the pair-count lane reduction reads it identically);
+    f/err folding is bit-identical to dist_block.py's rotation fori
+    (same order, same kahan_add, output-dim-only tiling). Must be
+    called inside a shard_map over ``axis_name``.
+    """
+    n_loc, d = x_loc.shape
+    rq, lanes = pend.shape
+    assert lanes == d + 3, (lanes, d)
+    assert compensated == (f_err is not None)
+    tile = fold_tile_rows(n_loc)
+    t_tiles = n_loc // tile
+    kern = functools.partial(
+        _ring_fold_kernel, ndev=ndev, axis_name=axis_name, d=d, kp=kp,
+        compensated=compensated, interpret=interpret)
+
+    vec = pl.BlockSpec((1, tile), lambda h, t: (t, 0),
+                       memory_space=pltpu.VMEM)
+    xspec = pl.BlockSpec((tile, d), lambda h, t: (t, 0),
+                         memory_space=pltpu.VMEM)
+    anyspec = pl.BlockSpec(memory_space=pltpu.ANY)
+    ins = [pend, x_loc, x_sq_loc.reshape(t_tiles, tile),
+           f.reshape(t_tiles, tile)]
+    in_specs = [anyspec, xspec, vec, vec]
+    out_specs = [anyspec, vec]
+    out_shape = [jax.ShapeDtypeStruct((ndev, rq, lanes), jnp.float32),
+                 jax.ShapeDtypeStruct((t_tiles, tile), jnp.float32)]
+    scratch = [pltpu.VMEM((t_tiles, tile), jnp.float32)]
+    if compensated:
+        ins.append(f_err.reshape(t_tiles, tile))
+        in_specs.append(vec)
+        out_specs.append(vec)
+        out_shape.append(
+            jax.ShapeDtypeStruct((t_tiles, tile), jnp.float32))
+        scratch.append(pltpu.VMEM((t_tiles, tile), jnp.float32))
+    scratch += [pltpu.VMEM((rq, lanes), jnp.float32)] \
+        + [pltpu.SemaphoreType.DMA] * 4
+
+    if compensated:
+        def kern_c(pend_r, x_r, xsq_r, f_r, err_r, out_r, fout_r,
+                   errout_r, facc, eacc, blk, *sems):
+            kern(pend_r, x_r, xsq_r, f_r, err_r, out_r, fout_r, errout_r,
+                 facc, eacc, blk, *sems)
+        body = kern_c
+    else:
+        def kern_p(pend_r, x_r, xsq_r, f_r, out_r, fout_r, facc, blk,
+                   *sems):
+            kern(pend_r, x_r, xsq_r, f_r, None, out_r, fout_r, None,
+                 facc, None, blk, *sems)
+        body = kern_p
+
+    kw = {} if interpret else {"compiler_params": _compiler_params()}
+    outs = pl.pallas_call(
+        body,
+        grid=(ndev - 1, t_tiles),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **kw,
+    )(*ins)
+    if compensated:
+        gathered, f2, e2 = outs
+        return gathered, f2.reshape(n_loc), e2.reshape(n_loc)
+    gathered, f2 = outs
+    return gathered, f2.reshape(n_loc), None

@@ -113,6 +113,92 @@ def _select_block_mesh(f, alpha, y, valid, c, q: int, rule: str = "mvp"):
     return w, slot_ok, -jnp.max(gv[0]), jnp.max(gv[1])
 
 
+def _check_ring(ring_exchange: bool, mesh: Mesh, kp: KernelParams,
+                selection: str) -> None:
+    """Factory-time guard for the ring-exchange runners: the ring
+    carries feature rows (a precomputed Gram has none to carry and its
+    symmetric round is already collective-light), the two-sided rules
+    only (the nu rule's per-class quarters keep the all_gather path,
+    same restriction as pipelined/fused), and at least two devices (a
+    one-device 'ring' has no hops — solve_mesh routes the plain
+    exchange there)."""
+    if not ring_exchange:
+        return
+    if kp.kind == "precomputed":
+        raise ValueError(
+            "ring_exchange supports feature kernels only (a precomputed "
+            "Gram has no rows for the candidate ring to carry; its "
+            "symmetric round is already collective-light)")
+    if selection not in ("mvp", "second_order"):
+        raise ValueError(
+            "ring_exchange supports selection in {'mvp', 'second_order'} "
+            "(the nu rule's per-class quarters keep the all_gather path)")
+    if int(mesh.devices.size) < 2:
+        raise ValueError(
+            "ring_exchange needs >= 2 devices (a one-device ring has no "
+            "hops; use the plain runner)")
+
+
+def _select_block_mesh_ring(f, alpha, y, valid, c, q: int, data_loc,
+                            ndev: int, interpret: bool):
+    """Ring-exchange counterpart of _select_block_mesh + _gather_ws for
+    the two-sided rules (ISSUE 11): each shard's per-side top-h
+    candidates travel the ICI ring as (2h, L+2) blocks of
+    [data row | score | gid bits] (ops/ring.py ring_gather), so
+    selection AND working-set recovery complete with ZERO XLA
+    collectives — the rows and per-row scalars arrive WITH the
+    candidates, eliminating the (q, d) + (q, S) recovery psums.
+
+    data_loc: (n_loc, L) f32 [x rows (d, widened) | per-row scalar
+    stack] — the lanes each winning slot needs downstream. Returns
+    (w, slot_ok, b_hi, b_lo, wdata (q, L)) with wdata ordered exactly
+    like combine_halves' [up | low] concat.
+
+    Bit-identity with the all_gather path (pinned in tests/test_ring.py):
+    the gathered candidate axis is reassembled device-major — the same
+    (r, P*h) layout `_global_top` builds — so the exact global top_k
+    picks identical winners (ties included); winner rows/scalars are the
+    owner's bits (the masked psum recovers the same values, as all
+    non-owner contributions are exact zeros); dead filler slots carry
+    finite real-row data either way and are masked by slot_ok
+    everywhere downstream. Global ids ride TWO value lanes as an exact
+    12/19-bit split — the docs/ARCHITECTURE.md numerics rule: a bitcast
+    int32 with a small payload reads as an f32 DENORMAL, which TPU data
+    paths may flush to zero; split values stay normal and exact."""
+    from dpsvm_tpu.ops.ring import ring_gather
+
+    cp, cn = split_c(c)
+    n_loc = f.shape[0]
+    gids = _global_ids(n_loc)
+    up = up_mask(alpha, y, cp, cn) & valid
+    low = low_mask(alpha, y, cp, cn) & valid
+    h = q // 2
+    scores = jnp.stack([jnp.where(up, -f, -jnp.inf),
+                        jnp.where(low, f, -jnp.inf)])
+    v, i = _top_h(scores, h)  # (2, h) local stage, as _global_top
+    g = jnp.take(gids, i).reshape(-1, 1)
+    flat = i.reshape(-1)  # side-major (2h,): [up half | low half]
+    data = jnp.take(data_loc, flat, axis=0)  # (2h, L)
+    g_hi = (g >> 12).astype(jnp.float32)   # < 2^19: exact in f32
+    g_lo = (g & 0xFFF).astype(jnp.float32)  # < 2^12: exact in f32
+    blk = jnp.concatenate([data, v.reshape(-1, 1), g_hi, g_lo], axis=1)
+    with jax.named_scope("mesh_candidate_ring"):
+        ag = ring_gather(blk, ndev, interpret=interpret)  # (P, 2h, L+3)
+    lanes = data_loc.shape[1]
+    cand = jnp.moveaxis(ag.reshape(ndev, 2, h, lanes + 3), 0, 1)
+    cand = cand.reshape(2, ndev * h, lanes + 3)  # device-major, like
+    av = cand[:, :, lanes]                       # _global_top's av/ag
+    agid = (cand[:, :, lanes + 1].astype(jnp.int32) << 12) \
+        | cand[:, :, lanes + 2].astype(jnp.int32)
+    gv, gi = lax.top_k(av, h)
+    ids = jnp.take_along_axis(agid, gi, axis=1)
+    win = jnp.take_along_axis(cand[:, :, :lanes], gi[:, :, None], axis=1)
+    w, slot_ok = combine_halves(ids[0], jnp.isfinite(gv[0]),
+                                ids[1], jnp.isfinite(gv[1]))
+    wdata = jnp.concatenate([win[0], win[1]], axis=0)  # (q, L)
+    return w, slot_ok, -jnp.max(gv[0]), jnp.max(gv[1]), wdata
+
+
 def _ws_owners(w, slot_ok, n_loc: int):
     """Per-device ownership of the replicated working-set ids: (l local
     slot index, own mask, l_safe clipped index). THE single definition of
@@ -149,7 +235,7 @@ def _gather_ws(x_loc, scal_loc, w, slot_ok, n_loc: int):
 def _mesh_round_core(x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
                      budget_left, kp, c, eps, tau, inner_iters: int,
                      inner_impl: str, interpret: bool, selection: str,
-                     pair_batch: int = 1):
+                     pair_batch: int = 1, ring_ws=None):
     """The shared mesh round step AFTER selection: working-set recovery
     (masked psum, or the symmetric local path for a precomputed Gram),
     the replicated (q, q) Gram block + subproblem solve (every device
@@ -160,9 +246,16 @@ def _mesh_round_core(x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
     solver/block.py _round_core instead.
 
     `scal_loc` is the (n_loc, 5) stack [x_sq, k_diag, alpha, y, f_eff].
-    Returns (alpha_w, coef, t, l, own, k_rows_loc)."""
+    `ring_ws`, when set, is the ring exchange's (qx (q, d) f32,
+    scal (q, 5) f32) — the working set already arrived WITH the
+    candidates (_select_block_mesh_ring), so the recovery psums are
+    skipped entirely. Returns (alpha_w, coef, t, l, own, k_rows_loc)."""
     n_loc = x_loc.shape[0]
-    if kp.kind == "precomputed":
+    if ring_ws is not None:
+        qx, scal = ring_ws
+        l, own, _ = _ws_owners(w, slot_ok, n_loc)
+        qsq = scal[:, 0]
+    elif kp.kind == "precomputed":
         # x_loc holds this shard's ROWS of the (symmetric) Gram matrix.
         # Symmetry makes everything local or tiny: K(W, W) = psum of
         # each shard's owned rows' W-columns ((q, q) traffic — never the
@@ -226,11 +319,18 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
                             selection: str = "mvp",
                             compensated: bool = False,
                             pair_batch: int = 1,
-                            donate_state: bool = False):
+                            donate_state: bool = False,
+                            ring_exchange: bool = False):
     """Build the jitted shard_mapped block-round chunk executor.
     selection: "mvp" | "second_order" | "nu" (solver/block.py rules).
     compensated: carry a shard-local Kahan residual of f so the fold's
-    fp32 rounding is deferred (solver/smo.py kahan_add)."""
+    fp32 rounding is deferred (solver/smo.py kahan_add).
+    ring_exchange: route the round's candidate exchange AND working-set
+    recovery through the Pallas ICI ring (_select_block_mesh_ring /
+    ops/ring.py) instead of the all_gather + psum pair — bit-identical
+    trajectories, zero XLA collectives in the device-form round body
+    (config.ring_exchange; tpulint `mesh_chunk_ring` pins it)."""
+    _check_ring(ring_exchange, mesh, kp, selection)
 
     def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
                    state: BlockState, max_iter):
@@ -248,15 +348,37 @@ def make_block_chunk_runner(mesh: Mesh, kp: KernelParams, c, eps: float,
             # convergence semantics; the final round runs gated to 0
             # pair updates).
             f_cur = eff_f(st)
-            w, slot_ok, b_hi, b_lo = _select_block_mesh(
-                f_cur, st.alpha, y_loc, valid_loc, c, q, rule=selection)
-            gap_open = b_lo > b_hi + 2.0 * eps
-            scal_loc = jnp.stack(
-                [x_sq_loc, k_diag_loc, st.alpha, y_loc, f_cur], axis=1)
+            if ring_exchange:
+                # Candidates + their rows/scalars arrive together over
+                # the DMA ring; no recovery psums downstream.
+                scal_loc = jnp.stack(
+                    [x_sq_loc, k_diag_loc, st.alpha, y_loc, f_cur],
+                    axis=1)
+                d_feat = x_loc.shape[1]
+                data_loc = jnp.concatenate(
+                    [x_loc.astype(jnp.float32), scal_loc], axis=1)
+                w, slot_ok, b_hi, b_lo, wdata = _select_block_mesh_ring(
+                    f_cur, st.alpha, y_loc, valid_loc, c, q, data_loc,
+                    int(mesh.devices.size), interpret)
+                ring_ws = (wdata[:, :d_feat], wdata[:, d_feat:])
+                gap_open = b_lo > b_hi + 2.0 * eps
+            else:
+                # The all_gather path traces in the ORIGINAL statement
+                # order so the ring_exchange=False program (and its
+                # committed tpulint budget) stays byte-identical.
+                w, slot_ok, b_hi, b_lo = _select_block_mesh(
+                    f_cur, st.alpha, y_loc, valid_loc, c, q,
+                    rule=selection)
+                gap_open = b_lo > b_hi + 2.0 * eps
+                scal_loc = jnp.stack(
+                    [x_sq_loc, k_diag_loc, st.alpha, y_loc, f_cur],
+                    axis=1)
+                ring_ws = None
             alpha_w, coef, t, l, own, k_rows_loc = _mesh_round_core(
                 x_loc, x_sq_loc, scal_loc, w, slot_ok, gap_open,
                 max_iter - st.pairs, kp, c, eps, tau, inner_iters,
-                inner_impl, interpret, selection, pair_batch=pair_batch)
+                inner_impl, interpret, selection, pair_batch=pair_batch,
+                ring_ws=ring_ws)
             # Fold: purely LOCAL (q, n_loc) kernel-row matmul (or, for
             # a precomputed Gram, the symmetric local column gather).
             f, f_err = maybe_kahan(st.f, st.f_err, coef @ k_rows_loc)
@@ -298,7 +420,8 @@ def make_block_shardlocal_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                        selection: str = "mvp",
                                        compensated: bool = False,
                                        pair_batch: int = 1,
-                                       donate_state: bool = False):
+                                       donate_state: bool = False,
+                                       ring_exchange: bool = False):
     """SHARD-PARALLEL working sets (config.local_working_sets — the
     Cascade-SVM / partitioned-parallel-SMO structure re-derived for the
     mesh; Graf et al. NIPS 2004, Cao et al. IEEE TNN 2006, PAPERS.md):
@@ -384,6 +507,7 @@ def make_block_shardlocal_chunk_runner(mesh: Mesh, kp: KernelParams, c,
             "'second_order'} (the nu rule's per-class stopping pair "
             "does not reduce shard-locally; see ops/select.py "
             "stopping_extrema)")
+    _check_ring(ring_exchange, mesh, kp, selection)
     p_dev = int(mesh.devices.size)
     r_sync = int(sync_rounds)
 
@@ -432,26 +556,48 @@ def make_block_shardlocal_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                 0, r_sync, local_round,
                 (st.alpha, st.f, st.f_err, pend0, jnp.int32(0)))
 
-            # ---- SYNC: the window's ONLY collectives.
-            with jax.named_scope("mesh_sync"):
-                ag = lax.all_gather(pend.reshape(r_sync * q, d + 3),
-                                    DATA_AXIS)  # (P, R*q, d+3)
-            pairs = st.pairs + jnp.sum(ag[:, :, d + 2]).astype(jnp.int32)
+            if ring_exchange:
+                # ---- SYNC over the ICI ring (ops/ring.py): the
+                # window's blocks travel P-1 remote-DMA hops and every
+                # arriving hop is folded IN-KERNEL — same rotation
+                # order, same kahan step, bit-identical gradient — so
+                # the sync's device form has zero XLA collectives left
+                # except the stopping handoff below (tpulint
+                # `shardlocal_chunk_ring` pins it).
+                from dpsvm_tpu.ops.ring import ring_fold_window
 
-            # Cross-shard fold: one (R*q, n_loc) kernel-row fold per
-            # PEER block — the same per-step footprint as R plain
-            # rounds' folds. The rotation skips the own block entirely
-            # (its deltas were folded locally each round; a masked
-            # all-P loop would burn one full fold matmul on zeros).
-            def fold_one(i, carry):
-                f, f_err = carry
-                blk = ag[(dev + 1 + i) % p_dev]
-                delta = blk[:, d + 1] @ kernel_rows(
-                    x_loc, x_sq_loc, blk[:, :d].astype(x_loc.dtype),
-                    blk[:, d], kp)
-                return maybe_kahan(f, f_err, delta)
+                with jax.named_scope("mesh_sync_ring"):
+                    ag, f, f_err = ring_fold_window(
+                        pend.reshape(r_sync * q, d + 3), x_loc,
+                        x_sq_loc, f, f_err, kp, p_dev,
+                        compensated=f_err is not None,
+                        interpret=interpret)
+                pairs = st.pairs + jnp.sum(
+                    ag[:, :, d + 2]).astype(jnp.int32)
+            else:
+                # ---- SYNC: the window's ONLY collectives.
+                with jax.named_scope("mesh_sync"):
+                    ag = lax.all_gather(pend.reshape(r_sync * q, d + 3),
+                                        DATA_AXIS)  # (P, R*q, d+3)
+                pairs = st.pairs + jnp.sum(
+                    ag[:, :, d + 2]).astype(jnp.int32)
 
-            f, f_err = lax.fori_loop(0, p_dev - 1, fold_one, (f, f_err))
+                # Cross-shard fold: one (R*q, n_loc) kernel-row fold
+                # per PEER block — the same per-step footprint as R
+                # plain rounds' folds. The rotation skips the own block
+                # entirely (its deltas were folded locally each round;
+                # a masked all-P loop would burn one full fold matmul
+                # on zeros).
+                def fold_one(i, carry):
+                    f, f_err = carry
+                    blk = ag[(dev + 1 + i) % p_dev]
+                    delta = blk[:, d + 1] @ kernel_rows(
+                        x_loc, x_sq_loc, blk[:, :d].astype(x_loc.dtype),
+                        blk[:, d], kp)
+                    return maybe_kahan(f, f_err, delta)
+
+                f, f_err = lax.fori_loop(0, p_dev - 1, fold_one,
+                                         (f, f_err))
 
             # ---- global stopping pair from the CORRECTED gradient:
             # local masked extrema + one (2,) max-allreduce handoff.
@@ -488,7 +634,8 @@ def make_block_pipelined_chunk_runner(mesh: Mesh, kp: KernelParams, c,
                                       selection: str = "mvp",
                                       compensated: bool = False,
                                       pair_batch: int = 1,
-                                      donate_state: bool = False):
+                                      donate_state: bool = False,
+                                      ring_exchange: bool = False):
     """PIPELINED mesh block runner (config.pipeline_rounds — the mesh
     counterpart of solver/block.py run_chunk_block_pipelined, and the
     path where the overlap is STRUCTURAL rather than scheduler luck):
@@ -523,6 +670,7 @@ def make_block_pipelined_chunk_runner(mesh: Mesh, kp: KernelParams, c,
             "pipelined mesh rounds support feature kernels only (the "
             "precomputed Gram's symmetric round has no (q, d) psum to "
             "hide; use make_block_chunk_runner)")
+    _check_ring(ring_exchange, mesh, kp, selection)
 
     def chunk_body(x_loc, y_loc, x_sq_loc, k_diag_loc, valid_loc,
                    state: BlockState, max_iter):
@@ -535,10 +683,27 @@ def make_block_pipelined_chunk_runner(mesh: Mesh, kp: KernelParams, c,
         def prefetch(f_eff, alpha):
             """Next working set + its data-side artifacts from the
             pre-fold (f, alpha). All collectives here are overlappable:
-            nothing downstream of the in-flight subproblem feeds them."""
-            w, ok, b_hi, b_lo = _select_block_mesh(
-                f_eff, alpha, y_loc, valid_loc, c, q, rule=selection)
-            qx, stat, _, _ = _gather_ws(x_loc, stat_loc, w, ok, n_loc)
+            nothing downstream of the in-flight subproblem feeds them.
+            Under ring_exchange the candidate gather + row psum become
+            ONE DMA-ring pass carrying rows and static scalars with the
+            candidates (_select_block_mesh_ring) — the overlap then no
+            longer depends on XLA scheduling async collectives under
+            the subproblem chain. The (q, 2) handoff psum stays: it
+            reads per-slot alpha/f CURRENT at round entry, which no
+            prefetch can carry."""
+            if ring_exchange:
+                d_feat = x_loc.shape[1]
+                data_loc = jnp.concatenate(
+                    [x_loc.astype(jnp.float32), stat_loc], axis=1)
+                w, ok, b_hi, b_lo, wdata = _select_block_mesh_ring(
+                    f_eff, alpha, y_loc, valid_loc, c, q, data_loc,
+                    int(mesh.devices.size), interpret)
+                qx, stat = wdata[:, :d_feat], wdata[:, d_feat:]
+            else:
+                w, ok, b_hi, b_lo = _select_block_mesh(
+                    f_eff, alpha, y_loc, valid_loc, c, q, rule=selection)
+                qx, stat, _, _ = _gather_ws(x_loc, stat_loc, w, ok,
+                                            n_loc)
             qsq, kd, y_w = stat[:, 0], stat[:, 1], stat[:, 2]
             dots = jnp.dot(qx, qx.T, preferred_element_type=jnp.float32)
             kb = kernel_from_dots(dots, qsq, qsq, kp)

@@ -452,6 +452,21 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     if config.dtype == "bfloat16":
         from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
         warn_if_bf16_degrades(x, config)
+    # bf16 Gram path (config.bf16_gram): same gate + loud-refusal
+    # contract as the single-chip path (solver/smo.py); the mesh
+    # shards the bf16-stored X exactly as it would the f32 one.
+    bf16_gram_stats = {}
+    if config.bf16_gram:
+        from dpsvm_tpu.ops.kernels import resolve_bf16_gram
+
+        _bfg_on, _, _bfg_entry = resolve_bf16_gram(x, config, gamma)
+        bf16_gram_stats = {"bf16_gram": _bfg_entry}
+        if _bfg_on:
+            dtype = jnp.bfloat16
+        else:
+            import warnings
+
+            warnings.warn(_bfg_entry["note"], stacklevel=3)
 
     if mesh is None:
         mesh = make_data_mesh(num_devices)
@@ -466,7 +481,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     # n_loc band pods actually land in). Needs n_loc padded to 1024 and
     # q/2 <= n_loc/128.
     from dpsvm_tpu.solver.block import (fused_fold_pays, pipeline_pays,
-                                        shardlocal_pays)
+                                        ring_pays, shardlocal_pays)
 
     _platform = mesh.devices.flat[0].platform
     _n_pad_f = pad_rows(n, n_dev, multiple=1024)
@@ -502,7 +517,24 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                      if config.pipeline_rounds is not None
                      else (_platform == "tpu"
                            and pipeline_pays(_n_loc_f, d))))
+    # Ring-overlapped candidate exchange (config.ring_exchange;
+    # ops/ring.py + dist_block.py _select_block_mesh_ring /
+    # ring_fold_window): the per-round/per-window all_gather + psums
+    # become remote-DMA ring hops, bit-identical trajectories. Composes
+    # with the global, pipelined and shard-local runners; the active and
+    # fused runners keep the all_gather path (config validates the
+    # explicit-True conflicts), as do nu trainers (per-class quarters)
+    # and one-device meshes (no hops).
+    use_ring = (use_block and n_dev > 1
+                and config.selection != "nu"
+                and kp.kind != "precomputed"
+                and not config.active_set_size
+                and (config.ring_exchange
+                     if config.ring_exchange is not None
+                     else (_platform == "tpu"
+                           and ring_pays(n_dev, _n_loc_f, d))))
     use_fused = (use_block and not use_pipe and not use_shardlocal
+                 and not use_ring
                  and config.selection != "nu"
                  and not config.active_set_size
                  and kp.kind != "precomputed"
@@ -639,13 +671,16 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
         def _plain_runner(rpc):
             # Shared by the default dispatch and the shard-local
             # engine's endgame demotion (which swaps runners mid-solve).
+            # The ring exchange rides along (bit-identical either way,
+            # so the demotion contract is unchanged).
             return make_block_chunk_runner(
                 mesh, kp, config.c_bounds(), eps_run,
                 float(config.tau), q, inner, rpc, inner_impl,
+                interpret=_platform != "tpu",
                 selection=config.selection,
                 compensated=config.compensated,
                 pair_batch=int(config.pair_batch),
-                donate_state=True)
+                donate_state=True, ring_exchange=use_ring)
 
         if config.active_set_size:
             from dpsvm_tpu.parallel.dist_block import (
@@ -684,7 +719,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 selection=config.selection,
                 compensated=config.compensated,
                 pair_batch=int(config.pair_batch),
-                donate_state=True)
+                donate_state=True, ring_exchange=use_ring)
         elif use_pipe:
             from dpsvm_tpu.parallel.dist_block import (
                 make_block_pipelined_chunk_runner)
@@ -696,7 +731,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 selection=config.selection,
                 compensated=config.compensated,
                 pair_batch=int(config.pair_batch),
-                donate_state=True)
+                donate_state=True, ring_exchange=use_ring)
         elif use_fused:
             from dpsvm_tpu.parallel.dist_block import (
                 make_block_fused_chunk_runner)
@@ -740,6 +775,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                         "shardlocal": bool(use_shardlocal),
                         "pipelined": bool(use_block and use_pipe),
                         "fused_fold": bool(use_block and use_fused),
+                        "ring_exchange": bool(use_ring),
                         "observed_chunks": observe})
     jax.block_until_ready((x_dev, y_dev, x_sq, k_diag, valid_dev, state))
     phase_seconds = {"setup": time.perf_counter() - t_entry,
@@ -855,6 +891,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
         **({"outer_rounds": int(state.rounds)} if use_block else {}),
         **({"shardlocal_demoted": shardlocal_demoted}
            if use_shardlocal else {}),
+        **({"ring_exchange": True} if use_ring else {}),
+        **bf16_gram_stats,
     }
     if obs.live:
         stats["obs_run_id"] = obs.run_id

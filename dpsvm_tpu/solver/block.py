@@ -114,6 +114,31 @@ def shardlocal_pays(n_loc: int, d: int) -> bool:
     return False
 
 
+def ring_pays(n_dev: int, n_loc: int, d: int) -> bool:
+    """Auto-gate for the ring-overlapped mesh candidate exchange
+    (ops/ring.py; config.ring_exchange). Same single-source discipline
+    as pipeline_pays / shardlocal_pays: the gate constants come from a
+    device measurement or the gate stays off.
+
+    Status (2026-08-04): the kernels are implemented and CPU-verified
+    bit-identical to the all_gather path in interpret mode
+    (tests/test_ring.py; all three runners), the device-form collective
+    contract is pinned by the tpulint mesh_chunk_ring /
+    shardlocal_chunk_ring budgets, and the A/B probe exists
+    (tools/profile_round.py --ring) — but no TPU was reachable this
+    session, so there is no measured crossover and the honest auto
+    default is OFF everywhere (config.ring_exchange=True forces it on
+    for measurement and for the CPU tests). Expected shape of the
+    eventual gate: pays when per-round exchange latency is a visible
+    round fraction — small n_loc (latency-bound rounds) or large P
+    (XLA's all_gather+psum dispatch chain grows while the ring's
+    per-hop payload shrinks); the shard-local in-kernel fold pays when
+    the window fold matmul is long enough to hide a hop's DMA
+    (max(DMA, matmul) vs DMA + matmul). Flip to the measured rule when
+    the device session lands."""
+    return False
+
+
 def pipeline_pays(n_rows: int, d: int) -> bool:
     """Auto-gate for the PIPELINED round engine (run_chunk_block_pipelined
     / the mesh pipelined runner), same single-source discipline as

@@ -272,6 +272,31 @@ def solve_fleet(
     if config.dtype == "bfloat16":
         from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
         warn_if_bf16_degrades(x, config)
+    # bf16 Gram path (config.bf16_gram): one gate decides for the WHOLE
+    # fleet (shared X, one storage dtype), against the largest box
+    # bound any problem runs under — per-problem C overrides included,
+    # so a single extreme-C problem in the fleet refuses bf16 for all
+    # (the conservative reading of the shared-storage contract). Same
+    # loud-refusal stats/warning as solve() (ops/kernels.py).
+    bf16_gram_stats = {}
+    if config.bf16_gram:
+        from dpsvm_tpu.ops.kernels import resolve_bf16_gram
+
+        c_max = max(config.c_bounds())
+        for p in problems:
+            if p.c is not None:
+                cs = np.asarray(p.c, np.float64).reshape(-1)
+                c_max = max(c_max, float(cs.max()))
+        _bfg_on, _, _entry = resolve_bf16_gram(
+            x, config, gamma, c_max=c_max,
+            scope="for the fleet (largest per-problem C)")
+        bf16_gram_stats = {"bf16_gram": _entry}
+        if _bfg_on:
+            dtype = jnp.bfloat16
+        else:
+            import warnings
+
+            warnings.warn(_entry["note"], stacklevel=3)
     if device is None:
         device = jax.devices()[0]
 
@@ -450,6 +475,7 @@ def solve_fleet(
                     "device_seconds": train_seconds,
                     "gram_resident": bool(use_gram),
                 },
+                **bf16_gram_stats,
             },
         ))
     return results

@@ -884,8 +884,13 @@ def _resident_gram_cached(x_host, build_x_p, n_pad, dtype,
     from dpsvm_tpu.ops.kernels import resident_gram
 
     # Keyed on the PADDED build shape, not the host shape: the same host
-    # X solved at two pad_to buckets needs two distinct Grams.
-    key = (kp, (n_pad, x_host.shape[1]), config.dtype,
+    # X solved at two pad_to buckets needs two distinct Grams — and on
+    # the EFFECTIVE storage dtype, not config.dtype: a bf16_gram solve
+    # whose gate accepted builds from bfloat16-rounded features while
+    # its config still says 'float32', and must never share a Gram with
+    # a plain float32 solve on the same host array (the _device_x_cached
+    # discipline).
+    key = (kp, (n_pad, x_host.shape[1]), str(dtype),
            getattr(device, "id", None), config.resolve_precision())
     ent = _GRAM_MEMO.get(key)
     if ent is not None and ent[0]() is x_host \
@@ -1036,6 +1041,22 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     if config.dtype == "bfloat16":
         from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
         warn_if_bf16_degrades(x, config)
+    # bf16 Gram path (config.bf16_gram): flip X storage to bfloat16
+    # (f32 MXU accumulation) ONLY where the per-problem perturbation
+    # bound allows; a refusal stays float32 and is loud in stats + a
+    # warning (ops/kernels.py resolve_bf16_gram).
+    bf16_gram_stats = {}
+    if config.bf16_gram:
+        from dpsvm_tpu.ops.kernels import resolve_bf16_gram
+
+        _bfg_on, _, _bfg_entry = resolve_bf16_gram(x, config, gamma)
+        bf16_gram_stats = {"bf16_gram": _bfg_entry}
+        if _bfg_on:
+            dtype = jnp.bfloat16
+        else:
+            import warnings
+
+            warnings.warn(_bfg_entry["note"], stacklevel=3)
 
     if device is None:
         device = jax.devices()[0]
@@ -1460,6 +1481,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         # boundary, chunk boundaries only).
         "phase_seconds": phase_seconds,
         **({"outer_rounds": int(state.rounds)} if use_block else {}),
+        **bf16_gram_stats,
     }
     if obs.live:
         stats["obs_run_id"] = obs.run_id

@@ -1,0 +1,349 @@
+"""Ring-overlapped mesh candidate exchange + bf16 Gram training path
+(ISSUE 11; ops/ring.py, config.ring_exchange / config.bf16_gram).
+
+The acceptance battery: interpret-mode ring exchange produces a
+BIT-IDENTICAL training trajectory to the all_gather path on the tier-1
+2-device CPU mesh (every runner it wires into: global, pipelined,
+shard-local — plus second_order and the compensated carry), the
+device-form tpulint contract is mutation-verified (a stray per-hop XLA
+collective or an extra bf16 convert must DRIFT the committed budget),
+the bf16-Gram gate accepts/refuses per problem with the refusal loud in
+stats AND as a warning, and the config/CLI surface validates the
+documented compositions. Heavy 8-device legs are `slow` (the
+test_shardlocal.py discipline).
+"""
+
+import copy
+import warnings
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.parallel.dist_smo import solve_mesh
+from dpsvm_tpu.solver.smo import solve
+
+BASE = SVMConfig(c=5.0, gamma=0.1, epsilon=1e-3, max_iter=200_000,
+                 engine="block", working_set_size=16, chunk_iters=64)
+
+
+def _pair(x, y, cfg, num_devices=2):
+    """(ring off, ring on) mesh solves with full per-chunk observation
+    streams, for bitwise trajectory comparison."""
+    obs_off, obs_on = [], []
+
+    def cb(sink):
+        return lambda it, bh, bl, st: sink.append((it, bh, bl)) and None
+
+    r0 = solve_mesh(x, y, cfg.replace(ring_exchange=False),
+                    num_devices=num_devices, callback=cb(obs_off))
+    r1 = solve_mesh(x, y, cfg.replace(ring_exchange=True),
+                    num_devices=num_devices, callback=cb(obs_on))
+    return r0, r1, obs_off, obs_on
+
+
+def _assert_bitwise(r0, r1, obs_off, obs_on):
+    assert obs_off == obs_on
+    assert r1.iterations == r0.iterations
+    np.testing.assert_array_equal(r1.alpha, r0.alpha)
+    np.testing.assert_array_equal(r1.stats["f"], r0.stats["f"])
+    assert (r1.b_hi, r1.b_lo) == (r0.b_hi, r0.b_lo)
+    assert r1.stats.get("ring_exchange") is True
+    assert "ring_exchange" not in r0.stats
+
+
+# ---- bit-identical trajectories, tier-1 2-device mesh ---------------
+
+
+def test_ring_global_runner_bitwise(blobs_small):
+    """The plain (global working set) runner: ring-carried candidates +
+    rows must reproduce the all_gather + psum trajectory bit for bit —
+    observation stream, alpha, f, extrema, pair counts."""
+    x, y = blobs_small
+    _assert_bitwise(*_pair(x, y, BASE))
+
+
+def test_ring_second_order_compensated_bitwise(blobs_small):
+    """The ring exchange is selection-rule- and carry-agnostic: WSS2
+    partner picking reads the same Gram block, and the Kahan residual
+    rides the fold untouched (the ring only moves SELECTION data)."""
+    x, y = blobs_small
+    cfg = BASE.replace(selection="second_order", compensated=True)
+    _assert_bitwise(*_pair(x, y, cfg))
+
+
+def test_ring_pipelined_runner_bitwise(blobs_small):
+    """Pipelined rounds: the prefetch's gather + row psum become the
+    ring pass; the (q, 2) handoff psum stays. Same trajectory pin."""
+    x, y = blobs_small
+    _assert_bitwise(*_pair(x, y, BASE.replace(pipeline_rounds=True)))
+
+
+def test_ring_shardlocal_runner_bitwise(blobs_small):
+    """Shard-local sync: the in-kernel per-hop fold (ops/ring.py
+    ring_fold_window) must match the all_gather + rotation-fori fold
+    bitwise — same fold order, same kahan step, output-dim-only
+    tiling — including the pair-count lane reduction and the endgame
+    demotion trajectory (the demoted global runner rides the ring
+    too)."""
+    x, y = blobs_small
+    cfg = BASE.replace(local_working_sets=2, sync_rounds=2)
+    r0, r1, a, b = _pair(x, y, cfg)
+    _assert_bitwise(r0, r1, a, b)
+    assert r0.stats["shardlocal_demoted"] == r1.stats["shardlocal_demoted"]
+
+
+# ---- 8-device legs (slow: several mesh solves) ----------------------
+
+
+@pytest.mark.slow
+def test_ring_8dev_bitwise_all_runners(blobs_medium):
+    """The full-width mesh: 7-hop rings across every wired runner stay
+    bit-identical (hop count, slot rotation and fold order all change
+    with P — the 2-device pin alone would not exercise mid-ring
+    forwarding)."""
+    x, y = blobs_medium
+    cfg = BASE.replace(working_set_size=32, inner_iters=64)
+    _assert_bitwise(*_pair(x, y, cfg, num_devices=8))
+    _assert_bitwise(*_pair(x, y, cfg.replace(pipeline_rounds=True),
+                           num_devices=8))
+    _assert_bitwise(*_pair(
+        x, y, cfg.replace(local_working_sets=2, sync_rounds=2,
+                          compensated=True), num_devices=8))
+
+
+# ---- tpulint device-form contract, mutation-verified ----------------
+
+
+def test_device_form_facts_catch_stray_hop_collective():
+    """The extractor side of the acceptance criterion: the device-form
+    walk counts XLA collective primitives through shard_map, loops AND
+    pallas kernel jaxprs — a psum smuggled next to the ring is seen;
+    the clean ring body reads zero collectives and nonzero DMA hops."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dpsvm_tpu.analysis.hlo_facts import device_form_facts
+    from dpsvm_tpu.ops.ring import ring_gather
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS
+
+    mesh = Mesh(np.array(jax.devices()[:8]), (DATA_AXIS,))
+
+    def clean(blk):
+        return ring_gather(blk, 8, interpret=False)
+
+    def mutated(blk):
+        out = ring_gather(blk, 8, interpret=False)
+        return out + lax.psum(blk, DATA_AXIS)[None]  # the stray hop sum
+
+    spec = P(DATA_AXIS)
+    arg = jnp.zeros((16, 8), jnp.float32)
+
+    def facts(fn):
+        mapped = shard_map(fn, mesh=mesh, in_specs=spec,
+                           out_specs=P(None, DATA_AXIS), check_rep=False)
+        return device_form_facts(jax.make_jaxpr(mapped)(arg))
+
+    f_clean, f_mut = facts(clean), facts(mutated)
+    assert f_clean["xla_collective_total"] == 0
+    assert f_clean["dma_starts"] > 0
+    assert f_mut["xla_collectives"]["psum"] == 1
+    assert f_mut["xla_collective_total"] == 1
+
+
+def test_ring_budgets_drift_on_mutation():
+    """The budget side: re-extracted ring facts PASS against the
+    committed budgets, and the two mutations the acceptance criterion
+    names — a stray per-hop XLA collective in the device form, an
+    extra f32<->bf16 convert in the bf16-Gram body — each flip the
+    verdict to DRIFT naming the fact path."""
+    import jax
+
+    from dpsvm_tpu.analysis import budget
+    from dpsvm_tpu.analysis.extract import entry_facts
+    from dpsvm_tpu.analysis.manifest import (block_chunk_bf16gram,
+                                             mesh_chunk_ring,
+                                             require_devices)
+
+    require_devices()
+    gen = budget.budget_jax_version()
+    if gen is not None and gen != jax.__version__:
+        pytest.skip(f"budgets generated under jax {gen}, running "
+                    f"{jax.__version__} (the pinned CI job is the gate)")
+
+    ring = entry_facts(mesh_chunk_ring())
+    assert budget.check_entry("mesh_chunk_ring", ring)["verdict"] \
+        == budget.PASS
+    mut = copy.deepcopy(ring)
+    df = mut["units"]["chunk"]["device_form"]
+    df["xla_collectives"]["psum"] += 1
+    df["xla_collective_total"] += 1
+    res = budget.check_entry("mesh_chunk_ring", mut)
+    assert res["verdict"] == budget.DRIFT
+    assert any("device_form" in d[0] for d in res["diffs"])
+
+    bfg = entry_facts(block_chunk_bf16gram())
+    assert budget.check_entry("block_chunk_bf16gram", bfg)["verdict"] \
+        == budget.PASS
+    mut2 = copy.deepcopy(bfg)
+    mut2["units"]["chunk"]["dtypes"]["f32_to_bf16_converts"] += 1
+    res2 = budget.check_entry("block_chunk_bf16gram", mut2)
+    assert res2["verdict"] == budget.DRIFT
+    assert any("f32_to_bf16" in d[0] for d in res2["diffs"])
+
+
+# ---- bf16 Gram gate -------------------------------------------------
+
+
+def test_bf16_gram_accepts_and_matches_bf16_dtype(blobs_small):
+    """An accepting gate (C=5 on benign blobs: risk ~ 5e-3) must train
+    EXACTLY as dtype='bfloat16' would — same storage rounding, same
+    trajectory — with the decision recorded in stats and no warning."""
+    x, y = blobs_small
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        rg = solve(x, y, BASE.replace(bf16_gram=True))
+    st = rg.stats["bf16_gram"]
+    assert st["active"] is True and "note" not in st
+    assert 0.0 < st["risk"] <= st["threshold"]
+    rb = solve(x, y, BASE.replace(dtype="bfloat16"))
+    np.testing.assert_array_equal(rg.alpha, rb.alpha)
+    assert rg.iterations == rb.iterations
+
+
+def test_bf16_gram_refuses_loudly_and_stays_f32(blobs_small):
+    """A refusing gate (extreme C amplifies storage rounding past the
+    threshold) must leave the solve bit-identical to plain float32,
+    carry the loud note in stats AND raise a warning — never a silent
+    fallback."""
+    x, y = blobs_small
+    hot = BASE.replace(c=4096.0, max_iter=4000)
+    with pytest.warns(UserWarning, match="bf16_gram REFUSED"):
+        rg = solve(x, y, hot.replace(bf16_gram=True))
+    st = rg.stats["bf16_gram"]
+    assert st["active"] is False
+    assert "REFUSED" in st["note"] and "float32" in st["note"]
+    assert st["risk"] > st["threshold"]
+    rf = solve(x, y, hot)
+    np.testing.assert_array_equal(rg.alpha, rf.alpha)
+    assert rg.iterations == rf.iterations
+
+
+def test_bf16_gram_mesh_and_ring_compose(blobs_small):
+    """The mesh path runs the same gate (sharding the bf16-stored X),
+    and the ring exchange carries bf16-originated rows widened to f32
+    exactly like the psum path — the two tentpole halves compose."""
+    x, y = blobs_small
+    cfg = BASE.replace(bf16_gram=True, ring_exchange=True)
+    rm = solve_mesh(x, y, cfg, num_devices=2)
+    assert rm.stats["bf16_gram"]["active"] is True
+    assert rm.stats["ring_exchange"] is True
+    rs = solve_mesh(x, y, BASE.replace(dtype="bfloat16"), num_devices=2)
+    np.testing.assert_array_equal(rm.alpha, rs.alpha)
+
+
+def test_bf16_gram_fleet_gate_covers_per_problem_c(blobs_small):
+    """One fleet, one storage dtype: the gate judges the LARGEST box
+    bound any problem runs under, so a single extreme-C problem refuses
+    bf16 for the whole fleet (per-problem C overrides included)."""
+    from dpsvm_tpu.solver.fleet import FleetProblem, solve_fleet
+
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=4000,
+                    bf16_gram=True)
+    probs = [FleetProblem(y=y), FleetProblem(y=-y)]
+    res = solve_fleet(x, probs, cfg)
+    assert all(r.stats["bf16_gram"]["active"] for r in res)
+    with pytest.warns(UserWarning, match="REFUSED for the fleet"):
+        res_hot = solve_fleet(
+            x, [FleetProblem(y=y), FleetProblem(y=-y, c=4096.0)], cfg)
+    assert all(not r.stats["bf16_gram"]["active"] for r in res_hot)
+
+
+def test_bf16_gram_resident_memo_keys_on_effective_dtype(blobs_small):
+    """The resident-Gram memo must key on the EFFECTIVE storage dtype:
+    a bf16_gram solve whose gate accepted builds its Gram from
+    bf16-rounded features while config.dtype still reads 'float32' —
+    it must neither reuse a plain f32 solve's cached Gram (claiming
+    bf16 while training exact) nor poison the f32 entry for later
+    solves on the same host array."""
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000,
+                    gram_resident=True)
+    r_ref = solve(x, y, cfg)                     # seeds the f32 memo
+    r_bf = solve(x, y, cfg.replace(bf16_gram=True))
+    assert r_bf.stats["bf16_gram"]["active"] is True
+    r_bfd = solve(x, y, cfg.replace(dtype="bfloat16"))
+    # True bf16 behavior, not a silent hit on the f32 entry...
+    np.testing.assert_array_equal(r_bf.alpha, r_bfd.alpha)
+    # ...and the f32 entry is uncorrupted afterwards.
+    r_f32 = solve(x, y, cfg)
+    np.testing.assert_array_equal(r_f32.alpha, r_ref.alpha)
+
+
+# ---- config / CLI surface -------------------------------------------
+
+
+def test_ring_exchange_validation():
+    with pytest.raises(ValueError, match="block-engine"):
+        SVMConfig(engine="xla", ring_exchange=True)
+    with pytest.raises(ValueError, match="feature kernels"):
+        SVMConfig(engine="block", ring_exchange=True,
+                  kernel="precomputed")
+    with pytest.raises(ValueError, match="ooc"):
+        SVMConfig(engine="block", ring_exchange=True, ooc=True)
+    with pytest.raises(ValueError, match="active_set_size"):
+        SVMConfig(engine="block", ring_exchange=True, active_set_size=64)
+    with pytest.raises(ValueError, match="fused_fold"):
+        SVMConfig(engine="block", ring_exchange=True, fused_fold=True)
+    # The documented compositions construct fine.
+    SVMConfig(engine="block", ring_exchange=True, pipeline_rounds=True)
+    SVMConfig(engine="block", ring_exchange=True, local_working_sets=2,
+              sync_rounds=4, compensated=True)
+
+
+def test_bf16_gram_validation():
+    with pytest.raises(ValueError, match="feature kernels"):
+        SVMConfig(bf16_gram=True, kernel="precomputed")
+    with pytest.raises(ValueError, match="bfloat16"):
+        SVMConfig(bf16_gram=True, dtype="bfloat16")
+    with pytest.raises(ValueError, match="ooc"):
+        SVMConfig(bf16_gram=True, engine="block", ooc=True)
+    SVMConfig(bf16_gram=True)  # plain request is valid on any engine
+
+
+def test_nu_fallback_names_ring_exchange(blobs_small):
+    """The nu trainers keep the all_gather path (per-class quarters);
+    a configured ring_exchange must be NAMED in the fallback warning,
+    not silently dropped (the PR 8 loud-fallback discipline)."""
+    from dpsvm_tpu.models.nusvm import train_nusvc
+
+    x, y = blobs_small
+    cfg = SVMConfig(engine="block", ring_exchange=True, epsilon=1e-2,
+                    max_iter=2000)
+    with pytest.warns(UserWarning, match="ring_exchange"):
+        train_nusvc(x, y, 0.3, cfg, backend="single")
+
+
+def test_cli_ring_and_bf16_flags(tmp_path):
+    """--ring-exchange / --bf16-gram reach SVMConfig and train a model
+    end to end (mesh backend for the ring; single-chip for the gate)."""
+    from dpsvm_tpu.cli import main
+    from dpsvm_tpu.data.loader import save_csv
+    from dpsvm_tpu.data.synth import make_blobs_binary
+
+    x, y = make_blobs_binary(n=240, d=8, seed=5, sep=2.0)
+    train_p = str(tmp_path / "train.csv")
+    save_csv(train_p, x, y)
+    rc = main(["train", "-f", train_p, "-m", str(tmp_path / "m1.npz"),
+               "-c", "5", "-g", "0.1", "--engine", "block",
+               "--backend", "mesh", "--num-devices", "2",
+               "--ring-exchange", "on", "-q"])
+    assert rc == 0
+    rc = main(["train", "-f", train_p, "-m", str(tmp_path / "m2.npz"),
+               "-c", "5", "-g", "0.1", "--engine", "block",
+               "--backend", "single", "--bf16-gram", "-q"])
+    assert rc == 0

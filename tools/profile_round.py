@@ -299,6 +299,223 @@ def ablate_shardlocal(x, y, cfg, q: int, reps: int, sync_rounds: int,
     return 0
 
 
+def ablate_ring(x, y, cfg, q: int, reps: int, sync_rounds: int,
+                dtype: str, obs_cfg=None):
+    """Ring-vs-all_gather whole-chunk A/B (ISSUE 11 — the measurement
+    solver/block.py ring_pays is waiting on): the global and shard-local
+    mesh runners each run with the collective exchange and with the
+    Pallas DMA ring (ops/ring.py), same salted starts, differenced over
+    two chunk lengths exactly like ablate_shardlocal. Trajectories are
+    bit-identical by construction (tests/test_ring.py), so the pairs
+    executed match and ms/round is the decisive number. On a CPU
+    harness the ring runs in interpret mode — the numbers are a
+    STRUCTURE check only (the interpreter emulates DMAs with gathers);
+    flip ring_pays only from a real-device run of this probe."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dpsvm_tpu.ops.kernels import (KernelParams, kernel_diag,
+                                       squared_norms)
+    from dpsvm_tpu.parallel.dist_block import (
+        make_block_chunk_runner, make_block_shardlocal_chunk_runner)
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh, pad_rows
+    from dpsvm_tpu.solver.block import BlockState
+    from dpsvm_tpu.solver.smo import _BUDGET_EPS
+
+    kp = KernelParams("rbf", cfg.resolve_gamma(x.shape[1]))
+    mesh = make_data_mesh()
+    p_dev = int(mesh.devices.size)
+    if p_dev < 2:
+        print("  ring A/B needs >= 2 devices (a one-device ring has no "
+              "hops); nothing to measure")
+        return 0
+    on_tpu = jax.default_backend() == "tpu"
+    impl = "pallas" if on_tpu else "xla"
+    n, d = x.shape
+    n_pad = pad_rows(n, p_dev)
+    x_p = np.zeros((n_pad, d), np.float32)
+    x_p[:n] = x
+    y_p = np.ones((n_pad,), np.float32)
+    y_p[:n] = y
+    valid = np.zeros((n_pad,), bool)
+    valid[:n] = True
+    shard = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    xd = jax.device_put(jnp.asarray(
+        x_p, jnp.bfloat16 if dtype == "bfloat16" else jnp.float32), shard)
+    yd = jax.device_put(jnp.asarray(y_p), shard)
+    x_sq = jax.jit(squared_norms, out_shardings=shard)(xd)
+    k_diag = jax.jit(kernel_diag, static_argnames="params",
+                     out_shardings=shard)(x_sq, params=kp)
+    vd = jax.device_put(jnp.asarray(valid), shard)
+    inner = 2 * q
+    base = BlockState(
+        alpha=jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard),
+        f=jax.device_put(jnp.asarray(-y_p, jnp.float32), shard),
+        b_hi=jax.device_put(jnp.float32(-1e9), rep),
+        b_lo=jax.device_put(jnp.float32(1e9), rep),
+        pairs=jax.device_put(jnp.int32(0), rep),
+        rounds=jax.device_put(jnp.int32(0), rep))
+
+    def make(kind, ring, rpc):
+        if kind == "global":
+            return make_block_chunk_runner(
+                mesh, kp, cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau),
+                q, inner, rpc, impl, interpret=not on_tpu,
+                ring_exchange=ring)
+        rpc = -(-rpc // sync_rounds) * sync_rounds
+        return make_block_shardlocal_chunk_runner(
+            mesh, kp, cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau),
+            q, inner, rpc, sync_rounds, impl, interpret=not on_tpu,
+            ring_exchange=ring)
+
+    print(f"  ring A/B: P={p_dev} devices, q={q}, inner={inner}, "
+          f"sync_rounds={sync_rounds}, reps={reps}"
+          + ("" if on_tpu else "  [interpret mode — structure only]"))
+    rows = []
+    for kind in ("global", "shardlocal"):
+        for ring in (False, True):
+            runs = {}
+            for rpc in (reps, 2 * reps):
+                runner = make(kind, ring, rpc)
+                jax.block_until_ready(runner(
+                    xd, yd, x_sq, k_diag, vd, base, jnp.int32(10 ** 9)))
+                best = None
+                for k in range(3):
+                    st = base._replace(f=salted(base.f, 7 * rpc + k))
+                    t0 = time.perf_counter()
+                    out = runner(xd, yd, x_sq, k_diag, vd, st,
+                                 jnp.int32(10 ** 9))
+                    jax.block_until_ready(out)
+                    t = time.perf_counter() - t0
+                    if best is None or t < best[0]:
+                        best = (t, int(out.rounds), int(out.pairs))
+                runs[rpc] = best
+            t = max(runs[2 * reps][0] - runs[reps][0], 0.0)
+            rounds = runs[2 * reps][1] - runs[reps][1]
+            pairs = runs[2 * reps][2] - runs[reps][2]
+            label = f"{kind}:{'ring' if ring else 'gather'}"
+            rows.append((label, t, rounds, pairs))
+            print(f"  {label:18s}: {rounds} rounds, {pairs} pairs, "
+                  f"{1e3 * t / max(rounds, 1):7.3f} ms/round "
+                  f"({pairs / max(t, 1e-9):,.0f} pairs/s)")
+    by = {lbl: (t, r, p) for lbl, t, r, p in rows}
+    for kind in ("global", "shardlocal"):
+        tg = by[f"{kind}:gather"][0]
+        tr = by[f"{kind}:ring"][0]
+        if tg > 0 and tr > 0:
+            print(f"  => {kind}: ring wall-clock = {tr / tg:.2f}x the "
+                  f"gather path's (flip solver/block.py ring_pays from "
+                  f"THIS number, measured on a real pod)")
+    if obs_cfg is not None:
+        from dpsvm_tpu.obs import obs_enabled
+        from dpsvm_tpu.obs.runlog import RunLog
+
+        if obs_enabled(obs_cfg):
+            with RunLog.open("profile_round", obs_config=obs_cfg,
+                             meta={"probe": "ring", "q": q,
+                                   "sync_rounds": sync_rounds,
+                                   "n_devices": p_dev, "dtype": dtype,
+                                   "interpret": not on_tpu}) as rl:
+                for label, t, rounds, pairs in rows:
+                    rl.record("ablation", variant=label,
+                              rounds=int(rounds), pairs=int(pairs),
+                              ms_per_round=round(
+                                  1e3 * t / max(rounds, 1), 4),
+                              device_seconds=round(t, 6))
+                rl.finish()
+    return 0
+
+
+def ablate_bf16_gram(x, y, cfg, q: int, reps: int, obs_cfg=None):
+    """bf16-vs-f32 Gram-path whole-chunk A/B (ISSUE 11): the single-chip
+    block chunk runner timed with X stored float32 vs bfloat16 — the
+    exact storage flip config.bf16_gram makes when the perturbation
+    bound accepts — plus the gate's own verdict on this data. The fold
+    and Gram passes read X, so the bf16 win is bounded by their share
+    of the round (PROFILE.md roofline); record the measured ratio next
+    to the gate decision."""
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import (KernelParams, kernel_diag,
+                                       resolve_bf16_gram, squared_norms)
+    # The UNDONATED runner: the probe legitimately re-dispatches a
+    # warmed state (the _jit_runner note in parallel/dist_block.py).
+    from dpsvm_tpu.solver.block import BlockState, run_chunk_block
+    from dpsvm_tpu.solver.smo import _BUDGET_EPS
+
+    n, d = x.shape
+    gamma = cfg.resolve_gamma(d)
+    kp = KernelParams("rbf", gamma)
+    active, risk, entry = resolve_bf16_gram(x, cfg.replace(bf16_gram=True),
+                                            gamma)
+    print(f"  bf16-gram gate on this data: active={active} "
+          f"risk={risk:.4g} (threshold {entry['threshold']})")
+    inner = 2 * q
+    rows = []
+    for dt_name, dt in (("float32", jnp.float32),
+                        ("bfloat16", jnp.bfloat16)):
+        xd = jnp.asarray(x, dt)
+        x_sq = jax.jit(squared_norms)(xd)
+        kd = jax.jit(kernel_diag, static_argnames="params")(x_sq,
+                                                            params=kp)
+        yd = jnp.asarray(y, jnp.float32)
+        vd = jnp.ones((n,), bool)
+        base = BlockState(
+            alpha=jnp.zeros((n,), jnp.float32), f=-yd,
+            b_hi=jnp.float32(-1e9), b_lo=jnp.float32(1e9),
+            pairs=jnp.int32(0), rounds=jnp.int32(0))
+        runs = {}
+        for rpc in (reps, 2 * reps):
+            kw = dict(kp=kp, c=cfg.c_bounds(), eps=_BUDGET_EPS,
+                      tau=float(cfg.tau), q=q, inner_iters=inner,
+                      rounds_per_chunk=rpc, inner_impl="xla")
+            jax.block_until_ready(run_chunk_block(
+                xd, yd, x_sq, kd, vd, base, jnp.int32(10 ** 9), **kw))
+            best = None
+            for k in range(3):
+                st = base._replace(f=salted(base.f, 11 * rpc + k))
+                t0 = time.perf_counter()
+                out = run_chunk_block(
+                    xd, yd, x_sq, kd, vd, st, jnp.int32(10 ** 9), **kw)
+                jax.block_until_ready(out)
+                t = time.perf_counter() - t0
+                if best is None or t < best[0]:
+                    best = (t, int(out.rounds), int(out.pairs))
+            runs[rpc] = best
+        t = max(runs[2 * reps][0] - runs[reps][0], 0.0)
+        rounds = runs[2 * reps][1] - runs[reps][1]
+        pairs = runs[2 * reps][2] - runs[reps][2]
+        rows.append((dt_name, t, rounds, pairs))
+        print(f"  x dtype {dt_name:9s}: {rounds} rounds, {pairs} pairs, "
+              f"{1e3 * t / max(rounds, 1):7.3f} ms/round "
+              f"({pairs / max(t, 1e-9):,.0f} pairs/s)")
+    tf, tb = rows[0][1], rows[1][1]
+    if tf > 0 and tb > 0:
+        print(f"  => bf16 Gram wall-clock = {tb / tf:.2f}x float32's "
+              f"(HBM-bound rounds should approach 0.5x on device; "
+              f"gate verdict above says whether THIS problem may use it)")
+    if obs_cfg is not None:
+        from dpsvm_tpu.obs import obs_enabled
+        from dpsvm_tpu.obs.runlog import RunLog
+
+        if obs_enabled(obs_cfg):
+            with RunLog.open("profile_round", obs_config=obs_cfg,
+                             meta={"probe": "bf16_gram", "q": q,
+                                   "gate_active": bool(active),
+                                   "gate_risk": round(risk, 6)}) as rl:
+                for dt_name, t, rounds, pairs in rows:
+                    rl.record("ablation", variant=dt_name,
+                              rounds=int(rounds), pairs=int(pairs),
+                              ms_per_round=round(
+                                  1e3 * t / max(rounds, 1), 4),
+                              device_seconds=round(t, 6))
+                rl.finish()
+    return 0
+
+
 # v5e per-chip ceilings (Google's published spec): the MXU runs bf16
 # (and default-precision f32, which lowers to one bf16 pass) matmuls at
 # 197 TFLOP/s; 'highest' f32 is ~6 bf16 passes. HBM streams 819 GB/s.
@@ -391,8 +608,20 @@ def main() -> int:
                          "subproblem chains per sync; the probe the "
                          "shardlocal_pays auto gate is waiting on)")
     ap.add_argument("--sync-rounds", type=int, default=4,
-                    help="--shardlocal: local rounds between syncs "
-                         "(default 4)")
+                    help="--shardlocal/--ring: local rounds between "
+                         "syncs (default 4)")
+    ap.add_argument("--ring", action="store_true",
+                    help="A/B the Pallas DMA-ring candidate exchange "
+                         "against the all_gather path on the global AND "
+                         "shard-local mesh runners over every visible "
+                         "device (ISSUE 11; the probe the ring_pays "
+                         "auto gate is waiting on — interpret-mode "
+                         "structure check on CPU)")
+    ap.add_argument("--bf16-gram", action="store_true",
+                    help="A/B the single-chip block chunk with X stored "
+                         "float32 vs bfloat16 (the config.bf16_gram "
+                         "storage flip) and print the perturbation "
+                         "gate's verdict for this data (ISSUE 11)")
     ap.add_argument("--roofline", action="store_true",
                     help="print the per-stage FLOPs/bytes roofline table "
                          "vs the v5e MXU/HBM ceilings and exit (no "
@@ -471,6 +700,17 @@ def main() -> int:
     n, d = x.shape
     if args.roofline:
         return roofline(n, d, q, args.dtype, fixed_ms=args.fixed_ms)
+    if args.ring or args.bf16_gram:
+        from dpsvm_tpu.config import ObsConfig
+
+        ocfg = ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir)
+        rc = 0
+        if args.ring:
+            rc |= ablate_ring(x, y, cfg, q, args.reps, args.sync_rounds,
+                              args.dtype, obs_cfg=ocfg)
+        if args.bf16_gram:
+            rc |= ablate_bf16_gram(x, y, cfg, q, args.reps, obs_cfg=ocfg)
+        return rc
     if args.shardlocal:
         return ablate_shardlocal(x, y, cfg, q, args.reps,
                                  args.sync_rounds, args.dtype)

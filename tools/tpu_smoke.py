@@ -277,6 +277,66 @@ def main() -> int:
           f"|b-b_ref|={db:.4f} "
           f"demoted={rsl.stats.get('shardlocal_demoted')} {status}")
 
+    # Ring-overlapped candidate exchange (ISSUE 11): the first real
+    # exercise of ops/ring.py's make_async_remote_copy path outside
+    # interpret mode — Mosaic lowering of the DMA ring + barrier, and
+    # the bit-identity claim (tests/test_ring.py pinned it in interpret
+    # mode; a real-ICI divergence would surface HERE first). Needs >= 2
+    # devices; single-chip sessions record the skip explicitly.
+    n_dev_all = len(jax.devices())
+    if n_dev_all >= 2:
+        ring_cfg = cfg.replace(engine="block", working_set_size=32,
+                               matmul_precision="default")
+        rg0 = solve_mesh(xf, yf, ring_cfg.replace(ring_exchange=False),
+                         num_devices=n_dev_all)
+        rg1 = solve_mesh(xf, yf, ring_cfg.replace(ring_exchange=True),
+                         num_devices=n_dev_all)
+        bitwise = bool(np.array_equal(rg0.alpha, rg1.alpha)
+                       and rg0.iterations == rg1.iterations)
+        db = abs(rg1.b - rf_ref.b)
+        ok = rg1.converged and bitwise and db < 5e-2
+        failures += not ok
+        record("mesh/ring_exchange", ok, pairs=int(rg1.iterations),
+               bitwise_vs_gather=bitwise, db=round(db, 5),
+               n_devices=n_dev_all)
+        print(f"ring exchange P={n_dev_all} pairs={rg1.iterations} "
+              f"bitwise={bitwise} |b-b_ref|={db:.4f} "
+              f"{'OK' if ok else 'FAIL'}")
+        rsr = solve_mesh(xf, yf,
+                         ring_cfg.replace(ring_exchange=True,
+                                          local_working_sets=2,
+                                          sync_rounds=2),
+                         num_devices=n_dev_all)
+        db = abs(rsr.b - rf_ref.b)
+        ok = rsr.converged and db < 5e-2
+        failures += not ok
+        record("mesh/ring_shardlocal", ok, pairs=int(rsr.iterations),
+               db=round(db, 5),
+               demoted=bool(rsr.stats.get("shardlocal_demoted")))
+        print(f"ring shard-local sync pairs={rsr.iterations} "
+              f"|b-b_ref|={db:.4f} {'OK' if ok else 'FAIL'}")
+    else:
+        record("mesh/ring_exchange", True, skipped=True,
+               reason="needs >= 2 devices")
+        print("ring exchange: SKIP (single-device session)")
+
+    # bf16 Gram gate (ISSUE 11): the perturbation bound's verdict on
+    # the smoke data plus one accept-path solve — bf16 X storage with
+    # f32 accumulation must legalize on real XLA:TPU and stay within
+    # the quality envelope the gate promises.
+    rbg = solve(xf, yf, cfg.replace(engine="block", working_set_size=32,
+                                    bf16_gram=True,
+                                    matmul_precision="default"))
+    bfg = rbg.stats["bf16_gram"]
+    db = abs(rbg.b - rf_ref.b)
+    ok = rbg.converged and (db < 5e-2 if bfg["active"] else db < 5e-3)
+    failures += not ok
+    record("bf16_gram", ok, active=bool(bfg["active"]),
+           risk=bfg["risk"], pairs=int(rbg.iterations), db=round(db, 5))
+    print(f"bf16 gram gate active={bfg['active']} risk={bfg['risk']} "
+          f"pairs={rbg.iterations} |b-b_ref|={db:.4f} "
+          f"{'OK' if ok else 'FAIL'}")
+
     # Fused per-pair Pallas engine.
     r_pl = solve(x, y, cfg.replace(engine="pallas"))
     db = abs(r_pl.b - r_ref.b)
