@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets bench bench_ooc_smoke bench_predict bench_serve bench_serve_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -25,7 +25,7 @@ test:
 # The ROADMAP.md tier-1 command VERBATIM (what the CI/driver gate runs):
 # same selection, same flags, same dot-count summary line.
 verify:
-	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1500 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 test_all:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
@@ -59,6 +59,15 @@ bench:
 # cache-hit counters (commit the output as BENCH_OOC_r<NN>.json).
 bench_ooc_smoke:
 	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) bench.py --ooc --obs
+
+# One-HBM-pass fused-round smoke (ISSUE 12): the --fused-round bench
+# leg on the CPU harness (interpret-mode kernels) — fused round vs the
+# stock fused engine at the same pinned budget, BITWISE-checked, gated
+# against the committed BENCH_FUSED_r*.json through the same drift-
+# normalized regression gate (tier1.yml runs this next to
+# bench_serve_smoke; the smoke output is not committed).
+bench_fused_smoke:
+	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) bench.py --fused-round --obs
 
 smoke:
 	$(PY) -m dpsvm_tpu.cli smoke

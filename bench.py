@@ -71,6 +71,12 @@ def _parse_args(argv=None):
                          "(solver/ooc.py): host-resident X, double-"
                          "buffered tile stream + block cache, gated "
                          "against BENCH_OOC_r*.json")
+    ap.add_argument("--fused-round", action="store_true",
+                    help="run the one-HBM-pass fused-round benchmark "
+                         "(ops/pallas_round.py, config.fused_round): "
+                         "the fused round vs the stock fused engine at "
+                         "the same budget, bitwise-checked, gated "
+                         "against BENCH_FUSED_r*.json")
     ap.add_argument("--obs", action="store_true",
                     help="enable the telemetry spine: the timed solves "
                          "write a schema-versioned run log whose per-"
@@ -523,6 +529,103 @@ def ooc_main(args=None) -> int:
     return 0
 
 
+def fused_main(args=None) -> int:
+    """One-HBM-pass fused-round benchmark (`python bench.py
+    --fused-round`, ISSUE 12): one budget-mode block solve through
+    config.fused_round=True (ops/pallas_round.py — gather/Gram/kernel
+    rows in one Pallas pass over X, fold+select in one pass over the
+    O(n) vectors) at a covtype-shaped operating point, reported as
+    fusedround_pairs_per_second and gated against the latest
+    BENCH_FUSED_r*.json with the same drift-normalized regression gate
+    as the headline. The stock fused engine (config.fused_fold=True)
+    runs the identical budget as the A/B column, and the artifact
+    embeds the BITWISE verdict between the two trajectories — the
+    fused round's correctness contract, checked on every bench run.
+    On the CPU harness the kernels run in interpret mode: the numbers
+    are a structure/regression anchor, not the TPU claim (flip
+    solver/block.py fused_round_pays only from a device run)."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.solver.smo import solve
+
+    calibration = _session_calibration()
+    print(f"[bench --fused-round] session calibration: "
+          f"{json.dumps(calibration)}", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    n, d = 16_384, 54
+    x = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
+    y = np.where(x[:, 0] + 0.2 * rng.standard_normal(n) > 0,
+                 1, -1).astype(np.int32)
+    budget = 50_000
+    cfg = SVMConfig(c=32.0, gamma=0.03125, epsilon=1e-3, engine="block",
+                    working_set_size=256, budget_mode=True,
+                    max_iter=budget, fused_round=True,
+                    obs=_obs_config(args))
+    stock_cfg = cfg.replace(fused_round=False, fused_fold=True)
+    solve(x, y, cfg.replace(max_iter=64))  # warm both executors
+    solve(x, y, stock_cfg.replace(max_iter=64))
+    runs = [solve(x, y, cfg) for _ in range(3)]
+    best = min(runs, key=lambda r: r.train_seconds)
+    if best.iterations < budget:
+        print(f"[bench --fused-round] ERROR: budget run executed "
+              f"{best.iterations} < {budget} pairs — budget contract "
+              "broken; no result emitted", file=sys.stderr)
+        return 1
+    stock = min([solve(x, y, stock_cfg) for _ in range(2)],
+                key=lambda r: r.train_seconds)
+    pps = best.iterations / max(best.train_seconds, 1e-9)
+    stock_pps = stock.iterations / max(stock.train_seconds, 1e-9)
+    # The correctness contract rides the benchmark: the fused round's
+    # trajectory is bitwise the stock fused engine's.
+    bitwise = bool(np.array_equal(best.alpha, stock.alpha)
+                   and best.iterations == stock.iterations)
+    result = {
+        "metric": (f"synthetic covtype-shaped {n}x{d} RBF one-HBM-pass "
+                   f"fused-round block solve (config.fused_round), "
+                   f"MEASURED at a {budget} pair-update budget, vs the "
+                   f"stock fused engine at the same budget"),
+        "value": round(best.train_seconds, 3),
+        "unit": "seconds",
+        "device": str(jax.devices()[0]),
+        "interpret_mode": jax.default_backend() != "tpu",
+        "pair_updates": int(best.iterations),
+        "fusedround_pairs_per_second": round(pps),
+        "fused_pairs_per_second": round(stock_pps),
+        "fused_seconds": round(stock.train_seconds, 3),
+        "bitwise_vs_fused_fold": bitwise,
+        "phase_seconds": best.stats.get("phase_seconds"),
+        "schema_version": _schema_version(),
+        "session_calibration": calibration,
+    }
+    if not bitwise:
+        # A bitwise break is a correctness regression, not a perf
+        # number — fail the leg loudly.
+        print("[bench --fused-round] ERROR: fused-round trajectory "
+              "diverged bitwise from the stock fused engine",
+              file=sys.stderr)
+        print(json.dumps(result))
+        return 1
+    result.update(_runlog_reconciliation(best, pps))
+    gate = _regression_gate(result,
+                            os.path.dirname(os.path.abspath(__file__)),
+                            pattern="BENCH_FUSED_r*.json",
+                            key="fusedround_pairs_per_second")
+    result.update(gate)
+    rl_note = (f"; runlog: {result['runlog']}"
+               if result.get("runlog") else "")
+    print(f"[bench --fused-round] {best.iterations} pairs in "
+          f"{best.train_seconds:.3f}s ({pps:.0f}/s) vs stock fused "
+          f"{stock.train_seconds:.3f}s ({stock_pps:.0f}/s), "
+          f"bitwise={bitwise}; gate: {gate.get('regression_gate')}"
+          f"{rl_note}", file=sys.stderr)
+    print(json.dumps(result))
+    return 0
+
+
 def main(args=None) -> int:
     import jax
 
@@ -749,4 +852,5 @@ def main(args=None) -> int:
 if __name__ == "__main__":
     _args = _parse_args()
     sys.exit(mesh_main(_args) if _args.mesh
-             else ooc_main(_args) if _args.ooc else main(_args))
+             else ooc_main(_args) if _args.ooc
+             else fused_main(_args) if _args.fused_round else main(_args))

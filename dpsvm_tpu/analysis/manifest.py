@@ -130,6 +130,141 @@ def block_chunk_single():
     ]
 
 
+def block_chunk_fusedround(extra_pass: bool = False):
+    """ONE-HBM-PASS fused round chunk (ISSUE 12, config.fused_round):
+    the round body as two Pallas passes — gather+Gram+kernel-rows over
+    X, fold+select over the O(n) vectors — with the subproblem dispatch
+    between them (solver/block.py run_chunk_block_fusedround_donated).
+
+    Dual fact views (the mesh_chunk_ring pattern): compiled facts come
+    from the INTERPRET lowering (the CPU-testable form), while the
+    ``device_form`` facts trace the interpret=False program and pin the
+    kernel/DMA structure — zero XLA collectives, zero host callbacks,
+    the donated carry (missed=0), and the dma_start count of the
+    in-kernel row gather. Memory facts are a pure function of the
+    canonical (N, D, Q) tile counts.
+
+    ``extra_pass=True`` builds the MUTATED form the drift test uses
+    (tests/test_tpulint.py, the ooc_fold_tile n-doubling discipline):
+    the same chunk plus one re-materialized XLA kernel-row pass over X
+    folded into f — exactly the extra HBM pass the one-pass contract
+    forbids; its facts must DRIFT against the committed budget (the
+    dot count and temp bytes move)."""
+    import jax
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.solver.block import (
+        run_chunk_block_fusedround_donated)
+
+    kw = dict(kp=_kp(), c=C_BOUNDS, eps=EPS, tau=TAU, q=Q,
+              inner_iters=INNER, rounds_per_chunk=ROUNDS_PER_CHUNK,
+              inner_impl="xla")
+    args = _chunk_args(N)
+
+    if extra_pass:
+        from dpsvm_tpu.ops.kernels import kernel_rows
+
+        def mutated(x, y, x_sq, k_diag, valid, state, mi, *,
+                    interpret):
+            st = run_chunk_block_fusedround_donated(
+                x, y, x_sq, k_diag, valid, state, mi,
+                interpret=interpret, **kw)
+            # The deliberate extra pass: re-gather Q rows and stream X
+            # through kernel_rows again (coefs from live state so XLA
+            # cannot fold it away).
+            qx = x[:Q]
+            extra = kernel_rows(x, x_sq, qx, x_sq[:Q], _kp())
+            return st._replace(f=st.f + st.alpha[:Q] @ extra)
+
+        # Same donation declaration as the clean entry so the drift
+        # isolates the extra pass, not a donation diff.
+        m_i = jax.jit(mutated, donate_argnums=(5,),
+                      static_argnames=("interpret",))
+        return [
+            Unit("chunk",
+                 lambda: m_i.lower(*args, interpret=True),
+                 _jaxpr_of(m_i, *args, interpret=True),
+                 device_jaxpr=_jaxpr_of(m_i, *args, interpret=False)),
+            _obs_unit(),
+        ]
+
+    return [
+        Unit("chunk",
+             lambda: run_chunk_block_fusedround_donated.lower(
+                 *args, interpret=True, **kw),
+             _jaxpr_of(run_chunk_block_fusedround_donated, *args,
+                       interpret=True, **kw),
+             device_jaxpr=_jaxpr_of(run_chunk_block_fusedround_donated,
+                                    *args, interpret=False, **kw)),
+        _obs_unit(),
+    ]
+
+
+def block_chunk_fused():
+    """Fused fold+select chunk (the stock fused engine,
+    config.fused_fold) via its DONATED runner — the ISSUE 12 donation
+    satellite's budget: the single-chip fused variant now dispatches a
+    donated carry like every other budgeted solver entry
+    (donation.missed pinned 0). Same dual interpret/device_form views
+    as block_chunk_fusedround (the fold_select pass is a Pallas
+    kernel)."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.solver.block import run_chunk_block_fused_donated
+
+    kw = dict(kp=_kp(), c=C_BOUNDS, eps=EPS, tau=TAU, q=Q,
+              inner_iters=INNER, rounds_per_chunk=ROUNDS_PER_CHUNK,
+              inner_impl="xla")
+    args = _chunk_args(N)
+    return [
+        Unit("chunk",
+             lambda: run_chunk_block_fused_donated.lower(
+                 *args, interpret=True, **kw),
+             _jaxpr_of(run_chunk_block_fused_donated, *args,
+                       interpret=True, **kw),
+             device_jaxpr=_jaxpr_of(run_chunk_block_fused_donated,
+                                    *args, interpret=False, **kw)),
+        _obs_unit(),
+    ]
+
+
+def block_chunk_pipelined():
+    """Single-chip PIPELINED chunk via its DONATED runner (ISSUE 12
+    donation satellite — the mesh pipelined runner was budgeted since
+    PR 5, the single-chip variant was not). pallas_select=False is the
+    CPU-harness form (pure XLA), so one compiled view suffices."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.solver.block import run_chunk_block_pipelined_donated
+
+    kw = dict(kp=_kp(), c=C_BOUNDS, eps=EPS, tau=TAU, q=Q,
+              inner_iters=INNER, rounds_per_chunk=ROUNDS_PER_CHUNK,
+              inner_impl="xla")
+    args = _chunk_args(N)
+    return [
+        Unit("chunk",
+             lambda: run_chunk_block_pipelined_donated.lower(*args, **kw),
+             _jaxpr_of(run_chunk_block_pipelined_donated, *args, **kw)),
+        _obs_unit(),
+    ]
+
+
+def block_chunk_active():
+    """Active-set (shrinking) chunk via its DONATED runner (ISSUE 12
+    donation satellite). Pure XLA."""
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.solver.block import run_chunk_block_active_donated
+
+    kw = dict(kp=_kp(), c=C_BOUNDS, eps=EPS, tau=TAU, q=Q,
+              inner_iters=INNER, rounds_per_chunk=ROUNDS_PER_CHUNK,
+              m=2 * Q, k_rounds=2, inner_impl="xla")
+    args = _chunk_args(N)
+    return [
+        Unit("chunk",
+             lambda: run_chunk_block_active_donated.lower(*args, **kw),
+             _jaxpr_of(run_chunk_block_active_donated, *args, **kw)),
+        _obs_unit(),
+    ]
+
+
 def fleet_chunk():
     """Batched multi-problem SMO chunk (solver/fleet.py): the whole
     OvO/OvR fleet advances in ONE dispatch per chunk."""
@@ -424,6 +559,10 @@ def mesh_predict():
 
 MANIFEST = {
     "block_chunk_single": block_chunk_single,
+    "block_chunk_fusedround": block_chunk_fusedround,
+    "block_chunk_fused": block_chunk_fused,
+    "block_chunk_pipelined": block_chunk_pipelined,
+    "block_chunk_active": block_chunk_active,
     "fleet_chunk": fleet_chunk,
     "mesh_chunk": mesh_chunk,
     "pipelined_chunk": pipelined_chunk,

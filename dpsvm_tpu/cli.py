@@ -94,6 +94,17 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "dispatch sequence (solver/fleet.py; power of "
                         "two, 1 = sequential solves; applies to the "
                         "OvR/OvO reduction on a single chip)")
+    p.add_argument("--fused-round", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="block engine: ONE-HBM-pass round body — the "
+                        "working-set gather, (q,n) kernel rows and "
+                        "(q,q) Gram block ride one Pallas streaming "
+                        "pass over X, and the fold contraction + next-"
+                        "round selection one pass over f "
+                        "(SVMConfig.fused_round; ops/pallas_round.py). "
+                        "Bit-identical trajectories to the fused-fold "
+                        "engine. auto = the measured gate (solver/"
+                        "block.py fused_round_pays, currently off)")
     p.add_argument("--pipeline-rounds", choices=["auto", "on", "off"],
                    default="auto",
                    help="block engine: software-pipeline the rounds — "
@@ -521,6 +532,8 @@ def _cmd_train(args) -> int:
             inner_iters=args.inner_iters,
             pair_batch=args.pair_batch,
             fleet_size=args.fleet_size,
+            fused_round={"auto": None, "on": True,
+                         "off": False}[args.fused_round],
             pipeline_rounds={"auto": None, "on": True,
                              "off": False}[args.pipeline_rounds],
             local_working_sets=(None if args.local_working_sets == 0

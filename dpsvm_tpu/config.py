@@ -189,6 +189,33 @@ class SVMConfig:
     # feature kernels; nu / active-set / precomputed use the plain path.
     fused_fold: Optional[bool] = None
 
+    # ONE-HBM-PASS fused round for the single-chip block engine
+    # (ops/pallas_round.py + solver/block.py run_chunk_block_fusedround;
+    # ISSUE 12 / ROADMAP item 1's single-chip leg). Extends fused_fold's
+    # fusion to the WHOLE round body: the working-set row gather runs as
+    # in-kernel dynamic-slice DMAs inside the kernel-row pass (one
+    # streaming pass over X builds the (q, n) kernel rows with the
+    # (q, q) Gram block riding grid step 0 — no qx round-trip, no
+    # separate dots buffer, no standalone Gram launch), and the fold
+    # contraction coef @ K(W, :) runs in-register inside the fold+select
+    # pass — so select -> gather -> Gram -> fold touches X and the O(n)
+    # vectors exactly once per round instead of three-plus times.
+    # Trajectories are BITWISE identical to the fused-fold engine
+    # (tests/test_fused_round.py pins it; interpret-mode kernels on the
+    # CPU harness).
+    #   None  -- auto: solver/block.py fused_round_pays — currently OFF
+    #            everywhere pending the device-session measurement (the
+    #            pipeline_rounds / ring_pays discipline);
+    #   True  -- force on (CPU tests/A-B probes run interpret mode);
+    #   False -- force off.
+    # Single-chip block-engine knob; same applicability contract as
+    # fused_fold (selection in {mvp, second_order}, feature kernels,
+    # q/2 <= n_pad/128 — contract misses fall back to the plain path);
+    # supersedes fused_fold when both would engage; the mesh runners
+    # keep their own per-shard fused fold+select machinery and ignore
+    # it. Composition limits validated below.
+    fused_round: Optional[bool] = None
+
     # Pipelined block rounds (solver/block.py run_chunk_block_pipelined,
     # parallel/dist_block.py pipelined runner; no reference equivalent —
     # the reference's host-driven loop cannot overlap anything): the
@@ -490,6 +517,45 @@ class SVMConfig:
                 "pipeline_rounds supports selection in {'mvp', "
                 "'second_order'} (the nu rule's per-class quarters keep "
                 "the plain round; same restriction as fused_fold)")
+        if self.fused_round:
+            if self.engine != "block":
+                raise ValueError(
+                    "fused_round is a block-engine knob (the per-pair "
+                    "engines have no round body to fuse; the fused "
+                    "pallas per-pair engine already fuses per pair); "
+                    "use engine='block'")
+            if self.kernel == "precomputed":
+                raise ValueError(
+                    "fused_round supports feature kernels only (its "
+                    "one-pass kernel evaluates kernel rows from "
+                    "streamed features; a precomputed Gram's rows are "
+                    "gathers, not matmuls)")
+            if self.gram_resident:
+                raise ValueError(
+                    "fused_round does not compose with "
+                    "gram_resident=True (the resident Gram routes the "
+                    "solve through the precomputed-kernel branches — "
+                    "same constraint as kernel='precomputed')")
+            if self.pipeline_rounds:
+                raise ValueError(
+                    "fused_round does not compose with "
+                    "pipeline_rounds=True (the pipelined engine "
+                    "prefetches the next selection off the critical "
+                    "path; the fused round folds it into the fold "
+                    "pass — the two solve the same floor differently) "
+                    "— use one or the other")
+            if self.active_set_size:
+                raise ValueError(
+                    "fused_round does not compose with active_set_size "
+                    "(the active cycle's restricted rounds defer their "
+                    "folds; the fused round's one-pass contract needs "
+                    "the full-n fold in-kernel) — use one or the other")
+            if self.ooc:
+                raise ValueError(
+                    "fused_round does not compose with ooc (the ooc "
+                    "fold streams host tiles; the fused round's single "
+                    "pass assumes X is HBM-resident) — use one or the "
+                    "other")
         if self.local_working_sets is not None and self.local_working_sets < 1:
             raise ValueError(
                 "local_working_sets must be None (auto), 1 (global "

@@ -78,6 +78,8 @@ def _warn_nu_fallbacks(config: SVMConfig, trainer: str) -> None:
         dropped.append("pipeline_rounds (plain serial rounds)")
     if config.fused_fold:
         dropped.append("fused_fold (plain fold + select)")
+    if config.fused_round:
+        dropped.append("fused_round (plain round body)")
     if config.local_working_sets is not None \
             and config.local_working_sets >= 2:
         dropped.append("local_working_sets (global working set)")

@@ -43,6 +43,67 @@ _BIG = float("inf")
 _IMAX = 2 ** 31 - 1
 
 
+def fold_delta(f, err, delta):
+    """The block fold's per-tile step, shared by _fold_select_kernel and
+    the one-pass round kernel (ops/pallas_round.py): plain add when
+    ``err`` is None, else the canonical Kahan step (solver/smo.py
+    kahan_add — the same function every other engine's fold uses).
+    Returns (f_new, err_new_or_None, f_sel) where f_sel is the effective
+    gradient the selection masks must see (true ~= f - err)."""
+    if err is not None:
+        from dpsvm_tpu.solver.smo import kahan_add
+
+        f_new, err_new = kahan_add(f, err, delta)
+        return f_new, err_new, f_new - err_new
+    f_new = f + delta
+    return f_new, None, f_new
+
+
+def emit_row_candidates(f_sel, alpha, y, valid_f, c, rows: int, base,
+                        upv_ref, upi_ref, lov_ref, loi_ref):
+    """Mask building + per-128-row candidate emission, shared by
+    _fold_select_kernel and the one-pass round kernel
+    (ops/pallas_round.py) so the selection semantics live once.
+
+    Set membership is the up_mask/low_mask algebra of ops/select.py,
+    re-expressed as pure i1 logic: those helpers build on jnp.where
+    over booleans, which Mosaic materializes at i8 and cannot truncate
+    back to i1 (same constraint, ops/pallas_fused.py) — keep the two
+    in sync. ``base`` is the flat id of this (rows, 128) block's first
+    element (caller passes pl.program_id(0) * rows * LANES)."""
+    valid = valid_f > 0.0  # float mask: see ops/pallas_fused.py
+    cp, cn = split_c(c)
+    pos = y > 0
+    neg = ~pos
+    if cp == cn:
+        lt_cp = lt_cn = alpha < cp
+    else:
+        lt_cp = alpha < cp
+        lt_cn = alpha < cn
+    gt_0 = alpha > 0
+    up = ((pos & lt_cp) | (neg & gt_0)) & valid
+    low = ((pos & gt_0) | (neg & lt_cn)) & valid
+
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
+    flat_ids = base + row_ids * LANES + col_ids
+
+    f_up = jnp.where(up, f_sel, _BIG)
+    f_low = jnp.where(low, f_sel, -_BIG)
+    # Per-ROW extremum + lowest-flat-id argext (SURVEY 7.3 item 4
+    # tie-break), keepdims so the lane reduction stays 2D for Mosaic.
+    upv = jnp.min(f_up, axis=1, keepdims=True)  # (rows, 1)
+    upi = jnp.min(jnp.where(f_up == upv, flat_ids, _IMAX),
+                  axis=1, keepdims=True)
+    lov = jnp.max(f_low, axis=1, keepdims=True)
+    loi = jnp.min(jnp.where(f_low == lov, flat_ids, _IMAX),
+                  axis=1, keepdims=True)
+    upv_ref[:] = upv
+    upi_ref[:] = upi
+    lov_ref[:] = lov
+    loi_ref[:] = loi
+
+
 def _fold_select_kernel(*refs, c, rows_per_block: int, compensated: bool,
                         fold: bool = True):
     """One grid step: fold a (rows, 128) block of delta into f and emit
@@ -64,61 +125,16 @@ def _fold_select_kernel(*refs, c, rows_per_block: int, compensated: bool,
          f_out_ref, upv_ref, upi_ref, lov_ref, loi_ref) = refs
 
     if fold:
-        delta = delta_ref[:]
-        f = f_ref[:]
+        f_new, err_new, f_sel = fold_delta(
+            f_ref[:], err_ref[:] if compensated else None, delta_ref[:])
         if compensated:
-            # The canonical Kahan step (true ~= f - err), shared with
-            # every other engine's fold.
-            from dpsvm_tpu.solver.smo import kahan_add
-
-            f_new, err_new = kahan_add(f, err_ref[:], delta)
             err_out_ref[:] = err_new
-            f_sel = f_new - err_new
-        else:
-            f_new = f + delta
-            f_sel = f_new
         f_out_ref[:] = f_new
 
-    # Set membership is the up_mask/low_mask algebra of ops/select.py,
-    # re-expressed as pure i1 logic: those helpers build on jnp.where
-    # over booleans, which Mosaic materializes at i8 and cannot truncate
-    # back to i1 (same constraint, ops/pallas_fused.py) — keep the two
-    # in sync.
-    alpha = alpha_ref[:]
-    y = y_ref[:]
-    valid = valid_ref[:] > 0.0  # float mask: see ops/pallas_fused.py
-    cp, cn = split_c(c)
-    pos = y > 0
-    neg = ~pos
-    if cp == cn:
-        lt_cp = lt_cn = alpha < cp
-    else:
-        lt_cp = alpha < cp
-        lt_cn = alpha < cn
-    gt_0 = alpha > 0
-    up = ((pos & lt_cp) | (neg & gt_0)) & valid
-    low = ((pos & gt_0) | (neg & lt_cn)) & valid
-
     rows = rows_per_block
-    col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
-    row_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0)
     base = pl.program_id(0) * (rows * LANES)
-    flat_ids = base + row_ids * LANES + col_ids
-
-    f_up = jnp.where(up, f_sel, _BIG)
-    f_low = jnp.where(low, f_sel, -_BIG)
-    # Per-ROW extremum + lowest-flat-id argext (SURVEY 7.3 item 4
-    # tie-break), keepdims so the lane reduction stays 2D for Mosaic.
-    upv = jnp.min(f_up, axis=1, keepdims=True)  # (rows, 1)
-    upi = jnp.min(jnp.where(f_up == upv, flat_ids, _IMAX),
-                  axis=1, keepdims=True)
-    lov = jnp.max(f_low, axis=1, keepdims=True)
-    loi = jnp.min(jnp.where(f_low == lov, flat_ids, _IMAX),
-                  axis=1, keepdims=True)
-    upv_ref[:] = upv
-    upi_ref[:] = upi
-    lov_ref[:] = lov
-    loi_ref[:] = loi
+    emit_row_candidates(f_sel, alpha_ref[:], y_ref[:], valid_ref[:], c,
+                        rows, base, upv_ref, upi_ref, lov_ref, loi_ref)
 
 
 @functools.partial(jax.jit,

@@ -1,0 +1,348 @@
+"""One-HBM-pass block round: Pallas-fused gather -> Gram -> fold -> select
+(ISSUE 12 / ROADMAP item 1, the single-chip leg).
+
+The block engine's round body was stock XLA ops stitched between Pallas
+kernels: working-set rows gathered by an XLA gather, the (q, n) kernel
+rows built by a separate matmul pass over X (materializing the dots AND
+the exp'd rows), the fold contraction reading them back, and only the
+fold+select tail fused (ops/pallas_fold_select.py). At block-engine
+scale the round is HBM-bound on X (ThunderSVM's regime — Catanzaro et
+al. fused kernel-row evaluation with the reduction consuming it for the
+same reason, PAPERS.md), so every eliminated pass over X and the O(n)
+vectors is direct wall-clock. This module makes the round exactly TWO
+Pallas passes with the subproblem dispatch between them:
+
+``gather_gram``
+    ONE streaming pass over X on a 1-D tile grid. At grid entry the
+    working-set rows are gathered into an on-core (q, d) scratch by q
+    in-kernel dynamic-slice DMAs from the HBM-resident X (the rows'
+    dots feed every tile, so the gather must complete before the first
+    tile's matmul — a per-tile copy-out-of-the-streamed-tile
+    formulation cannot work: tile t's (q, tile) dot slice needs the
+    complete (q, d) block, not the rows that happen to live in tile t).
+    Each tile step then runs the (q, d) x (d, tile) dot on the MXU (f32
+    accumulation) and rebuilds kernel values in-register with the
+    shared ``kernel_from_dots`` algebra — the (q, n) kernel rows reach
+    HBM exactly once, with no separate dots buffer, no qx round-trip
+    and no standalone Gram launch (the (q, q) block K(W, W) rides grid
+    step 0 from the same scratch).
+
+``fold_rows_select``
+    ONE pass over the (q, n) kernel rows and the O(n) vectors: per
+    (q, tile) block the fold coefficients contract to the tile's delta
+    in-register (never materialized), the fold applies it (Kahan when
+    compensated) and the next round's per-128-row working-set
+    candidates are emitted — the ops/pallas_fold_select.py kernel with
+    the delta input replaced by its own in-kernel contraction; the
+    mask/candidate code is literally shared (emit_row_candidates /
+    fold_delta).
+
+So select -> gather -> Gram -> fold touches X exactly once per round
+and f/alpha/y/valid exactly once, instead of the stock fused engine's
+gather + dots + exp + contraction + fold stages each taking their own
+trip through HBM. The q-sized per-slot scalars (alpha_W, f_W, y_W,
+norms, diag) stay tiny XLA gathers — O(q) reads, not passes.
+
+Correctness contract (the established pattern of the four existing
+Pallas kernels): ``interpret=True`` runs on the CPU harness and the
+trajectory is BITWISE identical to the stock fused engine
+(solver/block.py run_chunk_block_fused): the DMA row gather moves the
+identical bits ``jnp.take`` would; the per-tile dots split only the
+OUTPUT dim of the (q, d) x (d, n) matmul (the ops/ooc.py /
+ops/ring.py precedent — per-element results are unchanged);
+``kernel_from_dots`` is the same function; the in-kernel delta
+contraction splits only the output dim of coef @ K(W, :); and the
+fold/selection algebra is shared code. tests/test_fused_round.py pins
+full-solve bitwise equality across {mvp, second_order} x {compensated,
+plain} including padded tails; the tpulint ``block_chunk_fusedround``
+budget pins the device-form structure (zero collectives, zero host
+callbacks, donated carry) with the ring kernels' dual
+interpret-compile + device_form pattern.
+
+Padding contract (shared with the fused fold+select engine):
+n_pad % 1024 == 0 with ``valid`` marking real rows (solver/smo.py
+pads), q/2 <= n_pad/128, selection in {"mvp", "second_order"},
+feature kernels only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dpsvm_tpu.ops.pallas_fold_select import (LANES, emit_row_candidates,
+                                              fold_delta)
+
+#: rows of X per streamed tile — 8 x 128 lanes, the fold/select grid's
+#: block, so both kernels share one n_pad % 1024 == 0 padding contract.
+TILE_ROWS = 1024
+#: f/alpha/y/valid rows per fold/select grid block ((8, 128) f32 vregs —
+#: must match ops/pallas_fold_select.py's default so candidate flat ids
+#: are identical).
+FOLD_ROWS = 8
+#: in-flight row DMAs of the grid-entry gather (the guide's double-
+#: buffer pattern, widened): copy s+GATHER_BUF starts before copy s is
+#: waited on, so the q single-row transfers pipeline through the DMA
+#: engine instead of serializing q start->wait round-trips.
+GATHER_BUF = 8
+
+
+def _gather_gram_kernel(w_ref, x_any, x_blk, xsq_blk, qsq_blk,
+                        krows_ref, kb_ref, qx, sem, *, q: int, kp):
+    """One (TILE_ROWS, d) tile step of the single X pass.
+
+    Refs:
+      w_ref    (q,) int32 SMEM      — working-set ids (scalar prefetch)
+      x_any    (n_pad, d) ANY       — X in HBM, source of the row gather
+      x_blk    (TILE_ROWS, d) VMEM  — tile t of X (auto-pipelined)
+      xsq_blk  (1, TILE_ROWS) VMEM  — squared norms of tile t
+      qsq_blk  (1, q) VMEM          — working-set squared norms
+      krows_ref (q, TILE_ROWS) VMEM — tile t's kernel-row slice (out)
+      kb_ref   (q, q) VMEM          — K(W, W), written at step 0 (out)
+      qx       (q, d) VMEM scratch  — gathered rows (persists across
+                                      grid steps)
+    """
+    from dpsvm_tpu.ops.kernels import kernel_from_dots
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _gather():
+        # q in-kernel dynamic-slice row DMAs from the HBM-resident X —
+        # O(q d) traffic once per round, completing before any tile's
+        # dot consumes the block (see module docstring). Bitwise the
+        # same rows jnp.take would move (disjoint destination slots).
+        # GATHER_BUF copies stay in flight (reconstructed descriptors,
+        # per-slot semaphores — the double-buffer pattern) so the q
+        # transfers pipeline instead of paying q serial round-trips.
+        def cp(s, slot):
+            return pltpu.make_async_copy(
+                x_any.at[pl.ds(w_ref[s], 1), :],
+                qx.at[pl.ds(s, 1), :], sem.at[slot])
+
+        def warm(s, carry):
+            cp(s, s % GATHER_BUF).start()
+            return carry
+
+        lax.fori_loop(0, min(GATHER_BUF, q), warm, 0)
+
+        def hop(s, carry):
+            # Wait slot s FIRST, then refill it with copy s+GATHER_BUF:
+            # each slot's semaphore tracks exactly one in-flight copy.
+            cp(s, s % GATHER_BUF).wait()
+
+            @pl.when(s + GATHER_BUF < q)
+            def _refill():
+                cp(s + GATHER_BUF, s % GATHER_BUF).start()
+
+            return carry
+
+        lax.fori_loop(0, q, hop, 0)
+
+    qv = qx[...]  # (q, d), x storage dtype
+    dots = jnp.dot(qv, x_blk[...].T, preferred_element_type=jnp.float32)
+    krows_ref[...] = kernel_from_dots(dots, xsq_blk[0], qsq_blk[0], kp)
+
+    @pl.when(i == 0)
+    def _gram():
+        dots_w = jnp.dot(qv, qv.T, preferred_element_type=jnp.float32)
+        kb_ref[...] = kernel_from_dots(dots_w, qsq_blk[0], qsq_blk[0], kp)
+
+
+@functools.partial(jax.jit, static_argnames=("kp", "interpret"))
+def gather_gram(x, w, x_sq, qsq, kp, interpret: bool = False):
+    """The round's single pass over X: gather the working-set rows
+    in-kernel and emit the (q, n_pad) kernel rows K(W, :) plus the
+    (q, q) Gram block K(W, W) in one pallas_call.
+
+    x (n_pad, d) any float dtype, n_pad % TILE_ROWS == 0; w (q,) int32
+    ids (< n_pad — dead slots carry in-range filler, exactly what the
+    stock gather reads); x_sq (n_pad,) / qsq (q,) float32 squared
+    norms. Returns (k_rows f32 (q, n_pad), kb f32 (q, q)) — bitwise
+    what ``kernel_rows(x, x_sq, take(x, w), qsq, kp)`` and the stock
+    Gram-block matmul produce (output-dim tiling only)."""
+    n_pad, d = x.shape
+    q = w.shape[0]
+    assert n_pad % TILE_ROWS == 0, (n_pad, TILE_ROWS)
+    ntiles = n_pad // TILE_ROWS
+    kern = functools.partial(_gather_gram_kernel, q=q, kp=kp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ntiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((TILE_ROWS, d), lambda i, w: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE_ROWS), lambda i, w: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, q), lambda i, w: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((q, TILE_ROWS), lambda i, w: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q, q), lambda i, w: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[pltpu.VMEM((q, d), x.dtype),
+                        pltpu.SemaphoreType.DMA((GATHER_BUF,))],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((q, n_pad), jnp.float32),
+                   jax.ShapeDtypeStruct((q, q), jnp.float32)],
+        interpret=interpret,
+    )(w, x, x, x_sq.reshape(1, n_pad), qsq.reshape(1, q))
+
+
+def _fold_rows_select_kernel(*refs, c, rows_per_block: int,
+                             compensated: bool):
+    """One (q, TILE_ROWS) block of the fold+select pass: contract the
+    fold coefficients against the kernel-row slice in-register, fold
+    the resulting delta (Kahan when compensated) and emit the per-row
+    candidates — ops/pallas_fold_select.py's kernel with the delta
+    input replaced by its own contraction."""
+    if compensated:
+        (kr_ref, coef_ref, f_ref, err_ref, alpha_ref, y_ref, valid_ref,
+         f_out_ref, err_out_ref, upv_ref, upi_ref, lov_ref, loi_ref) = refs
+    else:
+        (kr_ref, coef_ref, f_ref, alpha_ref, y_ref, valid_ref,
+         f_out_ref, upv_ref, upi_ref, lov_ref, loi_ref) = refs
+    # The tile's fold delta: (q,) @ (q, TILE_ROWS) — the output-dim
+    # slice of the stock engine's coef @ K(W, :) contraction, never
+    # written to HBM.
+    delta = (coef_ref[0] @ kr_ref[...]).reshape(rows_per_block, LANES)
+    f_new, err_new, f_sel = fold_delta(
+        f_ref[:], err_ref[:] if compensated else None, delta)
+    if compensated:
+        err_out_ref[:] = err_new
+    f_out_ref[:] = f_new
+    base = pl.program_id(0) * (rows_per_block * LANES)
+    emit_row_candidates(f_sel, alpha_ref[:], y_ref[:], valid_ref[:], c,
+                        rows_per_block, base,
+                        upv_ref, upi_ref, lov_ref, loi_ref)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("c", "compensated", "interpret"))
+def fold_rows_select(k_rows, coef, f2d, err2d, alpha2d, y2d, valid2d, c,
+                     compensated: bool = False, interpret: bool = False):
+    """The round's single pass over the O(n) vectors: fold
+    coef @ K(W, :) into f (optionally Kahan-compensated) and emit the
+    next round's per-row working-set candidates.
+
+    k_rows (q, n_pad) f32 from gather_gram; coef (q,) f32 fold
+    coefficients (dead slots zeroed); the 2D arrays are the
+    (n_pad/128, 128) float32 views fold_select uses. Returns
+    (f_new2d, err_new2d_or_None, up_vals, up_ids, low_vals, low_ids) —
+    exactly fold_select's contract, with delta2d computed in-kernel."""
+    rows = f2d.shape[0]
+    n_pad = k_rows.shape[1]
+    q = k_rows.shape[0]
+    assert rows % FOLD_ROWS == 0 and rows * LANES == n_pad, (rows, n_pad)
+    nblocks = rows // FOLD_ROWS
+
+    block = pl.BlockSpec((FOLD_ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    cand = pl.BlockSpec((FOLD_ROWS, 1), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    kr = pl.BlockSpec((q, TILE_ROWS), lambda i: (0, i),
+                      memory_space=pltpu.VMEM)
+    cf = pl.BlockSpec((1, q), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    kern = functools.partial(_fold_rows_select_kernel, c=c,
+                             rows_per_block=FOLD_ROWS,
+                             compensated=compensated)
+    full = jax.ShapeDtypeStruct((rows, LANES), jnp.float32)
+    cval = jax.ShapeDtypeStruct((rows, 1), jnp.float32)
+    cidx = jax.ShapeDtypeStruct((rows, 1), jnp.int32)
+
+    if compensated:
+        ins = (k_rows, coef.reshape(1, q), f2d, err2d, alpha2d, y2d,
+               valid2d)
+        in_specs = [kr, cf, block, block, block, block, block]
+        out_specs = [block, block, cand, cand, cand, cand]
+        out_shape = [full, full, cval, cidx, cval, cidx]
+    else:
+        ins = (k_rows, coef.reshape(1, q), f2d, alpha2d, y2d, valid2d)
+        in_specs = [kr, cf, block, block, block, block]
+        out_specs = [block, cand, cand, cand, cand]
+        out_shape = [full, cval, cidx, cval, cidx]
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(nblocks,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*ins)
+    if compensated:
+        f_new, err_new, upv, upi, lov, loi = outs
+    else:
+        f_new, upv, upi, lov, loi = outs
+        err_new = None
+    return (f_new, err_new, upv[:, 0], upi[:, 0], lov[:, 0], loi[:, 0])
+
+
+def fused_round(x, y, x_sq, k_diag, y2d, valid2d, alpha, f, f_err,
+                w, slot_ok, b_hi, b_lo, budget_left, kp, c, eps: float,
+                tau: float, q: int, inner_iters: int, inner_impl: str,
+                interpret: bool, selection: str, pair_batch: int = 1):
+    """The thin composition layer: ONE complete block round as
+    gather_gram -> dispatch_subproblem -> scatter -> fold_rows_select,
+    stage-for-stage the body of solver/block.py run_chunk_block_fused
+    with the XLA gather/Gram/kernel-rows/delta stages replaced by the
+    two one-pass kernels (each replacement bitwise-exact — see module
+    docstring), so the trajectories are pinned bitwise equal.
+
+    `(w, slot_ok, b_hi, b_lo)` is the carried candidate set selected by
+    the PREVIOUS round's fold pass (exact post-fold extrema — the fused
+    engine's carry contract). Returns (alpha, f, f_err, b_hi_n, b_lo_n,
+    w_n, ok_n, t): the updated row state, the next round's candidates
+    and the executed pair count."""
+    from dpsvm_tpu.ops.pallas_fold_select import assemble_working_set
+    from dpsvm_tpu.solver.block import dispatch_subproblem
+
+    n_pad = y.shape[0]
+    shp = (n_pad // LANES, LANES)
+    compensated = f_err is not None
+    f_cur = f if f_err is None else f - f_err  # eff_f on loose fields
+    gap_open = b_lo > b_hi + 2.0 * eps
+    with jax.named_scope("fusedround_gather_gram"):
+        qsq = jnp.take(x_sq, w)
+        kd_w = jnp.take(k_diag, w)
+        a_w0 = jnp.take(alpha, w)
+        y_w = jnp.take(y, w)
+        f_w0 = jnp.take(f_cur, w)
+        k_rows, kb_w = gather_gram(x, w, x_sq, qsq, kp,
+                                   interpret=interpret)
+    # Per-round pair budget: clamped to the caller's remaining budget
+    # and gated to 0 on the terminal round (same as _round_core).
+    limit = jnp.minimum(jnp.int32(inner_iters), budget_left)
+    limit = jnp.where(gap_open, limit, 0)
+    with jax.named_scope("fusedround_subproblem"):
+        a_w, coef, t = dispatch_subproblem(
+            kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau, limit,
+            inner_impl, interpret, selection, pair_batch=pair_batch)
+    # Scatter alpha BEFORE the fused pass: its selection masks must see
+    # the updated box membership (the run_chunk_block_fused contract).
+    safe_w = jnp.where(slot_ok, w, jnp.int32(n_pad))
+    alpha = alpha.at[safe_w].set(jnp.where(slot_ok, a_w, 0.0),
+                                 mode="drop")
+    err2d = f_err.reshape(shp) if compensated else None
+    with jax.named_scope("fusedround_fold_select"):
+        f2d, err_new2d, upv, upi, lov, loi = fold_rows_select(
+            k_rows, coef, f.reshape(shp), err2d, alpha.reshape(shp),
+            y2d, valid2d, c, compensated=compensated,
+            interpret=interpret)
+    w_n, ok_n, b_hi_n, b_lo_n = assemble_working_set(upv, upi, lov, loi,
+                                                     q // 2)
+    return (alpha, f2d.reshape(n_pad),
+            err_new2d.reshape(n_pad) if compensated else None,
+            b_hi_n, b_lo_n, w_n, ok_n, t)

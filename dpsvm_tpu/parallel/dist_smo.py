@@ -543,6 +543,19 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                  and (config.fused_fold if config.fused_fold is not None
                       else (_platform == "tpu"
                             and fused_fold_pays(_n_loc_f, d))))
+    if config.fused_round:
+        # The one-HBM-pass round (ops/pallas_round.py) is single-chip:
+        # its in-kernel gather/fold assume the full row set is locally
+        # resident. Loud fallback, not a silent ignore (the PR 8
+        # discipline) — the mesh keeps its own per-shard fused
+        # fold+select machinery above.
+        import warnings
+
+        warnings.warn(
+            "fused_round=True is a single-chip knob; solve_mesh keeps "
+            "its per-shard fused fold+select path (config.fused_fold) "
+            "— the forced one-pass round does not apply on the mesh",
+            stacklevel=3)
     n_pad = _n_pad_f if use_fused else pad_rows(n, n_dev)
     if kp.kind == "precomputed":
         if n != d:

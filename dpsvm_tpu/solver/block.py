@@ -139,6 +139,31 @@ def ring_pays(n_dev: int, n_loc: int, d: int) -> bool:
     return False
 
 
+def fused_round_pays(n_rows: int, d: int) -> bool:
+    """Auto-gate for the ONE-HBM-PASS fused round (ops/pallas_round.py;
+    config.fused_round). Same single-source discipline as
+    pipeline_pays / ring_pays: the gate constants come from a device
+    measurement or the gate stays off.
+
+    Status (2026-08-04): the kernels are implemented and CPU-verified
+    bitwise identical to the stock fused engine in interpret mode
+    (tests/test_fused_round.py pins full-solve trajectories across both
+    selection rules and the compensated carry), the device-form
+    structure is pinned by the tpulint block_chunk_fusedround budget,
+    and the A/B probe exists (tools/profile_round.py --fused-round) —
+    but no TPU was reachable this session, so there is no measured
+    crossover and the honest auto default is OFF everywhere
+    (config.fused_round=True forces it on for measurement and for the
+    CPU tests). Expected shape of the eventual gate: pays where the
+    round is HBM-bound on X and the launch floor matters — large n*d
+    at small-to-moderate q (the one-pass kernel removes the qx/dots
+    round-trips and three XLA launches from the fixed round cost), and
+    should inherit fused_fold_pays' d-dependent crossover shape since
+    it strictly extends that kernel's fusion. Flip to the measured rule
+    when the device session lands (ROADMAP item 5's standing TODO)."""
+    return False
+
+
 def pipeline_pays(n_rows: int, d: int) -> bool:
     """Auto-gate for the PIPELINED round engine (run_chunk_block_pipelined
     / the mesh pipelined runner), same single-source discipline as
@@ -670,18 +695,14 @@ run_chunk_block_donated = partial(
     static_argnames=_CHUNK_STATICS)(_run_chunk_block)
 
 
-@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
-                                  "inner_iters", "rounds_per_chunk",
-                                  "inner_impl", "interpret", "selection",
-                                  "pair_batch"))
-def run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
-                          max_iter, kp: KernelParams, c, eps: float,
-                          tau: float, q: int, inner_iters: int,
-                          rounds_per_chunk: int,
-                          inner_impl: str = "pallas",
-                          interpret: bool = False,
-                          selection: str = "mvp",
-                          pair_batch: int = 1) -> BlockState:
+def _run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
+                           max_iter, kp: KernelParams, c, eps: float,
+                           tau: float, q: int, inner_iters: int,
+                           rounds_per_chunk: int,
+                           inner_impl: str = "pallas",
+                           interpret: bool = False,
+                           selection: str = "mvp",
+                           pair_batch: int = 1) -> BlockState:
     """Fused-fold variant of run_chunk_block: the round's fold and the
     NEXT round's selection run as ONE Pallas pass over f
     (ops/pallas_fold_select.py), eliminating the separate full-n
@@ -751,20 +772,29 @@ def run_chunk_block_fused(x, y, x_sq, k_diag, valid, state: BlockState,
     return final
 
 
-@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
-                                  "inner_iters", "rounds_per_chunk",
-                                  "inner_impl", "interpret", "selection",
-                                  "pair_batch", "pallas_select"))
-def run_chunk_block_pipelined(x, y, x_sq, k_diag, valid,
-                              state: BlockState, max_iter,
-                              kp: KernelParams, c, eps: float, tau: float,
-                              q: int, inner_iters: int,
-                              rounds_per_chunk: int,
-                              inner_impl: str = "xla",
-                              interpret: bool = False,
-                              selection: str = "mvp",
-                              pair_batch: int = 1,
-                              pallas_select: bool = False) -> BlockState:
+# Donated/undonated pair (the run_chunk_block pattern, PR 5 / ISSUE 12
+# satellite): the solve driver dispatches the DONATED variant (the host
+# loop rebinds `state = run_chunk(...)` and never touches the old one),
+# freeing the carried (n,) alpha/f buffers from the live set each
+# dispatch; the undonated name remains for probes that legitimately
+# re-dispatch a warmed state (tools/profile_round.py's salted A/Bs).
+run_chunk_block_fused = partial(
+    jax.jit, static_argnames=_CHUNK_STATICS)(_run_chunk_block_fused)
+run_chunk_block_fused_donated = partial(
+    jax.jit, donate_argnums=(5,),
+    static_argnames=_CHUNK_STATICS)(_run_chunk_block_fused)
+
+
+def _run_chunk_block_pipelined(x, y, x_sq, k_diag, valid,
+                               state: BlockState, max_iter,
+                               kp: KernelParams, c, eps: float, tau: float,
+                               q: int, inner_iters: int,
+                               rounds_per_chunk: int,
+                               inner_impl: str = "xla",
+                               interpret: bool = False,
+                               selection: str = "mvp",
+                               pair_batch: int = 1,
+                               pallas_select: bool = False) -> BlockState:
     """PIPELINED round engine (config.pipeline_rounds): hide the fixed
     selection/launch floor behind the serial subproblem chain.
 
@@ -861,20 +891,23 @@ def run_chunk_block_pipelined(x, y, x_sq, k_diag, valid,
     return final
 
 
-@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau", "q",
-                                  "inner_iters", "rounds_per_chunk",
-                                  "m", "k_rounds",
-                                  "inner_impl", "interpret", "selection",
-                                  "pair_batch"))
-def run_chunk_block_active(x, y, x_sq, k_diag, valid, state: BlockState,
-                           max_iter,
-                           kp: KernelParams, c, eps: float, tau: float,
-                           q: int, inner_iters: int, rounds_per_chunk: int,
-                           m: int, k_rounds: int,
-                           inner_impl: str = "xla",
-                           interpret: bool = False,
-                           selection: str = "mvp",
-                           pair_batch: int = 1) -> BlockState:
+_PIPE_STATICS = _CHUNK_STATICS + ("pallas_select",)
+run_chunk_block_pipelined = partial(
+    jax.jit, static_argnames=_PIPE_STATICS)(_run_chunk_block_pipelined)
+run_chunk_block_pipelined_donated = partial(
+    jax.jit, donate_argnums=(5,),
+    static_argnames=_PIPE_STATICS)(_run_chunk_block_pipelined)
+
+
+def _run_chunk_block_active(x, y, x_sq, k_diag, valid, state: BlockState,
+                            max_iter,
+                            kp: KernelParams, c, eps: float, tau: float,
+                            q: int, inner_iters: int, rounds_per_chunk: int,
+                            m: int, k_rounds: int,
+                            inner_impl: str = "xla",
+                            interpret: bool = False,
+                            selection: str = "mvp",
+                            pair_batch: int = 1) -> BlockState:
     """Active-set ("shrinking") variant of run_chunk_block.
 
     LibSVM shrinks by dropping bound-saturated rows from its scans and
@@ -991,3 +1024,75 @@ def run_chunk_block_active(x, y, x_sq, k_diag, valid, state: BlockState,
                           st.pairs + t_tot, st.rounds + k_done, f_err)
 
     return lax.while_loop(cond, cycle, state)
+
+
+_ACTIVE_STATICS = _CHUNK_STATICS + ("m", "k_rounds")
+run_chunk_block_active = partial(
+    jax.jit, static_argnames=_ACTIVE_STATICS)(_run_chunk_block_active)
+run_chunk_block_active_donated = partial(
+    jax.jit, donate_argnums=(5,),
+    static_argnames=_ACTIVE_STATICS)(_run_chunk_block_active)
+
+
+def _run_chunk_block_fusedround(x, y, x_sq, k_diag, valid,
+                                state: BlockState, max_iter,
+                                kp: KernelParams, c, eps: float,
+                                tau: float, q: int, inner_iters: int,
+                                rounds_per_chunk: int,
+                                inner_impl: str = "pallas",
+                                interpret: bool = False,
+                                selection: str = "mvp",
+                                pair_batch: int = 1) -> BlockState:
+    """ONE-HBM-PASS fused round engine (config.fused_round;
+    ops/pallas_round.py — ISSUE 12): run_chunk_block_fused with the
+    remaining stock-XLA round stages fused into two Pallas passes, so
+    one round touches X exactly once (the gather rides the kernel-row
+    pass as in-kernel row DMAs, the Gram block rides grid step 0) and
+    the O(n) vectors exactly once (the fold contraction runs
+    in-register inside the fold+select pass).
+
+    Loop structure, candidate carry, seeding, budget gating and
+    stopping are run_chunk_block_fused's VERBATIM — each replaced stage
+    is bitwise-exact (ops/pallas_round.py module docstring), so the
+    trajectory is pinned bitwise equal to the stock fused engine
+    (tests/test_fused_round.py). Same padding contract: n padded to a
+    multiple of 1024 with `valid` marking real rows, selection in
+    {"mvp", "second_order"}, q/2 <= n_pad/128, feature kernels only.
+    """
+    from dpsvm_tpu.ops.pallas_round import fused_round
+
+    n_pad = y.shape[0]
+    shp = (n_pad // 128, 128)
+    y2d = y.reshape(shp)
+    valid2d = valid.astype(jnp.float32).reshape(shp)
+    end = state.rounds + rounds_per_chunk
+
+    w0, ok0, bhi0, blo0 = select_block(eff_f(state), state.alpha, y, c, q,
+                                       valid=valid, rule=selection)
+    st0 = state._replace(b_hi=bhi0, b_lo=blo0)
+
+    def cond(carry):
+        st, w, ok = carry
+        return ((st.rounds < end) & (st.pairs < max_iter)
+                & (st.b_lo > st.b_hi + 2.0 * eps))
+
+    def body(carry):
+        st, w, slot_ok = carry
+        alpha, f, f_err, b_hi_n, b_lo_n, w_n, ok_n, t = fused_round(
+            x, y, x_sq, k_diag, y2d, valid2d, st.alpha, st.f, st.f_err,
+            w, slot_ok, st.b_hi, st.b_lo, max_iter - st.pairs, kp, c,
+            eps, tau, q, inner_iters, inner_impl, interpret, selection,
+            pair_batch=pair_batch)
+        new_st = BlockState(alpha, f, b_hi_n, b_lo_n, st.pairs + t,
+                            st.rounds + 1, f_err)
+        return new_st, w_n, ok_n
+
+    final, _, _ = lax.while_loop(cond, body, (st0, w0, ok0))
+    return final
+
+
+run_chunk_block_fusedround = partial(
+    jax.jit, static_argnames=_CHUNK_STATICS)(_run_chunk_block_fusedround)
+run_chunk_block_fusedround_donated = partial(
+    jax.jit, donate_argnums=(5,),
+    static_argnames=_CHUNK_STATICS)(_run_chunk_block_fusedround)

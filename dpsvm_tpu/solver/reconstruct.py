@@ -298,7 +298,7 @@ def solve_in_legs(base_solve, x, y, config: SVMConfig, callback=None,
         nonlocal inner, switch_pairs
         inner = inner.replace(engine="xla", pair_batch=1,
                               active_set_size=0, fused_fold=None,
-                              pipeline_rounds=None,
+                              fused_round=None, pipeline_rounds=None,
                               local_working_sets=None, sync_rounds=1)
         switch_pairs = pairs_done
         if config.verbose and not upfront:

@@ -1074,7 +1074,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     # round-trip + candidate top-k — a net LOSS on small rounds. The
     # crossover is d-dependent and pinned by the round-5 sweep
     # (solver/block.py fused_fold_pays docstring table).
-    from dpsvm_tpu.solver.block import fused_fold_pays, pipeline_pays
+    from dpsvm_tpu.solver.block import (fused_fold_pays, fused_round_pays,
+                                        pipeline_pays)
 
     n_pad_fused = -(-n // 1024) * 1024
     # Pipelined rounds (config.pipeline_rounds; solver/block.py
@@ -1085,11 +1086,15 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     # entirely; fusing it into the fold would re-serialize it). Works
     # with precomputed kernels and the resident Gram (the prefetch's
     # Gram block is a column gather there).
+    # The auto gate must never override an EXPLICIT fused_round=True
+    # (config rejects the explicit pipeline+fusedround pair as "one or
+    # the other"; the forced knob wins over pipeline_pays the same way).
     use_pipe = (use_block and config.selection != "nu"
                 and not config.active_set_size
                 and (config.pipeline_rounds
                      if config.pipeline_rounds is not None
                      else (device.platform == "tpu"
+                           and not config.fused_round
                            and pipeline_pays(n, d))))
     # The prefetch's own selection pass: the one-pass Pallas candidate
     # kernel where the fused path's padding contract holds on a real
@@ -1101,7 +1106,25 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                           and device.platform == "tpu"
                           and min(config.working_set_size, n_pad_fused)
                           <= n_pad_fused // 64)
-    use_fused = (use_block and not use_pipe and config.selection != "nu"
+    # One-HBM-pass fused round (config.fused_round; ops/pallas_round.py
+    # + solver/block.py run_chunk_block_fusedround): the fused-fold
+    # engine with the remaining XLA round stages (gather, Gram, kernel
+    # rows, fold contraction) fused into two Pallas passes. Same padding
+    # contract and restrictions as the fused fold+select; supersedes
+    # fused_fold when both would engage (it strictly extends that
+    # kernel's fusion); pipeline_rounds=True rejects it in config.
+    use_fusedround = (use_block and not use_pipe
+                      and config.selection != "nu"
+                      and not config.active_set_size
+                      and kp.kind != "precomputed" and not use_gram
+                      and min(config.working_set_size, n_pad_fused)
+                      <= n_pad_fused // 64
+                      and (config.fused_round
+                           if config.fused_round is not None
+                           else (device.platform == "tpu"
+                                 and fused_round_pays(n_pad_fused, d))))
+    use_fused = (use_block and not use_pipe and not use_fusedround
+                 and config.selection != "nu"
                  and not config.active_set_size
                  and kp.kind != "precomputed" and not use_gram
                  and min(config.working_set_size, n_pad_fused)
@@ -1118,7 +1141,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         # padding is masked out of selection via `valid`.
         blk = block_rows * 128
         n_pad = -(-n_min // blk) * blk
-    elif use_fused or pipe_pallas_select:
+    elif use_fused or use_fusedround or pipe_pallas_select:
         blk = 8 * 128  # fold_select's (block_rows=8, 128) grid blocks
         n_pad = -(-n_min // blk) * blk
     else:
@@ -1147,7 +1170,8 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         y_p = np.ones((n_pad,), np.float32)
         y_p[:n] = y_np
     y_dev = jax.device_put(jnp.asarray(y_p, jnp.float32), device)
-    if n_pad == n and not (use_pallas or use_fused or pipe_pallas_select):
+    if n_pad == n and not (use_pallas or use_fused or use_fusedround
+                           or pipe_pallas_select):
         valid_dev = None
     else:
         valid_np = np.zeros((n_pad,), bool)
@@ -1293,6 +1317,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                         "gram_resident": bool(use_gram),
                         "pipelined": bool(use_block and use_pipe),
                         "fused_fold": bool(use_block and use_fused),
+                        "fused_round": bool(use_block and use_fusedround),
                         "observed_chunks": observe})
 
     # PHASE CLOCK (honest per-phase wall time, SolveResult.stats
@@ -1341,9 +1366,14 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                     kp, config.c_bounds(), eps_run, float(config.tau),
                     chunk_len, use_cache, block_rows, interpret)
             elif use_block and m_act:
-                from dpsvm_tpu.solver.block import run_chunk_block_active
+                # Donated carries on every block variant (PR 5 pattern,
+                # completed by the ISSUE 12 satellite): the loop only
+                # ever reads the NEW state, so the old (n,) alpha/f
+                # buffers leave the live set (tpulint pins missed=0).
+                from dpsvm_tpu.solver.block import (
+                    run_chunk_block_active_donated)
 
-                state = run_chunk_block_active(
+                state = run_chunk_block_active_donated(
                     x_dev, y_dev, x_sq, k_diag, valid_dev, state,
                     max_iter, kp, config.c_bounds(), eps_run,
                     float(config.tau), q, inner, rounds_per_chunk,
@@ -1353,9 +1383,9 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                     pair_batch=int(config.pair_batch))
             elif use_block and use_pipe:
                 from dpsvm_tpu.solver.block import (
-                    run_chunk_block_pipelined)
+                    run_chunk_block_pipelined_donated)
 
-                state = run_chunk_block_pipelined(
+                state = run_chunk_block_pipelined_donated(
                     x_dev, y_dev, x_sq, k_diag, valid_dev, state,
                     max_iter, kp, config.c_bounds(), eps_run,
                     float(config.tau), q, inner, rounds_per_chunk,
@@ -1364,10 +1394,23 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                     selection=config.selection,
                     pair_batch=int(config.pair_batch),
                     pallas_select=pipe_pallas_select)
-            elif use_block and use_fused:
-                from dpsvm_tpu.solver.block import run_chunk_block_fused
+            elif use_block and use_fusedround:
+                from dpsvm_tpu.solver.block import (
+                    run_chunk_block_fusedround_donated)
 
-                state = run_chunk_block_fused(
+                state = run_chunk_block_fusedround_donated(
+                    x_dev, y_dev, x_sq, k_diag, valid_dev, state,
+                    max_iter, kp, config.c_bounds(), eps_run,
+                    float(config.tau), q, inner, rounds_per_chunk,
+                    inner_impl="pallas" if not interpret else "xla",
+                    interpret=interpret,
+                    selection=config.selection,
+                    pair_batch=int(config.pair_batch))
+            elif use_block and use_fused:
+                from dpsvm_tpu.solver.block import (
+                    run_chunk_block_fused_donated)
+
+                state = run_chunk_block_fused_donated(
                     x_dev, y_dev, x_sq, k_diag, valid_dev, state,
                     max_iter, kp, config.c_bounds(), eps_run,
                     float(config.tau), q, inner, rounds_per_chunk,

@@ -311,6 +311,52 @@ def test_ooc_fold_tile_budget_independent_of_n():
     assert don["missed"] == 0 and don["declared_donated"] == 1
 
 
+def test_fusedround_extra_hbm_pass_drifts():
+    """The one-pass contract, mutation-verified (ISSUE 12, the
+    ooc_fold_tile n-doubling discipline): the clean fused-round chunk
+    must PASS its committed budget, and the extra_pass mutation — the
+    same chunk plus one re-materialized XLA kernel-row pass over X,
+    with the identical donation declaration — must DRIFT, naming a
+    fact the extra pass moved (the dot count / temp bytes). Also pins
+    the headline zeros the budget exists for: zero collectives, zero
+    host-boundary transfers, donated carry, and the device form's
+    zero-XLA-collective + single-gather-DMA kernel structure."""
+    import json
+
+    import pytest
+
+    from dpsvm_tpu.analysis import budget
+    from dpsvm_tpu.analysis.manifest import (block_chunk_fusedround,
+                                             require_devices)
+
+    gen = budget.budget_jax_version()
+    if gen is not None and gen != jax.__version__:
+        pytest.skip(
+            f"budgets generated under jax {gen}, running {jax.__version__}")
+    require_devices()
+
+    clean = entry_facts(block_chunk_fusedround())
+    assert budget.check_entry("block_chunk_fusedround",
+                              clean)["verdict"] == budget.PASS
+    u = clean["units"]["chunk"]
+    assert all(v["count"] == 0 for v in u["collectives"].values())
+    assert all(v == 0 for v in u["transfers"].values())
+    assert u["donation"]["missed"] == 0
+    assert u["donation"]["declared_donated"] == 6  # the BlockState carry
+    df = u["device_form"]
+    assert df["xla_collective_total"] == 0
+    # The in-kernel row gather's two DMA issue sites (pipeline warm-up
+    # + in-loop refill), and nothing else.
+    assert df["dma_starts"] == 2
+
+    mutated = entry_facts(block_chunk_fusedround(extra_pass=True))
+    res = budget.check_entry("block_chunk_fusedround", mutated)
+    assert res["verdict"] == budget.DRIFT
+    drifted_paths = [p for p, _, _ in res["diffs"]]
+    assert any("dots" in p or "memory" in p for p in drifted_paths), \
+        json.dumps(drifted_paths)
+
+
 # ------------------------------------- the committed budgets (tier-1)
 
 def test_manifest_budgets_pass_against_committed(monkeypatch):

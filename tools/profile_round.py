@@ -86,7 +86,7 @@ def timed(fn, *args, reps: int) -> float:
 
 def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
            fused: bool = False, valid=None, budgets=None,
-           pipelined: bool = False):
+           pipelined: bool = False, fusedround: bool = False):
     """Stage attribution from WHOLE-CHUNK ablation — the only timing
     method the tunnel cannot distort (one dispatch per probe, big-state
     output, salted fresh start each time). Runs `reps` rounds at
@@ -104,6 +104,7 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
 
     from dpsvm_tpu.solver.block import (BlockState, run_chunk_block,
                                         run_chunk_block_fused,
+                                        run_chunk_block_fusedround,
                                         run_chunk_block_pipelined)
     from dpsvm_tpu.solver.smo import _BUDGET_EPS
 
@@ -140,7 +141,15 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
         # a smoke check, not the TPU claim).
         on_tpu = jax.default_backend() == "tpu"
         impl = "pallas" if on_tpu else "xla"
-        if fused:
+        if fusedround:
+            # The one-HBM-pass round (ISSUE 12): same padding contract
+            # as the fused engine; the --fused-round A/B differences
+            # this against the stock fused ablation.
+            run = lambda st, n: run_chunk_block_fusedround(
+                xd, yd, x_sq, k_diag, valid, st, jnp.int32(10 ** 9), kp,
+                cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
+                n, inner_impl=impl, interpret=not on_tpu)
+        elif fused:
             run = lambda st, n: run_chunk_block_fused(
                 xd, yd, x_sq, k_diag, valid, st, jnp.int32(10 ** 9), kp,
                 cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
@@ -595,6 +604,15 @@ def main() -> int:
     ap.add_argument("--fused", action="store_true",
                     help="ablate run_chunk_block_fused (fold+select as "
                          "one Pallas pass; rows padded to 1024)")
+    ap.add_argument("--fused-round", action="store_true",
+                    help="A/B the one-HBM-pass fused round "
+                         "(ops/pallas_round.py, config.fused_round) "
+                         "against the stock fused engine: both whole-"
+                         "chunk ablations back to back over the same "
+                         "inner budgets, rows mirrored into the obs "
+                         "runlog with --obs (ISSUE 12; the probe the "
+                         "fused_round_pays auto gate is waiting on — "
+                         "interpret-mode structure check on CPU)")
     ap.add_argument("--pipeline", action="store_true",
                     help="ablate run_chunk_block_pipelined (next round's "
                          "selection/gather/Gram issued from the pre-fold "
@@ -716,7 +734,7 @@ def main() -> int:
                                  args.sync_rounds, args.dtype)
     kp = KernelParams("rbf", cfg.resolve_gamma(d))
     valid_dev = None
-    if args.fused or args.pipeline:
+    if args.fused or args.pipeline or args.fused_round:
         # The fused runner's contract: rows padded to 1024 with a valid
         # mask (solver/smo.py pads the same way).
         n_pad = -(-n // 1024) * 1024
@@ -730,9 +748,10 @@ def main() -> int:
         valid_dev = jnp.asarray(valid)
         n = n_pad
         if q // 2 > n_pad // 128:
-            ap.error(f"--fused/--pipeline need q/2 <= n_pad/128 (one "
-                     f"candidate per 128-row per side): q={q}, "
-                     f"n_pad={n_pad} allows q <= {2 * (n_pad // 128)}")
+            ap.error(f"--fused/--pipeline/--fused-round need q/2 <= "
+                     f"n_pad/128 (one candidate per 128-row per side): "
+                     f"q={q}, n_pad={n_pad} allows q <= "
+                     f"{2 * (n_pad // 128)}")
     xd = jnp.asarray(x, jnp.bfloat16 if args.dtype == "bfloat16"
                      else jnp.float32)
     yd = jnp.asarray(y, jnp.float32)
@@ -745,6 +764,32 @@ def main() -> int:
     print(f"dataset={args.dataset} n={n} d={d} q={q} reps={args.reps}")
 
     c = cfg.c_bounds()
+
+    if args.fused_round:
+        # Fused-round-vs-stock-fused whole-chunk A/B (ISSUE 12 — the
+        # measurement solver/block.py fused_round_pays is waiting on).
+        # Trajectories are bitwise identical by construction
+        # (tests/test_fused_round.py), so pairs match and the fixed
+        # round cost is the decisive number.
+        budgets = (tuple(int(v) for v in args.budgets.split(","))
+                   if args.budgets else None)
+        print("  whole-chunk ablation — STOCK fused engine (baseline):")
+        rows_f, fix_f, marg_f = ablate(
+            xd, yd, x_sq, k_diag, kp, cfg, q, args.reps, fused=True,
+            valid=valid_dev, budgets=budgets)
+        print("  whole-chunk ablation — ONE-PASS fused round:")
+        rows_r, fix_r, marg_r = ablate(
+            xd, yd, x_sq, k_diag, kp, cfg, q, args.reps,
+            fusedround=True, valid=valid_dev, budgets=budgets)
+        if fix_f > 0:
+            print(f"  => fused-round fixed cost {fix_r:.3f} ms vs "
+                  f"stock fused {fix_f:.3f} ms "
+                  f"({fix_r / fix_f:.2f}x; flip solver/block.py "
+                  f"fused_round_pays from THIS number, measured on a "
+                  f"real device)")
+        obs_log_rows("fused", rows_f, fix_f, marg_f)
+        obs_log_rows("fusedround", rows_r, fix_r, marg_r)
+        return 0
 
     if args.ablate_only:
         budgets = (tuple(int(v) for v in args.budgets.split(","))

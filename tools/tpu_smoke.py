@@ -193,10 +193,12 @@ def main() -> int:
     rf_ref = solve(xf, yf, cfg.replace(engine="block",
                                        working_set_size=32,
                                        fused_fold=False))
+    fused_runs = {}
     for comp in (False, True):
         rf = solve(xf, yf, cfg.replace(engine="block", working_set_size=32,
                                        fused_fold=True, compensated=comp,
                                        matmul_precision="default"))
+        fused_runs[comp] = rf
         db = abs(rf.b - rf_ref.b)
         status = "OK" if (rf.converged and db < 5e-2) else "FAIL"
         failures += status == "FAIL"
@@ -205,6 +207,33 @@ def main() -> int:
                db=round(db, 5))
         print(f"fused fold+select compensated={comp} pairs={rf.iterations} "
               f"|b-b_ref|={db:.4f} {status}")
+
+    # One-HBM-pass fused round (ISSUE 12, config.fused_round): first
+    # real Mosaic lowering of ops/pallas_round.py — the scalar-prefetch
+    # grid, the in-kernel dynamic-slice row gather from HBM, the
+    # revisited (q, q) Gram output block and the in-register fold
+    # contraction. Gated on optimum quality; the bitwise field vs the
+    # stock fused engine is recorded informationally (the bit-identity
+    # CONTRACT is pinned on the CPU harness where both engines execute
+    # the identical scalar ops — real-MXU tiling may legitimately
+    # regroup the accumulations).
+    for comp in (False, True):
+        rfr = solve(xf, yf, cfg.replace(engine="block",
+                                        working_set_size=32,
+                                        fused_round=True,
+                                        compensated=comp,
+                                        matmul_precision="default"))
+        db = abs(rfr.b - rf_ref.b)
+        bitwise = bool(np.array_equal(rfr.alpha, fused_runs[comp].alpha)
+                       and rfr.iterations == fused_runs[comp].iterations)
+        status = "OK" if (rfr.converged and db < 5e-2) else "FAIL"
+        failures += status == "FAIL"
+        record(f"fused_round/compensated={comp}",
+               rfr.converged and db < 5e-2, pairs=int(rfr.iterations),
+               db=round(db, 5), bitwise_vs_fused_fold=bitwise)
+        print(f"one-pass fused round compensated={comp} "
+              f"pairs={rfr.iterations} |b-b_ref|={db:.4f} "
+              f"bitwise={bitwise} {status}")
 
     # Mesh fused fold+select on the single real chip (1-device mesh:
     # exercises the shard_mapped pallas_call lowering + gathered top-h).
