@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -68,6 +68,14 @@ bench_ooc_smoke:
 # bench_serve_smoke; the smoke output is not committed).
 bench_fused_smoke:
 	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) bench.py --fused-round --obs
+
+# Fault-tolerance smoke (ISSUE 13): the deterministic fault-injection
+# harness self-test, a kill -9 mid-ooc-solve followed by a --resume
+# that must land BITWISE on the uninterrupted trajectory, and a
+# dispatch-watchdog trip that must fail one batch explicitly and keep
+# the engine serving (tier1.yml runs this next to bench_serve_smoke).
+faults_smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/faults_smoke.py
 
 smoke:
 	$(PY) -m dpsvm_tpu.cli smoke

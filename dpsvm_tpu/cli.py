@@ -299,6 +299,22 @@ def _build_serve_parser(sub) -> argparse.ArgumentParser:
                         "requests finishing past it count as deadline "
                         "misses, requests expiring in queue are shed "
                         "with an explicit verdict (default: none)")
+    p.add_argument("--dispatch-timeout-ms", type=float, default=None,
+                   help="v2 engine: dispatch WATCHDOG — a batch not "
+                        "materialized within this bound is failed "
+                        "with explicit per-request 'failed' verdicts "
+                        "(per-model serve_dispatch_failures counter) "
+                        "and the engine keeps serving; a wedged "
+                        "device dispatch can never hang the pump "
+                        "(default: unbounded)")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="v2 engine: registry journal — atomically "
+                        "rewritten on every register/swap with the "
+                        "live {name -> model path + version} set; a "
+                        "restarted engine pointed at the same journal "
+                        "REPLAYS it through the normal validate-stage-"
+                        "warm path and serves the exact pre-crash "
+                        "model set (default: no journal)")
     p.add_argument("--buckets", default="16,64,256,1024,4096",
                    help="comma-separated power-of-two query buckets "
                         "(pre-compiled at startup)")
@@ -1075,7 +1091,9 @@ def _cmd_serve(args) -> int:
     from dpsvm_tpu.config import ServeConfig
     from dpsvm_tpu.serve import PredictServer, offered_load_sweep
 
-    if args.registry:
+    if args.registry or args.journal:
+        # --journal alone is a valid v2 start: a crash-restarted
+        # engine rehydrates its whole model set from the journal.
         return _cmd_serve_v2(args)
     if not args.model:
         print("error: -m/--model is required (or --registry NAME=PATH "
@@ -1220,7 +1238,7 @@ def _cmd_serve_v2(args) -> int:
               file=sys.stderr)
         return 2
     specs = []
-    for spec in args.registry:
+    for spec in args.registry or []:
         name, sep, path = spec.partition("=")
         if not sep or not name or not path:
             print(f"error: --registry wants NAME=PATH, got {spec!r}",
@@ -1233,6 +1251,8 @@ def _cmd_serve_v2(args) -> int:
         config = ServeConfig(
             buckets=buckets, dtype=args.dtype,
             deadline_ms=args.deadline_ms,
+            dispatch_timeout_ms=args.dispatch_timeout_ms,
+            journal_path=args.journal,
             metrics_port=args.metrics_port,
             metrics_host=args.metrics_host, slo_ms=args.slo_ms,
             obs=ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir))
@@ -1241,6 +1261,12 @@ def _cmd_serve_v2(args) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if engine._rehydrated and not args.quiet:
+        print(f"rehydrated {len(engine._rehydrated)} model(s) from "
+              f"{config.journal_path}: "
+              + ", ".join(f"{e.name} v{e.version}"
+                          for e in engine.registry.entries()),
+              file=sys.stderr)
     try:
         for name, path in specs:
             entry = engine.register(name, path)
@@ -1252,6 +1278,11 @@ def _cmd_serve_v2(args) -> int:
                       file=sys.stderr)
     except ModelLoadError as e:
         print(f"error: {e}", file=sys.stderr)
+        engine.close()
+        return 2
+    if not engine.registry.names():
+        print("error: no models to serve (--registry NAME=PATH, or a "
+              "--journal with recorded models)", file=sys.stderr)
         engine.close()
         return 2
     if engine.exporter is not None and not args.quiet:

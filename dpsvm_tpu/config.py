@@ -857,6 +857,23 @@ class ServeConfig:
       ``submit(..., deadline_ms=...)`` overrides. Distinct from
       slo_ms, which is purely an observability threshold and never
       changes scheduling.
+    dispatch_timeout_ms: dispatch WATCHDOG for the v2 engine (ISSUE
+      13): the bounded wait on the AsyncDispatcher's in-flight batch.
+      A batch not materialized within this bound — a wedged device
+      dispatch, the one failure mode that would otherwise hang the
+      pump thread forever — is FAILED with explicit per-request
+      'failed' verdicts and a per-model serve_dispatch_failures
+      counter, and the engine keeps serving subsequent batches. None
+      (default) = unbounded wait (the pre-watchdog behavior).
+    journal_path: registry JOURNAL for the v2 engine (ISSUE 13): a
+      JSON file atomically rewritten on every register/swap/unregister
+      with the live {name -> model path + version} set. A restarting
+      ServingEngine constructed with the same path REPLAYS it through
+      the normal validate-stage-warm registration path, so a crashed
+      or killed server rehydrates its exact live model set (versions
+      included) with zero operator action. Only file-backed models
+      journal (in-memory model objects cannot be replayed). None
+      (default) = no journal.
     """
 
     buckets: tuple = (16, 64, 256, 1024, 4096)
@@ -869,6 +886,8 @@ class ServeConfig:
     metrics_host: str = "127.0.0.1"
     slo_ms: float = 50.0
     deadline_ms: Optional[float] = None
+    dispatch_timeout_ms: Optional[float] = None
+    journal_path: Optional[str] = None
     # Observability (dpsvm_tpu/obs): serve run logs + trace spans.
     # Bucket latency HISTOGRAMS are always on (they replaced the old
     # bounded timing deques at identical cost); this only gates the
@@ -912,6 +931,15 @@ class ServeConfig:
         if self.deadline_ms is not None and self.deadline_ms <= 0:
             raise ValueError(
                 "deadline_ms must be > 0 (None = no deadlines)")
+        if self.dispatch_timeout_ms is not None \
+                and self.dispatch_timeout_ms <= 0:
+            raise ValueError(
+                "dispatch_timeout_ms must be > 0 (None = unbounded "
+                "dispatch wait, no watchdog)")
+        if self.journal_path is not None and not self.journal_path:
+            raise ValueError(
+                "journal_path must be a file path (None = no registry "
+                "journal)")
 
     def replace(self, **kw) -> "ServeConfig":
         return dataclasses.replace(self, **kw)

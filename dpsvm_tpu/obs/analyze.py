@@ -172,12 +172,23 @@ def summarize_run(run: Run) -> dict:
         "cache_lookups": fin.get("cache_lookups"),
         "cache_evictions": fin.get("cache_evictions"),
         "tiles_streamed": fin.get("tiles_streamed"),
+        # Fault-tolerance accounting (ISSUE 13 satellite): counts of
+        # the fault-story event records — injected/real transient
+        # faults, retry attempts, safe-config demotions, journal
+        # rehydrates — so a run's recovery history reads off the
+        # report table.
+        "fault_events": {
+            name: sum(1 for e in run.events if e.get("name") == name)
+            for name in ("fault", "retry", "demotion", "rehydrate",
+                         "dispatch_failed", "resume")
+        },
         # Serving-engine accounting (ISSUE 10 satellite): the v2
         # engine's final record carries its scheduler counters; None
         # for solver runs (and v1 serve runs, which predate them).
         "deadline_misses": fin.get("deadline_misses"),
         "expired": fin.get("expired"),
         "hot_swaps": fin.get("hot_swaps"),
+        "dispatch_failures": fin.get("dispatch_failures"),
         "serve_requests": fin.get("requests") if man.get(
             "tool") == "serve" else None,
         "batch_occupancy_mean": ((fin.get("batch_occupancy") or {})
@@ -280,8 +291,14 @@ _REPORT_COLS = (
     ("n", "n"), ("d", "d"), ("chunks", "chunks"), ("pairs", "pairs"),
     ("device_s", "device_seconds"), ("pairs/s", "pairs_per_second"),
     ("gap last", "gap_last"), ("stalls", None), ("compiles", "compiles"),
-    ("cache", None), ("serve", None), ("phases", None), ("done", None),
+    ("cache", None), ("serve", None), ("faults", None),
+    ("phases", None), ("done", None),
 )
+
+#: faults-column legend: event name -> compact tag (ISSUE 13).
+_FAULT_TAGS = (("fault", "f"), ("retry", "r"), ("demotion", "d"),
+               ("resume", "c"), ("rehydrate", "h"),
+               ("dispatch_failed", "x"))
 
 
 def _report_row(s: dict) -> list:
@@ -308,7 +325,8 @@ def _report_row(s: dict) -> list:
         elif head == "serve":
             # Serving-engine column (ISSUE 10 satellite): deadline
             # misses / hot swaps / mean batch occupancy for v2 serve
-            # runs, "-" for everything else.
+            # runs, "-" for everything else. fail= appears only when
+            # dispatches actually failed (ISSUE 13 watchdog).
             if s.get("deadline_misses") is None:
                 row.append("-")
             else:
@@ -316,7 +334,18 @@ def _report_row(s: dict) -> list:
                 row.append(
                     f"miss={s['deadline_misses']} "
                     f"swap={s.get('hot_swaps') or 0}"
+                    + (f" fail={s['dispatch_failures']}"
+                       if s.get("dispatch_failures") else "")
                     + (f" occ={occ:.2f}" if occ is not None else ""))
+        elif head == "faults":
+            # Fault-story column (ISSUE 13 satellite): compact tags,
+            # e.g. "f=1 r=1" for one fault + one retry, "d=1" for a
+            # safe-config demotion, "h=1" for a journal rehydrate;
+            # "0" when the run saw no fault events.
+            ev = s.get("fault_events") or {}
+            parts = [f"{tag}={ev[name]}" for name, tag in _FAULT_TAGS
+                     if ev.get(name)]
+            row.append(" ".join(parts) if parts else "0")
         elif head == "phases":
             row.append(ph_txt)
         else:

@@ -44,10 +44,11 @@ from dpsvm_tpu.ops.kernels import KernelParams, kernel_diag, kernel_from_dots
 from dpsvm_tpu.ops.select import c_of, low_mask, split_c, up_mask
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
-from dpsvm_tpu.solver.smo import (SMOState, assert_finite_state, eff_f,
-                                  kahan_add)
+from dpsvm_tpu.solver.smo import (SMOState, assert_finite_state,
+                                  check_obs_finite, eff_f, kahan_add)
 from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
                                      mesh_shard_map, pad_rows)
+from dpsvm_tpu.testing import faults
 
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -58,6 +59,34 @@ _I32_MAX = jnp.iinfo(jnp.int32).max
 # stalled engine is demoted promptly; large enough that the per-chunk
 # host round-trip stays amortized over thousands of pair updates.
 _SHARDLOCAL_WINDOWS_PER_CHUNK = 8
+
+# One-time flag for _warn_multihost_retry_dropped: a k(k-1)/2-submodel
+# multiclass job would otherwise repeat the identical warning per
+# submodel solve (the nu-fallback warning discipline, PR 8).
+_WARNED_MULTIHOST_RETRY = False
+
+
+def _warn_multihost_retry_dropped(config) -> None:
+    """Loud, once-per-process notice that retry_faults was dropped
+    (ISSUE 13 satellite — the knob used to vanish silently): on a
+    multi-host pod a faulted process cannot re-sync its peers'
+    collectives mid-job, so in-process retries are impossible and the
+    recovery procedure is a JOB RELAUNCH with ``--resume`` against the
+    same ``--checkpoint`` path (process-0-written, backend-portable)."""
+    global _WARNED_MULTIHOST_RETRY
+    if _WARNED_MULTIHOST_RETRY or config.retry_faults <= 0:
+        return
+    _WARNED_MULTIHOST_RETRY = True
+    import warnings
+
+    warnings.warn(
+        f"retry_faults={config.retry_faults} is disabled on this "
+        f"{jax.process_count()}-process pod: a faulted process cannot "
+        "re-sync its peers' collectives mid-job, so in-process retry "
+        "cannot work multi-host. Recovery procedure: run with "
+        "--checkpoint PATH --checkpoint-every N, and on a fault "
+        "RELAUNCH the whole job with --resume — training continues "
+        "from the last checkpoint.", stacklevel=3)
 
 
 def _global_ids(n_loc: int) -> jax.Array:
@@ -418,24 +447,36 @@ def solve_mesh(
             alpha_init=alpha_init, f_init=f_init)
 
     from dpsvm_tpu.solver.smo import (_precision_ctx, _retry_callback,
+                                      _solve_with_degradation,
                                       run_with_fault_retry)
 
-    def attempt(cfg_k, res_k, k):
-        return _solve_mesh_impl(x, y, cfg_k, num_devices, mesh,
-                                _retry_callback(callback, cfg_k,
-                                                checkpoint_path, k),
-                                checkpoint_path, res_k, alpha_init, f_init)
+    def run(cfg, res):
+        def attempt(cfg_k, res_k, k):
+            return _solve_mesh_impl(x, y, cfg_k, num_devices, mesh,
+                                    _retry_callback(callback, cfg_k,
+                                                    checkpoint_path, k),
+                                    checkpoint_path, res_k, alpha_init,
+                                    f_init)
 
-    # Single-controller retry only: on a multi-host pod a faulted process
-    # cannot re-sync its peers' collectives mid-job, so retries are
-    # forced OFF there automatically — recovery happens by relaunching
-    # the whole job with --resume (checkpoints are process-0-written and
-    # backend-portable).
-    retry_cfg = (config if jax.process_count() == 1
-                 else config.replace(retry_faults=0))
-    with _precision_ctx(config):
-        return run_with_fault_retry(retry_cfg, checkpoint_path, resume,
-                                    attempt)
+        # Single-controller retry only: on a multi-host pod a faulted
+        # process cannot re-sync its peers' collectives mid-job, so
+        # retries are forced OFF there automatically — recovery happens
+        # by relaunching the whole job with --resume (checkpoints are
+        # process-0-written and backend-portable), which the one-time
+        # warning names.
+        if jax.process_count() == 1:
+            retry_cfg = cfg
+        else:
+            retry_cfg = cfg.replace(retry_faults=0)
+            _warn_multihost_retry_dropped(cfg)
+        with _precision_ctx(cfg):
+            return run_with_fault_retry(retry_cfg, checkpoint_path, res,
+                                        attempt)
+
+    # Non-finite sentinel + safe-config demotion (ISSUE 13): the mesh
+    # loop observes the same chunk-boundary extrema as the single-chip
+    # driver, so it gets the same backstop.
+    return _solve_with_degradation(config, checkpoint_path, resume, run)
 
 
 def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
@@ -790,6 +831,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                         "fused_fold": bool(use_block and use_fused),
                         "ring_exchange": bool(use_ring),
                         "observed_chunks": observe})
+    from dpsvm_tpu.solver.smo import drain_pending_obs_events
+    drain_pending_obs_events(obs)
     jax.block_until_ready((x_dev, y_dev, x_sq, k_diag, valid_dev, state))
     phase_seconds = {"setup": time.perf_counter() - t_entry,
                      "solve": 0.0, "observe": 0.0, "finalize": 0.0}
@@ -821,6 +864,7 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
         with obs.span("mesh/chunk"):
             t0 = time.perf_counter()
             dispatches += 1
+            faults.device_fault("dispatch", f"mesh chunk {dispatches}")
             state = run_chunk(x_dev, y_dev, x_sq, k_diag, valid_dev,
                               state, max_iter)
             jax.block_until_ready(state)
@@ -832,6 +876,11 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
         # budget exits are refreshed exactly below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
+        # Non-finite sentinel (the solver/smo.py contract): a NaN gap
+        # would read "converged" below and return a silently corrupt
+        # model — raise for the demotion wrapper instead.
+        b_hi, b_lo = faults.poison_obs(b_hi, b_lo)
+        check_obs_finite(b_hi, b_lo, it, f"mesh p={n_dev}")
         obs.chunk(pairs=it, b_hi=b_hi, b_lo=b_lo,
                   device_seconds=chunk_dt, dispatch=dispatches,
                   shardlocal=bool(shardlocal_live))

@@ -27,10 +27,12 @@ regression gate is ``tools/loadgen.py``.
 
 from dpsvm_tpu.serving.dispatch import ServeResult, ServingEngine
 from dpsvm_tpu.serving.registry import (LoadedModel, ModelLoadError,
-                                        ModelRegistry, load_model_file)
+                                        ModelRegistry, RegistryJournal,
+                                        load_model_file)
 from dpsvm_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = [
-    "ServingEngine", "ServeResult", "ModelRegistry", "LoadedModel",
-    "ModelLoadError", "load_model_file", "Scheduler", "Request",
+    "ServingEngine", "ServeResult", "ModelRegistry", "RegistryJournal",
+    "LoadedModel", "ModelLoadError", "load_model_file", "Scheduler",
+    "Request",
 ]

@@ -29,6 +29,7 @@ Three mechanisms, each attacking one cost the v1 PredictServer pays:
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Optional
 
@@ -41,8 +42,10 @@ from dpsvm_tpu.obs.metrics import Registry
 from dpsvm_tpu.obs.trace import span
 from dpsvm_tpu.serve import (_dense_batch_factory, effective_buckets,
                              warn_if_bf16_serving_risky)
-from dpsvm_tpu.serving.registry import LoadedModel, ModelRegistry
+from dpsvm_tpu.serving.registry import (LoadedModel, ModelRegistry,
+                                        RegistryJournal)
 from dpsvm_tpu.serving.scheduler import Request, Scheduler
+from dpsvm_tpu.testing import faults
 
 
 @dataclasses.dataclass
@@ -55,6 +58,12 @@ class ServeResult:
                   silently served late);
       "expired" — shed at batch-forming time (deadline already passed
                   before any device work): no decision rows, counted.
+      "failed"  — the batch's device dispatch raised or tripped the
+                  dispatch watchdog (ServeConfig.dispatch_timeout_ms):
+                  no decision rows, counted per model
+                  (serve_dispatch_failures); the engine keeps serving
+                  subsequent batches — an explicit verdict, never a
+                  hung pump thread (ISSUE 13).
 
     ``entry`` is the LoadedModel THAT SERVED the request (the version
     resolved at submit) — label folding must use it, not a fresh
@@ -84,6 +93,10 @@ class ServeResult:
     @property
     def deadline_missed(self) -> bool:
         return self.verdict in ("late", "expired")
+
+    @property
+    def failed(self) -> bool:
+        return self.verdict == "failed"
 
 
 class UnionGroup:
@@ -171,10 +184,20 @@ class AsyncDispatcher:
     the previous. The issue->collect interval spans the NEXT batch's
     host-side forming — that overlap is the point — so the honest
     per-dispatch cost recorded is the time actually spent BLOCKING on
-    materialization (``wait_s``), not the interval."""
+    materialization (``wait_s``), not the interval.
 
-    def __init__(self):
+    Completed items are 5-tuples ``(meta, rows, wait_s, window_s,
+    error)``: ``error`` is None on success, else a human-readable
+    reason and ``rows`` is None — the engine fails that batch with
+    explicit 'failed' verdicts and keeps serving (ISSUE 13). With
+    ``timeout_s`` set (ServeConfig.dispatch_timeout_ms), the blocking
+    materialization runs on a watchdog thread and a batch not
+    materialized within the bound is failed the same way — a wedged
+    device dispatch costs one batch, never the pump thread."""
+
+    def __init__(self, timeout_s: Optional[float] = None):
         self._inflight = None  # (device result, meta, t_issue)
+        self._timeout = timeout_s
 
     @property
     def busy(self) -> bool:
@@ -183,11 +206,24 @@ class AsyncDispatcher:
     def issue(self, group: UnionGroup, qb: np.ndarray, bucket: int,
               meta) -> list:
         """Dispatch (async), then materialize the PREVIOUS in-flight
-        batch. Returns [(meta, out_rows, wait_s, window_s)] for every
-        batch completed by this call (0 or 1)."""
+        batch. Returns the completed 5-tuples (0, 1 or — when this
+        batch's dispatch itself raises — 2 items)."""
         prev = self._inflight
-        self._inflight = (group.dispatch(qb, bucket), meta,
-                          time.perf_counter())
+        try:
+            # serve_dispatch fault seam: an injected dispatch
+            # exception at batch K (deliberately NOT armed inside
+            # UnionGroup.dispatch — warm-up calls must never fault).
+            if faults.arrive("serve_dispatch"):
+                raise RuntimeError(
+                    "injected fault at seam 'serve_dispatch'")
+            dev = group.dispatch(qb, bucket)
+        except Exception as e:
+            self._inflight = None
+            out = self._materialize(prev)
+            out.append((meta, None, 0.0, 0.0,
+                        f"dispatch raised {type(e).__name__}: {e}"))
+            return out
+        self._inflight = (dev, meta, time.perf_counter())
         return self._materialize(prev)
 
     def drain(self) -> list:
@@ -195,15 +231,49 @@ class AsyncDispatcher:
         self._inflight = None
         return out
 
-    @staticmethod
-    def _materialize(item) -> list:
+    def _materialize(self, item) -> list:
         if item is None:
             return []
         dev, meta, t_issue = item
         t0 = time.perf_counter()
-        rows = np.asarray(dev)
+        if self._timeout is None:
+            try:
+                rows, err = np.asarray(dev), None
+            except Exception as e:
+                rows, err = None, (f"materialization raised "
+                                   f"{type(e).__name__}: {e}")
+        else:
+            # Bounded wait: the blocking np.asarray runs on a daemon
+            # watchdog thread. On timeout the batch is FAILED and the
+            # pump moves on; the orphaned thread finishes (or never
+            # does — a truly wedged runtime) without holding anything
+            # the engine needs. The serve_stall fault seam fires in
+            # the waiting thread, modeling exactly that wedge.
+            box: dict = {}
+
+            def _pull():
+                try:
+                    faults.serve_stall()
+                    box["rows"] = np.asarray(dev)
+                except Exception as e:  # pragma: no cover - rare path
+                    box["err"] = (f"materialization raised "
+                                  f"{type(e).__name__}: {e}")
+
+            th = threading.Thread(target=_pull, daemon=True,
+                                  name="dpsvm-dispatch-watchdog")
+            th.start()
+            th.join(self._timeout)
+            if th.is_alive():
+                rows, err = None, (
+                    f"dispatch watchdog: batch not materialized within "
+                    f"{self._timeout * 1e3:.0f} ms; failing the batch "
+                    "and serving on")
+            elif "err" in box:
+                rows, err = None, box["err"]
+            else:
+                rows, err = box["rows"], None
         t1 = time.perf_counter()
-        return [(meta, rows, t1 - t0, t1 - t_issue)]
+        return [(meta, rows, t1 - t0, t1 - t_issue, err)]
 
 
 class ServingEngine:
@@ -229,7 +299,9 @@ class ServingEngine:
         self.registry = ModelRegistry(prepare=self._prepare_entry,
                                       on_swap=self._on_swap)
         self._groups: dict = {}
-        self._dispatcher = AsyncDispatcher()
+        self._dispatcher = AsyncDispatcher(
+            timeout_s=(None if config.dispatch_timeout_ms is None
+                       else config.dispatch_timeout_ms / 1e3))
         self._done: dict = {}
         self._next_ticket = 0
         self._dispatches = 0
@@ -255,6 +327,10 @@ class ServingEngine:
         self.coalesced = self.metrics.counter(
             "serve.coalesced_dispatches_total")
         self.compiles = self.metrics.counter("serve.compiles_total")
+        self.dispatch_failures = self.metrics.counter(
+            "serve.dispatch_failures_total")
+        self.watchdog_trips = self.metrics.counter(
+            "serve.watchdog_trips_total")
         self._per_model: dict = {}
 
         # Compile accounting, scoped to THIS engine's own dispatches
@@ -303,6 +379,49 @@ class ServingEngine:
             self.exporter = openmetrics.MetricsExporter(
                 _render, port=config.metrics_port,
                 host=config.metrics_host)
+
+        # Crash recovery (ISSUE 13): replay the registry journal, then
+        # attach it. Replay runs BEFORE attach so a crash mid-replay
+        # can never rewrite the durable record with a partial subset;
+        # each journaled model re-registers through the normal
+        # validate-stage-warm path at its exact pre-crash version, so
+        # the rehydrated engine serves decisions identical to the one
+        # that died. A missing/corrupt journaled model file fails
+        # construction LOUDLY (ModelLoadError) — silently coming up
+        # with a hole in the model set is the failure mode the journal
+        # exists to prevent.
+        self.journal = None
+        self._rehydrated: list = []
+        if config.journal_path:
+            try:
+                journal = RegistryJournal(config.journal_path)
+                entries = journal.load()
+                for name in sorted(entries):
+                    rec = entries[name]
+                    self.registry.restore(name, rec["source"],
+                                          int(rec["version"]))
+                    self._model_metrics(name)
+                    self._rehydrated.append(name)
+                if self._rehydrated:
+                    self._obs.event(
+                        "rehydrate", models=list(self._rehydrated),
+                        versions={n: int(entries[n]["version"])
+                                  for n in self._rehydrated})
+                self.registry.attach_journal(journal)
+                self.journal = journal
+            except BaseException:
+                # Failed construction: close() is unreachable on a
+                # half-built engine, so tear down the already-started
+                # pieces here — a leaked exporter keeps the metrics
+                # port bound ('Address already in use' on every
+                # construction retry) and a leaked sink/run log
+                # accumulates per attempt.
+                self._closing = True
+                if self.exporter is not None:
+                    self.exporter.close()
+                compilelog.remove_sink(self._compile_sink)
+                self._obs.finish(aborted=True)
+                raise
 
     # ------------------------------------------------------ registration
     def _members_for(self, key, extra=None) -> list:
@@ -385,6 +504,8 @@ class ServingEngine:
                 "expired": self.metrics.counter(
                     f"serve.expired.{name}"),
                 "swaps": self.metrics.counter(f"serve.swaps.{name}"),
+                "failures": self.metrics.counter(
+                    f"serve.dispatch_failures.{name}"),
                 "latency": self.metrics.histogram(
                     f"serve.request_seconds.{name}"),
             }
@@ -536,29 +657,38 @@ class ServingEngine:
                 qb = np.zeros((bucket, group.d), np.float32)
                 qb[:rows] = merged
             completed += self._issue(group, qb, bucket, batch, rows,
-                                     segments=None)
+                                     chain=None, final=True)
         else:
             # One oversized request (form() guarantees multi-request
             # batches fit the top bucket): loop the top bucket,
             # assembling segments into one output before completion.
-            segments = []
+            # The chain dict carries the segment parts AND the dead
+            # flag a failed segment sets, so one failed dispatch fails
+            # the whole request exactly once — later segments of a
+            # dead chain complete as no-ops.
+            chain = {"parts": [], "total": rows, "dead": False}
             s = 0
             while s < rows:
+                if chain["dead"]:
+                    # An already-completed segment failed the chain
+                    # (raise or watchdog): the request is already
+                    # 'failed' — dispatching the remaining segments
+                    # would be pure wasted device work.
+                    break
                 take = min(rows - s, top)
                 qb = merged[s:s + take]
                 if take != top:
                     qp = np.zeros((top, group.d), np.float32)
                     qp[:take] = qb
                     qb = qp
-                last = s + take >= rows
                 completed += self._issue(
-                    group, qb, top, batch if last else None, take,
-                    segments=(segments, s, rows))
+                    group, qb, top, batch, take,
+                    chain=chain, final=s + take >= rows)
                 s += take
         return completed
 
     def _issue(self, group, qb, bucket, batch, used_rows,
-               segments) -> int:
+               chain, final) -> int:
         # Counters advance BEFORE the dispatch and ride the meta as a
         # snapshot: the chunk record for THIS batch must carry ITS OWN
         # cumulative (pairs, dispatch) — the completion callback fires
@@ -566,7 +696,7 @@ class ServingEngine:
         # already describe the next batch.
         self._dispatches += 1
         self._rows_total += used_rows
-        meta = (group, batch, used_rows, segments,
+        meta = (group, batch, used_rows, chain, final,
                 self._rows_total, self._dispatches)
         self._tl.in_dispatch = True
         try:
@@ -574,8 +704,7 @@ class ServingEngine:
         finally:
             self._tl.in_dispatch = False
         self.batch_occupancy.observe(used_rows / bucket)
-        if batch is not None and \
-                len({r.entry.name for r in batch}) > 1:
+        if final and len({r.entry.name for r in batch}) > 1:
             self.coalesced.add(1)
         completed = 0
         for item in items:
@@ -583,22 +712,26 @@ class ServingEngine:
         return completed
 
     def _complete_batch(self, item) -> int:
-        (group, batch, used_rows, segments, rows_cum, dispatch_no), \
-            out, wait_s, window_s = item
+        (group, batch, used_rows, chain, final, rows_cum,
+         dispatch_no), out, wait_s, window_s, err = item
         self.dispatch_seconds.observe(wait_s)
         self._obs.chunk(pairs=rows_cum, b_hi=0.0, b_lo=0.0,
                         device_seconds=wait_s,
                         dispatch=dispatch_no,
                         rows=int(used_rows), window_seconds=
-                        round(window_s, 6))
-        if segments is not None:
-            seg_list, offset, total_rows = segments
-            seg_list.append(out[:used_rows])
-            if batch is None:  # not the final segment yet
+                        round(window_s, 6),
+                        **({"failed": True} if err is not None else {}))
+        if err is not None:
+            return self._fail_batch(batch, chain, err, dispatch_no)
+        if chain is not None:
+            if chain["dead"]:  # an earlier segment already failed it
                 return 0
-            out = np.concatenate(seg_list)
-            used_rows = total_rows
-        if batch is None:
+            chain["parts"].append(out[:used_rows])
+            if not final:
+                return 0
+            out = np.concatenate(chain["parts"])
+            used_rows = chain["total"]
+        elif not final:  # pragma: no cover - unsegmented is always final
             return 0
         now = time.perf_counter()
         lo = 0
@@ -608,6 +741,34 @@ class ServingEngine:
             if req.entry.f64_cols.size:
                 _overwrite_f64(req.entry, req.rows, dec)
             self._finish_served(req, dec, now)
+        return len(batch)
+
+    def _fail_batch(self, batch, chain, err: str,
+                    dispatch_no: int) -> int:
+        """A dispatch raised or the watchdog tripped: complete every
+        request of the batch with an explicit 'failed' verdict and the
+        per-model counters — the engine itself keeps serving (the
+        wedged dispatch cost one batch, not the pump thread)."""
+        if chain is not None:
+            if chain["dead"]:
+                return 0  # the chain already failed once
+            chain["dead"] = True
+        self.dispatch_failures.add(1)
+        if "watchdog" in err:
+            self.watchdog_trips.add(1)
+        names = sorted({r.entry.name for r in batch})
+        self._obs.event("dispatch_failed", models=names,
+                        error=err[:200], dispatch=dispatch_no,
+                        watchdog=bool("watchdog" in err))
+        now = time.perf_counter()
+        for req in batch:
+            mm = self._model_metrics(req.entry.name)
+            mm["failures"].add(1)
+            self._done[req.ticket] = ServeResult(
+                ticket=req.ticket, model=req.entry.name,
+                version=req.entry.version, decision=None,
+                verdict="failed", latency_s=now - req.t_submit,
+                entry=req.entry)
         return len(batch)
 
     # -------------------------------------------------------- completion
@@ -670,6 +831,7 @@ class ServingEngine:
                 "deadline_misses": mm["misses"].value,
                 "expired": mm["expired"].value,
                 "swaps": mm["swaps"].value,
+                "dispatch_failures": mm["failures"].value,
                 "request_seconds": mm["latency"].snapshot(),
             }
         return {
@@ -684,6 +846,9 @@ class ServingEngine:
             "deadline_misses": self.deadline_misses.value,
             "expired": self.expired.value,
             "hot_swaps": self.hot_swaps.value,
+            "dispatch_failures": self.dispatch_failures.value,
+            "watchdog_trips": self.watchdog_trips.value,
+            "rehydrated_models": list(self._rehydrated),
             "coalesced_dispatches": self.coalesced.value,
             "compiles": self.compiles.value,
             "batch_occupancy": self.batch_occupancy.snapshot(),
@@ -702,6 +867,7 @@ class ServingEngine:
         depth = self.scheduler.depth_by_model()
         versions = {e.name: e.version for e in self.registry.entries()}
         req_s, row_s, miss_s, exp_s, swap_s = [], [], [], [], []
+        fail_s = []
         lat_samples = []
         for name, mm in sorted(self._per_model.items()):
             lb = {"model": name}
@@ -710,6 +876,7 @@ class ServingEngine:
             miss_s.append(("_total", lb, mm["misses"].value))
             exp_s.append(("_total", lb, mm["expired"].value))
             swap_s.append(("_total", lb, mm["swaps"].value))
+            fail_s.append(("_total", lb, mm["failures"].value))
             if len(mm["latency"]):
                 lat_samples.extend(om.summary_samples(
                     mm["latency"], labels=lb))
@@ -726,6 +893,14 @@ class ServingEngine:
                       "already passed)", exp_s),
             om.metric("serving_hot_swaps", "counter",
                       "zero-downtime model version swaps", swap_s),
+            om.metric("serving_dispatch_failures", "counter",
+                      "requests failed by a raising or watchdog-"
+                      "bounded device dispatch (explicit 'failed' "
+                      "verdicts, engine kept serving)", fail_s),
+            om.counter("serving_watchdog_trips",
+                       "dispatches failed by the dispatch watchdog "
+                       "(ServeConfig.dispatch_timeout_ms)",
+                       self.watchdog_trips.value),
             om.gauge("serving_model_version",
                      "live registered version per model",
                      [({"model": n}, v)

@@ -27,6 +27,9 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import json
+import os
+import tempfile
 import threading
 from typing import Callable, Optional, Union
 
@@ -133,6 +136,13 @@ def load_model_file(path: str) -> Union[MulticlassSVM, SVMModel]:
     loading problem — truncated zip, missing keys, zlib corruption in a
     member, wrong model_type — raises :class:`ModelLoadError` so the
     registry can refuse the file without disturbing the live version."""
+    from dpsvm_tpu.testing import faults
+
+    # swap_corrupt fault seam: when armed, this load reads a
+    # deterministically corrupted copy of the file, so the REAL
+    # validate/reject path below is what the chaos legs exercise —
+    # never a mocked error. Identity when disarmed.
+    path = faults.maybe_corrupt_model(path)
     try:
         if path.endswith(".npz"):
             z = np.load(path, allow_pickle=False)
@@ -195,6 +205,69 @@ def build_loaded(name: str, source, version: int) -> LoadedModel:
                        f64_cols=f64_cols)
 
 
+class RegistryJournal:
+    """Durable record of the live model set (ISSUE 13 crash recovery).
+
+    The registry itself is process memory — a crashed or restarted
+    engine comes back EMPTY, which a millions-of-users front door
+    cannot afford. The journal closes that gap with the minimum
+    durable state: a JSON file holding {name -> model path + version},
+    ATOMICALLY REWRITTEN (tmp + rename, the checkpoint discipline) on
+    every register/swap/unregister, so it is always a complete,
+    parseable snapshot of the live set — a kill at any instant leaves
+    either the old snapshot or the new one, never a torn file.
+
+    Replay (:meth:`ServingEngine` construction) re-registers each
+    journaled (name, path) through the NORMAL validate-stage-warm
+    path, seeding version counters so the rehydrated engine serves the
+    exact pre-crash versions. Only file-backed models journal:
+    in-memory model objects cannot be replayed, so they are recorded
+    nowhere (the registry's entries() still serves them live)."""
+
+    FORMAT_VERSION = 1
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, models: dict) -> None:
+        """Atomically persist {name: {"source": path, "version": v}}."""
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".journal.tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump({"format_version": self.FORMAT_VERSION,
+                           "models": models}, fh, indent=1)
+            os.replace(tmp, self.path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def load(self) -> dict:
+        """The journaled {name: {"source", "version"}} map; {} when the
+        journal does not exist yet. A corrupt/unreadable journal fails
+        LOUDLY — silently serving an empty model set after a crash is
+        exactly the failure mode the journal exists to prevent."""
+        if not os.path.exists(self.path):
+            return {}
+        try:
+            with open(self.path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ValueError(
+                f"registry journal {self.path!r} is unreadable "
+                f"({type(e).__name__}: {e}); refusing to start with a "
+                "silently empty model set — repair or remove the "
+                "journal explicitly") from e
+        if int(doc.get("format_version", -1)) != self.FORMAT_VERSION:
+            raise ValueError(
+                f"registry journal {self.path!r} has format_version "
+                f"{doc.get('format_version')!r}; this build writes "
+                f"{self.FORMAT_VERSION}")
+        return dict(doc.get("models", {}))
+
+
 class ModelRegistry:
     """name -> live LoadedModel, with atomic replacement.
 
@@ -205,12 +278,81 @@ class ModelRegistry:
     registry is untouched."""
 
     def __init__(self, prepare: Optional[Callable] = None,
-                 on_swap: Optional[Callable] = None):
+                 on_swap: Optional[Callable] = None,
+                 journal: Optional[RegistryJournal] = None):
         self._lock = threading.Lock()
         self._live: dict = {}
         self._versions: dict = {}
         self._prepare = prepare
         self._on_swap = on_swap
+        self._journal = journal
+        # Journal writes run OUTSIDE self._lock (disk I/O must never
+        # stall request routing, which takes self._lock on every
+        # submit via get()). Publish order is preserved by snapshotting
+        # under self._lock with a sequence number and skipping any
+        # snapshot older than the last one written.
+        self._journal_lock = threading.Lock()
+        self._journal_seq = 0
+        self._journal_written_seq = 0
+
+    def attach_journal(self, journal: RegistryJournal) -> None:
+        """Attach (and immediately snapshot to) a journal. The engine
+        attaches AFTER replay — a journal attached during replay would
+        be rewritten with each partially replayed subset, and a crash
+        mid-replay would then SHRINK the durable record. An unwritable
+        journal raises HERE (engine construction, no traffic yet):
+        discovering it at the post-crash rehydrate would be too late."""
+        with self._lock:
+            self._journal = journal
+            snap = self._journal_snapshot_locked()
+        self._journal_publish(snap, strict=True)
+
+    def _journal_snapshot_locked(self):
+        """Snapshot the live set for the journal (caller holds
+        self._lock; cheap — pure dict work, no I/O). Only file-backed
+        entries are recorded: an in-memory object registration cannot
+        be replayed, so journaling it would turn the next rehydrate
+        into a hard error for state that was never durable to begin
+        with. Returns (seq, payload, journal) or None."""
+        if self._journal is None:
+            return None
+        self._journal_seq += 1
+        return (self._journal_seq, {
+            e.name: {"source": e.source, "version": e.version}
+            for e in self._live.values() if e.source != "<object>"},
+            self._journal)
+
+    def _journal_publish(self, snap, strict: bool = False) -> None:
+        """Write a snapshot taken by _journal_snapshot_locked to disk,
+        outside the registry lock. A snapshot that lost the race to a
+        newer one is dropped (the journal is a whole-set snapshot, so
+        the newest write is always the full current truth). A write
+        failure must NOT fail the registration that produced it — the
+        in-memory registry is the serving truth and the flip has
+        already happened — so it warns LOUDLY instead (a rotting
+        journal means a post-crash rehydrate serves a stale set);
+        ``strict`` (attach time) re-raises."""
+        if snap is None:
+            return
+        seq, payload, journal = snap
+        with self._journal_lock:
+            if seq <= self._journal_written_seq:
+                return
+            try:
+                journal.write(payload)
+                self._journal_written_seq = seq
+            except Exception as e:
+                if strict:
+                    raise
+                import warnings
+
+                warnings.warn(
+                    f"registry journal write to {journal.path!r} "
+                    f"FAILED ({type(e).__name__}: {e}); the live "
+                    "model set is SERVING but NOT DURABLE — a crash "
+                    "now rehydrates the previous journaled set. Fix "
+                    "the journal path/disk and trigger any "
+                    "register/swap to re-snapshot.", stacklevel=3)
 
     def register(self, name: str, source) -> LoadedModel:
         """Load + validate + prepare `source`, then atomically publish
@@ -231,9 +373,22 @@ class ModelRegistry:
             prev = self._live.get(name)
             self._live[name] = entry
             self._versions[name] = version
+            snap = self._journal_snapshot_locked()
+        self._journal_publish(snap)
         if prev is not None and self._on_swap is not None:
             self._on_swap(prev, entry)
         return entry
+
+    def restore(self, name: str, source: str, version: int) -> LoadedModel:
+        """Journal-replay registration: register `source` as `name`
+        pinned at exactly `version` (the pre-crash version), through
+        the same load/validate/prepare path as a live register. The
+        version counter is seeded so monotonicity continues from the
+        journaled history, not from 1."""
+        with self._lock:
+            self._versions[name] = max(self._versions.get(name, 0),
+                                       int(version) - 1)
+        return self.register(name, source)
 
     def swap(self, name: str, source) -> LoadedModel:
         """Hot-swap an EXISTING name to a new version (register with a
@@ -262,9 +417,12 @@ class ModelRegistry:
     def unregister(self, name: str) -> LoadedModel:
         with self._lock:
             try:
-                return self._live.pop(name)
+                entry = self._live.pop(name)
             except KeyError:
                 raise KeyError(f"no model {name!r} registered") from None
+            snap = self._journal_snapshot_locked()
+        self._journal_publish(snap)
+        return entry
 
     def names(self) -> list:
         with self._lock:

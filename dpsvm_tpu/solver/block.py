@@ -187,6 +187,50 @@ def pipeline_pays(n_rows: int, d: int) -> bool:
     return False
 
 
+#: the SAFE configuration (ISSUE 13 graceful degradation): knob ->
+#: safe value. f32 storage and the stock engines — every fused /
+#: pipelined / reduced-precision accelerator drops out, because those
+#: are exactly the knobs that can amplify a hostile coefficient scale
+#: into a non-finite carried gradient (the bf16 guards bound the
+#: NORMAL case; the demotion path is the backstop for the tail).
+_SAFE_KNOBS = (
+    ("dtype", "float32"),
+    ("bf16_gram", False),
+    ("fused_round", False),
+    ("fused_fold", False),
+    ("pipeline_rounds", False),
+)
+
+
+def demote_to_safe(config):
+    """(safe_config, dropped_knobs) for the graceful-degradation path
+    (solver/smo.py _solve_with_degradation): the same config with
+    every risky knob at its safe value, or ``(None, ())`` when the
+    config is ALREADY safe — then a non-finite trajectory is a real
+    numerics bug the caller must propagate, not retry.
+
+    A knob counts as DROPPED only when it was truthy; None auto-gates
+    are still pinned to False in the demoted config (a measured-pays
+    profile must not silently re-enable a fused path on the safe
+    rerun) but do not by themselves make a config "unsafe"."""
+    changes = {}
+    dropped = []
+    for knob, safe in _SAFE_KNOBS:
+        cur = getattr(config, knob)
+        if knob == "dtype":
+            if cur != safe:
+                changes[knob] = safe
+                dropped.append(f"dtype={cur}")
+        else:
+            if cur is not safe:
+                changes[knob] = safe
+            if cur:
+                dropped.append(knob)
+    if not dropped:
+        return None, ()
+    return config.replace(**changes), tuple(dropped)
+
+
 class PipelinedCand(NamedTuple):
     """The pipelined engine's loop-carried prefetch: the NEXT round's
     working set plus everything about it that does not depend on the

@@ -58,8 +58,10 @@ from dpsvm_tpu.solver.block import dispatch_subproblem, select_block
 from dpsvm_tpu.solver.cache import (CacheState, init_cache, probe_rows,
                                     refresh_rows)
 from dpsvm_tpu.solver.result import SolveResult
-from dpsvm_tpu.solver.smo import (_BUDGET_EPS, maybe_kahan,
+from dpsvm_tpu.solver.smo import (_BUDGET_EPS, check_obs_finite,
+                                  drain_pending_obs_events, maybe_kahan,
                                   run_with_fault_retry)
+from dpsvm_tpu.testing import faults
 
 
 class OocState(NamedTuple):
@@ -212,6 +214,8 @@ def solve_ooc(
     config: SVMConfig,
     callback=None,
     device: Optional[jax.Device] = None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
     alpha_init=None,
     f_init=None,
     pad_to: Optional[int] = None,
@@ -220,17 +224,35 @@ def solve_ooc(
     result contract as solver/smo.solve; `x` may be any array-like the
     host can slice row-blocks from — np.ndarray or np.memmap.
 
-    Checkpointing is not implemented for this driver (the in-core
-    engines own that path); fault retries ride the shared
-    run_with_fault_retry machinery restarting from scratch."""
+    Checkpoint/resume (ISSUE 13): with ``checkpoint_path`` and
+    ``config.checkpoint_every > 0``, the FULL driver carry — alpha,
+    raw f AND the compensated f_err lanes, pair/round counters,
+    extrema — is written atomically at round boundaries as a
+    FORMAT_VERSION 2 checkpoint (utils/checkpoint.py). ``resume=True``
+    restores it; because raw f and f_err are both restored, a cache-off
+    resume reproduces the uninterrupted trajectory BITWISE from the
+    restore point (tests/test_ooc.py pins it, memmap and padded tails
+    included). The block kernel-row cache is deliberately NOT
+    checkpointed — an (L, n) HBM cache would dwarf the O(n) state it
+    rides on — so a resumed run restarts it cold (exact, just
+    re-streamed; ``stats['cache_cold_restart']`` records it), which
+    also means cache-ON resumes are exact-but-not-bitwise (a cold
+    cache changes which rounds take the all-hit path).
+
+    Fault retries ride the shared run_with_fault_retry machinery and
+    resume from the last checkpoint this run wrote (else restart from
+    scratch) — host-scale ooc runs are exactly the multi-hour jobs
+    that get preempted."""
     from dpsvm_tpu.solver.smo import _precision_ctx
 
-    def attempt(cfg_k, _res, _k):
+    def attempt(cfg_k, res_k, _k):
         return _solve_ooc_impl(x, y, cfg_k, callback, device,
+                               checkpoint_path, res_k,
                                alpha_init, f_init, pad_to)
 
     with _precision_ctx(config):
-        return run_with_fault_retry(config, None, False, attempt)
+        return run_with_fault_retry(config, checkpoint_path, resume,
+                                    attempt)
 
 
 def _tile_host(x, s: int, t: int, n: int, d: int):
@@ -245,8 +267,20 @@ def _tile_host(x, s: int, t: int, n: int, d: int):
     return np.ascontiguousarray(blk)
 
 
+def _put_tile(x, s: int, t: int, n: int, d: int, dtype, device):
+    """One round-stream tile's host->HBM upload, with the
+    ``ooc_tile_put`` fault seam in front: an injected transient here
+    models the H2D DMA faulting mid-stream (the tunneled-runtime
+    preemption shape), which the retry wrapper recovers from the last
+    checkpoint."""
+    faults.device_fault("ooc_tile_put", f"tile rows [{s}, {s + t})")
+    return jax.device_put(jnp.asarray(_tile_host(x, s, t, n, d), dtype),
+                          device)
+
+
 def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
-                    alpha_init, f_init, pad_to) -> SolveResult:
+                    checkpoint_path, resume, alpha_init, f_init,
+                    pad_to) -> SolveResult:
     t_entry = time.perf_counter()
     y_np = np.asarray(y, np.int32)
     n, d = x.shape
@@ -302,6 +336,7 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
                         "selection": config.selection, "ooc": True,
                         "ooc_tile_rows": tile, "ooc_tiles": tiles,
                         "ooc_cache_lines": lines})
+    drain_pending_obs_events(obs)
 
     with obs.span("solver/ooc_setup_stream"):
         xsq_tiles = []
@@ -327,6 +362,49 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
     f = jax.device_put(f, device)
     alpha = jax.device_put(alpha, device)
     f_err = jnp.zeros_like(f) if config.compensated else None
+
+    # ---- checkpoint resume (ISSUE 13): restore the FULL v2 carry —
+    # alpha, raw f and the compensated f_err lanes, pair/round
+    # counters. Padded lanes re-initialize exactly as a fresh start
+    # does (-y_p / 0): they are masked out of every selection, and the
+    # padded-tail bit-identity pin proves they never steer the
+    # real-row trajectory. A checkpoint resume takes precedence over
+    # alpha_init/f_init (the solve() contract).
+    start_pairs = 0
+    start_rounds = 0
+    resumed_from = None
+    if resume:
+        from dpsvm_tpu.utils.checkpoint import resume_state
+
+        st = resume_state(checkpoint_path, config, n)
+        if st is not None:
+            a_pad = np.zeros((n_pad,), np.float32)
+            a_pad[:n] = st.alpha
+            f_pad = np.asarray(-y_p, np.float32)
+            f_pad[:n] = st.f
+            alpha = jax.device_put(jnp.asarray(a_pad), device)
+            f = jax.device_put(jnp.asarray(f_pad), device)
+            if f_err is not None:
+                e_pad = np.zeros((n_pad,), np.float32)
+                if st.f_err is not None:
+                    # v2 ooc checkpoints carry the raw Kahan residual;
+                    # restoring it is what makes the resumed
+                    # compensated trajectory BITWISE equal to the
+                    # uninterrupted one (v1 files restart it at zero —
+                    # exact, but a different rounding path).
+                    e_pad[:n] = st.f_err
+                f_err = jax.device_put(jnp.asarray(e_pad), device)
+            start_pairs = st.iteration
+            start_rounds = st.rounds
+            resumed_from = st.iteration
+            obs.event("resume", iteration=start_pairs,
+                      rounds=start_rounds,
+                      format_version=st.format_version,
+                      cache_cold_restart=bool(use_cache))
+
+    # The block kernel-row cache restarts COLD on resume (an (L, n)
+    # HBM cache is not worth persisting next to the O(n) carry); the
+    # first post-resume rounds re-stream what it held.
     cache = init_cache(lines, n_pad) if use_cache else None
     cache = jax.device_put(cache, device) if use_cache else None
 
@@ -342,8 +420,11 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
     phase_seconds = {"setup": time.perf_counter() - t_entry,
                      "solve": 0.0, "observe": 0.0, "finalize": 0.0}
 
-    pairs = 0
-    rounds = 0
+    from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer
+
+    ckpt = PeriodicCheckpointer(checkpoint_path, config, start_pairs)
+    pairs = start_pairs
+    rounds = start_rounds
     dispatches = 0
     tiles_streamed = 0
     bytes_h2d = 0
@@ -371,11 +452,19 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
         try:
             t0 = time.perf_counter()
             dispatches += 1
+            faults.device_fault("dispatch", f"ooc round {rounds + 1}")
             w_d, ok_d, bh_d, bl_d, hit_d, slot_d = _ooc_select(
                 f, f_err, alpha, y_dev, valid_dev, keys_arg,
                 c=c, q=q, selection=config.selection)
             b_hi = float(np.asarray(bh_d))
             b_lo = float(np.asarray(bl_d))
+            # Non-finite sentinel (free: the extrema are already
+            # materialized). A NaN gap would otherwise read as
+            # "converged" (NaN comparisons are False) and return a
+            # silently corrupt model — the one outcome no fault may
+            # produce.
+            b_hi, b_lo = faults.poison_obs(b_hi, b_lo)
+            check_obs_finite(b_hi, b_lo, pairs, "ooc")
             converged = not (b_lo > b_hi + 2.0 * eps_run)
             if converged or pairs >= max_iter:
                 round_dt = time.perf_counter() - t0
@@ -430,15 +519,11 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
                 f_tiles = []
                 err_tiles = [] if f_err is not None else None
                 dots = []
-                nxt = jax.device_put(
-                    jnp.asarray(_tile_host(x, 0, tile, n, d), dtype),
-                    device)
+                nxt = _put_tile(x, 0, tile, n, d, dtype, device)
                 for i in range(tiles):
                     cur, nxt = nxt, (
-                        jax.device_put(
-                            jnp.asarray(_tile_host(x, (i + 1) * tile,
-                                                   tile, n, d), dtype),
-                            device)
+                        _put_tile(x, (i + 1) * tile, tile, n, d,
+                                  dtype, device)
                         if i + 1 < tiles else None)
                     dispatches += 1
                     s = i * tile
@@ -518,6 +603,18 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
             assert_finite_state(OocState(alpha, f, b_hi, b_lo, pairs,
                                          rounds, cache_hits),
                                 pairs, "ooc")
+        if ckpt.due(pairs) or (abort and ckpt.active):
+            # Round-boundary checkpoint, gated BEFORE any np.asarray
+            # materialization (the smo.py discipline). The v2 payload
+            # carries the RAW f plus the f_err lanes — not the
+            # effective f - f_err the in-core v1 writers save —
+            # because the compensated resume must continue the exact
+            # Kahan accumulation bits, not restart the residual.
+            ckpt.save(pairs, np.asarray(alpha)[:n], np.asarray(f)[:n],
+                      b_hi, b_lo, force=True,
+                      f_err=(np.asarray(f_err)[:n]
+                             if f_err is not None else None),
+                      rounds=rounds)
         if config.verbose:
             print(f"[ooc] round={rounds} pairs={pairs} "
                   f"gap={b_lo - b_hi:.6f} tiles={round_tiles} "
@@ -552,6 +649,13 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
         "cache_evictions": cache_evictions,
         "phase_seconds": phase_seconds,
     }
+    if resumed_from is not None:
+        stats["resumed_from"] = resumed_from
+        # The block cache is never checkpointed: a resumed cache-on
+        # run restarted it cold (exact, but the first post-resume
+        # rounds re-stream what it held — and all-hit round placement
+        # differs from the uninterrupted run's).
+        stats["cache_cold_restart"] = bool(use_cache)
     if obs.live:
         stats["obs_run_id"] = obs.run_id
         stats["obs_runlog"] = obs.path

@@ -35,6 +35,7 @@ from dpsvm_tpu.ops.select import (c_of, low_mask, refresh_extrema_host,
                                   select_working_set_nu, split_c, up_mask)
 from dpsvm_tpu.solver.cache import CacheState, init_cache, lookup_one, lookup_pair
 from dpsvm_tpu.solver.result import SolveResult
+from dpsvm_tpu.testing import faults
 
 
 class SMOState(NamedTuple):
@@ -719,15 +720,166 @@ def run_with_fault_retry(config: SVMConfig, checkpoint_path, resume,
             return attempt_fn(cfg_k, res_k, k)
         except jax.errors.JaxRuntimeError as e:
             if k == attempts - 1 or not _is_transient_fault(e):
+                # Queued cross-attempt events will never be drained
+                # now — clear them so they cannot leak into the run
+                # log of an unrelated later solve on this thread.
+                clear_pending_obs_events()
                 raise
             nxt = "from checkpoint" if _resume_now() else "from scratch"
             print(f"[fault-retry] transient device fault "
                   f"({str(e)[:160]!r}); retry {k + 1}/{attempts - 1} {nxt}",
                   file=_sys.stderr, flush=True)
+            # Runlog trail (ISSUE 13 obs satellite): the faulted
+            # attempt's run log died with the exception (RunObs.__del__
+            # finishes it aborted=True); the NEXT attempt's run drains
+            # these into `fault`/`retry` event records, so the retry
+            # story is readable from the runlog alone.
+            queue_pending_obs_event("fault", error=str(e)[:200],
+                                    attempt=k, transient=True)
+            queue_pending_obs_event("retry", attempt=k + 1,
+                                    resume=bool(_resume_now()))
             jax.clear_caches()
             if _RETRY_BACKOFF_S:
                 time.sleep(_RETRY_BACKOFF_S[min(k, len(_RETRY_BACKOFF_S) - 1)])
+        except BaseException:
+            # Any other terminal failure (NonFiniteTrajectory on a
+            # safe config, validation errors, ...) exits this wrapper
+            # too: same stale-event hygiene.
+            clear_pending_obs_events()
+            raise
     raise AssertionError("unreachable")
+
+
+class NonFiniteTrajectory(FloatingPointError):
+    """The chunk-boundary host observation read a non-finite optimality
+    gap — the carried gradient has blown up (bf16 storage at hostile
+    coefficient scale, inf features, absurd gamma/C). Raised by
+    :func:`check_obs_finite` INSTEAD of letting the loop continue: NaN
+    comparisons are False, so ``b_lo > b_hi + 2*eps`` would read
+    "converged" and return a silently corrupt model. solve() catches
+    this once and demotes to the safe configuration
+    (solver/block.py demote_to_safe), restoring the last checkpoint
+    when one exists."""
+
+
+def check_obs_finite(b_hi: float, b_lo: float, it: int,
+                     backend: str) -> None:
+    """Free non-finite sentinel on the chunk-boundary observation
+    (``b_hi``/``b_lo`` are already materialized host scalars).
+
+    NaN in either extremum is corruption. For infinities, only the
+    IMPOSSIBLE signs trip it: ops/select.py computes b_hi = min f over
+    I_up (masked entries +inf) and b_lo = max f over I_low (masked
+    -inf), so a legitimately EMPTY side reads b_hi=+inf / b_lo=-inf
+    (and the stopping test correctly reads converged) — but b_hi=-inf
+    or b_lo=+inf can only come from inf entries in f winning the
+    min/max, and would otherwise hold the gap open forever."""
+    if (b_hi != b_hi or b_lo != b_lo  # NaN
+            or b_hi == float("-inf") or b_lo == float("inf")):
+        raise NonFiniteTrajectory(
+            f"[{backend}] non-finite optimality extrema at iteration "
+            f"{it}: b_hi={b_hi!r} b_lo={b_lo!r} — the carried gradient "
+            "has blown up; demoting to the safe configuration (f32 "
+            "storage, stock engine) or failing loudly")
+
+
+# Cross-attempt obs handoff: a faulted/demoted attempt's run log is
+# already finished (aborted) when the decision to retry/demote is
+# made, so the wrapper queues the event here and the NEXT attempt's
+# impl drains it into its own run log right after run_obs(). Thread-
+# local: concurrent solves (serving admin threads, tests) must not
+# cross-pollinate each other's retry stories.
+import threading as _threading  # noqa: E402  (module-scope by design)
+
+_PENDING_OBS = _threading.local()
+
+
+def queue_pending_obs_event(name: str, **fields) -> None:
+    lst = getattr(_PENDING_OBS, "events", None)
+    if lst is None:
+        lst = _PENDING_OBS.events = []
+    lst.append((name, fields))
+
+
+def clear_pending_obs_events() -> None:
+    _PENDING_OBS.events = []
+
+
+def drain_pending_obs_events(obs) -> None:
+    """Emit (and clear) queued cross-attempt events into a live run's
+    log. Clears even when obs is off — stale events must never leak
+    into an unrelated later solve."""
+    lst = getattr(_PENDING_OBS, "events", None)
+    if not lst:
+        return
+    _PENDING_OBS.events = []
+    for name, fields in lst:
+        obs.event(name, **fields)
+
+
+def _solve_with_degradation(config: SVMConfig, checkpoint_path,
+                            resume, run):
+    """Graceful degradation around a whole solve (ISSUE 13): on a
+    :class:`NonFiniteTrajectory` — the non-finite sentinel tripping at
+    a chunk boundary — restore the last checkpoint this run wrote (or
+    restart) and demote ONCE to the safe configuration (f32 storage,
+    stock block engine; solver/block.py demote_to_safe), with a loud
+    warning, ``stats['demoted_faults']`` and a ``demotion`` runlog
+    event — the shard-local endgame-demotion pattern applied to
+    numerics faults. A config that is ALREADY safe propagates the
+    error: that is a real numerics bug (inf features, absurd gamma/C),
+    and hiding it behind a retry would loop forever.
+
+    ``run(cfg, resume)`` executes the full retry-wrapped solve under
+    ``cfg``."""
+    import os as _os
+
+    def _mtime():
+        try:
+            return _os.path.getmtime(checkpoint_path) if checkpoint_path \
+                else None
+        except OSError:
+            return None
+
+    baseline_mtime = _mtime()
+    try:
+        return run(config, resume)
+    except NonFiniteTrajectory as e:
+        from dpsvm_tpu.solver.block import demote_to_safe
+
+        safe_cfg, dropped = demote_to_safe(config)
+        if safe_cfg is None:
+            raise
+        # Resume only a checkpoint THIS run wrote (or one the caller
+        # explicitly asked for) — the run_with_fault_retry staleness
+        # discipline.
+        res_now = resume or (bool(checkpoint_path)
+                             and _mtime() is not None
+                             and _mtime() != baseline_mtime)
+        import warnings
+
+        warnings.warn(
+            f"non-finite solver trajectory ({e}); DEMOTING to the safe "
+            f"configuration (dropped: {', '.join(dropped)}) and "
+            + ("resuming from the last checkpoint"
+               if res_now else "restarting from scratch")
+            + " — results will be exact but slower; investigate the "
+            "input scaling / C / gamma that produced the blow-up",
+            stacklevel=3)
+        queue_pending_obs_event("demotion", reason=str(e)[:200],
+                                dropped=list(dropped),
+                                resumed=bool(res_now))
+        try:
+            res = run(safe_cfg, res_now)
+        except BaseException:
+            clear_pending_obs_events()  # stale-event hygiene
+            raise
+        res.stats["demoted_faults"] = \
+            int(res.stats.get("demoted_faults", 0)) + 1
+        res.stats["demotion"] = {"dropped": list(dropped),
+                                 "resumed": bool(res_now),
+                                 "reason": str(e)[:200]}
+        return res
 
 
 # Auto resident-Gram gating (config.gram_resident=None): fraction of the
@@ -971,17 +1123,17 @@ def solve(
         # Out-of-core streaming driver (solver/ooc.py): X stays in host
         # memory; the block engine's fold streams over double-buffered
         # tiles. Its own host loop (the stream must be fed per round),
-        # same result contract.
-        if checkpoint_path or resume:
-            raise ValueError(
-                "ooc does not implement checkpoint/resume yet; run "
-                "without --checkpoint (fault retries restart from "
-                "scratch)")
+        # same result contract — including checkpoint/resume (v2
+        # full-carry checkpoints, bitwise cache-off resume) and the
+        # non-finite demotion wrapper below.
         from dpsvm_tpu.solver.ooc import solve_ooc
 
-        return solve_ooc(x, y, config, callback=callback, device=device,
-                         alpha_init=alpha_init, f_init=f_init,
-                         pad_to=pad_to)
+        return _solve_with_degradation(
+            config, checkpoint_path, resume,
+            lambda cfg, res: solve_ooc(
+                x, y, cfg, callback=callback, device=device,
+                checkpoint_path=checkpoint_path, resume=res,
+                alpha_init=alpha_init, f_init=f_init, pad_to=pad_to))
     if config.reconstruct_every:
         # Exact-f64 reconstruction legs around the device solve: the
         # productized form of the extreme-C recipe (solver/reconstruct.py;
@@ -995,15 +1147,19 @@ def solve(
                              alpha_init=alpha_init, f_init=f_init,
                              device=device)
 
-    def attempt(cfg_k, res_k, k):
-        return _solve_impl(x, y, cfg_k,
-                           _retry_callback(callback, cfg_k,
-                                           checkpoint_path, k),
-                           device, checkpoint_path, res_k,
-                           alpha_init, f_init, pad_to)
+    def run(cfg, res):
+        def attempt(cfg_k, res_k, k):
+            return _solve_impl(x, y, cfg_k,
+                               _retry_callback(callback, cfg_k,
+                                               checkpoint_path, k),
+                               device, checkpoint_path, res_k,
+                               alpha_init, f_init, pad_to)
 
-    with _precision_ctx(config):
-        return run_with_fault_retry(config, checkpoint_path, resume, attempt)
+        with _precision_ctx(cfg):
+            return run_with_fault_retry(cfg, checkpoint_path, res,
+                                        attempt)
+
+    return _solve_with_degradation(config, checkpoint_path, resume, run)
 
 
 def _noop_callback(it, b_hi, b_lo, state):
@@ -1319,6 +1475,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                         "fused_fold": bool(use_block and use_fused),
                         "fused_round": bool(use_block and use_fusedround),
                         "observed_chunks": observe})
+    drain_pending_obs_events(obs)
 
     # PHASE CLOCK (honest per-phase wall time, SolveResult.stats
     # ["phase_seconds"]). jax dispatches are async, so phase boundaries
@@ -1360,6 +1517,7 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         try:
             t0 = time.perf_counter()
             dispatches += 1
+            faults.device_fault("dispatch", f"chunk {dispatches}")
             if use_pallas:
                 state = _run_chunk_pallas(
                     x_dev, y_dev, x_sq, valid_dev, state, max_iter,
@@ -1459,6 +1617,13 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         # budget exits exactly (refresh_extrema_host below).
         it, b_hi, b_lo = _unpack_obs(_pack_obs(
             state.pairs if use_block else state.it, state.b_hi, state.b_lo))
+        # Non-finite sentinel (free — the extrema are already host
+        # scalars): a NaN gap would read "converged" below (NaN
+        # comparisons are False) and return a silently corrupt model;
+        # raise instead so _solve_with_degradation can restore the
+        # checkpoint and demote to the safe configuration.
+        b_hi, b_lo = faults.poison_obs(b_hi, b_lo)
+        check_obs_finite(b_hi, b_lo, it, "single-chip")
         obs.chunk(pairs=it, b_hi=b_hi, b_lo=b_lo,
                   device_seconds=chunk_dt, dispatch=dispatches)
         converged = not (b_lo > b_hi + 2.0 * eps_run)

@@ -1,0 +1,11 @@
+"""dpsvm_tpu.testing — deterministic fault-injection harness.
+
+The production seams (solver loops, checkpoint writes, registry
+loads, the serving dispatcher) import :mod:`dpsvm_tpu.testing.faults`
+lazily at their hook sites; disarmed, every hook is a cheap host-side
+no-op with zero HLO effect (the committed tpulint budgets pin that).
+"""
+
+from dpsvm_tpu.testing import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -1,0 +1,320 @@
+"""Deterministic fault-injection harness (ISSUE 13).
+
+Every fault-tolerance behavior in this repo is PROVEN by an injected
+fault, not hoped for: the retry wrapper, the checkpoint write
+discipline, ooc resume, the registry's corrupted-swap refusal, the
+serving watchdog and the non-finite demotion path all carry a named
+SEAM — a host-side hook this module arms. The old ad-hoc monkeypatch
+fault tests (tests/test_fault_recovery.py's ``inject_fault`` fixture)
+migrate onto these seams, and the same seams drive ``make
+faults_smoke`` and the loadgen chaos leg.
+
+Design constraints, in priority order:
+
+* **Zero HLO effect when disarmed.** Every seam is pure host code on a
+  host-driven boundary (a chunk dispatch, a ``device_put``, a
+  checkpoint write, an npz load, a scalar observation) — arming or
+  disarming the harness can never change a compiled program, which is
+  why the committed tpulint budgets stay byte-identical with the
+  harness importable everywhere (the PR 6 obs discipline).
+* **Deterministic.** A :class:`FaultPlan` fires on exact ARRIVAL
+  COUNTS at a seam (the N-th chunk dispatch, the T-th tile put), never
+  on wall clock or randomness; byte corruption is seeded so two runs
+  of one plan corrupt identically.
+* **Cheap when disarmed.** The hot-path check is one module attribute
+  read + a truthiness test (``_PLAN`` is None unless a plan is
+  installed or ``DPSVM_FAULTS`` is set).
+
+Activation
+----------
+Programmatic (tests)::
+
+    from dpsvm_tpu.testing import faults
+    with faults.install(faults.FaultPlan.parse("dispatch@3")):
+        solve(...)
+
+Environment (subprocess / CLI chaos runs)::
+
+    DPSVM_FAULTS="ooc_tile_put@2" python -m dpsvm_tpu.cli train --ooc ...
+
+Spec grammar: comma-separated ``seam[@N][xK]`` — fire on the N-th
+arrival at that seam (1-based, default 1) and keep firing for K
+consecutive arrivals (default 1). ``DPSVM_FAULTS_SEED`` seeds byte
+corruption (default 0).
+
+Seams
+-----
+=================  ====================================================
+``dispatch``       chunk/round dispatch in the single-chip, mesh and
+                   ooc host loops raises a transient
+                   ``JaxRuntimeError("UNAVAILABLE: ...")`` — the
+                   run_with_fault_retry recovery class.
+``ooc_tile_put``   the ooc tile stream's host->HBM ``device_put``
+                   raises the same transient class at tile-put T.
+``ckpt_truncate``  a checkpoint write is truncated mid-save and the
+                   writer dies (raises) BEFORE the atomic rename —
+                   the preemption the tmp+rename discipline exists
+                   for; the previous checkpoint must survive intact.
+``swap_corrupt``   a registry model load reads a deterministically
+                   corrupted copy of the file — the swap must be
+                   refused (ModelLoadError) with the live version
+                   still serving.
+``serve_dispatch`` a serving bucket dispatch raises — the engine must
+                   fail that batch with explicit 'failed' verdicts
+                   and keep serving.
+``serve_stall``    a serving batch's materialization stalls past the
+                   dispatch watchdog (sleeps ``STALL_SECONDS`` in the
+                   waiting thread) — the watchdog must bound it.
+``nonfinite_obs``  the chunk-boundary host observation reads NaN —
+                   the graceful-degradation sentinel's trigger.
+=================  ====================================================
+
+Firing records accumulate on ``plan.fired`` (a Counter) so tests can
+assert the fault really happened — a fault test whose fault never
+fired proves nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import re
+import threading
+import time
+from collections import Counter
+from typing import List, Optional
+
+#: every seam name a spec may arm (typos fail loudly at parse time).
+SEAMS = frozenset({
+    "dispatch", "ooc_tile_put", "ckpt_truncate", "swap_corrupt",
+    "serve_dispatch", "serve_stall", "nonfinite_obs",
+})
+
+#: how long a fired ``serve_stall`` sleeps (long enough to trip any
+#: sane dispatch watchdog, short enough that the daemon worker thread
+#: dies quickly after the test). Tests may monkeypatch.
+STALL_SECONDS = 5.0
+
+_SPEC_RE = re.compile(r"^(?P<seam>[a-z_]+)(@(?P<at>\d+))?(x(?P<times>\d+))?$")
+
+
+class FaultInjected(RuntimeError):
+    """A non-device injected fault (e.g. the checkpoint-write
+    truncation). Device-shaped seams raise jax.errors.JaxRuntimeError
+    instead so they exercise the REAL recovery classification."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One armed seam: fire on arrivals [at, at + times)."""
+
+    seam: str
+    at: int = 1
+    times: int = 1
+
+    def __post_init__(self):
+        if self.seam not in SEAMS:
+            raise ValueError(
+                f"unknown fault seam {self.seam!r} (have "
+                f"{sorted(SEAMS)})")
+        if self.at < 1 or self.times < 1:
+            raise ValueError(
+                f"fault spec {self.seam}@{self.at}x{self.times}: "
+                "@N and xK must be >= 1 (arrivals are 1-based)")
+
+    def covers(self, arrival: int) -> bool:
+        return self.at <= arrival < self.at + self.times
+
+
+class FaultPlan:
+    """A deterministic set of armed seams with per-seam arrival
+    counters. Thread-safe: serving seams fire from pump/admin threads
+    concurrently."""
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self.arrivals: Counter = Counter()
+        self.fired: Counter = Counter()
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        """Parse the ``DPSVM_FAULTS`` grammar: comma-separated
+        ``seam[@N][xK]`` tokens."""
+        specs = []
+        for tok in (t.strip() for t in (text or "").split(",")):
+            if not tok:
+                continue
+            m = _SPEC_RE.match(tok)
+            if m is None:
+                raise ValueError(
+                    f"bad fault spec {tok!r} (grammar: seam[@N][xK], "
+                    f"seams: {sorted(SEAMS)})")
+            specs.append(FaultSpec(
+                seam=m.group("seam"),
+                at=int(m.group("at") or 1),
+                times=int(m.group("times") or 1)))
+        return cls(specs, seed=seed)
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.specs)
+
+    def arrive(self, seam: str) -> bool:
+        """Count one arrival at `seam`; True when an armed spec covers
+        this arrival (the caller then injects its fault)."""
+        with self._lock:
+            self.arrivals[seam] += 1
+            n = self.arrivals[seam]
+            hit = any(s.seam == seam and s.covers(n) for s in self.specs)
+            if hit:
+                self.fired[seam] += 1
+            return hit
+
+
+# ------------------------------------------------------- active plan
+# _PLAN is the installed plan (tests); _ENV_CACHE memoizes the parsed
+# DPSVM_FAULTS value so the disarmed hot path is one env read + a
+# string compare.
+_PLAN: Optional[FaultPlan] = None
+_ENV_CACHE: tuple = ("", None)  # (env string, FaultPlan | None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The armed plan, or None (the overwhelmingly common case)."""
+    global _ENV_CACHE
+    if _PLAN is not None:
+        return _PLAN if _PLAN.armed else None
+    env = os.environ.get("DPSVM_FAULTS", "")
+    if not env:
+        return None
+    if env != _ENV_CACHE[0]:
+        seed = int(os.environ.get("DPSVM_FAULTS_SEED", "0"))
+        _ENV_CACHE = (env, FaultPlan.parse(env, seed=seed))
+    return _ENV_CACHE[1]
+
+
+@contextlib.contextmanager
+def install(plan: Optional[FaultPlan]):
+    """Install `plan` as the process-wide active plan for the scope
+    (tests). Nesting replaces; exit restores the previous plan."""
+    global _PLAN
+    prev = _PLAN
+    _PLAN = plan
+    try:
+        yield plan
+    finally:
+        _PLAN = prev
+
+
+def arrive(seam: str) -> bool:
+    """The universal seam check: False-fast when nothing is armed."""
+    plan = active_plan()
+    return plan is not None and plan.arrive(seam)
+
+
+# ------------------------------------------------------- seam actions
+
+def device_fault(seam: str, detail: str = "") -> None:
+    """Raise the transient device-runtime fault class when `seam`
+    fires (the exact classification run_with_fault_retry retries:
+    UNAVAILABLE is the tunneled-runtime preemption marker)."""
+    if arrive(seam):
+        import jax
+
+        raise jax.errors.JaxRuntimeError(
+            f"UNAVAILABLE: injected fault at seam {seam!r}"
+            + (f" ({detail})" if detail else ""))
+
+
+def damage_checkpoint(tmp_path: str) -> None:
+    """The ``ckpt_truncate`` seam: truncate the staged tmp file to half
+    its bytes and die before the atomic rename — exactly what a
+    preemption mid-save leaves behind. The save_checkpoint except path
+    must unlink the wreck and leave the previous checkpoint intact."""
+    if arrive("ckpt_truncate"):
+        size = os.path.getsize(tmp_path)
+        with open(tmp_path, "r+b") as fh:
+            fh.truncate(size // 2)
+        raise FaultInjected(
+            f"injected preemption mid-checkpoint-save ({tmp_path}: "
+            f"{size} -> {size // 2} bytes, rename never ran)")
+
+
+def corrupt_bytes(data: bytes, seed: int = 0,
+                  mode: str = "truncate") -> bytes:
+    """Deterministically corrupt an npz payload. ``truncate`` cuts the
+    byte stream inside the member data (a partial copy / killed
+    writer); ``flip`` XORs a seeded sample of bytes past the zip local
+    header (bit rot / torn write). Same (data, seed, mode) -> same
+    output, always != input for len(data) > 64."""
+    import numpy as np
+
+    if mode == "truncate":
+        # Keep the zip local-file header so np.load starts parsing and
+        # fails INSIDE a member read — the lazy-decompression case the
+        # registry's eager validation exists for.
+        return data[:max(64, int(len(data) * 0.6))]
+    if mode == "flip":
+        rng = np.random.default_rng(seed)
+        arr = np.frombuffer(data, np.uint8).copy()
+        # Flip past the zip local header when the payload is big enough
+        # to have one worth preserving; tiny payloads flip anywhere
+        # (the != guarantee only holds above 64 bytes either way).
+        lo = 64 if len(arr) > 64 else 0
+        idx = rng.integers(lo, max(lo + 1, len(arr)),
+                           size=min(32, max(1, len(arr))))
+        idx = idx[idx < len(arr)]
+        arr[idx] ^= 0xFF
+        return arr.tobytes()
+    raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+def corrupt_npz_file(src: str, dst: Optional[str] = None,
+                     seed: int = 0, mode: str = "truncate") -> str:
+    """Write a deterministically corrupted copy of `src` (the chaos
+    legs' bad-swap input). Returns the written path."""
+    with open(src, "rb") as fh:
+        data = fh.read()
+    if dst is None:
+        root, ext = os.path.splitext(src)
+        dst = f"{root}.corrupt{ext or '.npz'}"
+    bad = corrupt_bytes(data, seed=seed, mode=mode)
+    with open(dst, "wb") as fh:
+        fh.write(bad)
+    return dst
+
+
+def maybe_corrupt_model(path: str) -> str:
+    """The ``swap_corrupt`` seam: when fired, the registry load reads a
+    corrupted sibling copy instead of `path`, so the REAL
+    validate/reject path is what gets exercised (never a mocked
+    error). Returns `path` unchanged when disarmed."""
+    if not isinstance(path, str) or not arrive("swap_corrupt"):
+        return path
+    plan = active_plan()
+    seed = plan.seed if plan is not None else 0
+    import tempfile
+
+    dst = os.path.join(tempfile.mkdtemp(prefix="dpsvm_fault_"),
+                       os.path.basename(path))
+    return corrupt_npz_file(path, dst, seed=seed)
+
+
+def poison_obs(b_hi: float, b_lo: float):
+    """The ``nonfinite_obs`` seam: the chunk-boundary host observation
+    reads NaN — what a numerics blowup in the carried gradient looks
+    like from the host. Identity when disarmed."""
+    if arrive("nonfinite_obs"):
+        return float("nan"), float("nan")
+    return b_hi, b_lo
+
+
+def serve_stall() -> None:
+    """The ``serve_stall`` seam: called from the dispatcher's WAITING
+    thread (never the pump thread) so a fired stall models a wedged
+    device dispatch the watchdog must bound."""
+    if arrive("serve_stall"):
+        time.sleep(STALL_SECONDS)

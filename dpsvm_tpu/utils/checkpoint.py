@@ -1,9 +1,29 @@
 """Solver-state checkpoint / resume.
 
-The reference has NO training-state checkpointing (SURVEY.md section 5.3:
-an MPI rank death kills the job and all progress); only the final model is
-persisted. Full solver state here is just {alpha, f, iteration, b_hi, b_lo}
-plus config, so periodic checkpoints are nearly free. Stored as .npz.
+The reference has NO training-state checkpointing (SURVEY.md section
+5.3: an MPI rank death kills the job and all progress); only the final
+model is persisted. Full solver state here is just {alpha, f,
+iteration, b_hi, b_lo} plus config, so periodic checkpoints are nearly
+free. Stored as .npz, written atomically (tmp + rename).
+
+FORMAT_VERSION history:
+
+* v1 — alpha / f / iteration / b_hi / b_lo / config. ``f`` is the
+  EFFECTIVE gradient (the in-core drivers save ``f - f_err``), so a
+  compensated resume restarts its Kahan residual at zero — correct,
+  but not bit-identical to the uninterrupted trajectory.
+* v2 (ISSUE 13) — adds the optional ``f_err`` compensated-residual
+  lanes and the block/ooc ``rounds`` counter, the full out-of-core
+  driver carry. With raw ``f`` and ``f_err`` both present, an ooc
+  resume reproduces the uninterrupted trajectory BITWISE from the
+  restore point (tests/test_ooc.py pins it). v1 files still load
+  (``f_err`` -> None, ``rounds`` -> 0) for in-core resumes; v2 files
+  without ``f_err`` behave exactly like v1.
+
+Injected-fault coverage (dpsvm_tpu/testing/faults.py): the
+``ckpt_truncate`` seam kills a save between the tmp write and the
+rename — the previous checkpoint must survive intact, which is the
+whole point of the tmp+rename discipline.
 """
 
 from __future__ import annotations
@@ -12,34 +32,67 @@ import dataclasses
 import json
 import os
 import tempfile
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import numpy as np
 
 from dpsvm_tpu.config import SVMConfig
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+
+#: versions load_checkpoint accepts (newer-than-known fails loudly —
+#: silently dropping fields a future writer relied on could corrupt a
+#: resume).
+_READABLE_VERSIONS = (1, 2)
+
+
+class CheckpointState(NamedTuple):
+    """One loaded checkpoint. ``f_err`` is None for v1 files and
+    uncompensated runs; ``rounds`` is 0 where the writer predates it."""
+
+    alpha: np.ndarray
+    f: np.ndarray
+    iteration: int
+    b_hi: float
+    b_lo: float
+    config: SVMConfig
+    f_err: Optional[np.ndarray]
+    rounds: int
+    format_version: int
 
 
 def save_checkpoint(path: str, alpha, f, iteration: int, b_hi: float,
-                    b_lo: float, config: SVMConfig) -> None:
-    """Atomic write (tmp + rename) so a preemption mid-save never leaves a
-    truncated checkpoint."""
+                    b_lo: float, config: SVMConfig, *, f_err=None,
+                    rounds: Optional[int] = None) -> None:
+    """Atomic write (tmp + rename) so a preemption mid-save never
+    leaves a truncated checkpoint. ``f_err``/``rounds`` are the v2
+    extras (the ooc driver's full carry); omitted fields are simply
+    absent from the file."""
+    from dpsvm_tpu.testing import faults
+
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     try:
+        payload = dict(
+            format_version=FORMAT_VERSION,
+            alpha=np.asarray(alpha, np.float32),
+            f=np.asarray(f, np.float32),
+            iteration=np.int64(iteration),
+            b_hi=np.float32(b_hi),
+            b_lo=np.float32(b_lo),
+            config_json=json.dumps(dataclasses.asdict(config)),
+        )
+        if f_err is not None:
+            payload["f_err"] = np.asarray(f_err, np.float32)
+        if rounds is not None:
+            payload["rounds"] = np.int64(rounds)
         with os.fdopen(fd, "wb") as fh:
-            np.savez_compressed(
-                fh,
-                format_version=FORMAT_VERSION,
-                alpha=np.asarray(alpha, np.float32),
-                f=np.asarray(f, np.float32),
-                iteration=np.int64(iteration),
-                b_hi=np.float32(b_hi),
-                b_lo=np.float32(b_lo),
-                config_json=json.dumps(dataclasses.asdict(config)),
-            )
+            np.savez_compressed(fh, **payload)
+        # Injected preemption point (ckpt_truncate seam): fires AFTER
+        # the tmp bytes exist and BEFORE the rename — the previous
+        # checkpoint at `path` must be untouched by the wreckage.
+        faults.damage_checkpoint(tmp)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -47,29 +100,81 @@ def save_checkpoint(path: str, alpha, f, iteration: int, b_hi: float,
         raise
 
 
+def load_checkpoint_state(path: str) -> CheckpointState:
+    """Load any readable checkpoint version into the v2 state shape."""
+    z = np.load(path, allow_pickle=False)
+    version = int(z["format_version"])
+    if version not in _READABLE_VERSIONS:
+        raise ValueError(
+            f"unsupported checkpoint version {version} (this build "
+            f"reads {_READABLE_VERSIONS})")
+    config = SVMConfig(**json.loads(str(z["config_json"])))
+    return CheckpointState(
+        alpha=z["alpha"].astype(np.float32),
+        f=z["f"].astype(np.float32),
+        iteration=int(z["iteration"]),
+        b_hi=float(z["b_hi"]),
+        b_lo=float(z["b_lo"]),
+        config=config,
+        f_err=(z["f_err"].astype(np.float32) if "f_err" in z.files
+               else None),
+        rounds=int(z["rounds"]) if "rounds" in z.files else 0,
+        format_version=version,
+    )
+
+
+def load_checkpoint(path: str):
+    """Returns (alpha, f, iteration, b_hi, b_lo, config) — the v1
+    caller shape, valid for every readable version."""
+    st = load_checkpoint_state(path)
+    return (st.alpha, st.f, st.iteration, st.b_hi, st.b_lo, st.config)
+
+
+def _validate_restore(st: CheckpointState, path: str,
+                      config: SVMConfig, n: int) -> None:
+    """Refuse resumes that would silently corrupt the solution (the
+    restored gradient f is only valid for the kernel/C it was computed
+    under, and only for the same rows)."""
+    if st.alpha.shape[0] != n:
+        raise ValueError(
+            f"checkpoint {path} holds state for n={st.alpha.shape[0]} "
+            f"rows, but the current dataset has n={n}")
+    if not (np.isfinite(st.alpha).all() and np.isfinite(st.f).all()
+            and (st.f_err is None or np.isfinite(st.f_err).all())):
+        raise ValueError(
+            f"checkpoint {path} holds non-finite solver state "
+            "(corrupt or hand-edited — this repo's writers never "
+            "persist non-finite state); refusing to resume it")
+    for field in ("c", "gamma", "kernel", "degree", "coef0", "epsilon"):
+        if getattr(st.config, field) != getattr(config, field):
+            raise ValueError(
+                f"checkpoint {path} was written with {field}="
+                f"{getattr(st.config, field)!r}, current run uses "
+                f"{getattr(config, field)!r}; refusing to resume")
+
+
 def resume_solver_state(path: Optional[str], config: SVMConfig, n: int):
     """Load + validate a solver checkpoint for resuming.
 
-    Returns (alpha, f, iteration, b_hi, b_lo) or None when `path` is unset
-    or missing. Raises ValueError when the checkpoint belongs to a
-    different dataset size or incompatible hyper-parameters — resuming
-    across those would silently corrupt the solution (the restored
-    gradient f is only valid for the kernel/C it was computed under).
-    """
+    Returns (alpha, f, iteration, b_hi, b_lo) or None when `path` is
+    unset or missing. Raises ValueError when the checkpoint belongs to
+    a different dataset size or incompatible hyper-parameters."""
+    st = resume_state(path, config, n)
+    if st is None:
+        return None
+    return st.alpha, st.f, st.iteration, st.b_hi, st.b_lo
+
+
+def resume_state(path: Optional[str], config: SVMConfig,
+                 n: int) -> Optional[CheckpointState]:
+    """The full-carry resume (the ooc driver's entry): the validated
+    CheckpointState including the v2 ``f_err``/``rounds`` extras, or
+    None when `path` is unset or missing."""
     if not path or not os.path.exists(path):
         return None
-    alpha, f, it, b_hi, b_lo, saved = load_checkpoint(path)
-    if alpha.shape[0] != n:
-        raise ValueError(
-            f"checkpoint {path} holds state for n={alpha.shape[0]} rows, "
-            f"but the current dataset has n={n}")
-    for field in ("c", "gamma", "kernel", "degree", "coef0", "epsilon"):
-        if getattr(saved, field) != getattr(config, field):
-            raise ValueError(
-                f"checkpoint {path} was written with {field}="
-                f"{getattr(saved, field)!r}, current run uses "
-                f"{getattr(config, field)!r}; refusing to resume")
-    return alpha, f, it, b_hi, b_lo
+    st = load_checkpoint_state(path)
+    _validate_restore(st, path, config, n)
+    return st
 
 
 class PeriodicCheckpointer:
@@ -91,23 +196,36 @@ class PeriodicCheckpointer:
         return self.active and iteration - self.last >= self.every
 
     def save(self, iteration: int, alpha, f, b_hi: float, b_lo: float,
-             force: bool = False) -> bool:
+             force: bool = False, f_err=None,
+             rounds: Optional[int] = None) -> bool:
         """Save when the cadence is due, or unconditionally with
         ``force`` (abort exits: the state being stopped at must not
-        exist only in memory)."""
+        exist only in memory). ``f_err``/``rounds`` ride through to
+        the v2 payload when the caller carries them.
+
+        NON-FINITE STATE IS NEVER PERSISTED: the block/ooc observed
+        extrema lag the fold by one round, so the round that blows up
+        the gradient would otherwise write a NaN checkpoint under
+        finite-looking extrema — and the demotion path would then
+        faithfully resume the corruption. Skipping the save keeps the
+        LAST GOOD checkpoint as the restore point (the sentinel trips
+        one observation later)."""
         if not (self.active and (force or self.due(iteration))):
             return False
-        save_checkpoint(self.path, np.asarray(alpha), np.asarray(f),
-                        iteration, b_hi, b_lo, self.config)
+        alpha = np.asarray(alpha)
+        f = np.asarray(f)
+        f_err = None if f_err is None else np.asarray(f_err)
+        if not (np.isfinite(alpha).all() and np.isfinite(f).all()
+                and (f_err is None or np.isfinite(f_err).all())):
+            import warnings
+
+            warnings.warn(
+                f"checkpoint at iteration {iteration} SKIPPED: solver "
+                "state holds non-finite values (gradient blow-up); the "
+                "previous checkpoint is kept as the restore point",
+                stacklevel=3)
+            return False
+        save_checkpoint(self.path, alpha, f, iteration, b_hi, b_lo,
+                        self.config, f_err=f_err, rounds=rounds)
         self.last = iteration
         return True
-
-
-def load_checkpoint(path: str):
-    """Returns (alpha, f, iteration, b_hi, b_lo, config)."""
-    z = np.load(path, allow_pickle=False)
-    if int(z["format_version"]) != FORMAT_VERSION:
-        raise ValueError(f"unsupported checkpoint version {int(z['format_version'])}")
-    config = SVMConfig(**json.loads(str(z["config_json"])))
-    return (z["alpha"].astype(np.float32), z["f"].astype(np.float32),
-            int(z["iteration"]), float(z["b_hi"]), float(z["b_lo"]), config)

@@ -25,6 +25,68 @@ def test_checkpoint_roundtrip(tmp_path):
     assert cfg.c == CFG.c and cfg.chunk_iters == CFG.chunk_iters
 
 
+def test_checkpoint_v2_full_carry_roundtrip(tmp_path):
+    """FORMAT_VERSION 2 (ISSUE 13): the ooc driver's full carry —
+    f_err lanes + round counter — rides the same file; omitted extras
+    read back as None/0."""
+    from dpsvm_tpu.utils.checkpoint import (FORMAT_VERSION,
+                                            load_checkpoint_state)
+
+    p = str(tmp_path / "ck2.npz")
+    alpha = np.arange(5, dtype=np.float32)
+    save_checkpoint(p, alpha, -alpha, 99, -0.1, 0.2, CFG,
+                    f_err=alpha * 1e-7, rounds=17)
+    st = load_checkpoint_state(p)
+    assert st.format_version == FORMAT_VERSION == 2
+    np.testing.assert_array_equal(st.f_err, alpha * 1e-7)
+    assert st.rounds == 17 and st.iteration == 99
+    # extras omitted -> absent, not zero-filled
+    save_checkpoint(p, alpha, -alpha, 99, -0.1, 0.2, CFG)
+    st = load_checkpoint_state(p)
+    assert st.f_err is None and st.rounds == 0
+    # the v1-shaped reader stays valid on v2 files
+    a2, f2, it, _, _, cfg = load_checkpoint(p)
+    assert it == 99 and cfg.c == CFG.c
+
+
+def test_v1_checkpoint_still_loads_and_resumes(blobs_small, tmp_path):
+    """Back-compat (ISSUE 13): a FORMAT_VERSION 1 file — what every
+    pre-v2 run wrote — still loads (f_err -> None, rounds -> 0) and
+    still resumes an in-core solve to the uninterrupted optimum."""
+    import dataclasses
+    import json
+
+    from dpsvm_tpu.utils.checkpoint import load_checkpoint_state
+
+    x, y = blobs_small
+    full = solve(x, y, CFG)
+    part = solve(x, y, CFG.replace(max_iter=128))
+    p = str(tmp_path / "v1.npz")
+    # A v1 file exactly as the old writer produced it.
+    np.savez_compressed(
+        p, format_version=1,
+        alpha=np.asarray(part.alpha, np.float32),
+        f=np.asarray(part.stats["f"], np.float32),
+        iteration=np.int64(part.iterations),
+        b_hi=np.float32(part.b_hi), b_lo=np.float32(part.b_lo),
+        config_json=json.dumps(dataclasses.asdict(CFG)))
+    st = load_checkpoint_state(p)
+    assert st.format_version == 1 and st.f_err is None and st.rounds == 0
+    res = solve(x, y, CFG, checkpoint_path=p, resume=True)
+    assert res.converged
+    assert res.iterations == full.iterations
+    np.testing.assert_allclose(res.alpha, full.alpha, atol=1e-4)
+    # unknown future versions refuse loudly
+    np.savez_compressed(str(tmp_path / "v9.npz"), format_version=9,
+                        alpha=np.zeros(3, np.float32),
+                        f=np.zeros(3, np.float32),
+                        iteration=np.int64(0), b_hi=np.float32(0),
+                        b_lo=np.float32(0),
+                        config_json=json.dumps(dataclasses.asdict(CFG)))
+    with pytest.raises(ValueError, match="unsupported checkpoint"):
+        load_checkpoint_state(str(tmp_path / "v9.npz"))
+
+
 def test_interrupted_run_resumes_to_same_answer(blobs_small, tmp_path):
     x, y = blobs_small
     p = str(tmp_path / "solver.npz")

@@ -6,6 +6,14 @@ The reference loses the entire run when an MPI rank dies. Here:
     (solver/smo.py run_with_fault_retry);
 (b) a killed PROCESS resumes from its checkpoint on relaunch to the
     identical optimum (subprocess SIGKILL test).
+
+Faults are injected through the deterministic harness's ``dispatch``
+seam (dpsvm_tpu/testing/faults.py — ISSUE 13; this file's old ad-hoc
+``_run_chunk`` monkeypatch fixture migrated onto it), so the faulted
+dispatch is the REAL host-loop boundary every backend shares. The one
+remaining monkeypatch is the non-transient classification test, which
+exercises the error-class filter itself — a seam that only ever raises
+the transient class cannot cover it.
 """
 
 import os
@@ -21,6 +29,7 @@ import pytest
 import dpsvm_tpu.solver.smo as smo_mod
 from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.solver.smo import solve
+from dpsvm_tpu.testing import faults
 
 CFG = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000,
                 chunk_iters=64, checkpoint_every=64, retry_faults=2)
@@ -31,32 +40,16 @@ def no_backoff(monkeypatch):
     monkeypatch.setattr(smo_mod, "_RETRY_BACKOFF_S", ())
 
 
-@pytest.fixture
-def inject_fault(monkeypatch):
-    """Make the Nth _run_chunk dispatch raise a transient runtime fault
-    (by default the 3rd, so checkpoints exist before the fault)."""
-    orig = smo_mod._run_chunk
-    state = {"calls": 0, "faults": 0, "fault_at": {3},
-             "msg": "UNAVAILABLE: injected tunnel fault"}
-
-    def faulty(*a, **kw):
-        state["calls"] += 1
-        if state["calls"] in state["fault_at"]:
-            state["faults"] += 1
-            raise jax.errors.JaxRuntimeError(state["msg"])
-        return orig(*a, **kw)
-
-    monkeypatch.setattr(smo_mod, "_run_chunk", faulty)
-    return state
-
-
 def test_auto_retry_resumes_from_checkpoint(blobs_small, tmp_path,
-                                            no_backoff, inject_fault):
+                                            no_backoff):
     x, y = blobs_small
     full = solve(x, y, CFG.replace(retry_faults=0))
     p = str(tmp_path / "ck.npz")
-    res = solve(x, y, CFG, checkpoint_path=p)
-    assert inject_fault["faults"] == 1  # the fault really fired
+    # The 3rd chunk dispatch of THIS solve faults (checkpoints exist
+    # by then: the cadence saves every chunk at these settings).
+    with faults.install(faults.FaultPlan.parse("dispatch@3")) as plan:
+        res = solve(x, y, CFG, checkpoint_path=p)
+    assert plan.fired["dispatch"] == 1  # the fault really fired
     assert res.converged
     # Checkpoint resume replays the identical trajectory: same optimum.
     np.testing.assert_allclose(res.alpha, full.alpha, atol=1e-5)
@@ -64,19 +57,18 @@ def test_auto_retry_resumes_from_checkpoint(blobs_small, tmp_path,
     assert res.iterations == full.iterations
 
 
-def test_auto_retry_without_checkpoint_restarts(blobs_small, no_backoff,
-                                                inject_fault):
+def test_auto_retry_without_checkpoint_restarts(blobs_small, no_backoff):
     # Unobserved solves run in ONE dispatch — fault it, and verify the
     # retry restarts (observed/chunked this time) and completes.
-    inject_fault["fault_at"] = {1}
     x, y = blobs_small
-    res = solve(x, y, CFG.replace(checkpoint_every=0))
-    assert inject_fault["faults"] == 1
+    with faults.install(faults.FaultPlan.parse("dispatch@1")) as plan:
+        res = solve(x, y, CFG.replace(checkpoint_every=0))
+    assert plan.fired["dispatch"] == 1
     assert res.converged
 
 
 def test_retry_never_resumes_stale_checkpoint(blobs_small, tmp_path,
-                                              no_backoff, inject_fault):
+                                              no_backoff):
     """A retry must not silently continue a PREVIOUS run's leftover
     checkpoint when this run (checkpoint_every=0, resume=False) never
     wrote one — that would replace the fresh training the caller asked
@@ -90,61 +82,62 @@ def test_retry_never_resumes_stale_checkpoint(blobs_small, tmp_path,
     prev = solve(x, y, cfg.replace(retry_faults=0))
     save_checkpoint(p, prev.alpha, prev.stats["f"],
                     prev.iterations - 1, prev.b_hi, prev.b_lo, cfg)
-    inject_fault["calls"] = 0  # the setup solve above consumed dispatches
-    inject_fault["fault_at"] = {1}
-    res = solve(x, y, cfg, checkpoint_path=p)
-    assert inject_fault["faults"] == 1
+    with faults.install(faults.FaultPlan.parse("dispatch@1")) as plan:
+        res = solve(x, y, cfg, checkpoint_path=p)
+    assert plan.fired["dispatch"] == 1
     assert res.converged
     # Restarted from scratch, not from the stale state: full iteration
     # count, not the ~1 iteration a stale resume would report.
     assert res.iterations == prev.iterations
 
 
-def test_retry_budget_exhausts(blobs_small, tmp_path, no_backoff,
-                               inject_fault):
-    inject_fault["fault_at"] = {1, 2, 3, 4, 5, 6, 7, 8}
+def test_retry_budget_exhausts(blobs_small, tmp_path, no_backoff):
+    # Every attempt's first dispatch faults -> the budget (retry_faults
+    # + 1 attempts) exhausts and the last fault propagates.
     x, y = blobs_small
-    with pytest.raises(jax.errors.JaxRuntimeError, match="UNAVAILABLE"):
-        solve(x, y, CFG, checkpoint_path=str(tmp_path / "ck.npz"))
-    assert inject_fault["faults"] == CFG.retry_faults + 1
+    with faults.install(
+            faults.FaultPlan.parse("dispatch@1x64")) as plan:
+        with pytest.raises(jax.errors.JaxRuntimeError,
+                           match="UNAVAILABLE"):
+            solve(x, y, CFG, checkpoint_path=str(tmp_path / "ck.npz"))
+    assert plan.fired["dispatch"] == CFG.retry_faults + 1
 
 
 def test_nontransient_fault_propagates(blobs_small, no_backoff,
-                                       inject_fault):
-    inject_fault["fault_at"] = {1}
-    inject_fault["msg"] = "INVALID_ARGUMENT: a real bug, not the tunnel"
+                                       monkeypatch):
+    # Deliberately NOT a harness seam: this pins the transient-fault
+    # CLASSIFIER (INVALID_ARGUMENT must not be retried), so the
+    # injection must produce a non-transient error the seam never
+    # raises.
+    calls = {"n": 0}
+    orig = smo_mod._run_chunk
+
+    def faulty(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError(
+                "INVALID_ARGUMENT: a real bug, not the tunnel")
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(smo_mod, "_run_chunk", faulty)
     x, y = blobs_small
-    with pytest.raises(jax.errors.JaxRuntimeError, match="INVALID_ARGUMENT"):
+    with pytest.raises(jax.errors.JaxRuntimeError,
+                       match="INVALID_ARGUMENT"):
         solve(x, y, CFG)
-    assert inject_fault["faults"] == 1  # no retry on deterministic errors
+    assert calls["n"] == 1  # no retry on deterministic errors
 
 
-def test_mesh_auto_retry(blobs_small, tmp_path, no_backoff, monkeypatch):
-    """The mesh path shares the retry wrapper; inject at its runner
-    factory level."""
-    import dpsvm_tpu.parallel.dist_smo as dist_mod
-
-    orig = dist_mod._make_chunk_runner
-    state = {"calls": 0}
-
-    def factory(*a, **kw):
-        runner = orig(*a, **kw)
-
-        def run(*ra, **rkw):
-            state["calls"] += 1
-            if state["calls"] == 3:
-                raise jax.errors.JaxRuntimeError("UNAVAILABLE: injected")
-            return runner(*ra, **rkw)
-
-        return run
-
-    monkeypatch.setattr(dist_mod, "_make_chunk_runner", factory)
+def test_mesh_auto_retry(blobs_small, tmp_path, no_backoff):
+    """The mesh path shares the retry wrapper AND the dispatch seam
+    (parallel/dist_smo.py chunk loop)."""
     from dpsvm_tpu.parallel.dist_smo import solve_mesh
 
     x, y = blobs_small
     full = solve(x, y, CFG.replace(retry_faults=0))
-    res = solve_mesh(x, y, CFG, num_devices=8,
-                     checkpoint_path=str(tmp_path / "ck.npz"))
+    with faults.install(faults.FaultPlan.parse("dispatch@3")) as plan:
+        res = solve_mesh(x, y, CFG, num_devices=8,
+                         checkpoint_path=str(tmp_path / "ck.npz"))
+    assert plan.fired["dispatch"] == 1
     assert res.converged
     np.testing.assert_allclose(res.alpha, full.alpha, atol=1e-4)
 
@@ -177,7 +170,8 @@ print("DONE", res.iterations, flush=True)
 def test_subprocess_kill_then_resume(tmp_path):
     """Kill a solving process mid-run (SIGKILL — nothing can be flushed);
     relaunching resumes from the periodic checkpoint and lands on the
-    same optimum as an uninterrupted solve."""
+    same optimum as an uninterrupted solve. (The ooc twin of this test
+    — with a BITWISE final-state pin — runs in `make faults_smoke`.)"""
     from dpsvm_tpu.data.synth import make_blobs_binary
     from dpsvm_tpu.utils.hostenv import cleaned_cpu_env
 

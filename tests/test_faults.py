@@ -1,0 +1,297 @@
+"""Deterministic fault-injection harness + graceful degradation
+(dpsvm_tpu/testing/faults.py — ISSUE 13).
+
+Every fault-tolerance behavior is proven by a REAL injected fault
+through a named seam: the checkpoint tmp+rename discipline under a
+truncated write, the non-finite sentinel + safe-config demotion, the
+obs fault/retry/demotion event trail and its `cli obs report` column,
+and the one-time multi-host retry warning. The solver-loop retry
+behaviors live in test_fault_recovery.py (migrated onto the same
+seams); the ooc tile-put/resume pins in test_ooc.py; the serving
+seams (journal, watchdog, corrupted swap) in test_serving.py.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import dpsvm_tpu.solver.smo as smo_mod
+from dpsvm_tpu.config import ObsConfig, SVMConfig
+from dpsvm_tpu.solver.smo import NonFiniteTrajectory, solve
+from dpsvm_tpu.testing import faults
+
+
+@pytest.fixture
+def no_backoff(monkeypatch):
+    monkeypatch.setattr(smo_mod, "_RETRY_BACKOFF_S", ())
+
+
+# --------------------------------------------------------- the harness
+
+def test_plan_parse_and_deterministic_firing():
+    plan = faults.FaultPlan.parse("dispatch@3, ooc_tile_put@2x2")
+    assert [plan.arrive("dispatch") for _ in range(5)] == \
+        [False, False, True, False, False]
+    assert [plan.arrive("ooc_tile_put") for _ in range(4)] == \
+        [False, True, True, False]
+    assert plan.fired == {"dispatch": 1, "ooc_tile_put": 2}
+    # Default @1: the first arrival fires.
+    p2 = faults.FaultPlan.parse("ckpt_truncate")
+    assert p2.arrive("ckpt_truncate") and not p2.arrive("ckpt_truncate")
+
+
+def test_plan_rejects_typos_and_bad_counts():
+    with pytest.raises(ValueError, match="unknown fault seam"):
+        faults.FaultPlan.parse("dispatchh")
+    with pytest.raises(ValueError, match="1-based"):
+        faults.FaultPlan.parse("dispatch@0")
+    with pytest.raises(ValueError, match="grammar"):
+        faults.FaultPlan.parse("dispatch@@3")
+
+
+def test_disarmed_is_inert_and_install_scopes():
+    assert faults.active_plan() is None
+    assert not faults.arrive("dispatch")
+    plan = faults.FaultPlan.parse("dispatch@1")
+    with faults.install(plan):
+        assert faults.active_plan() is plan
+        inner = faults.FaultPlan.parse("serve_stall@1")
+        with faults.install(inner):
+            assert faults.active_plan() is inner
+        assert faults.active_plan() is plan
+    assert faults.active_plan() is None
+
+
+def test_env_activation(monkeypatch):
+    monkeypatch.setenv("DPSVM_FAULTS", "nonfinite_obs@4")
+    plan = faults.active_plan()
+    assert plan is not None and plan.specs[0].at == 4
+    # Same env string -> the SAME cached plan (arrival counts persist
+    # across call sites, which is what makes @N meaningful).
+    assert faults.active_plan() is plan
+    monkeypatch.setenv("DPSVM_FAULTS", "")
+    assert faults.active_plan() is None
+
+
+def test_corruption_is_seeded_and_effective(tmp_path):
+    src = str(tmp_path / "m.npz")
+    np.savez_compressed(src, a=np.arange(4096, dtype=np.float32))
+    c1 = faults.corrupt_npz_file(src, str(tmp_path / "c1.npz"), seed=3)
+    c2 = faults.corrupt_npz_file(src, str(tmp_path / "c2.npz"), seed=3)
+    assert open(c1, "rb").read() == open(c2, "rb").read()
+    assert open(c1, "rb").read() != open(src, "rb").read()
+    with pytest.raises(Exception):
+        np.load(c1)["a"].sum()
+    flip = faults.corrupt_npz_file(src, str(tmp_path / "f.npz"),
+                                   seed=3, mode="flip")
+    assert os.path.getsize(flip) == os.path.getsize(src)
+    assert open(flip, "rb").read() != open(src, "rb").read()
+
+
+# -------------------------------------- checkpoint-write preemption
+
+def test_truncated_checkpoint_write_preserves_previous(tmp_path):
+    """ckpt_truncate seam: the writer dies mid-save with a half-written
+    tmp file — the atomic-rename discipline must leave the PREVIOUS
+    checkpoint bit-for-bit intact and no wreckage behind."""
+    from dpsvm_tpu.utils.checkpoint import (load_checkpoint_state,
+                                            save_checkpoint)
+
+    cfg = SVMConfig(c=1.0, gamma=0.1)
+    p = str(tmp_path / "ck.npz")
+    alpha = np.arange(6, dtype=np.float32)
+    save_checkpoint(p, alpha, -alpha, 100, -0.5, 0.5, cfg)
+    before = open(p, "rb").read()
+    with faults.install(faults.FaultPlan.parse("ckpt_truncate")) as plan:
+        with pytest.raises(faults.FaultInjected, match="preemption"):
+            save_checkpoint(p, alpha * 2, -alpha, 200, 0.0, 0.0, cfg)
+    assert plan.fired["ckpt_truncate"] == 1
+    assert open(p, "rb").read() == before
+    assert not [t for t in os.listdir(tmp_path) if t.endswith(".tmp")]
+    assert load_checkpoint_state(p).iteration == 100
+
+
+# ------------------------------------------- non-finite -> demotion
+
+def test_nonfinite_obs_demotes_to_safe_config(blobs_small, no_backoff):
+    """The graceful-degradation tentpole: a NaN surfacing in the
+    chunk-boundary observation restarts the solve under the SAFE
+    configuration (f32 storage here — the bf16 dtype is the dropped
+    knob) with a loud warning, stats['demoted_faults'] and the exact
+    f32 optimum."""
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, max_iter=100_000,
+                    chunk_iters=128, dtype="bfloat16")
+    ref = solve(x, y, cfg.replace(dtype="float32"),
+                callback=lambda *a: None)
+    with faults.install(faults.FaultPlan.parse("nonfinite_obs@2")) as plan, \
+            warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = solve(x, y, cfg, callback=lambda *a: None)
+    assert plan.fired["nonfinite_obs"] == 1
+    assert res.stats["demoted_faults"] == 1
+    assert res.stats["demotion"]["dropped"] == ["dtype=bfloat16"]
+    assert any("DEMOTING" in str(m.message) for m in w)
+    assert res.converged
+    np.testing.assert_array_equal(res.alpha, ref.alpha)
+
+
+def test_nonfinite_on_safe_config_fails_loudly(blobs_small, no_backoff):
+    """An already-safe config has nothing to demote: the sentinel must
+    PROPAGATE (a real numerics bug), never loop or return a silently
+    corrupt 'converged' model."""
+    x, y = blobs_small
+    with faults.install(faults.FaultPlan.parse("nonfinite_obs@1")):
+        with pytest.raises(NonFiniteTrajectory, match="non-finite"):
+            solve(x, y, SVMConfig(c=1.0, gamma=0.1, chunk_iters=128),
+                  callback=lambda *a: None)
+
+
+def test_sentinel_sign_convention():
+    """ops/select.py masks I_up with +inf (b_hi = min) and I_low with
+    -inf (b_lo = max): the LEGITIMATE empty-side values b_hi=+inf /
+    b_lo=-inf must pass (they correctly read converged), while the
+    impossible signs — inf entries in f winning the min/max — must
+    trip."""
+    from dpsvm_tpu.solver.smo import check_obs_finite
+
+    inf = float("inf")
+    check_obs_finite(-1.0, 1.0, 0, "t")       # ordinary open gap
+    check_obs_finite(inf, -inf, 0, "t")       # both sides empty: legit
+    check_obs_finite(inf, 0.5, 0, "t")        # empty I_up: legit
+    for bad in ((float("nan"), 1.0), (-1.0, float("nan")),
+                (-inf, 1.0), (-1.0, inf)):
+        with pytest.raises(NonFiniteTrajectory):
+            check_obs_finite(bad[0], bad[1], 0, "t")
+
+
+def test_nonfinite_state_never_checkpointed(tmp_path):
+    """The observed extrema lag the fold by one round, so the blow-up
+    round would otherwise persist NaN f under finite extrema — the
+    writer must SKIP that save (keeping the last good checkpoint as
+    the restore point) and resume must refuse a non-finite file."""
+    from dpsvm_tpu.utils.checkpoint import (PeriodicCheckpointer,
+                                            load_checkpoint_state,
+                                            resume_state,
+                                            save_checkpoint)
+
+    cfg = SVMConfig(c=1.0, gamma=0.1, checkpoint_every=1)
+    p = str(tmp_path / "ck.npz")
+    ck = PeriodicCheckpointer(p, cfg)
+    alpha = np.ones(4, np.float32)
+    assert ck.save(10, alpha, -alpha, -0.5, 0.5)
+    bad_f = np.array([0.0, np.nan, 0.0, 0.0], np.float32)
+    with pytest.warns(UserWarning, match="SKIPPED"):
+        assert not ck.save(20, alpha, bad_f, -0.5, 0.5)
+    assert load_checkpoint_state(p).iteration == 10  # last good kept
+    # A non-finite file (written by some other tool) refuses resume.
+    save_checkpoint(str(tmp_path / "bad.npz"), alpha, bad_f, 20,
+                    -0.5, 0.5, cfg)
+    with pytest.raises(ValueError, match="non-finite"):
+        resume_state(str(tmp_path / "bad.npz"), cfg, 4)
+
+
+def test_mesh_nonfinite_obs_demotes(blobs_small, no_backoff):
+    """The mesh loop carries the same sentinel + demotion backstop as
+    the single-chip driver (a NaN gap must never read 'converged' on
+    any backend)."""
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, chunk_iters=128,
+                    dtype="bfloat16")
+    ref = solve_mesh(x, y, cfg.replace(dtype="float32"), num_devices=2,
+                     callback=lambda *a: None)
+    with faults.install(faults.FaultPlan.parse("nonfinite_obs@2")) as plan, \
+            warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = solve_mesh(x, y, cfg, num_devices=2,
+                         callback=lambda *a: None)
+    assert plan.fired["nonfinite_obs"] == 1
+    assert res.stats["demoted_faults"] == 1
+    assert any("DEMOTING" in str(m.message) for m in w)
+    assert res.converged
+    np.testing.assert_array_equal(res.alpha, ref.alpha)
+
+
+def test_demote_to_safe_knob_inventory():
+    from dpsvm_tpu.solver.block import demote_to_safe
+
+    cfg, dropped = demote_to_safe(SVMConfig(
+        engine="block", dtype="bfloat16", fused_fold=True))
+    assert cfg.dtype == "float32" and cfg.fused_fold is False
+    # auto (None) gates are pinned off but not reported as drops
+    assert cfg.fused_round is False and cfg.pipeline_rounds is False
+    assert dropped == ("dtype=bfloat16", "fused_fold")
+    safe, none_dropped = demote_to_safe(SVMConfig(engine="block"))
+    assert safe is None and none_dropped == ()
+
+
+# -------------------------------------------------- obs event trail
+
+def test_fault_retry_events_and_report_column(blobs_small, no_backoff,
+                                              tmp_path, monkeypatch):
+    """A retried fault leaves fault/retry event records in the (new
+    attempt's) run log, and `cli obs report` renders them in the
+    faults column."""
+    from dpsvm_tpu.obs.analyze import (load_runs, render_report,
+                                       summarize_run)
+    from dpsvm_tpu.obs.runlog import read_runlog, records_for
+
+    monkeypatch.setenv("DPSVM_OBS_DIR", str(tmp_path))
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, max_iter=100_000, chunk_iters=64,
+                    retry_faults=2, obs=ObsConfig(
+                        enabled=True, runlog_dir=str(tmp_path)))
+    with faults.install(faults.FaultPlan.parse("dispatch@2")) as plan:
+        res = solve(x, y, cfg, callback=lambda *a: None)
+    assert plan.fired["dispatch"] == 1
+    assert res.converged
+    recs = read_runlog(res.stats["obs_runlog"])
+    events = records_for(recs, res.stats["obs_run_id"], "event")
+    names = [e["name"] for e in events]
+    assert "fault" in names and "retry" in names
+    fault_ev = next(e for e in events if e["name"] == "fault")
+    assert "injected fault" in fault_ev["error"]
+    summaries = [summarize_run(r)
+                 for r in load_runs([res.stats["obs_runlog"]])
+                 if r.run_id == res.stats["obs_run_id"]]
+    assert summaries[0]["fault_events"]["fault"] == 1
+    assert summaries[0]["fault_events"]["retry"] == 1
+    table = render_report(summaries)
+    assert "faults" in table.splitlines()[0]
+    assert "f=1 r=1" in table
+
+
+# ------------------------------------- multi-host retry-drop warning
+
+def test_multihost_retry_drop_warns_once(blobs_small, monkeypatch):
+    """dist_smo satellite: forcing retry_faults=0 on a multi-process
+    pod must WARN (naming the relaunch-with---resume procedure), and
+    only once per process — not once per submodel solve."""
+    import jax
+
+    import dpsvm_tpu.parallel.dist_smo as dist_mod
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(dist_mod, "_WARNED_MULTIHOST_RETRY", False)
+    x, y = blobs_small
+    cfg = SVMConfig(c=1.0, gamma=0.1, epsilon=1e-3, retry_faults=2)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = solve_mesh(x, y, cfg, num_devices=2)
+        solve_mesh(x, y, cfg, num_devices=2)  # second call: no repeat
+    assert res.converged
+    msgs = [str(m.message) for m in w
+            if "retry_faults" in str(m.message)]
+    assert len(msgs) == 1, msgs
+    assert "--resume" in msgs[0] and "RELAUNCH" in msgs[0]
+    # retry_faults=0 (or an explicit 0) never warns.
+    monkeypatch.setattr(dist_mod, "_WARNED_MULTIHOST_RETRY", False)
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        solve_mesh(x, y, cfg.replace(retry_faults=0), num_devices=2)
+    assert not [m for m in w2 if "retry_faults" in str(m.message)]

@@ -200,8 +200,93 @@ def test_ooc_mesh_backend_rejected(data):
                    num_devices=2)
 
 
-def test_ooc_checkpoint_rejected(data, tmp_path):
+# ------------------------------ checkpoint/resume (ISSUE 13 tentpole)
+# The pin standard is the module's own: BITWISE equality to the
+# uninterrupted run — same alpha bits, same gradient bits, same pair
+# count — which the v2 checkpoint's full carry (raw f + f_err lanes +
+# round counter) makes possible.
+
+def test_ooc_resume_bitwise(data, incore, tmp_path):
+    """Abort mid-solve (forced checkpoint at the abort boundary), then
+    resume: the final state must equal the uninterrupted trajectory's
+    BITWISE — which is also bitwise-equal to the in-core engine."""
     x, y = data
-    with pytest.raises(ValueError, match="checkpoint"):
-        solve(x, y, CFG.replace(ooc=True),
-              checkpoint_path=str(tmp_path / "ck.npz"))
+    p = str(tmp_path / "ooc.ck.npz")
+    cfg = CFG.replace(ooc=True, ooc_tile_rows=256,
+                      checkpoint_every=1_000_000)  # only the abort saves
+    part = solve(x, y, cfg, callback=lambda it, bh, bl, st: it >= 600,
+                 checkpoint_path=p)
+    assert not part.converged and part.iterations < incore.iterations
+    res = solve(x, y, cfg, checkpoint_path=p, resume=True)
+    assert res.stats["resumed_from"] == part.iterations
+    _assert_bitwise(incore, res)
+
+
+def test_ooc_resume_memmap_and_padded_tail(data, tmp_path):
+    """The resume pin through BOTH hard cases at once: a memmap-backed
+    X (never fully host-resident) at an n that leaves a zero-padded
+    tail tile. Compensated, so the restored f_err lanes carry."""
+    x, y = data
+    x, y = x[:1000], y[:1000]  # 1000 % 256 != 0 -> padded tail
+    cfg = CFG.replace(compensated=True)
+    ic = solve(x, y, cfg)
+    path = tmp_path / "x.dat"
+    mm = np.memmap(path, dtype=np.float32, mode="w+", shape=x.shape)
+    mm[:] = x
+    mm.flush()
+    ro = np.memmap(path, dtype=np.float32, mode="r", shape=x.shape)
+    p = str(tmp_path / "ooc.ck.npz")
+    ocfg = cfg.replace(ooc=True, ooc_tile_rows=256,
+                       checkpoint_every=1_000_000)
+    part = solve(ro, y, ocfg,
+                 callback=lambda it, bh, bl, st: it >= 500,
+                 checkpoint_path=p)
+    assert not part.converged
+    from dpsvm_tpu.utils.checkpoint import load_checkpoint_state
+    st = load_checkpoint_state(p)
+    assert st.format_version == 2 and st.f_err is not None
+    assert st.rounds > 0
+    res = solve(ro, y, ocfg, checkpoint_path=p, resume=True)
+    _assert_bitwise(ic, res)
+
+
+def test_ooc_tile_put_fault_retries_from_checkpoint(data, incore,
+                                                    tmp_path,
+                                                    monkeypatch):
+    """An injected transient fault on a mid-stream tile device_put
+    (the ooc_tile_put seam) retries from the periodic checkpoint and
+    still lands bitwise on the uninterrupted optimum; the run log
+    carries the fault/retry/resume trail."""
+    import dpsvm_tpu.solver.smo as smo_mod
+    from dpsvm_tpu.testing import faults
+
+    monkeypatch.setattr(smo_mod, "_RETRY_BACKOFF_S", ())
+    x, y = data
+    p = str(tmp_path / "ooc.ck.npz")
+    cfg = CFG.replace(ooc=True, ooc_tile_rows=256, checkpoint_every=256,
+                      obs=ObsConfig(enabled=True,
+                                    runlog_dir=str(tmp_path)))
+    with faults.install(faults.FaultPlan.parse("ooc_tile_put@30")) as plan:
+        res = solve(x, y, cfg, checkpoint_path=p)
+    assert plan.fired["ooc_tile_put"] == 1
+    assert res.stats["resumed_from"] > 0
+    _assert_bitwise(incore, res)
+    from dpsvm_tpu.obs.runlog import read_runlog, records_for
+    events = records_for(read_runlog(res.stats["obs_runlog"]),
+                         res.stats["obs_run_id"], "event")
+    names = [e["name"] for e in events]
+    assert "fault" in names and "retry" in names and "resume" in names
+
+
+def test_ooc_cache_restarts_cold_on_resume(data, tmp_path):
+    """Cache-ON resume is exact but NOT bitwise (the cold cache moves
+    the all-hit rounds), and says so: stats['cache_cold_restart']."""
+    x, y = data
+    p = str(tmp_path / "ooc.ck.npz")
+    cfg = CFG.replace(ooc=True, ooc_tile_rows=256, ooc_cache_lines=1024,
+                      checkpoint_every=1_000_000)
+    solve(x, y, cfg, callback=lambda it, bh, bl, st: it >= 600,
+          checkpoint_path=p)
+    res = solve(x, y, cfg, checkpoint_path=p, resume=True)
+    assert res.converged
+    assert res.stats["cache_cold_restart"] is True

@@ -554,6 +554,228 @@ def test_scrape_racing_predict_server_close(two_versions):
     assert bodies
 
 
+# ---------------------------------- crash recovery + watchdog (ISSUE 13)
+
+def test_journal_replay_after_simulated_crash(model_files, two_versions,
+                                              tmp_path):
+    """An engine with a registry journal dies (no close(), nothing
+    flushed — the journal was written atomically at register/swap
+    time); a new engine on the same journal replays the EXACT live
+    set: same names, same versions, decisions identical per model."""
+    p1, p2 = model_files
+    _, _, x = two_versions
+    jp = str(tmp_path / "registry.journal")
+    eng = _engine(journal_path=jp)
+    eng.register("m", p1)
+    eng.swap("m", p2)          # version 2 is the live one
+    eng.register("aux", p1)
+    q = np.asarray(x[:16], np.float32)
+    pre_m = eng.decision(q, model="m")
+    pre_aux = eng.decision(q, model="aux")
+    del eng  # crash: close() never runs
+
+    eng2 = _engine(journal_path=jp)
+    assert sorted(eng2._rehydrated) == ["aux", "m"]
+    assert eng2.registry.get("m").version == 2
+    assert eng2.registry.get("aux").version == 1
+    np.testing.assert_array_equal(eng2.decision(q, model="m"), pre_m)
+    np.testing.assert_array_equal(eng2.decision(q, model="aux"),
+                                  pre_aux)
+    # an unregister shrinks the journal too
+    eng2.unregister("aux")
+    eng2.close()
+    eng3 = _engine(journal_path=jp)
+    assert eng3.registry.names() == ["m"]
+    eng3.close()
+
+
+def test_journal_skips_object_models_and_refuses_corrupt(two_versions,
+                                                         tmp_path):
+    """Object-registered models are not journalable (nothing to
+    replay); a corrupt journal file refuses construction LOUDLY."""
+    import json
+
+    m1, _, _ = two_versions
+    jp = str(tmp_path / "registry.journal")
+    eng = _engine(journal_path=jp)
+    eng.register("obj", m1)  # in-memory object: journaled nowhere
+    eng.close()
+    assert json.load(open(jp))["models"] == {}
+    eng2 = _engine(journal_path=jp)  # replays to an empty (valid) set
+    assert eng2._rehydrated == []
+    eng2.close()
+    with open(jp, "w") as fh:
+        fh.write('{"format_version": 1, "models": {tor')  # torn write
+    with pytest.raises(ValueError, match="journal"):
+        _engine(journal_path=jp)
+
+
+def test_failed_replay_releases_port_and_sinks(tmp_path):
+    """A journal replay failure aborts construction AFTER the metrics
+    exporter bound its port and the compile sink registered — close()
+    is unreachable on a half-built engine, so __init__ itself must
+    tear those down: a supervisor retrying construction on a fixed
+    port must see the REAL error again, not EADDRINUSE, and sinks
+    must not accumulate per attempt."""
+    import json
+    import socket
+
+    from dpsvm_tpu.obs import compilelog
+    from dpsvm_tpu.serving.registry import ModelLoadError
+
+    jp = str(tmp_path / "registry.journal")
+    with open(jp, "w") as fh:
+        json.dump({"format_version": 1, "models": {
+            "ghost": {"source": str(tmp_path / "missing.npz"),
+                      "version": 3}}}, fh)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    n_sinks = len(compilelog._sinks)
+    for _ in range(2):  # second attempt would EADDRINUSE on a leak
+        with pytest.raises(ModelLoadError):
+            _engine(journal_path=jp, metrics_port=port)
+    assert len(compilelog._sinks) == n_sinks
+
+
+def test_corrupted_swap_seam_leaves_live_serving(model_files,
+                                                 two_versions):
+    """The swap_corrupt fault seam: the registry load reads
+    deterministically corrupted bytes — the swap must be refused via
+    the REAL validation path and the live version keeps serving."""
+    from dpsvm_tpu.serving import ModelLoadError
+    from dpsvm_tpu.testing import faults
+
+    p1, p2 = model_files
+    m1, _, x = two_versions
+    eng = _engine()
+    eng.register("m", p1)
+    q = np.asarray(x[:12], np.float32)
+    ref = eng.decision(q)
+    with faults.install(faults.FaultPlan.parse("swap_corrupt")) as plan:
+        with pytest.raises(ModelLoadError):
+            eng.swap("m", p2)
+    assert plan.fired["swap_corrupt"] == 1
+    assert eng.registry.get("m").version == 1
+    np.testing.assert_array_equal(eng.decision(q), ref)
+    eng.close()
+
+
+def test_dispatch_fault_fails_batch_and_engine_survives(two_versions):
+    """serve_dispatch seam: a raising dispatch fails THAT batch with
+    explicit 'failed' verdicts + per-model counters; the next batch
+    serves normally."""
+    from dpsvm_tpu.testing import faults
+
+    m1, _, x = two_versions
+    eng = _engine()
+    eng.register("m", m1)
+    q = np.asarray(x[:12], np.float32)
+    ref = eng.decision(q)
+    with faults.install(
+            faults.FaultPlan.parse("serve_dispatch@1")) as plan:
+        ticket = eng.submit(q, model="m")
+        done = eng.drain()
+    assert plan.fired["serve_dispatch"] == 1
+    res = done[ticket]
+    assert res.verdict == "failed" and res.failed
+    assert res.decision is None and res.labels() is None
+    assert eng.dispatch_failures.value == 1
+    assert eng.snapshot()["per_model"]["m"]["dispatch_failures"] == 1
+    np.testing.assert_array_equal(eng.decision(q), ref)
+    eng.close()
+
+
+def test_failed_segment_chain_stops_dispatching(two_versions):
+    """An oversized request whose mid-chain segment fails must not
+    keep dispatching the remaining segments: the chain is dead, the
+    request already carries its 'failed' verdict, and further device
+    work would be pure waste."""
+    from dpsvm_tpu.testing import faults
+
+    m1, _, x = two_versions
+    eng = _engine()  # buckets (16, 64): 200 rows = 4 segments
+    eng.register("m", m1)
+    big = np.repeat(np.asarray(x[:20], np.float32), 10, axis=0)
+    assert big.shape[0] == 200
+    with faults.install(
+            faults.FaultPlan.parse("serve_dispatch@2")) as plan:
+        ticket = eng.submit(big, model="m")
+        done = eng.drain()
+    assert done[ticket].verdict == "failed"
+    assert eng.dispatch_failures.value == 1  # ONE failure, not four
+    # Segment 2's issue failed the chain; segments 3 and 4 were never
+    # dispatched (every dispatch passes the seam, so arrivals count
+    # them).
+    assert plan.arrivals["serve_dispatch"] == 2, plan.arrivals
+    # and the engine still serves
+    assert eng.decision(np.asarray(x[:8], np.float32)) is not None
+    eng.close()
+
+
+def test_watchdog_bounds_wedged_dispatch(two_versions, monkeypatch):
+    """The dispatch watchdog (ServeConfig.dispatch_timeout_ms): a
+    stalled materialization fails within the bound — explicit verdict,
+    watchdog counter — and the pump keeps serving with the watchdog
+    still armed."""
+    from dpsvm_tpu.testing import faults
+
+    monkeypatch.setattr(faults, "STALL_SECONDS", 3.0)
+    m1, _, x = two_versions
+    eng = _engine(dispatch_timeout_ms=150.0)
+    eng.register("m", m1)
+    q = np.asarray(x[:12], np.float32)
+    ref = eng.decision(q)  # healthy (and timeout-supervised) baseline
+    with faults.install(
+            faults.FaultPlan.parse("serve_stall@1")) as plan:
+        ticket = eng.submit(q, model="m")
+        t0 = time.perf_counter()
+        done = eng.drain()
+        bounded = time.perf_counter() - t0
+    assert plan.fired["serve_stall"] == 1
+    assert done[ticket].verdict == "failed"
+    assert bounded < 2.0, bounded  # the 3s stall never blocked us
+    assert eng.watchdog_trips.value == 1
+    np.testing.assert_array_equal(eng.decision(q), ref)
+    eng.close()
+
+
+def test_scrape_during_watchdog_race(two_versions, monkeypatch):
+    """A /metrics scrape concurrent with a watchdog-supervised stall
+    must see complete expositions throughout — including the
+    serving_dispatch_failures family once the trip lands — and the
+    engine must finish the drain bounded."""
+    from dpsvm_tpu.testing import faults
+
+    monkeypatch.setattr(faults, "STALL_SECONDS", 3.0)
+    m1, _, x = two_versions
+    eng = _engine(dispatch_timeout_ms=200.0, metrics_port=0)
+    eng.register("m", m1)
+    q = np.asarray(x[:12], np.float32)
+    url = eng.exporter.url
+    stop, errors, bodies = threading.Event(), [], []
+    hammer = threading.Thread(target=_hammer_scrapes,
+                              args=(url, stop, errors, bodies))
+    hammer.start()
+    try:
+        with faults.install(faults.FaultPlan.parse("serve_stall@1")):
+            ticket = eng.submit(q, model="m")
+            done = eng.drain()
+        time.sleep(0.05)  # at least one post-trip scrape
+    finally:
+        stop.set()
+        hammer.join(timeout=5)
+    assert not errors, errors
+    assert bodies  # scrapes really ran during the stall window
+    assert done[ticket].verdict == "failed"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        text = resp.read().decode()
+    assert 'serving_dispatch_failures_total{model="m"} 1' in text
+    assert "serving_watchdog_trips_total 1" in text
+    eng.close()
+
+
 # ----------------------------------------------------------- config/CLI
 
 def test_deadline_config_validation():
@@ -562,6 +784,12 @@ def test_deadline_config_validation():
     with pytest.raises(ValueError, match="deadline_ms"):
         ServeConfig(deadline_ms=-5.0)
     assert ServeConfig(deadline_ms=100.0).deadline_ms == 100.0
+    with pytest.raises(ValueError, match="dispatch_timeout_ms"):
+        ServeConfig(dispatch_timeout_ms=0.0)
+    with pytest.raises(ValueError, match="journal_path"):
+        ServeConfig(journal_path="")
+    assert ServeConfig(dispatch_timeout_ms=250.0).dispatch_timeout_ms \
+        == 250.0
 
 
 def test_cli_serve_registry_roundtrip(model_files, two_versions,

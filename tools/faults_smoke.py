@@ -1,0 +1,206 @@
+"""Fault-tolerance smoke (ISSUE 13) — `make faults_smoke`, wired into
+tier1.yml.
+
+Three checks, each proving an acceptance behavior with a REAL injected
+fault (dpsvm_tpu/testing/faults.py), end to end:
+
+1. **Harness self-test** — spec parsing, deterministic arrival firing,
+   seeded byte corruption reproducibility, env-var activation.
+2. **ooc kill -9 / --resume** — a subprocess training out-of-core with
+   periodic checkpoints is SIGKILLed mid-solve (nothing can be
+   flushed); a relaunch with resume lands BITWISE on the uninterrupted
+   run's alpha/f/extrema. This is the acceptance criterion verbatim,
+   as a process-level kill rather than an in-process abort.
+3. **Watchdog trip** — a stalled dispatch (serve_stall seam) must be
+   bounded by ServeConfig.dispatch_timeout_ms, fail with an explicit
+   'failed' verdict + counters, and leave the engine serving the next
+   batch.
+
+Runs on the CPU harness (JAX_PLATFORMS=cpu), no artifacts written;
+exit 0 = all behaviors held.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def check_harness() -> None:
+    from dpsvm_tpu.testing import faults
+
+    plan = faults.FaultPlan.parse("dispatch@3,ooc_tile_put@2x2")
+    fires = [plan.arrive("dispatch") for _ in range(5)]
+    assert fires == [False, False, True, False, False], fires
+    fires = [plan.arrive("ooc_tile_put") for _ in range(4)]
+    assert fires == [False, True, True, False], fires
+    assert plan.fired == {"dispatch": 1, "ooc_tile_put": 2}, plan.fired
+    try:
+        faults.FaultPlan.parse("not_a_seam")
+        raise AssertionError("typo'd seam accepted")
+    except ValueError:
+        pass
+    # Disarmed: no plan, every arrival is a no-op False.
+    assert faults.active_plan() is None
+    assert not faults.arrive("dispatch")
+    # Seeded corruption is reproducible and genuinely corrupting.
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="dpsvm_faults_smoke_")
+    src = os.path.join(tmp, "m.npz")
+    np.savez_compressed(src, a=np.arange(4096, dtype=np.float32))
+    c1 = faults.corrupt_npz_file(src, os.path.join(tmp, "c1.npz"), seed=3)
+    c2 = faults.corrupt_npz_file(src, os.path.join(tmp, "c2.npz"), seed=3)
+    with open(c1, "rb") as f1, open(c2, "rb") as f2:
+        assert f1.read() == f2.read(), "corruption not deterministic"
+    try:
+        np.load(c1)["a"].sum()
+        raise AssertionError("corrupted npz loaded cleanly")
+    except AssertionError:
+        raise
+    except Exception:
+        pass
+    print("[faults_smoke] harness self-test OK")
+
+
+_CHILD = r"""
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, {repo!r})
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synth import make_blobs_binary
+from dpsvm_tpu.solver.smo import solve
+
+x, y = make_blobs_binary(n=1024, d=24, seed=11, sep=1.0)
+cfg = SVMConfig(c=2.0, epsilon=1e-3, engine="block", working_set_size=64,
+                max_iter=50_000, ooc=True, ooc_tile_rows=256,
+                compensated=True, checkpoint_every=128, retry_faults=0)
+slow = "--slow" in sys.argv
+def cb(it, bh, bl, st):
+    if slow:
+        time.sleep(0.02)  # widen the kill window
+res = solve(x, y, cfg, callback=cb, checkpoint_path={ck!r}, resume=True)
+np.savez({out!r}, alpha=res.alpha, f=res.stats["f"],
+         b_hi=np.float64(res.b_hi), b_lo=np.float64(res.b_lo),
+         iterations=res.iterations, converged=res.converged)
+print("DONE", res.iterations, flush=True)
+"""
+
+
+def check_ooc_kill_resume() -> None:
+    """kill -9 mid-ooc-solve, then --resume: bitwise-equal final state
+    (the ISSUE 13 acceptance criterion)."""
+    import tempfile
+
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data.synth import make_blobs_binary
+    from dpsvm_tpu.solver.smo import solve
+    from dpsvm_tpu.utils.hostenv import cleaned_cpu_env
+
+    tmp = tempfile.mkdtemp(prefix="dpsvm_faults_smoke_")
+    ck = os.path.join(tmp, "ooc.ck.npz")
+    out = os.path.join(tmp, "ooc.result.npz")
+    code = _CHILD.format(repo=REPO, ck=ck, out=out)
+    env = cleaned_cpu_env(1)
+
+    proc = subprocess.Popen([sys.executable, "-c", code, "--slow"],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    deadline = time.time() + 180
+    try:
+        while time.time() < deadline and not os.path.exists(ck):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "child finished before a checkpoint appeared: "
+                    + proc.stderr.read().decode()[-500:])
+            time.sleep(0.05)
+        assert os.path.exists(ck), "no ooc checkpoint within 180s"
+        time.sleep(0.3)  # advance past the first checkpoint
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert not os.path.exists(out), "child should have died mid-run"
+    print("[faults_smoke] SIGKILLed ooc child mid-solve "
+          f"(checkpoint at {ck})")
+
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, timeout=600)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    z = np.load(out)
+    assert bool(z["converged"])
+
+    x, y = make_blobs_binary(n=1024, d=24, seed=11, sep=1.0)
+    full = solve(x, y, SVMConfig(c=2.0, epsilon=1e-3, engine="block",
+                                 working_set_size=64, max_iter=50_000,
+                                 ooc=True, ooc_tile_rows=256,
+                                 compensated=True))
+    assert int(z["iterations"]) == full.iterations
+    np.testing.assert_array_equal(z["alpha"], full.alpha)
+    np.testing.assert_array_equal(z["f"], full.stats["f"])
+    assert float(z["b_hi"]) == full.b_hi
+    assert float(z["b_lo"]) == full.b_lo
+    print("[faults_smoke] ooc kill -9 -> resume BITWISE-equal "
+          f"({full.iterations} pairs) OK")
+
+
+def check_watchdog() -> None:
+    from dpsvm_tpu.config import ServeConfig, SVMConfig
+    from dpsvm_tpu.models.multiclass import train_multiclass
+    from dpsvm_tpu.serving import ServingEngine
+    from dpsvm_tpu.testing import faults
+
+    rng = np.random.default_rng(7)
+    x = np.concatenate([
+        rng.normal(size=(60, 4)).astype(np.float32) + off
+        for off in (0.0, 2.5)])
+    y = np.repeat([0, 1], 60)
+    model, _ = train_multiclass(
+        x, y, SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3), strategy="ovr")
+
+    faults.STALL_SECONDS = 3.0
+    eng = ServingEngine(ServeConfig(buckets=(16, 64),
+                                    dispatch_timeout_ms=150.0))
+    eng.register("m", model)
+    q = np.asarray(x[:12], np.float32)
+    ref = eng.decision(q)  # healthy baseline
+    with faults.install(faults.FaultPlan.parse("serve_stall@1")) as plan:
+        ticket = eng.submit(q, model="m")
+        t0 = time.perf_counter()
+        done = eng.drain()
+        bounded = time.perf_counter() - t0
+    assert plan.fired["serve_stall"] == 1, "stall never fired"
+    res = done[ticket]
+    assert res.verdict == "failed" and res.decision is None, res
+    assert bounded < 2.0, f"watchdog not bounded: {bounded:.2f}s"
+    assert eng.watchdog_trips.value == 1
+    assert eng.snapshot()["per_model"]["m"]["dispatch_failures"] == 1
+    # The engine keeps serving after the trip, identically.
+    np.testing.assert_array_equal(eng.decision(q), ref)
+    eng.close()
+    print(f"[faults_smoke] watchdog tripped in {bounded:.2f}s "
+          "(150 ms bound + drain), explicit 'failed' verdict, engine "
+          "kept serving OK")
+
+
+def main() -> int:
+    check_harness()
+    check_ooc_kill_resume()
+    check_watchdog()
+    print("[faults_smoke] ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

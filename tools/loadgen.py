@@ -79,7 +79,7 @@ def closed_loop(engine, n_requests: int, concurrency: int, sizes,
     submitted = completed = 0
     outstanding = 0
     swapped = swap_fn is None
-    verdicts = {"ok": 0, "late": 0, "expired": 0}
+    verdicts = {"ok": 0, "late": 0, "expired": 0, "failed": 0}
     t0 = time.perf_counter()
     last_progress = t0
     while completed < n_requests:
@@ -198,6 +198,15 @@ def main(argv=None) -> int:
                          "tightens it)")
     ap.add_argument("--aux-share", type=float, default=0.15,
                     help="traffic share of the second registered model")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the CHAOS leg after the sweep (ISSUE "
+                         "13): a corrupted-file hot swap at the best "
+                         "operating point (must be refused, live "
+                         "version keeps serving) and an engine "
+                         "kill/rehydrate-from-journal cycle "
+                         "(decisions must be identical per live "
+                         "model version, zero failed/expired on the "
+                         "surviving path); always on with --smoke")
     ap.add_argument("--smoke", action="store_true",
                     help="short CI sweep: fewer requests, artifact to "
                          "--out (never the committed r<NN> series), no "
@@ -247,8 +256,13 @@ def main(argv=None) -> int:
         paths[name] = os.path.join(tmp, f"{name}.npz")
         m.save(paths[name])
 
+    # The registry journal rides along from the start (free: one tiny
+    # atomic JSON rewrite per register/swap) — it is what the chaos
+    # leg's kill/rehydrate cycle replays.
+    journal_path = os.path.join(tmp, "registry.journal")
     config = ServeConfig(metrics_port=0,
                          deadline_ms=args.deadline_ms,
+                         journal_path=journal_path,
                          obs=ObsConfig(enabled=args.obs,
                                        runlog_dir=args.obs_dir))
     engine = ServingEngine(config)
@@ -328,7 +342,8 @@ def main(argv=None) -> int:
     # a swap that stalled the serving loop would blow it and show up
     # here).
     peak = swap_leg
-    assert peak["failed"] == 0 and peak["expired"] == 0, peak
+    assert peak["failed"] == 0 and peak["expired"] == 0 \
+        and peak["verdicts"]["failed"] == 0, peak
     assert engine.hot_swaps.value == 1
 
     # --- overload leg: tight deadline at high concurrency — the
@@ -340,6 +355,86 @@ def main(argv=None) -> int:
     print(f"[loadgen] overload: miss_rate="
           f"{overload['deadline_miss_rate']} "
           f"(expired {overload['expired']})", file=sys.stderr)
+
+    # --- CHAOS leg (ISSUE 13): the two crash-recovery behaviors the
+    # engine now owes, exercised at the best operating point.
+    chaos = None
+    if args.chaos or args.smoke:
+        from dpsvm_tpu.serving import ModelLoadError
+        from dpsvm_tpu.testing import faults as fault_harness
+
+        # (a) corrupted-file hot swap: a deterministically corrupted
+        # copy of the v2 file must be REFUSED (ModelLoadError) with
+        # the live version untouched and still serving — the
+        # validate-before-flip contract under a realistic bad file.
+        bad = fault_harness.corrupt_npz_file(
+            paths["mnist_v2"], os.path.join(tmp, "mnist.corrupt.npz"),
+            seed=5)
+        live_before = engine.registry.get("mnist").version
+        refused = False
+        try:
+            engine.swap("mnist", bad)
+        except ModelLoadError as e:
+            refused = True
+            print(f"[loadgen] chaos: corrupted swap refused "
+                  f"({str(e)[:80]}...)", file=sys.stderr)
+        assert refused, "corrupted swap was ACCEPTED"
+        assert engine.registry.get("mnist").version == live_before
+        surviving = closed_loop(engine, max(32, args.requests // 4),
+                                best_clean["concurrency"], sizes,
+                                traffic, seed=7)
+        assert surviving["failed"] == 0 \
+            and surviving["verdicts"]["failed"] == 0 \
+            and surviving["expired"] == 0, surviving
+
+        # (b) engine kill/rehydrate-from-journal: a SECOND engine
+        # constructed from the same journal must replay the exact
+        # live set (versions included) and serve decisions identical
+        # to the pre-crash engine, then carry traffic with zero
+        # failed/expired. The first engine is deliberately NOT closed
+        # first — the journal's durability cannot depend on a clean
+        # shutdown.
+        names = [t[0] for t in traffic]
+        probe_rng = np.random.default_rng(123)
+        probes = {n: probe_rng.random((8, engine.registry.get(n).d),
+                                      dtype=np.float32)
+                  for n in names}
+        pre = {n: engine.decision(probes[n], model=n) for n in names}
+        pre_versions = {e.name: e.version
+                        for e in engine.registry.entries()}
+        eng2 = ServingEngine(ServeConfig(
+            deadline_ms=args.deadline_ms, journal_path=journal_path,
+            obs=ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir)))
+        post_versions = {e.name: e.version
+                        for e in eng2.registry.entries()}
+        assert post_versions == pre_versions, (pre_versions,
+                                               post_versions)
+        for n in names:
+            np.testing.assert_array_equal(
+                eng2.decision(probes[n], model=n), pre[n])
+        rehydrated = closed_loop(eng2, max(32, args.requests // 4),
+                                 best_clean["concurrency"], sizes,
+                                 traffic, seed=8)
+        assert rehydrated["failed"] == 0 \
+            and rehydrated["verdicts"]["failed"] == 0 \
+            and rehydrated["expired"] == 0, rehydrated
+        eng2.close()
+        print(f"[loadgen] chaos: kill/rehydrate replayed "
+              f"{len(post_versions)} models ({post_versions}), "
+              f"decisions identical, surviving path clean",
+              file=sys.stderr)
+        chaos = {
+            "corrupted_swap_refused": refused,
+            "live_version_after_bad_swap": live_before,
+            "surviving_leg": {k: surviving[k] for k in
+                              ("rows_per_second", "verdicts",
+                               "expired", "failed")},
+            "rehydrated_versions": post_versions,
+            "rehydrated_decisions_identical": True,
+            "rehydrated_leg": {k: rehydrated[k] for k in
+                               ("rows_per_second", "verdicts",
+                                "expired", "failed")},
+        }
 
     frontier = [{k: lg[k] for k in
                  ("concurrency", "rows_per_second",
@@ -370,6 +465,7 @@ def main(argv=None) -> int:
         "overload_leg": {k: overload[k] for k in
                          ("concurrency", "requests", "expired",
                           "deadline_miss_rate", "verdicts")},
+        **({"chaos": chaos} if chaos is not None else {}),
         "engine": engine.snapshot(),
         "metrics_scrape": {k: scrape[k] for k in
                            ("status", "lines", "families",
