@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -48,6 +48,23 @@ lint:
 # structural change; commit the JSON diff (it is the review artifact).
 lint_budgets:
 	$(PY) -m tools.tpulint --write-budgets
+
+# Measured autotuner (ISSUE 14; ROADMAP item 5): run the probe
+# registry on THIS device kind and persist the DeviceProfile JSON
+# under dpsvm_tpu/autotune/profiles/ — commit the diff (the tpulint-
+# budgets discipline; jax-version-stamped, refused on skew). On a pod
+# session this is the ONE command that closes the *_pays measurement
+# loop; on the CPU harness it regenerates the non-authoritative seed
+# profile (all gates stay at the OFF defaults by construction):
+#   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 make autotune
+autotune:
+	DPSVM_OBS=1 $(PY) -m dpsvm_tpu.cli autotune run
+
+# CI leg (tier1.yml): tiny-shape probe pass into a TEMP profile, run
+# twice, schema + stable-field/decision determinism asserted. Never
+# touches the committed profiles.
+autotune_smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 DPSVM_OBS=1 $(PY) -m dpsvm_tpu.cli autotune run --smoke
 
 bench:
 	$(PY) bench.py

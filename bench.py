@@ -130,6 +130,38 @@ def _runlog_reconciliation(res, metric_pps: float) -> dict:
     }
 
 
+def _device_fields() -> dict:
+    """Device-identity stamp for every benchmark artifact (ISSUE 14
+    satellite): the regression gate refuses to drift-normalize across
+    device KINDS — calibration cancels session speed, not hardware —
+    so artifacts must say what they were measured on."""
+    import jax
+
+    from dpsvm_tpu.autotune.profile import device_kind_of
+
+    devs = jax.devices()
+    return {
+        "device": str(devs[0]),
+        # The ONE device-kind keying rule, shared with profile
+        # resolution and the solvers' gate provenance.
+        "device_kind": device_kind_of(devs[0]),
+        "n_devices": len(devs),
+    }
+
+
+def _artifact_device_kind(doc: dict):
+    """A benchmark artifact's device kind: the explicit stamp, else
+    derived from the recorded device string where UNAMBIGUOUS — the
+    legacy CPU-harness artifacts all say 'TFRT_CPU_0'. TPU device
+    strings stay None (kind granularity matters: a v4 baseline must
+    not adjudicate a v5e run just because both say TPU)."""
+    kind = doc.get("device_kind")
+    if kind:
+        return kind
+    dev = str(doc.get("device") or "")
+    return "cpu" if "cpu" in dev.lower() else None
+
+
 def _session_calibration() -> dict:
     """Fixed-reference-kernel measurement for THIS session (VERDICT
     round-5 weak #1): a pinned compute kernel whose FLOP count never
@@ -285,7 +317,20 @@ def _regression_gate(current: dict, root: str,
       NO_CALIBRATION   — previous artifact predates the calibration
                          field: the delta is reported RAW and
                          informational (cross-session drift cannot be
-                         separated out)."""
+                         separated out)
+      DEVICE_MISMATCH  — the artifacts were measured on different
+                         device KINDS (ISSUE 14 satellite): the
+                         calibration kernel cancels session speed,
+                         not hardware, so the delta is reported RAW
+                         and adjudicates nothing
+      DEVICE_UNKNOWN   — the baseline predates the device_kind stamp
+                         AND its kind cannot be derived from its
+                         recorded device string (e.g. the TPU-session
+                         BENCH_r03-r05): cross-kind normalization
+                         cannot be ruled out, so the delta is RAW and
+                         informational. Legacy CPU-harness baselines
+                         (device 'TFRT_CPU_0') derive to 'cpu' and
+                         keep adjudicating against cpu runs."""
     path, prev = _latest_bench_artifact(root, pattern, key=key)
     if prev is None:
         return {"regression_gate": "NO_BASELINE"}
@@ -294,6 +339,29 @@ def _regression_gate(current: dict, root: str,
         f"previous_{key}": prev[key],
     }
     cur_pps = current[key]
+    # Device-kind refusal (ISSUE 14 satellite): the calibration kernel
+    # separates SESSION speed, not HARDWARE — drift-normalizing a v5e
+    # run against a CPU-harness baseline would spuriously FLAG (or
+    # worse, spuriously PASS). Cross-kind comparisons report the raw
+    # delta as informational and adjudicate nothing. Baselines
+    # predating the device_kind stamp derive their kind from the
+    # recorded device string where unambiguous ('TFRT_CPU_0' -> cpu —
+    # every committed CPU-harness baseline CI gates against); a
+    # baseline whose kind stays unknown refuses too (DEVICE_UNKNOWN),
+    # because the refusal must protect the FIRST stamped device run,
+    # not start one commit later.
+    # Symmetric derivation: an unstamped CURRENT with a recognizable
+    # device string must not bypass the refusal either.
+    cur_kind = _artifact_device_kind(current)
+    prev_kind = _artifact_device_kind(prev)
+    if cur_kind and prev_kind != cur_kind:
+        out.update({
+            "regression_gate": ("DEVICE_UNKNOWN" if prev_kind is None
+                                else "DEVICE_MISMATCH"),
+            "previous_device_kind": prev_kind,
+            "raw_delta": round(cur_pps / prev[key] - 1.0, 4),
+        })
+        return out
     prev_cal = (prev.get("session_calibration") or {}).get(
         "best_of_5_seconds")
     cur_cal = (current.get("session_calibration") or {}).get(
@@ -340,7 +408,6 @@ def mesh_main(args=None) -> int:
     import os
 
     import jax
-    import numpy as np
 
     from dpsvm_tpu.config import SVMConfig
     from dpsvm_tpu.parallel.dist_smo import solve_mesh
@@ -349,13 +416,13 @@ def mesh_main(args=None) -> int:
     print(f"[bench --mesh] session calibration: {json.dumps(calibration)}",
           file=sys.stderr)
     # covtype-shaped synthetic, scaled to a row count every harness can
-    # hold (same generator family as tools/profile_round.py --dataset
-    # covtype; pinned seed).
-    rng = np.random.default_rng(0)
+    # hold (THE shared generator — autotune probes and
+    # tools/profile_round.py measure the same data family; pinned
+    # seed keeps committed artifacts reproducible).
+    from dpsvm_tpu.data import make_covtype_like
+
     n, d = 65_536, 54
-    x = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
-    y = np.where(x[:, 0] + 0.2 * rng.standard_normal(n) > 0,
-                 1, -1).astype(np.int32)
+    x, y = make_covtype_like(n, d, seed=0)
     budget = 200_000
     cfg = SVMConfig(c=32.0, gamma=0.03125, epsilon=1e-3, engine="block",
                     working_set_size=256, budget_mode=True,
@@ -379,8 +446,7 @@ def mesh_main(args=None) -> int:
                    f"{budget} pair-update budget"),
         "value": round(best.train_seconds, 3),
         "unit": "seconds",
-        "n_devices": n_dev,
-        "device": str(jax.devices()[0]),
+        **_device_fields(),
         "pair_updates": int(best.iterations),
         "mesh_pairs_per_second": round(pps),
         # Per-phase wall clock of the best run (SolveResult.stats):
@@ -459,20 +525,16 @@ def ooc_main(args=None) -> int:
     records carry the per-round tile/cache fields."""
     import os
 
-    import jax
-    import numpy as np
-
     from dpsvm_tpu.config import SVMConfig
     from dpsvm_tpu.solver.smo import solve
 
     calibration = _session_calibration()
     print(f"[bench --ooc] session calibration: {json.dumps(calibration)}",
           file=sys.stderr)
-    rng = np.random.default_rng(0)
+    from dpsvm_tpu.data import make_covtype_like
+
     n, d = 16_384, 54
-    x = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
-    y = np.where(x[:, 0] + 0.2 * rng.standard_normal(n) > 0,
-                 1, -1).astype(np.int32)
+    x, y = make_covtype_like(n, d, seed=0)
     budget = 50_000
     cfg = SVMConfig(c=32.0, gamma=0.03125, epsilon=1e-3, engine="block",
                     working_set_size=256, budget_mode=True,
@@ -496,7 +558,7 @@ def ooc_main(args=None) -> int:
                    f"{budget} pair-update budget"),
         "value": round(best.train_seconds, 3),
         "unit": "seconds",
-        "device": str(jax.devices()[0]),
+        **_device_fields(),
         "pair_updates": int(best.iterations),
         "ooc_pairs_per_second": round(pps),
         "tiles_streamed": st.get("tiles_streamed"),
@@ -555,11 +617,10 @@ def fused_main(args=None) -> int:
     calibration = _session_calibration()
     print(f"[bench --fused-round] session calibration: "
           f"{json.dumps(calibration)}", file=sys.stderr)
-    rng = np.random.default_rng(0)
+    from dpsvm_tpu.data import make_covtype_like
+
     n, d = 16_384, 54
-    x = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
-    y = np.where(x[:, 0] + 0.2 * rng.standard_normal(n) > 0,
-                 1, -1).astype(np.int32)
+    x, y = make_covtype_like(n, d, seed=0)
     budget = 50_000
     cfg = SVMConfig(c=32.0, gamma=0.03125, epsilon=1e-3, engine="block",
                     working_set_size=256, budget_mode=True,
@@ -590,7 +651,7 @@ def fused_main(args=None) -> int:
                    f"stock fused engine at the same budget"),
         "value": round(best.train_seconds, 3),
         "unit": "seconds",
-        "device": str(jax.devices()[0]),
+        **_device_fields(),
         "interpret_mode": jax.default_backend() != "tpu",
         "pair_updates": int(best.iterations),
         "fusedround_pairs_per_second": round(pps),
@@ -783,6 +844,7 @@ def main(args=None) -> int:
             f"seconds_to_convergence)"),
         "value": round(budget_seconds, 3),
         "unit": "seconds",
+        **_device_fields(),
         "vs_baseline": round(BASELINE_10GPU_SECONDS / budget_seconds, 3),
         "pair_updates": int(bres.iterations),
         "pairs_per_second": round(pairs_per_second),

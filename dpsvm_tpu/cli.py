@@ -393,8 +393,29 @@ def _build_obs_parser(sub) -> argparse.ArgumentParser:
              "records of a stream; no jax or device needed")
 
 
+def _build_autotune_parser(sub) -> argparse.ArgumentParser:
+    # Same forwarding pattern as `lint`/`obs`: main() hands
+    # `autotune ...` argv verbatim to dpsvm_tpu/autotune.run_cli —
+    # one flag surface.
+    return sub.add_parser(
+        "autotune", add_help=False,
+        help="measured device profiling for the solver's auto gates "
+             "(dpsvm_tpu/autotune): `autotune run` probes this device "
+             "kind and persists a committed DeviceProfile JSON (the "
+             "make autotune target), `autotune show` prints the "
+             "active profile + decisions, `autotune diff A B` "
+             "compares two profiles; flags as in `python -m "
+             "dpsvm_tpu.cli autotune run --help`")
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["autotune"]:
+        # Forwarded verbatim (the lint/obs discipline) so `cli
+        # autotune` and the library surface share one flag set.
+        from dpsvm_tpu.autotune import run_cli
+
+        return run_cli(argv[1:])
     if argv[:1] == ["lint"]:
         # Forward verbatim so `cli lint` and `python -m tools.tpulint`
         # share one flag surface (budget.run_lint's parser) — no
@@ -417,6 +438,7 @@ def main(argv=None) -> int:
     _build_serve_parser(sub)
     _build_lint_parser(sub)
     _build_obs_parser(sub)
+    _build_autotune_parser(sub)
     p = sub.add_parser("smoke", help="device/mesh environment smoke test")
     p.add_argument("--num-devices", type=int, default=None)
     args = parser.parse_args(argv)

@@ -1,6 +1,6 @@
 from dpsvm_tpu.data.loader import load_csv, load_data, save_csv, sniff_format
 from dpsvm_tpu.data.synth import (make_adult_like, make_blobs_binary,
-                                  make_mnist_like)
+                                  make_covtype_like, make_mnist_like)
 from dpsvm_tpu.data.converters import (
     libsvm_to_csv,
     mnist_to_odd_even,
@@ -15,6 +15,7 @@ __all__ = [
     "save_csv",
     "make_adult_like",
     "make_blobs_binary",
+    "make_covtype_like",
     "make_mnist_like",
     "libsvm_to_csv",
     "mnist_to_odd_even",

@@ -193,6 +193,10 @@ def summarize_run(run: Run) -> dict:
             "tool") == "serve" else None,
         "batch_occupancy_mean": ((fin.get("batch_occupancy") or {})
                                  .get("mean")),
+        # Auto-gate provenance (ISSUE 14): the manifest's autotune
+        # record — which DeviceProfile (if any) resolved the solve's
+        # None-valued accelerator knobs, and what each decided.
+        "autotune": man.get("autotune"),
     }
     return out
 
@@ -292,7 +296,7 @@ _REPORT_COLS = (
     ("device_s", "device_seconds"), ("pairs/s", "pairs_per_second"),
     ("gap last", "gap_last"), ("stalls", None), ("compiles", "compiles"),
     ("cache", None), ("serve", None), ("faults", None),
-    ("phases", None), ("done", None),
+    ("profile", None), ("phases", None), ("done", None),
 )
 
 #: faults-column legend: event name -> compact tag (ISSUE 13).
@@ -337,6 +341,27 @@ def _report_row(s: dict) -> list:
                     + (f" fail={s['dispatch_failures']}"
                        if s.get("dispatch_failures") else "")
                     + (f" occ={occ:.2f}" if occ is not None else ""))
+        elif head == "profile":
+            # Auto-gate provenance column (ISSUE 14): "-" for runs
+            # that consulted no auto gate, "default" when the gates
+            # fell back to the hand-measured defaults, else the
+            # resolving DeviceProfile's basename — with "+knob" tags
+            # for every gate the profile flipped ON.
+            at = s.get("autotune")
+            if not at or not at.get("gates"):
+                row.append("-")
+            else:
+                gates = at["gates"]
+                profs = {g.get("profile") for g in gates.values()
+                         if g.get("source") == "profile"}
+                if not profs:
+                    row.append("default")
+                else:
+                    name = os.path.basename(next(iter(profs)))
+                    on = [k for k, g in gates.items()
+                          if g.get("source") == "profile"
+                          and g.get("decision")]
+                    row.append(name + "".join(f" +{k}" for k in on))
         elif head == "faults":
             # Fault-story column (ISSUE 13 satellite): compact tags,
             # e.g. "f=1 r=1" for one fault + one retry, "d=1" for a

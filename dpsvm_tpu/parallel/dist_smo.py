@@ -521,10 +521,19 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
     # (solver/block.py fused_fold_pays — round-5 sweep covering the
     # n_loc band pods actually land in). Needs n_loc padded to 1024 and
     # q/2 <= n_loc/128.
-    from dpsvm_tpu.solver.block import (fused_fold_pays, pipeline_pays,
+    from dpsvm_tpu.solver.block import (autotune_gate_resolver,
+                                        fused_fold_pays, pipeline_pays,
                                         ring_pays, shardlocal_pays)
 
     _platform = mesh.devices.flat[0].platform
+    # Auto-gate resolution (ISSUE 14): None-valued knobs resolve
+    # through the installed DeviceProfile for this device kind with
+    # the hand-measured *_pays defaults as fallback; provenance of
+    # every consulted gate lands in stats["autotune"] + the manifest
+    # via _autotune_embed (the solver/smo.py contract).
+    _auto_gate, _autotune_embed = autotune_gate_resolver(
+        mesh.devices.flat[0])
+
     _n_pad_f = pad_rows(n, n_dev, multiple=1024)
     _n_loc_f = _n_pad_f // n_dev
     # Shard-parallel working sets (config.local_working_sets;
@@ -543,8 +552,16 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                       and not config.budget_mode
                       and not config.pipeline_rounds
                       and (_lws >= 2 if _lws is not None
-                           else (_platform == "tpu"
-                                 and shardlocal_pays(_n_loc_f, d))))
+                           # Structural guard BEFORE the profile: a
+                           # P=1 mesh is the pure-sync-overhead regime
+                           # a kind-wide measured True (taken on P>=2)
+                           # must not engage — same reason the probe
+                           # itself skips below 2 devices.
+                           else (n_dev > 1
+                                 and _auto_gate(
+                                     "local_working_sets",
+                                     _platform == "tpu"
+                                     and shardlocal_pays(_n_loc_f, d)))))
     # Pipelined mesh rounds (config.pipeline_rounds; dist_block.py
     # make_block_pipelined_chunk_runner): the per-round all_gather/psum
     # collectives are issued from the pre-fold carry and can hide behind
@@ -556,8 +573,17 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 and kp.kind != "precomputed"
                 and (config.pipeline_rounds
                      if config.pipeline_rounds is not None
-                     else (_platform == "tpu"
-                           and pipeline_pays(_n_loc_f, d))))
+                     # The MESH-specific knob ("pipeline_rounds_mesh",
+                     # the pipeline_mesh probe): the mesh pipelined
+                     # engine's overlap is structural (collective-async
+                     # gather/psum racing the replicated chain) and
+                     # must not be adjudicated by the single-chip
+                     # probe's verdict — that engine merely reorders
+                     # kernels and is expected to measure a LOSS.
+                     else _auto_gate(
+                         "pipeline_rounds_mesh",
+                         _platform == "tpu"
+                         and pipeline_pays(_n_loc_f, d))))
     # Ring-overlapped candidate exchange (config.ring_exchange;
     # ops/ring.py + dist_block.py _select_block_mesh_ring /
     # ring_fold_window): the per-round/per-window all_gather + psums
@@ -572,8 +598,10 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                 and not config.active_set_size
                 and (config.ring_exchange
                      if config.ring_exchange is not None
-                     else (_platform == "tpu"
-                           and ring_pays(n_dev, _n_loc_f, d))))
+                     else _auto_gate(
+                         "ring_exchange",
+                         _platform == "tpu"
+                         and ring_pays(n_dev, _n_loc_f, d))))
     use_fused = (use_block and not use_pipe and not use_shardlocal
                  and not use_ring
                  and config.selection != "nu"
@@ -830,7 +858,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
                         "pipelined": bool(use_block and use_pipe),
                         "fused_fold": bool(use_block and use_fused),
                         "ring_exchange": bool(use_ring),
-                        "observed_chunks": observe})
+                        "observed_chunks": observe,
+                        **_autotune_embed()})
     from dpsvm_tpu.solver.smo import drain_pending_obs_events
     drain_pending_obs_events(obs)
     jax.block_until_ready((x_dev, y_dev, x_sq, k_diag, valid_dev, state))
@@ -955,6 +984,8 @@ def _solve_mesh_impl(x, y, config, num_devices, mesh, callback,
            if use_shardlocal else {}),
         **({"ring_exchange": True} if use_ring else {}),
         **bf16_gram_stats,
+        # Auto-gate provenance (ISSUE 14; the solver/smo.py contract).
+        **_autotune_embed(),
     }
     if obs.live:
         stats["obs_run_id"] = obs.run_id

@@ -276,6 +276,63 @@ class AsyncDispatcher:
         return [(meta, rows, t1 - t0, t1 - t_issue, err)]
 
 
+def suggest_buckets(row_samples, current_buckets) -> dict:
+    """Occupancy-driven ``ServeConfig.buckets`` suggestion (ISSUE 14
+    satellite — the ROADMAP item 2 stub closed, report-only).
+
+    `row_samples` are observed LIVE rows per dispatch (the engine's
+    batch_rows histogram window); `current_buckets` the configured
+    power-of-two ladder. The suggestion is the smallest ladder whose
+    rungs sit at the next power of two above the traffic's p25/p50/
+    p75/p95 marks (top bucket always kept — it caps segment size), and
+    the record carries the PROJECTED mean occupancy under both ladders
+    so the advice is adjudicable before anyone applies it.
+
+    Pure function of host-held values — unit-testable, zero device
+    work. Applying a suggestion stays behind the profile discipline:
+    the autotune ``serve_buckets`` probe measures whether dispatch
+    cost even tracks the bucket on this device (a latency-floored
+    device makes padding free, and then FEWER buckets win on compile
+    count)."""
+    rows = np.asarray(list(row_samples), np.float64)
+    rows = rows[rows > 0]
+    current = tuple(int(b) for b in current_buckets)
+    if rows.size == 0:
+        return {"current_buckets": list(current),
+                "suggested_buckets": None,
+                "note": "no dispatches observed"}
+    top = current[-1]
+
+    def pow2_at_least(v):
+        return 1 << max(0, int(np.ceil(np.log2(max(float(v), 1.0)))))
+
+    marks = {f"p{q}": float(np.percentile(rows, q))
+             for q in (25, 50, 75, 95)}
+    ladder = sorted({min(pow2_at_least(v), top)
+                     for v in marks.values()} | {top})
+
+    def projected_occupancy(buckets):
+        b = np.asarray(buckets, np.float64)
+        # First bucket that fits each dispatch (observed rows never
+        # exceed the top bucket: oversized requests are segmented).
+        idx = np.minimum(np.searchsorted(b, rows), len(b) - 1)
+        return round(float(np.mean(rows / b[idx])), 4)
+
+    return {
+        "current_buckets": list(current),
+        "suggested_buckets": [int(b) for b in ladder],
+        "observed_rows": {**{k: round(v, 1) for k, v in marks.items()},
+                          "max": int(rows.max()),
+                          "dispatches": int(rows.size)},
+        "projected_occupancy": {
+            "current": projected_occupancy(current),
+            "suggested": projected_occupancy(ladder)},
+        "note": ("report-only: apply via ServeConfig.buckets only "
+                 "where the autotune serve_buckets probe says "
+                 "right-sizing pays on this device"),
+    }
+
+
 class ServingEngine:
     """Multi-model serving engine v2: model registry with zero-downtime
     hot swap, deadline-aware continuous batching, async dispatch.
@@ -320,6 +377,11 @@ class ServingEngine:
             "serve.dispatch_seconds")
         self.batch_occupancy = self.metrics.histogram(
             "serve.batch_occupancy")
+        # Absolute live rows per dispatch (the occupancy histogram's
+        # numerator): what the report-only bucket_suggestion() reads —
+        # occupancy alone cannot recover WHICH bucket sizes the
+        # traffic actually needs (ISSUE 14 satellite, ROADMAP item 2).
+        self.batch_rows = self.metrics.histogram("serve.batch_rows")
         self.deadline_misses = self.metrics.counter(
             "serve.deadline_misses_total")
         self.expired = self.metrics.counter("serve.expired_total")
@@ -704,6 +766,7 @@ class ServingEngine:
         finally:
             self._tl.in_dispatch = False
         self.batch_occupancy.observe(used_rows / bucket)
+        self.batch_rows.observe(used_rows)
         if final and len({r.entry.name for r in batch}) > 1:
             self.coalesced.add(1)
         completed = 0
@@ -819,6 +882,18 @@ class ServingEngine:
         return res.labels()  # the SERVING version's fold, swap-safe
 
     # --------------------------------------------------------- telemetry
+    def bucket_suggestion(self) -> dict:
+        """Report-only ``ServeConfig.buckets`` advice from the
+        engine's own dispatch telemetry (ISSUE 14 satellite; closes
+        the ROADMAP item 2 occupancy-autotuning stub). Pure host read
+        of the batch_rows histogram window — never applied
+        automatically: whether right-sizing pays at all is a DEVICE
+        property (the autotune ``serve_buckets`` probe measures it),
+        so applying the suggestion stays behind the profile
+        discipline."""
+        return suggest_buckets(self.batch_rows.window_values(),
+                               self.config.buckets)
+
     def snapshot(self) -> dict:
         """JSON-able engine state: counters, queue state, histogram
         snapshots, per-model breakdown — the serve run log's final
@@ -936,6 +1011,19 @@ class ServingEngine:
                 "serving_dispatch_seconds",
                 "host blocking wait per dispatch (overlap residual), "
                 "recent window", self.dispatch_seconds))
+        sug = self.bucket_suggestion()
+        if sug.get("suggested_buckets"):
+            # Report-only occupancy-driven bucket advice (ISSUE 14):
+            # one gauge sample per suggested ladder slot, so an
+            # operator's dashboard can see the suggestion drift under
+            # live traffic without log scraping. Never self-applied.
+            fams.append(om.gauge(
+                "serving_suggested_bucket",
+                "occupancy-driven ServeConfig.buckets suggestion "
+                "(report-only; apply behind the autotune profile "
+                "discipline)",
+                [({"slot": str(i)}, b)
+                 for i, b in enumerate(sug["suggested_buckets"])]))
         return om.render(fams)
 
     def close(self) -> None:

@@ -109,8 +109,10 @@ def shardlocal_pays(n_loc: int, d: int) -> bool:
     chain dominates the round (the covtype P=8 regime, where it is THE
     Amdahl term) and the CPU-measured pair-inflation factor kappa stays
     under ~5; does NOT pay at P=1 (pure sync overhead) or under tiny
-    per-shard row counts where local working sets starve. Flip to the
-    measured rule when the device session lands."""
+    per-shard row counts where local working sets starve. This is the
+    NO-PROFILE default: an installed DeviceProfile's measured verdict
+    (dpsvm_tpu/autotune, `make autotune` on the pod) overrides it via
+    resolve_auto_gate."""
     return False
 
 
@@ -134,8 +136,9 @@ def ring_pays(n_dev: int, n_loc: int, d: int) -> bool:
     (XLA's all_gather+psum dispatch chain grows while the ring's
     per-hop payload shrinks); the shard-local in-kernel fold pays when
     the window fold matmul is long enough to hide a hop's DMA
-    (max(DMA, matmul) vs DMA + matmul). Flip to the measured rule when
-    the device session lands."""
+    (max(DMA, matmul) vs DMA + matmul). This is the NO-PROFILE
+    default: an installed DeviceProfile's measured verdict
+    (dpsvm_tpu/autotune) overrides it via resolve_auto_gate."""
     return False
 
 
@@ -159,8 +162,10 @@ def fused_round_pays(n_rows: int, d: int) -> bool:
     at small-to-moderate q (the one-pass kernel removes the qx/dots
     round-trips and three XLA launches from the fixed round cost), and
     should inherit fused_fold_pays' d-dependent crossover shape since
-    it strictly extends that kernel's fusion. Flip to the measured rule
-    when the device session lands (ROADMAP item 5's standing TODO)."""
+    it strictly extends that kernel's fusion. This is the NO-PROFILE
+    default: an installed DeviceProfile's measured verdict
+    (dpsvm_tpu/autotune, ROADMAP item 5's one-command pod TODO)
+    overrides it via resolve_auto_gate."""
     return False
 
 
@@ -181,10 +186,65 @@ def pipeline_pays(n_rows: int, d: int) -> bool:
     shortens the dependency chain, not the kernel-time sum), while the
     MESH engine is where the overlap is structural — the prefetched
     all_gather/psum pair is collective-async and CAN hide behind the
-    replicated subproblem chain. Flip this to the measured rule when
-    the device session lands; PROFILE.md's pipelined section tracks the
-    pending measurement."""
+    replicated subproblem chain. This is the NO-PROFILE default: an
+    installed DeviceProfile's measured verdict (dpsvm_tpu/autotune)
+    overrides it via resolve_auto_gate; PROFILE.md's pipelined section
+    tracks the pending measurement."""
     return False
+
+
+def resolve_auto_gate(knob: str, default: bool,
+                      device_kind: str = "") -> tuple:
+    """Resolve one ``None``-valued (auto) accelerator knob: the
+    installed :mod:`dpsvm_tpu.autotune` DeviceProfile's measured
+    verdict for this device kind when one exists, else `default` (the
+    hand-measured ``*_pays`` expressions above — the ISSUE 14 loop
+    closure: the obs spine's probe measurements now DECIDE the gates
+    instead of every gate sitting hard-OFF "pending device
+    measurement").
+
+    Returns ``(decision, provenance)`` where provenance is the
+    JSON-able record the solvers embed in ``SolveResult.stats
+    ['autotune']`` and the runlog manifest: ``{"source": "profile",
+    profile file, probe ratio, threshold, ...}`` or ``{"source":
+    "default", "decision": ...}``. A profile can only carry a True
+    verdict from an AUTHORITATIVE (real-device) probe — see
+    autotune/probes.py — so installing the committed CPU-harness seed
+    profile provably never changes a compiled program."""
+    from dpsvm_tpu.autotune.profile import gate_decision
+
+    hit = gate_decision(knob, device_kind=device_kind or None)
+    if hit is None:
+        return bool(default), {"source": "default",
+                               "decision": bool(default)}
+    return bool(hit["decision"]), {"source": "profile", **hit}
+
+
+def autotune_gate_resolver(device) -> tuple:
+    """The solvers' shared gate-resolution scaffold: returns
+    ``(gate, embed)`` where ``gate(knob, default)`` resolves one auto
+    knob via :func:`resolve_auto_gate` (accumulating provenance) and
+    ``embed()`` renders the accumulated records as the
+    ``{"autotune": {...}}`` fragment both smo.py and dist_smo.py splat
+    into ``SolveResult.stats`` AND the runlog manifest — ONE
+    definition of the record shape the obs report's profile column and
+    tests/test_autotune.py's stats/manifest parity pin read."""
+    from dpsvm_tpu.autotune.profile import device_kind_of
+
+    dev_kind = device_kind_of(device)
+    prov: dict = {}
+
+    def gate(knob: str, default: bool) -> bool:
+        dec, rec = resolve_auto_gate(knob, default,
+                                     device_kind=dev_kind)
+        prov[knob] = rec
+        return dec
+
+    def embed() -> dict:
+        return ({"autotune": {"device_kind": dev_kind, "gates": prov}}
+                if prov else {})
+
+    return gate, embed
 
 
 #: the SAFE configuration (ISSUE 13 graceful degradation): knob ->

@@ -1230,8 +1230,17 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
     # round-trip + candidate top-k — a net LOSS on small rounds. The
     # crossover is d-dependent and pinned by the round-5 sweep
     # (solver/block.py fused_fold_pays docstring table).
-    from dpsvm_tpu.solver.block import (fused_fold_pays, fused_round_pays,
+    from dpsvm_tpu.solver.block import (autotune_gate_resolver,
+                                        fused_fold_pays, fused_round_pays,
                                         pipeline_pays)
+
+    # Auto-gate resolution (ISSUE 14): each None-valued accelerator
+    # knob resolves through the installed DeviceProfile for THIS
+    # device kind (dpsvm_tpu/autotune — measured verdicts) with the
+    # hand-measured *_pays expressions as the no-profile default.
+    # Provenance of every gate actually consulted lands in
+    # stats["autotune"] and the runlog manifest via _autotune_embed.
+    _auto_gate, _autotune_embed = autotune_gate_resolver(device)
 
     n_pad_fused = -(-n // 1024) * 1024
     # Pipelined rounds (config.pipeline_rounds; solver/block.py
@@ -1249,9 +1258,11 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                 and not config.active_set_size
                 and (config.pipeline_rounds
                      if config.pipeline_rounds is not None
-                     else (device.platform == "tpu"
-                           and not config.fused_round
-                           and pipeline_pays(n, d))))
+                     else (not config.fused_round
+                           and _auto_gate(
+                               "pipeline_rounds",
+                               device.platform == "tpu"
+                               and pipeline_pays(n, d)))))
     # The prefetch's own selection pass: the one-pass Pallas candidate
     # kernel where the fused path's padding contract holds on a real
     # TPU, else the plain masked top-k (CPU tests keep the jnp path —
@@ -1277,8 +1288,10 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                       <= n_pad_fused // 64
                       and (config.fused_round
                            if config.fused_round is not None
-                           else (device.platform == "tpu"
-                                 and fused_round_pays(n_pad_fused, d))))
+                           else _auto_gate(
+                               "fused_round",
+                               device.platform == "tpu"
+                               and fused_round_pays(n_pad_fused, d))))
     use_fused = (use_block and not use_pipe and not use_fusedround
                  and config.selection != "nu"
                  and not config.active_set_size
@@ -1474,7 +1487,12 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
                         "pipelined": bool(use_block and use_pipe),
                         "fused_fold": bool(use_block and use_fused),
                         "fused_round": bool(use_block and use_fusedround),
-                        "observed_chunks": observe})
+                        "observed_chunks": observe,
+                        # Gate-resolution provenance (ISSUE 14): how
+                        # each consulted auto knob resolved — profile
+                        # file + probe ratio + threshold, or the
+                        # hand-measured default.
+                        **_autotune_embed()})
     drain_pending_obs_events(obs)
 
     # PHASE CLOCK (honest per-phase wall time, SolveResult.stats
@@ -1690,6 +1708,11 @@ def _solve_impl(x, y, config, callback, device, checkpoint_path, resume,
         "phase_seconds": phase_seconds,
         **({"outer_rounds": int(state.rounds)} if use_block else {}),
         **bf16_gram_stats,
+        # Auto-gate provenance (ISSUE 14): present whenever this solve
+        # consulted at least one None-valued accelerator knob — each
+        # entry says whether the decision came from an installed
+        # DeviceProfile (with probe ratio + threshold) or the default.
+        **_autotune_embed(),
     }
     if obs.live:
         stats["obs_run_id"] = obs.run_id

@@ -1,0 +1,505 @@
+"""Measured autotuner (ISSUE 14): probe determinism, DeviceProfile
+round-trip + version-skew refusal, gate-resolution provenance in
+SolveResult.stats and the runlog manifest, the no-profile bitwise
+fallback, the tpulint zero-HLO-effect contract with a profile
+installed, and the report-only bucket suggestion.
+
+Budget notes (the tier-1 suite is tight): everything here runs on
+existing fixtures at tiny shapes, the probe passes use the smoke
+scale with a FAKE clock (no real timing loops beyond the solver work
+itself), and no interpret-mode Pallas kernel is compiled — the probes
+exercised are the XLA-only ones (pipeline, serve_buckets)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from dpsvm_tpu.autotune import (DeviceProfile, ProfileError,
+                                load_profile, run_probes, stable_view,
+                                use_profile)
+from dpsvm_tpu.autotune.profile import (PROFILE_SCHEMA, active_profile,
+                                        gate_decision, profile_path,
+                                        slug)
+from dpsvm_tpu.config import ObsConfig, SVMConfig
+
+
+class FakeClock:
+    """Deterministic timer: every interval reads as exactly `step`
+    seconds, so two same-seed probe passes produce byte-identical
+    records (including the measured fields)."""
+
+    def __init__(self, step: float = 1e-3):
+        self.t = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        self.t += self.step
+        return self.t
+
+
+def _mk_profile(decisions, jax_version=None, device_kind="cpu",
+                ratio=0.5, authoritative=True):
+    """Hand-built profile for gate tests (no probe pass needed)."""
+    probes = {}
+    for knob, dec in decisions.items():
+        name = {"pipeline_rounds": "pipeline",
+                "pipeline_rounds_mesh": "pipeline_mesh",
+                "local_working_sets": "shardlocal",
+                "ring_exchange": "ring",
+                "fused_round": "fused_round"}[knob]
+        probes[name] = {"probe": name, "knob": knob, "seed": 0,
+                        "shapes": {"n": 1024, "d": 16, "q": 16},
+                        "a_seconds": 1.0, "b_seconds": ratio,
+                        "ratio": ratio, "threshold": 0.9,
+                        "authoritative": authoritative,
+                        "verdict": bool(dec)}
+    return DeviceProfile(
+        device_kind=device_kind, backend="cpu", n_devices=8,
+        jax=jax_version or jax.__version__,
+        utc="2026-08-04T00:00:00Z", git_sha="deadbeef", seed=0,
+        probes=probes, decisions=dict(decisions))
+
+
+ALL_OFF = {"pipeline_rounds": False, "pipeline_rounds_mesh": False,
+           "local_working_sets": False, "ring_exchange": False,
+           "fused_round": False}
+
+CFG = SVMConfig(engine="block", working_set_size=16, epsilon=1e-2)
+
+
+# ------------------------------------------------------- probe passes
+
+def test_probe_pass_deterministic_and_runlogged(tmp_path):
+    """Same seed -> same runlog probe records (stable fields AND, with
+    the fake clock, the measured fields) and the same stable profile
+    view; records are schema'd through the shared runlog substrate."""
+    from dpsvm_tpu.obs.runlog import read_runlog
+
+    ocfg = ObsConfig(enabled=True, runlog_dir=str(tmp_path))
+    profs = [run_probes(knobs=["pipeline", "serve_buckets"], seed=3,
+                        smoke=True, timer=FakeClock(),
+                        obs_config=ocfg, verbose=False)
+             for _ in range(2)]
+    assert stable_view(profs[0]) == stable_view(profs[1])
+    assert profs[0].probes == profs[1].probes  # fake clock: bytewise
+    # CPU probes are never authoritative -> decisions match the
+    # hand-measured OFF defaults by construction.
+    assert profs[0].decisions == {"pipeline_rounds": False}
+
+    path, = tmp_path.glob("autotune-*.jsonl")
+    recs = read_runlog(str(path))
+    probe_recs = [r for r in recs if r["kind"] == "probe"]
+    assert len(probe_recs) == 4  # 2 probes x 2 passes
+    for r in probe_recs:
+        assert {"schema", "run", "probe", "knob", "shapes", "seed",
+                "verdict", "authoritative"} <= r.keys()
+    by_run = {}
+    for r in probe_recs:
+        by_run.setdefault(r["run"], []).append(
+            {k: v for k, v in r.items() if k not in ("run",)})
+    a, b = by_run.values()
+    assert a == b  # the record streams themselves are identical
+    # The manifest/final envelope every runlog tool shares.
+    assert [r["kind"] for r in recs if r["kind"] != "probe"] \
+        == ["manifest", "final"] * 2
+
+
+# --------------------------------------- profile persistence contract
+
+def test_profile_round_trip(tmp_path):
+    prof = _mk_profile(ALL_OFF)
+    p = prof.save(str(tmp_path / "cpu.json"))
+    back = load_profile(p)
+    assert back.decisions == prof.decisions
+    assert back.probes == prof.probes
+    assert back.jax == prof.jax and back.device_kind == "cpu"
+    assert back.path == p
+    # Strict JSON on disk (no NaN/Infinity literals).
+    json.loads(open(p).read(), parse_constant=lambda s: (_ for _ in
+                                                         ()).throw(
+        ValueError(f"non-strict JSON constant {s}")))
+
+
+def test_profile_schema_refusal(tmp_path):
+    prof = _mk_profile(ALL_OFF)
+    p = prof.save(str(tmp_path / "cpu.json"))
+    doc = json.load(open(p))
+    doc["schema"] = PROFILE_SCHEMA + 1
+    open(p, "w").write(json.dumps(doc))
+    with pytest.raises(ProfileError):
+        load_profile(p)
+    # Malformed shapes are hard errors too, never half-applied.
+    open(p, "w").write(json.dumps({"schema": PROFILE_SCHEMA}))
+    with pytest.raises(ProfileError):
+        load_profile(p)
+    # Malformed FIELD values surface as ProfileError (the refusal
+    # contract), never a TypeError crashing a solve path.
+    doc = _mk_profile(ALL_OFF).to_json()
+    doc["n_devices"] = None
+    open(p, "w").write(json.dumps(doc))
+    with pytest.raises(ProfileError, match="malformed"):
+        load_profile(p)
+
+
+def test_honesty_rule_enforced_at_load(tmp_path):
+    """A True decision must be backed by an authoritative True-verdict
+    probe AT LOAD TIME, not just at write time — a hand-edited or
+    corrupted committed artifact that violates the honesty rule is
+    refused whole, never half-applied."""
+    good = _mk_profile({**ALL_OFF, "ring_exchange": True})
+    p = good.save(str(tmp_path / "cpu.json"))
+    load_profile(p)  # authoritative True-verdict backing: loads clean
+
+    # Decision True but the backing probe is non-authoritative.
+    doc = good.to_json()
+    doc["probes"]["ring"]["authoritative"] = False
+    (tmp_path / "cpu.json").write_text(json.dumps(doc))
+    with pytest.raises(ProfileError, match="honesty"):
+        load_profile(p)
+
+    # Decision True with no probe record for the knob at all.
+    doc = good.to_json()
+    del doc["probes"]["ring"]
+    (tmp_path / "cpu.json").write_text(json.dumps(doc))
+    with pytest.raises(ProfileError, match="honesty"):
+        load_profile(p)
+
+
+def test_malformed_profile_refused_on_solve_path(tmp_path, monkeypatch):
+    doc = _mk_profile(ALL_OFF).to_json()
+    doc["seed"] = "not-an-int"
+    (tmp_path / "cpu.json").write_text(json.dumps(doc))
+    monkeypatch.setenv("DPSVM_AUTOTUNE_DIR", str(tmp_path))
+    monkeypatch.delenv("DPSVM_AUTOTUNE_PROFILE", raising=False)
+    with pytest.warns(UserWarning, match="refused"):
+        assert active_profile("cpu") is None
+    assert gate_decision("pipeline_rounds", device_kind="cpu") is None
+
+
+def test_skipped_probe_leaves_knob_undecided():
+    """A skipped probe (e.g. the ring probe on a 1-device host) must
+    NOT write a decision — recording False would masquerade as a
+    measured verdict and override the defaults for the whole device
+    kind."""
+    from dpsvm_tpu.autotune.probes import PROBE_KNOBS, _skip_record
+    from dpsvm_tpu.autotune.probes import ProbeContext, run_probes
+    import dpsvm_tpu.autotune.probes as probes_mod
+
+    ctx = ProbeContext(smoke=True)
+    rec = _skip_record("ring", ctx, "needs >= 2 devices")
+    assert rec["verdict"] is False and rec["skipped"]
+    # Run the registry with the ring probe forced to skip.
+    orig = probes_mod.PROBES["ring"]
+    probes_mod.PROBES["ring"] = lambda c: _skip_record(
+        "ring", c, "forced skip (test)")
+    try:
+        prof = run_probes(knobs=["ring"], smoke=True,
+                          timer=FakeClock(), verbose=False)
+    finally:
+        probes_mod.PROBES["ring"] = orig
+    assert PROBE_KNOBS["ring"] == "ring_exchange"
+    assert "ring_exchange" not in prof.decisions
+    assert gate_decision_from(prof, "ring_exchange") is None
+
+
+def gate_decision_from(prof, knob):
+    """gate_decision through an installed profile (helper)."""
+    with use_profile(prof):
+        return gate_decision(knob, device_kind=prof.device_kind)
+
+
+def test_version_skew_refusal(tmp_path, monkeypatch):
+    """A profile stamped by a different jax major.minor is treated as
+    absent (gates fall back to defaults), not half-applied."""
+    stale = _mk_profile({"pipeline_rounds": True}, jax_version="9.9.0")
+    stale.save(str(tmp_path / "cpu.json"))
+    monkeypatch.setenv("DPSVM_AUTOTUNE_DIR", str(tmp_path))
+    monkeypatch.delenv("DPSVM_AUTOTUNE_PROFILE", raising=False)
+    with pytest.warns(UserWarning, match="jax"):
+        assert active_profile("cpu") is None
+    assert gate_decision("pipeline_rounds", device_kind="cpu") is None
+    # Same file restamped with the RUNNING jax loads fine.
+    fresh = _mk_profile({"pipeline_rounds": True})
+    fresh.save(str(tmp_path / "cpu.json"))
+    got = active_profile("cpu")
+    assert got is not None and got.decisions["pipeline_rounds"]
+
+
+def test_partial_run_merges_existing_profile(tmp_path):
+    """A `--knobs` subset pass merges OVER the existing profile for
+    the device kind (fresh records win, unmeasured knobs keep their
+    decisions) instead of silently replacing it — re-probing one knob
+    must never drop every other measured decision back to the OFF
+    defaults. Blending across device kinds or a jax skew refuses."""
+    from dpsvm_tpu.autotune import _merge_partial
+
+    base = _mk_profile(ALL_OFF)
+    p = base.save(str(tmp_path / "cpu.json"))
+    fresh = _mk_profile({"ring_exchange": True}, ratio=0.4)
+    merged = _merge_partial(fresh, p)
+    assert merged.decisions == {**ALL_OFF, "ring_exchange": True}
+    assert set(merged.probes) == set(base.probes)  # nothing dropped
+    assert merged.probes["ring"]["ratio"] == 0.4  # fresh record wins
+    assert merged.probes["pipeline"] == base.probes["pipeline"]
+
+    # A SKIPPED fresh probe (e.g. the ring probe on a 1-device
+    # session) must not clobber the measured record while its decision
+    # survives — the old record stays, so the profile never shows a
+    # True decision backed by a 'skipped' probe.
+    rich = _mk_profile({**ALL_OFF, "ring_exchange": True})
+    pr = rich.save(str(tmp_path / "rich.json"))
+    import dataclasses as _dc
+    skip_pass = _dc.replace(
+        _mk_profile({}),
+        probes={"ring": {"probe": "ring", "knob": "ring_exchange",
+                         "seed": 0, "shapes": {},
+                         "skipped": "needs >= 2 devices",
+                         "authoritative": False, "verdict": False}},
+        decisions={})
+    merged2 = _merge_partial(skip_pass, pr)
+    assert merged2.probes["ring"] == rich.probes["ring"]  # measured kept
+    assert merged2.decisions["ring_exchange"] is True
+
+    stale = _mk_profile(ALL_OFF, jax_version="9.9.0")
+    ps = stale.save(str(tmp_path / "stale.json"))
+    with pytest.raises(ProfileError, match="version-skewed"):
+        _merge_partial(fresh, ps)
+
+    other = _mk_profile(ALL_OFF, device_kind="TPU v5e")
+    po = other.save(str(tmp_path / "other.json"))
+    with pytest.raises(ProfileError, match="refusing"):
+        _merge_partial(fresh, po)
+
+
+def test_full_pass_merges_skipped_over_measured(tmp_path):
+    """The save-path policy: a FULL `make autotune` pass also merges —
+    a 1-device session of a measured kind skips its mesh probes, and
+    a blind overwrite would silently drop the pod-measured
+    authoritative decisions for those knobs. An incompatible (jax-
+    skewed) existing file refuses a partial pass but is replaced by a
+    full pass (regeneration)."""
+    import dataclasses as _dc
+
+    from dpsvm_tpu.autotune import _maybe_merge
+
+    pod = _mk_profile({**ALL_OFF, "ring_exchange": True})
+    p = pod.save(str(tmp_path / "cpu.json"))
+    # Fresh FULL pass on a 1-device host: ring skipped, no decision.
+    one_dev = _dc.replace(
+        _mk_profile({k: False for k in ALL_OFF
+                     if k != "ring_exchange"}),
+        probes={**{n: r for n, r in
+                   _mk_profile(ALL_OFF).probes.items() if n != "ring"},
+                "ring": {"probe": "ring", "knob": "ring_exchange",
+                         "seed": 0, "shapes": {},
+                         "skipped": "needs >= 2 devices",
+                         "authoritative": False, "verdict": False}})
+    merged = _maybe_merge(one_dev, p, partial=False)
+    assert merged.decisions["ring_exchange"] is True  # pod verdict kept
+    assert merged.probes["ring"] == pod.probes["ring"]  # measured kept
+
+    # Skewed existing file: full pass replaces, partial refuses.
+    stale = _mk_profile(ALL_OFF, jax_version="9.9.0")
+    ps = stale.save(str(tmp_path / "stale.json"))
+    fresh = _mk_profile(ALL_OFF)
+    assert _maybe_merge(fresh, ps, partial=False) is fresh
+    with pytest.raises(ProfileError, match="version-skewed"):
+        _maybe_merge(fresh, ps, partial=True)
+
+
+def test_device_kind_mismatch_refusal(tmp_path, monkeypatch):
+    other = _mk_profile(ALL_OFF, device_kind="TPU v5e")
+    p = other.save(str(tmp_path / "cpu.json"))
+    monkeypatch.setenv("DPSVM_AUTOTUNE_PROFILE", p)
+    with pytest.warns(UserWarning, match="measured on"):
+        assert active_profile("cpu") is None
+    assert slug("TPU v5e") == "tpu-v5e"
+    assert profile_path("TPU v5e").endswith("tpu-v5e.json")
+
+
+# ------------------------------------------- gate resolution contract
+
+def test_gate_provenance_in_stats_and_manifest(blobs_small, tmp_path):
+    """With a profile installed, every consulted auto gate's
+    resolution (profile file, probe ratio, threshold) appears in
+    SolveResult.stats['autotune'] AND the runlog manifest."""
+    from dpsvm_tpu.obs.runlog import read_runlog
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_small
+    prof = _mk_profile(ALL_OFF)
+    prof.save(str(tmp_path / "prof.json"))
+    installed = load_profile(str(tmp_path / "prof.json"))
+    cfg = CFG.replace(obs=ObsConfig(enabled=True,
+                                    runlog_dir=str(tmp_path)))
+    with use_profile(installed):
+        res = solve(x, y, cfg)
+    at = res.stats["autotune"]
+    assert at["device_kind"] == "cpu"
+    gates = at["gates"]
+    assert set(gates) == {"pipeline_rounds", "fused_round"}
+    for knob, g in gates.items():
+        assert g["source"] == "profile" and g["decision"] is False
+        assert g["profile"].endswith("prof.json")
+        assert g["ratio"] == 0.5 and g["threshold"] == 0.9
+    path, = tmp_path.glob("solve-*.jsonl")
+    man, = [r for r in read_runlog(str(path)) if r["kind"] == "manifest"]
+    assert man["autotune"]["gates"] == gates
+
+
+def test_no_profile_bitwise_fallback(blobs_small):
+    """The acceptance contract: an all-False profile changes DECISIONS
+    never PROGRAMS — the trajectory is bitwise the no-profile one, and
+    provenance says where each decision came from."""
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_small
+    with use_profile(None):
+        r0 = solve(x, y, CFG)
+    with use_profile(_mk_profile(ALL_OFF)):
+        r1 = solve(x, y, CFG)
+    np.testing.assert_array_equal(r0.alpha, r1.alpha)
+    assert r0.iterations == r1.iterations
+    assert r0.stats["autotune"]["gates"]["pipeline_rounds"]["source"] \
+        == "default"
+    assert r1.stats["autotune"]["gates"]["pipeline_rounds"]["source"] \
+        == "profile"
+
+
+def test_profile_verdict_flips_gate(blobs_small):
+    """A True verdict actually routes the solve: pipeline_rounds=None
+    resolves ON from the profile (the measured-crossover flip the
+    whole subsystem exists for), exactly (same optimum)."""
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = blobs_small
+    with use_profile(None):
+        base = solve(x, y, CFG)
+    with use_profile(_mk_profile({**ALL_OFF,
+                                  "pipeline_rounds": True})):
+        res = solve(x, y, CFG)
+    g = res.stats["autotune"]["gates"]["pipeline_rounds"]
+    assert g["source"] == "profile" and g["decision"] is True
+    assert res.converged
+    # Exactness: the pipelined engine reaches the same optimum (the
+    # corrected-gradient contract) — decisions change the route, not
+    # the destination.
+    assert abs(res.b - base.b) < 5e-2
+    # An EXPLICIT knob always wins over the profile.
+    with use_profile(_mk_profile({**ALL_OFF,
+                                  "pipeline_rounds": True})):
+        forced = solve(x, y, CFG.replace(pipeline_rounds=False))
+    assert "pipeline_rounds" not in forced.stats.get(
+        "autotune", {}).get("gates", {})
+
+
+def test_mesh_gate_provenance(blobs_small):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    with use_profile(_mk_profile(ALL_OFF)):
+        res = solve_mesh(x, y, CFG, num_devices=8)
+    gates = res.stats["autotune"]["gates"]
+    # The mesh consults the MESH pipeline knob — the single-chip
+    # probe's verdict must not adjudicate the structurally different
+    # mesh pipelined engine.
+    assert {"pipeline_rounds_mesh", "local_working_sets",
+            "ring_exchange"} <= set(gates)
+    assert "pipeline_rounds" not in gates
+    assert all(g["source"] == "profile" for g in gates.values())
+    assert res.converged
+
+
+def test_shardlocal_auto_gate_requires_multidevice(blobs_small):
+    """A kind-wide measured local_working_sets=True (taken on P>=2)
+    must not engage the shard-local engine on a 1-device mesh — the
+    pure-sync-overhead regime the probe itself refuses to measure.
+    The gate is structurally guarded, not even consulted."""
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = blobs_small
+    with use_profile(_mk_profile({**ALL_OFF,
+                                  "local_working_sets": True})):
+        res = solve_mesh(x, y, CFG, num_devices=1)
+    gates = res.stats["autotune"]["gates"]
+    assert "local_working_sets" not in gates
+    assert "shardlocal_demoted" not in res.stats
+    assert res.converged
+
+
+# ------------------------------------------------ zero-HLO-effect pin
+
+def test_tpulint_zero_hlo_with_profile_installed():
+    """The committed-budget contract with a profile INSTALLED: the
+    manifest's lowered facts are identical under use_profile and still
+    PASS the committed budget — the autotuner cannot change a compiled
+    program, only which one a solve picks."""
+    from dpsvm_tpu.analysis import budget
+    from dpsvm_tpu.analysis.extract import entry_facts
+    from dpsvm_tpu.analysis.manifest import (block_chunk_single,
+                                             require_devices)
+
+    require_devices()
+    gen = budget.budget_jax_version()
+    if gen is not None and gen != jax.__version__:
+        pytest.skip(f"budgets generated under jax {gen}, running "
+                    f"{jax.__version__} (the pinned CI job is the gate)")
+    with use_profile(None):
+        plain = entry_facts(block_chunk_single())
+    with use_profile(_mk_profile(ALL_OFF)):
+        installed = entry_facts(block_chunk_single())
+    assert plain == installed
+    assert budget.check_entry("block_chunk_single",
+                              installed)["verdict"] == budget.PASS
+
+
+# --------------------------------------------- bucket suggestion (obs)
+
+def test_suggest_buckets_pure():
+    from dpsvm_tpu.serving.dispatch import suggest_buckets
+
+    cur = (16, 64, 256, 1024, 4096)
+    out = suggest_buckets([], cur)
+    assert out["suggested_buckets"] is None
+
+    # Traffic of small requests through a coarse ladder: suggestion
+    # right-sizes and the projected occupancy must not get worse.
+    rows = [3, 5, 9, 12, 20, 28, 33, 60] * 16
+    out = suggest_buckets(rows, cur)
+    assert out["suggested_buckets"][-1] == 4096  # top bucket kept
+    assert all(b & (b - 1) == 0 for b in out["suggested_buckets"])
+    assert out["projected_occupancy"]["suggested"] \
+        >= out["projected_occupancy"]["current"]
+    assert out["observed_rows"]["dispatches"] == len(rows)
+    assert "report-only" in out["note"]
+
+    # Rows at the bucket edges stay in their bucket (occupancy 1.0).
+    out2 = suggest_buckets([16] * 8, cur)
+    assert out2["projected_occupancy"]["suggested"] == 1.0
+
+
+def test_engine_reports_bucket_suggestion_and_gauge():
+    """The engine's own telemetry: batch_rows feeds the suggestion and
+    the /metrics exposition carries the report-only gauge."""
+    from dpsvm_tpu.config import ServeConfig
+    from dpsvm_tpu.serving import ServingEngine
+    from tools.bench_serve import _synthetic_multiclass
+
+    eng = ServingEngine(ServeConfig(buckets=(16, 64), warm_start=False))
+    try:
+        eng.register("m", _synthetic_multiclass(3, 8, 64, 0.5, "ovr",
+                                                0.5, seed=2))
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            eng.submit(rng.random((3, 8), dtype=np.float32), model="m")
+        eng.drain()
+        sug = eng.bucket_suggestion()
+        assert sug["suggested_buckets"] is not None
+        assert sug["current_buckets"] == [16, 64]
+        text = eng.render_openmetrics()
+        assert "serving_suggested_bucket{" in text
+    finally:
+        eng.close()

@@ -130,6 +130,62 @@ def test_multichip_gate_flags_mesh_regression(tmp_path):
     assert out["normalized_delta"] < -bench._REGRESSION_BAND
 
 
+def test_device_kind_mismatch_refused(tmp_path):
+    """ISSUE 14 satellite: a v5e run must not be drift-normalized
+    against a CPU-harness baseline (the calibration kernel cancels
+    session speed, not hardware) — cross-kind comparisons report the
+    raw delta as informational and adjudicate nothing."""
+    _write(tmp_path, "BENCH_r06.json",
+           {**_cur(), "device_kind": "cpu"})
+    cur = {**_cur(pps=3_000_000), "device_kind": "TPU v5 lite"}
+    out = bench._regression_gate(cur, str(tmp_path))
+    assert out["regression_gate"] == "DEVICE_MISMATCH"
+    assert out["previous_device_kind"] == "cpu"
+    assert "raw_delta" in out and "normalized_delta" not in out
+
+
+def test_device_kind_match_compares(tmp_path):
+    _write(tmp_path, "BENCH_r06.json",
+           {**_cur(), "device_kind": "TPU v5 lite"})
+    out = bench._regression_gate(
+        {**_cur(), "device_kind": "TPU v5 lite"}, str(tmp_path))
+    assert out["regression_gate"] == "PASS"
+
+
+def test_device_kind_legacy_cpu_artifacts_derive_and_compare(tmp_path):
+    # Legacy CPU-harness artifacts (no device_kind stamp, device
+    # string 'TFRT_CPU_0' — every baseline CI gates against) derive
+    # kind 'cpu' and keep adjudicating cpu runs...
+    _write(tmp_path, "BENCH_r06.json",
+           {**_cur(), "device": "TFRT_CPU_0"})
+    out = bench._regression_gate(
+        {**_cur(), "device_kind": "cpu"}, str(tmp_path))
+    assert out["regression_gate"] == "PASS"
+    # ...and refuse a stamped TPU run (the satellite's core scenario:
+    # the FIRST v5e run must not be normalized against a CPU-harness
+    # baseline, without waiting for one stamped artifact per family).
+    out = bench._regression_gate(
+        {**_cur(pps=3_000_000), "device_kind": "TPU v5 lite"},
+        str(tmp_path))
+    assert out["regression_gate"] == "DEVICE_MISMATCH"
+
+
+def test_device_kind_unknown_baseline_refused(tmp_path):
+    # A baseline with NO device information at all (BENCH_r01-r05;
+    # r03-r05 were real TPU sessions) cannot rule out a cross-kind
+    # comparison: raw delta reported, nothing adjudicated — even for
+    # a cpu current run (the baseline might be the TPU one).
+    _write(tmp_path, "BENCH_r06.json", _cur())
+    out = bench._regression_gate(
+        {**_cur(), "device_kind": "cpu"}, str(tmp_path))
+    assert out["regression_gate"] == "DEVICE_UNKNOWN"
+    assert out["previous_device_kind"] is None
+    assert "raw_delta" in out and "normalized_delta" not in out
+    # An UNSTAMPED current (legacy caller) still compares as before.
+    out = bench._regression_gate(_cur(), str(tmp_path))
+    assert out["regression_gate"] == "PASS"
+
+
 def test_bare_artifact_shape(tmp_path):
     # Bare (unwrapped) result dicts parse too.
     _write(tmp_path, "BENCH_r06.json",

@@ -286,7 +286,9 @@ def main(argv=None) -> int:
         "compacted_vs_stacked": ab,
         "warm_seconds": {str(k): round(v, 4) for k, v in
                          server.stats["warm_seconds"].items()},
-        "device": str(dev),
+        # Device-identity stamp (ISSUE 14 satellite): the regression
+        # gate refuses cross-device-kind comparisons.
+        **bench._device_fields(),
         "device_numbers": ("measured" if on_tpu else
                            "pending — no TPU reachable this session; "
                            "CPU-harness wall clocks are for structure/"

@@ -467,11 +467,17 @@ def main(argv=None) -> int:
                           "deadline_miss_rate", "verdicts")},
         **({"chaos": chaos} if chaos is not None else {}),
         "engine": engine.snapshot(),
+        # Occupancy-driven bucket advice (ISSUE 14 satellite; ROADMAP
+        # item 2's stub closed): report-only — applying it stays
+        # behind the autotune profile discipline.
+        "bucket_suggestion": engine.bucket_suggestion(),
         "metrics_scrape": {k: scrape[k] for k in
                            ("status", "lines", "families",
                             "eof_terminated", "per_model_labels",
                             "ok")},
-        "device": str(dev),
+        # Device-identity stamp (ISSUE 14 satellite): the regression
+        # gate refuses cross-device-kind comparisons.
+        **bench._device_fields(),
         "device_numbers": ("measured" if on_tpu else
                            "pending — no TPU reachable this session; "
                            "CPU-harness wall clocks adjudicate "
@@ -482,6 +488,14 @@ def main(argv=None) -> int:
         "smoke": bool(args.smoke),
     }
     result.update(_runlog_reconciliation(engine, engine._rows_total))
+    sug = result["bucket_suggestion"]
+    if sug.get("suggested_buckets"):
+        print(f"[loadgen] bucket suggestion (report-only): "
+              f"{sug['current_buckets']} -> {sug['suggested_buckets']} "
+              f"(projected occupancy "
+              f"{sug['projected_occupancy']['current']} -> "
+              f"{sug['projected_occupancy']['suggested']})",
+              file=sys.stderr)
     engine.close()
 
     gate = bench._regression_gate(result, REPO,

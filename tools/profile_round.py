@@ -27,61 +27,12 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-
-def salted(x, k: int):
-    """Return a copy of float array/scalar x whose contents differ
-    REPRESENTABLY from x (relative 2^-20 bump, exact in fp32 for any
-    magnitude) in a fresh device buffer. Both properties matter on the
-    tunneled runtime: re-dispatching the same buffer OR content-identical
-    values can be served from the result cache without executing
-    (measured ~0 ms readings; see the bench_predict.py trap notes). The
-    perturbation is harmless to cost profiling — probe runs never need
-    exact optima."""
-    import jax
-    import jax.numpy as jnp
-
-    out = x * jnp.float32(1.0 + k * 2.0 ** -20)
-    jax.block_until_ready(out)
-    return out
-
-
-def timed(fn, *args, reps: int) -> float:
-    """Seconds per repetition of fn, measured inside one dispatch.
-
-    Differences two in-dispatch repetition counts (reps and 2*reps) so the
-    tunnel's fixed per-dispatch latency cancels — a single-dispatch
-    measurement reads tens of ms of sync overhead into every stage
-    (the trap documented in tools/bench_predict.py; on a local TPU the
-    two estimates agree)."""
-    import jax
-    from functools import partial
-    from jax import lax
-
-    @partial(jax.jit, static_argnames="n")
-    def loop(*a, n):
-        def body(i, carry):
-            return fn(*carry)
-        return lax.fori_loop(0, n, body, a)
-
-    jax.block_until_ready(loop(*args, n=reps))      # compile 1
-    jax.block_until_ready(loop(*args, n=2 * reps))  # compile 2
-
-    salt = [0]
-
-    def run(n):
-        # Off-clock representable perturbation of the first float arg —
-        # see salted() for why both fresh buffer and fresh contents are
-        # required on this runtime.
-        salt[0] += 1
-        a = (salted(args[0], salt[0]),) + args[1:]
-        t0 = time.perf_counter()
-        jax.block_until_ready(loop(*a, n=n))
-        return time.perf_counter() - t0
-
-    # best-of-2 per count absorbs tunnel jitter between the two probes.
-    t1 = min(run(reps), run(reps))
-    t2 = min(run(2 * reps), run(2 * reps))
-    return max(t2 - t1, 0.0) / reps
+# The measurement core is SHARED with the autotune registry probes
+# (ISSUE 14 satellite: tool ablations and autotune probes are the same
+# measurement): salted off-clock perturbation, fori_loop-differenced
+# stage timing, and the whole-chunk differenced runner.
+from dpsvm_tpu.autotune.probe import (differenced_rounds, salted,  # noqa: E402
+                                      timed_loop as timed)
 
 
 def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
@@ -113,22 +64,8 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
                       b_hi=jnp.float32(-1e9), b_lo=jnp.float32(1e9),
                       pairs=jnp.int32(0), rounds=jnp.int32(0))
     rows = []
-    salt = [0]
 
-    def probe(run, reps_n):
-        best = None
-        for _ in range(3):
-            salt[0] += 1
-            st = base._replace(f=salted(base.f, salt[0]))
-            t0 = time.perf_counter()
-            out = run(st, reps_n)
-            jax.block_until_ready(out)
-            t = time.perf_counter() - t0
-            if best is None or t < best[0]:
-                best = (t, int(out.rounds), int(out.pairs))
-        return best
-
-    for inner in (budgets or (1, max(2, q // 4), q, 2 * q)):
+    for bi, inner in enumerate(budgets or (1, max(2, q // 4), q, 2 * q)):
         # _BUDGET_EPS keeps the stopping test open so EVERY probe runs
         # its exact round budget with its full inner budget — from the
         # zero start the mnist shape otherwise converges mid-probe,
@@ -170,15 +107,13 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
                 xd, yd, x_sq, k_diag, None, st, jnp.int32(10 ** 9), kp,
                 cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
                 n, inner_impl=impl)
-        jax.block_until_ready(run(base, reps))       # compile + warm
-        jax.block_until_ready(run(base, 2 * reps))
-        t1, r1, p1 = probe(run, reps)
-        t2, r2, p2 = probe(run, 2 * reps)
-        # Differencing the two round counts cancels the tunnel's fixed
-        # per-dispatch latency (~60-80 ms — it otherwise reads as
-        # +F/reps ms on every round, HALVING when reps doubles).
-        t = max(t2 - t1, 0.0)
-        rounds, pairs = r2 - r1, p2 - p1
+        # The shared differenced whole-chunk runner (autotune/probe.py):
+        # warm + best-of-3 salted starts per chunk length, differenced
+        # so the tunnel's fixed per-dispatch latency (~60-80 ms)
+        # cancels instead of reading as +F/reps ms on every round.
+        t, rounds, pairs = differenced_rounds(
+            lambda rpc, run=run: (lambda st: run(st, rpc)),
+            base, reps, salt_base=1000 * (bi + 1))
         rows.append((inner, rounds, pairs, 1e3 * t / max(rounds, 1),
                      1e6 * t / max(pairs, 1), t))
         print(f"  inner={inner:5d}: {rounds} rounds, {pairs} pairs, "
@@ -272,26 +207,18 @@ def ablate_shardlocal(x, y, cfg, q: int, reps: int, sync_rounds: int,
     print(f"  shard-local A/B: P={p_dev} devices, q={q}, inner={inner}, "
           f"sync_rounds={sync_rounds}, reps={reps}")
     results = {}
-    for kind in ("global", "shardlocal"):
-        runs = {}
-        for rpc in (reps, 2 * reps):
-            runner = make(kind, rpc)
-            jax.block_until_ready(runner(
-                xd, yd, x_sq, k_diag, vd, base, jnp.int32(10 ** 9)))
-            best = None
-            for k in range(3):
-                st = base._replace(f=salted(base.f, 7 * rpc + k))
-                t0 = time.perf_counter()
-                out = runner(xd, yd, x_sq, k_diag, vd, st,
-                             jnp.int32(10 ** 9))
-                jax.block_until_ready(out)
-                t = time.perf_counter() - t0
-                if best is None or t < best[0]:
-                    best = (t, int(out.rounds), int(out.pairs))
-            runs[rpc] = best
-        t = max(runs[2 * reps][0] - runs[reps][0], 0.0)
-        rounds = runs[2 * reps][1] - runs[reps][1]
-        pairs = runs[2 * reps][2] - runs[reps][2]
+    for ki, kind in enumerate(("global", "shardlocal")):
+        # Shared differenced whole-chunk runner (autotune/probe.py).
+        # Salt bases are DISJOINT from ablate_ring's 7000*(vi+1)
+        # family: --ring --shardlocal in one process share the same
+        # global chunk runner + operands, and a colliding salt would
+        # re-dispatch content-identical states the result cache can
+        # serve without executing (the ~0 ms trap probe.py documents).
+        t, rounds, pairs = differenced_rounds(
+            lambda rpc, kind=kind: (
+                lambda st, r=make(kind, rpc): r(
+                    xd, yd, x_sq, k_diag, vd, st, jnp.int32(10 ** 9))),
+            base, reps, salt_base=50000 * (ki + 1))
         results[kind] = (t, rounds, pairs)
         print(f"  {kind:10s}: {rounds} rounds, {pairs} pairs, "
               f"{1e3 * t / max(rounds, 1):7.3f} ms/round, "
@@ -383,32 +310,20 @@ def ablate_ring(x, y, cfg, q: int, reps: int, sync_rounds: int,
           f"sync_rounds={sync_rounds}, reps={reps}"
           + ("" if on_tpu else "  [interpret mode — structure only]"))
     rows = []
-    for kind in ("global", "shardlocal"):
-        for ring in (False, True):
-            runs = {}
-            for rpc in (reps, 2 * reps):
-                runner = make(kind, ring, rpc)
-                jax.block_until_ready(runner(
-                    xd, yd, x_sq, k_diag, vd, base, jnp.int32(10 ** 9)))
-                best = None
-                for k in range(3):
-                    st = base._replace(f=salted(base.f, 7 * rpc + k))
-                    t0 = time.perf_counter()
-                    out = runner(xd, yd, x_sq, k_diag, vd, st,
-                                 jnp.int32(10 ** 9))
-                    jax.block_until_ready(out)
-                    t = time.perf_counter() - t0
-                    if best is None or t < best[0]:
-                        best = (t, int(out.rounds), int(out.pairs))
-                runs[rpc] = best
-            t = max(runs[2 * reps][0] - runs[reps][0], 0.0)
-            rounds = runs[2 * reps][1] - runs[reps][1]
-            pairs = runs[2 * reps][2] - runs[reps][2]
-            label = f"{kind}:{'ring' if ring else 'gather'}"
-            rows.append((label, t, rounds, pairs))
-            print(f"  {label:18s}: {rounds} rounds, {pairs} pairs, "
-                  f"{1e3 * t / max(rounds, 1):7.3f} ms/round "
-                  f"({pairs / max(t, 1e-9):,.0f} pairs/s)")
+    for vi, (kind, ring) in enumerate(
+            (k, r) for k in ("global", "shardlocal")
+            for r in (False, True)):
+        # Shared differenced whole-chunk runner (autotune/probe.py).
+        t, rounds, pairs = differenced_rounds(
+            lambda rpc, kind=kind, ring=ring: (
+                lambda st, r=make(kind, ring, rpc): r(
+                    xd, yd, x_sq, k_diag, vd, st, jnp.int32(10 ** 9))),
+            base, reps, salt_base=7000 * (vi + 1))
+        label = f"{kind}:{'ring' if ring else 'gather'}"
+        rows.append((label, t, rounds, pairs))
+        print(f"  {label:18s}: {rounds} rounds, {pairs} pairs, "
+              f"{1e3 * t / max(rounds, 1):7.3f} ms/round "
+              f"({pairs / max(t, 1e-9):,.0f} pairs/s)")
     by = {lbl: (t, r, p) for lbl, t, r, p in rows}
     for kind in ("global", "shardlocal"):
         tg = by[f"{kind}:gather"][0]
@@ -476,27 +391,17 @@ def ablate_bf16_gram(x, y, cfg, q: int, reps: int, obs_cfg=None):
             alpha=jnp.zeros((n,), jnp.float32), f=-yd,
             b_hi=jnp.float32(-1e9), b_lo=jnp.float32(1e9),
             pairs=jnp.int32(0), rounds=jnp.int32(0))
-        runs = {}
-        for rpc in (reps, 2 * reps):
+        # Shared differenced whole-chunk runner (autotune/probe.py).
+        def make_run(rpc, xd=xd, x_sq=x_sq, kd=kd, yd=yd, vd=vd):
             kw = dict(kp=kp, c=cfg.c_bounds(), eps=_BUDGET_EPS,
                       tau=float(cfg.tau), q=q, inner_iters=inner,
                       rounds_per_chunk=rpc, inner_impl="xla")
-            jax.block_until_ready(run_chunk_block(
-                xd, yd, x_sq, kd, vd, base, jnp.int32(10 ** 9), **kw))
-            best = None
-            for k in range(3):
-                st = base._replace(f=salted(base.f, 11 * rpc + k))
-                t0 = time.perf_counter()
-                out = run_chunk_block(
-                    xd, yd, x_sq, kd, vd, st, jnp.int32(10 ** 9), **kw)
-                jax.block_until_ready(out)
-                t = time.perf_counter() - t0
-                if best is None or t < best[0]:
-                    best = (t, int(out.rounds), int(out.pairs))
-            runs[rpc] = best
-        t = max(runs[2 * reps][0] - runs[reps][0], 0.0)
-        rounds = runs[2 * reps][1] - runs[reps][1]
-        pairs = runs[2 * reps][2] - runs[reps][2]
+            return lambda st: run_chunk_block(
+                xd, yd, x_sq, kd, vd, st, jnp.int32(10 ** 9), **kw)
+
+        t, rounds, pairs = differenced_rounds(
+            make_run, base, reps,
+            salt_base=11000 * (1 if dt_name == "float32" else 2))
         rows.append((dt_name, t, rounds, pairs))
         print(f"  x dtype {dt_name:9s}: {rounds} rounds, {pairs} pairs, "
               f"{1e3 * t / max(rounds, 1):7.3f} ms/round "
