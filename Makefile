@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -85,6 +85,17 @@ bench_ooc_smoke:
 # bench_serve_smoke; the smoke output is not committed).
 bench_fused_smoke:
 	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) bench.py --fused-round --obs
+
+# Network front-door smoke (ISSUE 15): the same loadgen engine driven
+# through a REAL localhost socket — clean leg with per-class EXACT
+# client/server verdict reconciliation, seeded connection-fault chaos
+# leg (kills, a stalled reader, partial writes, an accept drop, one
+# mid-leg hot swap), protocol fuzz burst, graceful drain under
+# sustained load, journal rehydrate re-proven BITWISE through the
+# socket path, zero server-thread leaks. Temp artifact (tier1.yml runs
+# this next to bench_serve_smoke and faults_smoke).
+serve_net_smoke:
+	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) tools/loadgen.py --net --smoke --obs
 
 # Fault-tolerance smoke (ISSUE 13): the deterministic fault-injection
 # harness self-test, a kill -9 mid-ooc-solve followed by a --resume

@@ -208,6 +208,14 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None, help="solver checkpoint path")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="iterations between checkpoints (0 = off)")
+    p.add_argument("--checkpoint-keep", type=int, default=1,
+                   help="rotating checkpoint generations to keep "
+                        "(path, path.1, ...): a checkpoint corrupted "
+                        "by the very fault being recovered from still "
+                        "leaves an older restorable one; --resume "
+                        "falls back to the newest loadable generation "
+                        "with a loud warning (default 1 = overwrite "
+                        "in place)")
     p.add_argument("--retry-faults", type=int, default=2,
                    help="automatic retries on transient device faults, "
                         "resuming from --checkpoint when set (default 2; "
@@ -294,6 +302,29 @@ def _build_serve_parser(sub) -> argparse.ArgumentParser:
                         "repeatable. stdin rows may prefix 'NAME|' to "
                         "route; a line 'swap NAME=PATH' hot-swaps a "
                         "model mid-stream")
+    p.add_argument("--listen", default=None, metavar="HOST:PORT",
+                   help="v2 engine: NETWORK FRONT DOOR (ISSUE 15) — "
+                        "serve the length-prefixed binary frame "
+                        "protocol (dpsvm_tpu/serving/wire.py) on this "
+                        "TCP endpoint instead of stdin: persistent "
+                        "connections, client deadline budgets "
+                        "propagated into the EDF scheduler, admission "
+                        "rejects with retry hints, per-connection "
+                        "read/write bounds; SIGTERM performs a "
+                        "graceful drain (finish or shed in-flight "
+                        "work by its own deadline, flush verdicts, "
+                        "GOODBYE, close). Port 0 = ephemeral, printed "
+                        "at startup")
+    p.add_argument("--admission-max-rows", type=int, default=None,
+                   help="--listen: queued-row saturation bound — a "
+                        "request arriving past it is REJECTED "
+                        "immediately with a retry_after_ms hint "
+                        "instead of buffered (default: max_pending)")
+    p.add_argument("--conn-timeout-ms", type=float, default=None,
+                   help="--listen: per-connection read AND write "
+                        "timeout override (read bounds slow-loris / "
+                        "half-open peers, write bounds stalled "
+                        "readers; defaults 30000/10000)")
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="v2 engine: default per-request deadline — "
                         "requests finishing past it count as deadline "
@@ -586,6 +617,7 @@ def _cmd_train(args) -> int:
             ooc_cache_lines=args.ooc_cache_lines,
             dtype=args.dtype, chunk_iters=args.chunk_iters,
             checkpoint_every=args.checkpoint_every,
+            checkpoint_keep=args.checkpoint_keep,
             retry_faults=args.retry_faults, verbose=not args.quiet,
             # With --obs the SOLVER owns the device-trace capture (its
             # spans then appear named inside it); without it the CLI's
@@ -1113,9 +1145,11 @@ def _cmd_serve(args) -> int:
     from dpsvm_tpu.config import ServeConfig
     from dpsvm_tpu.serve import PredictServer, offered_load_sweep
 
-    if args.registry or args.journal:
+    if args.registry or args.journal or args.listen:
         # --journal alone is a valid v2 start: a crash-restarted
         # engine rehydrates its whole model set from the journal.
+        # --listen is v2-only (the network front door fronts the
+        # ServingEngine).
         return _cmd_serve_v2(args)
     if not args.model:
         print("error: -m/--model is required (or --registry NAME=PATH "
@@ -1270,14 +1304,20 @@ def _cmd_serve_v2(args) -> int:
 
     try:
         buckets = tuple(int(t) for t in args.buckets.split(",") if t)
+        timeouts = {}
+        if args.conn_timeout_ms is not None:
+            timeouts = dict(conn_read_timeout_ms=args.conn_timeout_ms,
+                            conn_write_timeout_ms=args.conn_timeout_ms)
         config = ServeConfig(
             buckets=buckets, dtype=args.dtype,
             deadline_ms=args.deadline_ms,
             dispatch_timeout_ms=args.dispatch_timeout_ms,
-            journal_path=args.journal,
+            journal_path=args.journal, listen=args.listen,
+            admission_max_rows=args.admission_max_rows,
             metrics_port=args.metrics_port,
             metrics_host=args.metrics_host, slo_ms=args.slo_ms,
-            obs=ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir))
+            obs=ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir),
+            **timeouts)
         t0 = time.perf_counter()
         engine = ServingEngine(config)
     except ValueError as e:
@@ -1314,6 +1354,9 @@ def _cmd_serve_v2(args) -> int:
         print(f"engine ready in {time.perf_counter() - t0:.2f}s: "
               f"{len(specs)} models, deadline "
               f"{config.deadline_ms or 'none'} ms", file=sys.stderr)
+
+    if args.listen:
+        return _serve_listen(args, engine, config)
 
     order: list = []
 
@@ -1373,6 +1416,53 @@ def _cmd_serve_v2(args) -> int:
               f"dispatches ({snap['coalesced_dispatches']} coalesced; "
               f"{snap['deadline_misses']} deadline misses, "
               f"{snap['hot_swaps']} hot swaps)", file=sys.stderr)
+    return 0
+
+
+def _serve_listen(args, engine, config, stop_event=None) -> int:
+    """``cli serve --listen HOST:PORT``: run the network front door
+    until SIGTERM/SIGINT, then GRACEFULLY DRAIN — stop accepting,
+    finish or shed in-flight work by its own deadline (the engine's
+    normal explicit verdicts), flush final verdicts, GOODBYE each
+    connection, close the engine (journal already consistent: it was
+    written atomically at register/swap time). `stop_event` is the
+    test seam — production flow sets it from the signal handler."""
+    import signal
+    import threading
+
+    from dpsvm_tpu.serving.server import ServeServer
+
+    server = ServeServer(engine)
+    stop = stop_event if stop_event is not None else threading.Event()
+    handled = {}
+    if stop_event is None:
+        def _on_signal(signum, frame):
+            stop.set()  # tiny handler; the drain runs on the main thread
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            handled[sig] = signal.signal(sig, _on_signal)
+    if not args.quiet:
+        print(f"front door listening on {server.host}:{server.port} "
+              "(SIGTERM = graceful drain)", file=sys.stderr)
+    try:
+        stop.wait()
+        # Drain with OUR handler still installed: a second SIGTERM
+        # during the drain is a no-op (the event is already set), not
+        # a mid-drain process kill — 'SIGTERM = graceful drain' holds
+        # unconditionally. Handlers restore only after teardown.
+        snap = server.close()
+        engine.close()
+    finally:
+        for sig, prev in handled.items():
+            signal.signal(sig, prev)
+    if not args.quiet:
+        v = snap["verdicts"]
+        print(f"drained: {snap['frames_accepted']} frames over "
+              f"{snap['conns_opened']} connections -> "
+              + " ".join(f"{k}={v[k]}" for k in sorted(v))
+              + (f" undeliverable={snap['undeliverable_total']}"
+                 if snap["undeliverable_total"] else ""),
+              file=sys.stderr)
     return 0
 
 

@@ -427,6 +427,13 @@ class SVMConfig:
     dtype: str = "float32"  # storage dtype for X ("float32" | "bfloat16")
     chunk_iters: int = 2048  # SMO iterations per on-device while_loop dispatch
     checkpoint_every: int = 0  # iterations between solver checkpoints; 0 = off
+    # Rotating checkpoint retention (ISSUE 15 satellite): keep the K
+    # newest generations (path, path.1, ..., path.(K-1)) so a
+    # checkpoint corrupted BY the fault being recovered from still
+    # leaves an older restorable one; --resume falls back to the
+    # newest loadable generation with a loud warning. 1 = the
+    # historical overwrite-in-place.
+    checkpoint_keep: int = 1
     verbose: bool = False
 
     # Observability (dpsvm_tpu/obs): run logs, metrics, trace spans.
@@ -783,6 +790,12 @@ class SVMConfig:
                 "or 'highest'")
         if self.retry_faults < 0:
             raise ValueError("retry_faults must be >= 0 (0 = no retry)")
+        if not 1 <= self.checkpoint_keep <= 99:
+            raise ValueError(
+                "checkpoint_keep must be in [1, 99] (1 = single "
+                "overwritten checkpoint; K keeps K rotating "
+                "generations — the resume fallback scans suffixes "
+                ".1..99)")
         if self.chunk_iters < 1:
             raise ValueError("chunk_iters must be >= 1")
         if self.max_iter > 2 ** 31 - 1:
@@ -865,6 +878,35 @@ class ServeConfig:
       'failed' verdicts and a per-model serve_dispatch_failures
       counter, and the engine keeps serving subsequent batches. None
       (default) = unbounded wait (the pre-watchdog behavior).
+    listen: "HOST:PORT" for the network front door (ISSUE 15,
+      dpsvm_tpu/serving/server.py): a persistent-connection TCP
+      endpoint speaking the length-prefixed binary frame protocol
+      (serving/wire.py) in front of the v2 engine. Port 0 binds an
+      ephemeral port (read it from ``server.port``). None (default) =
+      no network endpoint (in-process submit only). Like
+      metrics_host, prefer loopback unless the network is trusted —
+      the protocol is plaintext and unauthenticated.
+    admission_max_rows: ADMISSION CONTROL bound for the front door:
+      a request arriving while the engine already holds this many
+      queued rows is REJECTED immediately with an explicit wire
+      verdict and a ``retry_after_ms`` hint, instead of buffering
+      without bound (the engine-internal ``max_pending`` backpressure
+      still guards in-process callers). None (default) = use
+      ``max_pending``. Must not exceed max_pending (admission must
+      trip BEFORE the blocking in-engine backpressure).
+    admission_retry_ms: base of the ``retry_after_ms`` hint on
+      rejected verdicts; the hint scales with queue overshoot
+      (deterministic — the client backoff tests pin it).
+    conn_read_timeout_ms / conn_write_timeout_ms: per-connection
+      socket timeouts on the front door. The read timeout bounds
+      slow-loris and dead-peer cost (an idle or half-open connection
+      is closed after this long with no complete frame); the write
+      timeout bounds a stalled reader (a verdict write blocked this
+      long kills ONLY that connection and counts its verdicts
+      undeliverable — the pump thread is never the one blocked).
+    max_frame_bytes: upper bound on a frame payload, checked from the
+      fixed-size header BEFORE any allocation — a hostile length
+      prefix costs one connection, never server memory.
     journal_path: registry JOURNAL for the v2 engine (ISSUE 13): a
       JSON file atomically rewritten on every register/swap/unregister
       with the live {name -> model path + version} set. A restarting
@@ -888,6 +930,12 @@ class ServeConfig:
     deadline_ms: Optional[float] = None
     dispatch_timeout_ms: Optional[float] = None
     journal_path: Optional[str] = None
+    listen: Optional[str] = None
+    admission_max_rows: Optional[int] = None
+    admission_retry_ms: float = 50.0
+    conn_read_timeout_ms: float = 30000.0
+    conn_write_timeout_ms: float = 10000.0
+    max_frame_bytes: int = 64 * 1024 * 1024
     # Observability (dpsvm_tpu/obs): serve run logs + trace spans.
     # Bucket latency HISTOGRAMS are always on (they replaced the old
     # bounded timing deques at identical cost); this only gates the
@@ -940,6 +988,39 @@ class ServeConfig:
             raise ValueError(
                 "journal_path must be a file path (None = no registry "
                 "journal)")
+        if self.listen is not None:
+            host, sep, port = str(self.listen).rpartition(":")
+            if not sep or not host or not port.isdigit() \
+                    or not (0 <= int(port) <= 65535):
+                raise ValueError(
+                    f"listen must be 'HOST:PORT' (port 0 = ephemeral), "
+                    f"got {self.listen!r}")
+        if self.admission_max_rows is not None:
+            if self.admission_max_rows < 1:
+                raise ValueError(
+                    "admission_max_rows must be >= 1 (None = "
+                    "max_pending)")
+            if self.admission_max_rows > self.max_pending:
+                raise ValueError(
+                    "admission_max_rows must not exceed max_pending "
+                    f"({self.max_pending}): admission rejects must "
+                    "trip BEFORE the blocking in-engine backpressure")
+        if self.admission_retry_ms <= 0:
+            raise ValueError("admission_retry_ms must be > 0")
+        if self.conn_read_timeout_ms <= 0 \
+                or self.conn_write_timeout_ms <= 0:
+            raise ValueError(
+                "conn_read_timeout_ms / conn_write_timeout_ms must be "
+                "> 0 (they bound slow-loris and stalled-reader cost)")
+        if self.max_frame_bytes < 4096:
+            raise ValueError(
+                "max_frame_bytes must be >= 4096 (smaller would "
+                "refuse even a one-row request frame)")
+
+    def listen_addr(self) -> tuple:
+        """('host', port) from the validated listen spec."""
+        host, _, port = str(self.listen).rpartition(":")
+        return host, int(port)
 
     def replace(self, **kw) -> "ServeConfig":
         return dataclasses.replace(self, **kw)

@@ -191,6 +191,10 @@ def summarize_run(run: Run) -> dict:
         "dispatch_failures": fin.get("dispatch_failures"),
         "serve_requests": fin.get("requests") if man.get(
             "tool") == "serve" else None,
+        # Network front-door accounting (ISSUE 15): the engine
+        # snapshot's "net" sub-dict (connection / frame / verdict /
+        # protocol-error counters) when a ServeServer was attached.
+        "net": fin.get("net"),
         "batch_occupancy_mean": ((fin.get("batch_occupancy") or {})
                                  .get("mean")),
         # Auto-gate provenance (ISSUE 14): the manifest's autotune
@@ -330,16 +334,23 @@ def _report_row(s: dict) -> list:
             # Serving-engine column (ISSUE 10 satellite): deadline
             # misses / hot swaps / mean batch occupancy for v2 serve
             # runs, "-" for everything else. fail= appears only when
-            # dispatches actually failed (ISSUE 13 watchdog).
+            # dispatches actually failed (ISSUE 13 watchdog); rej= /
+            # perr= only when the network front door rejected frames
+            # or saw protocol errors (ISSUE 15).
             if s.get("deadline_misses") is None:
                 row.append("-")
             else:
                 occ = s.get("batch_occupancy_mean")
+                net = s.get("net") or {}
                 row.append(
                     f"miss={s['deadline_misses']} "
                     f"swap={s.get('hot_swaps') or 0}"
                     + (f" fail={s['dispatch_failures']}"
                        if s.get("dispatch_failures") else "")
+                    + (f" rej={net['rejected']}"
+                       if net.get("rejected") else "")
+                    + (f" perr={net['protocol_errors']}"
+                       if net.get("protocol_errors") else "")
                     + (f" occ={occ:.2f}" if occ is not None else ""))
         elif head == "profile":
             # Auto-gate provenance column (ISSUE 14): "-" for runs

@@ -21,18 +21,33 @@ merging. This package grows that into a multi-model engine:
   :class:`ServingEngine` frontend that ties the three together and
   exports the whole thing on /metrics and the serve run log.
 
+The network front door (ISSUE 15) rides on top:
+
+* :mod:`dpsvm_tpu.serving.wire`    — the length-prefixed binary frame
+  protocol (clock-skew-safe deadline budgets, five-verdict contract).
+* :mod:`dpsvm_tpu.serving.server`  — :class:`ServeServer`, the
+  persistent-connection TCP endpoint: admission control, per-
+  connection read/write bounds, protocol-error containment, graceful
+  drain, exact verdict accounting.
+* :mod:`dpsvm_tpu.serving.client`  — :class:`ServeClient`, bounded
+  retry with backoff + jitter on connect/``rejected`` only (never on
+  ``failed``/``expired`` — no duplicated compute).
+
 The closed-loop load generator driving this engine through the bench
-regression gate is ``tools/loadgen.py``.
+regression gate is ``tools/loadgen.py`` (``--net`` drives it through
+the socket path with connection-fault injection).
 """
 
+from dpsvm_tpu.serving.client import ServeClient
 from dpsvm_tpu.serving.dispatch import ServeResult, ServingEngine
 from dpsvm_tpu.serving.registry import (LoadedModel, ModelLoadError,
                                         ModelRegistry, RegistryJournal,
                                         load_model_file)
 from dpsvm_tpu.serving.scheduler import Request, Scheduler
+from dpsvm_tpu.serving.server import ServeServer
 
 __all__ = [
     "ServingEngine", "ServeResult", "ModelRegistry", "RegistryJournal",
     "LoadedModel", "ModelLoadError", "load_model_file", "Scheduler",
-    "Request",
+    "Request", "ServeServer", "ServeClient",
 ]

@@ -365,6 +365,18 @@ class ServingEngine:
         self._rows_total = 0
         self._closing = False
         self._closed = False
+        # Lifecycle lock (ISSUE 15 satellite): drain() and close()
+        # serialize on it, so close() during an active drain() waits
+        # for the drain to finish and then tears down exactly once —
+        # double-shutdown is idempotent from any interleaving, and a
+        # scrape during a drain reads live instruments (never torn:
+        # only close() flips _closing, under this lock). RLock because
+        # close() itself drains.
+        self._lifecycle = threading.RLock()
+        # The attached network front door (serving/server.py), if any:
+        # its counters join snapshot() and the /metrics exposition so
+        # one scrape carries one truth.
+        self._front = None
 
         # Always-on instruments (the PredictServer discipline): one
         # Registry per engine; percentiles everywhere come from THESE
@@ -403,7 +415,6 @@ class ServingEngine:
         # compiles fire synchronously on the compiling thread — a
         # shared bool would let one thread's finally-reset hide the
         # other thread's compile from the counter.
-        import threading
         import weakref
 
         self._tl = threading.local()
@@ -645,11 +656,18 @@ class ServingEngine:
 
     def drain(self) -> dict:
         """Pump until every queued request and in-flight batch is
-        complete; returns (and pops) all completed results."""
-        while self.scheduler.queue_depth or self._dispatcher.busy:
-            self.pump()
-        self._gc_groups()
-        return self.results()
+        complete; returns (and pops) all completed results. Serialized
+        against close() on the lifecycle lock: a close() racing an
+        active drain waits for it, and a drain() after close is a
+        no-op returning whatever already completed — double-shutdown
+        from any interleaving is idempotent."""
+        with self._lifecycle:
+            if self._closed:
+                return self.results()
+            while self.scheduler.queue_depth or self._dispatcher.busy:
+                self.pump()
+            self._gc_groups()
+            return self.results()
 
     def results(self) -> dict:
         """Pop everything completed so far: {ticket: ServeResult}."""
@@ -882,6 +900,17 @@ class ServingEngine:
         return res.labels()  # the SERVING version's fold, swap-safe
 
     # --------------------------------------------------------- telemetry
+    def attach_net(self, front) -> None:
+        """Attach the network front door (serving/server.py): its
+        counters join snapshot() (under the ``net`` key — the run
+        log's final record and ``obs report``'s serve column read it)
+        and its OpenMetrics families join the /metrics exposition.
+        While a front door is attached, ITS pump thread is the
+        engine's single driver — in-process submit()/drain() callers
+        must not race it (registry swaps on admin threads remain
+        fine)."""
+        self._front = front
+
     def bucket_suggestion(self) -> dict:
         """Report-only ``ServeConfig.buckets`` advice from the
         engine's own dispatch telemetry (ISSUE 14 satellite; closes
@@ -930,6 +959,8 @@ class ServingEngine:
             "dispatch_seconds": self.dispatch_seconds.snapshot(),
             "request_seconds": self.request_seconds.snapshot(),
             "per_model": per_model,
+            **({"net": self._front.net_snapshot()}
+               if self._front is not None else {}),
         }
 
     def render_openmetrics(self) -> str:
@@ -1024,22 +1055,35 @@ class ServingEngine:
                 "discipline)",
                 [({"slot": str(i)}, b)
                  for i, b in enumerate(sug["suggested_buckets"])]))
+        if self._front is not None:
+            # Front-door families (ISSUE 15): connection/frame/verdict
+            # accounting rides the SAME exposition — one scrape, one
+            # truth for the chaos legs' reconciliation.
+            fams.extend(self._front.net_families())
         return om.render(fams)
 
     def close(self) -> None:
         """Drain outstanding work, stop /metrics FIRST (the ordering
         contract: a racing scrape sees a full exposition, the # EOF
         stub, or a clean refusal — never a half-torn-down read),
-        detach the compile sink and finish the serve run log."""
+        detach the compile sink and finish the serve run log. A
+        close() arriving DURING an active drain() waits on the
+        lifecycle lock for that drain to complete, then tears down
+        once; a second close() is a no-op (ISSUE 15 satellite)."""
         if self._closed:
             return
-        self._closing = True
-        if self.exporter is not None:
-            self.exporter.close()
-        self.drain()
-        compilelog.remove_sink(self._compile_sink)
-        self._obs.finish(**self.snapshot())
-        self._closed = True
+        with self._lifecycle:
+            if self._closed:
+                return
+            self._closing = True
+            if self.exporter is not None:
+                self.exporter.close()
+            while self.scheduler.queue_depth or self._dispatcher.busy:
+                self.pump()
+            self._gc_groups()
+            compilelog.remove_sink(self._compile_sink)
+            self._obs.finish(**self.snapshot())
+            self._closed = True
 
     def __enter__(self):
         return self

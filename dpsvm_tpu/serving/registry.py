@@ -230,7 +230,14 @@ class RegistryJournal:
         self.path = path
 
     def write(self, models: dict) -> None:
-        """Atomically persist {name: {"source": path, "version": v}}."""
+        """Atomically AND durably persist {name: {"source": path,
+        "version": v}}: tmp + fsync + rename + directory fsync — the
+        checkpoint.py discipline. Without the fsyncs the PR 13
+        crash-recovery guarantee held against killed processes but
+        not power loss (the rename could reach disk before the tmp
+        file's data blocks)."""
+        from dpsvm_tpu.utils.checkpoint import fsync_dir
+
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".journal.tmp")
@@ -238,7 +245,10 @@ class RegistryJournal:
             with os.fdopen(fd, "w") as fh:
                 json.dump({"format_version": self.FORMAT_VERSION,
                            "models": models}, fh, indent=1)
+                fh.flush()
+                os.fsync(fh.fileno())  # data durable BEFORE the rename
             os.replace(tmp, self.path)
+            fsync_dir(d)  # …and the rename itself durable after
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)

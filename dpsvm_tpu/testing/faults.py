@@ -67,6 +67,20 @@ Seams
                    waiting thread) — the watchdog must bound it.
 ``nonfinite_obs``  the chunk-boundary host observation reads NaN —
                    the graceful-degradation sentinel's trigger.
+``net_accept``     the front door drops an incoming connection at
+                   accept time without a frame (accept-queue overflow
+                   / SYN drop seen from the client as an immediate
+                   EOF) — the connect-retry recovery class.
+``net_conn_drop``  a client connection dies mid-flight: the request
+                   frame was fully sent, the socket closes before the
+                   verdict is read — the server's verdict becomes
+                   undeliverable; accounting must still close.
+``net_read_stall`` the client stalls ``NET_STALL_SECONDS`` before
+                   reading its verdict — a slow reader whose cost the
+                   server's bounded writes must contain.
+``net_partial_write``  the client writes only HALF a request frame and
+                   closes — the server must kill only that connection
+                   (truncated-frame accounting), never wedge.
 =================  ====================================================
 
 Firing records accumulate on ``plan.fired`` (a Counter) so tests can
@@ -89,12 +103,21 @@ from typing import List, Optional
 SEAMS = frozenset({
     "dispatch", "ooc_tile_put", "ckpt_truncate", "swap_corrupt",
     "serve_dispatch", "serve_stall", "nonfinite_obs",
+    "net_accept", "net_conn_drop", "net_read_stall",
+    "net_partial_write",
 })
 
 #: how long a fired ``serve_stall`` sleeps (long enough to trip any
 #: sane dispatch watchdog, short enough that the daemon worker thread
 #: dies quickly after the test). Tests may monkeypatch.
 STALL_SECONDS = 5.0
+
+#: how long a fired ``net_read_stall`` client stalls before reading its
+#: verdict (a slow reader, not a dead one — shorter than STALL_SECONDS
+#: because the stall rides INSIDE a chaos leg's wall clock; the server
+#: must be provably unaffected, so nothing waits on it). Tests and the
+#: loadgen chaos leg may monkeypatch.
+NET_STALL_SECONDS = 0.5
 
 _SPEC_RE = re.compile(r"^(?P<seam>[a-z_]+)(@(?P<at>\d+))?(x(?P<times>\d+))?$")
 
@@ -318,3 +341,35 @@ def serve_stall() -> None:
     device dispatch the watchdog must bound."""
     if arrive("serve_stall"):
         time.sleep(STALL_SECONDS)
+
+
+# The network seams (ISSUE 15). net_accept fires in the SERVER's accept
+# path; the other three fire in the CLIENT (serving/client.py), because
+# the behaviors they model — a killed connection, a slow reader, a
+# truncated send — are things the wire does TO the server: arming them
+# in the client exercises the server's real read/write/accounting
+# paths, never a mock.
+
+def net_accept_drop() -> bool:
+    """True when the ``net_accept`` seam fires: the server drops this
+    incoming connection without a frame."""
+    return arrive("net_accept")
+
+
+def net_conn_drop() -> bool:
+    """True when the ``net_conn_drop`` seam fires: the client must
+    close its socket after the send, before reading the verdict."""
+    return arrive("net_conn_drop")
+
+
+def net_partial_write() -> bool:
+    """True when the ``net_partial_write`` seam fires: the client must
+    send only half the frame bytes and close."""
+    return arrive("net_partial_write")
+
+
+def net_read_stall() -> None:
+    """The ``net_read_stall`` seam: the client sleeps before reading
+    its verdict — a slow reader the server must not block on."""
+    if arrive("net_read_stall"):
+        time.sleep(NET_STALL_SECONDS)

@@ -24,6 +24,20 @@ Injected-fault coverage (dpsvm_tpu/testing/faults.py): the
 ``ckpt_truncate`` seam kills a save between the tmp write and the
 rename — the previous checkpoint must survive intact, which is the
 whole point of the tmp+rename discipline.
+
+DURABILITY (ISSUE 15 satellite): tmp+rename alone survives a killed
+PROCESS but not power loss — without an fsync the rename can hit the
+disk before the tmp file's data blocks, leaving a correctly-named
+checkpoint full of garbage. Every atomic write here fsyncs the tmp
+file BEFORE the rename and the parent directory AFTER it (the
+directory entry itself must be durable); tests pin the ordering by
+monkeypatching ``os.fsync``.
+
+RETENTION (ISSUE 15 satellite): ``SVMConfig.checkpoint_keep = K``
+keeps K rotating generations (``path`` newest, ``path.1`` …
+``path.(K-1)`` oldest) so a checkpoint corrupted BY the fault being
+recovered from still leaves an older restorable generation; resume
+falls back to the newest loadable one with a loud warning.
 """
 
 from __future__ import annotations
@@ -61,13 +75,33 @@ class CheckpointState(NamedTuple):
     format_version: int
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY: after an os.replace, the rename itself lives
+    in the directory entry — without this a power loss can forget the
+    rename while keeping the (already-fsynced) file data. Filesystems
+    that refuse directory fsync (some network mounts) are skipped:
+    they provide no such durability to lose."""
+    try:
+        dfd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
 def save_checkpoint(path: str, alpha, f, iteration: int, b_hi: float,
                     b_lo: float, config: SVMConfig, *, f_err=None,
                     rounds: Optional[int] = None) -> None:
-    """Atomic write (tmp + rename) so a preemption mid-save never
-    leaves a truncated checkpoint. ``f_err``/``rounds`` are the v2
-    extras (the ooc driver's full carry); omitted fields are simply
-    absent from the file."""
+    """Atomic DURABLE write (tmp + fsync + rename + dir fsync) so
+    neither a preemption mid-save nor a power loss right after the
+    rename can leave a truncated or garbage checkpoint (fsync-before-
+    rename is what makes the rename mean something). ``f_err``/
+    ``rounds`` are the v2 extras (the ooc driver's full carry);
+    omitted fields are simply absent from the file."""
     from dpsvm_tpu.testing import faults
 
     d = os.path.dirname(os.path.abspath(path))
@@ -89,11 +123,17 @@ def save_checkpoint(path: str, alpha, f, iteration: int, b_hi: float,
             payload["rounds"] = np.int64(rounds)
         with os.fdopen(fd, "wb") as fh:
             np.savez_compressed(fh, **payload)
+            # Durability ordering: the tmp file's bytes must be ON
+            # DISK before the rename publishes its name (tests pin
+            # fsync-before-replace by monkeypatching os.fsync).
+            fh.flush()
+            os.fsync(fh.fileno())
         # Injected preemption point (ckpt_truncate seam): fires AFTER
         # the tmp bytes exist and BEFORE the rename — the previous
         # checkpoint at `path` must be untouched by the wreckage.
         faults.damage_checkpoint(tmp)
         os.replace(tmp, path)
+        fsync_dir(d)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -130,6 +170,23 @@ def load_checkpoint(path: str):
     return (st.alpha, st.f, st.iteration, st.b_hi, st.b_lo, st.config)
 
 
+class CheckpointCorrupt(ValueError):
+    """A checkpoint that cannot be trusted (unreadable file or
+    non-finite state) — the class the retention fallback skips past;
+    COMPATIBILITY refusals (wrong n, wrong hyper-parameters) stay
+    plain ValueError and always propagate: they are a caller error an
+    older generation would share."""
+
+
+def _check_integrity(st: CheckpointState, path: str) -> None:
+    if not (np.isfinite(st.alpha).all() and np.isfinite(st.f).all()
+            and (st.f_err is None or np.isfinite(st.f_err).all())):
+        raise CheckpointCorrupt(
+            f"checkpoint {path} holds non-finite solver state "
+            "(corrupt or hand-edited — this repo's writers never "
+            "persist non-finite state); refusing to resume it")
+
+
 def _validate_restore(st: CheckpointState, path: str,
                       config: SVMConfig, n: int) -> None:
     """Refuse resumes that would silently corrupt the solution (the
@@ -139,18 +196,22 @@ def _validate_restore(st: CheckpointState, path: str,
         raise ValueError(
             f"checkpoint {path} holds state for n={st.alpha.shape[0]} "
             f"rows, but the current dataset has n={n}")
-    if not (np.isfinite(st.alpha).all() and np.isfinite(st.f).all()
-            and (st.f_err is None or np.isfinite(st.f_err).all())):
-        raise ValueError(
-            f"checkpoint {path} holds non-finite solver state "
-            "(corrupt or hand-edited — this repo's writers never "
-            "persist non-finite state); refusing to resume it")
+    _check_integrity(st, path)
     for field in ("c", "gamma", "kernel", "degree", "coef0", "epsilon"):
         if getattr(st.config, field) != getattr(config, field):
             raise ValueError(
                 f"checkpoint {path} was written with {field}="
                 f"{getattr(st.config, field)!r}, current run uses "
                 f"{getattr(config, field)!r}; refusing to resume")
+
+
+def checkpoint_generations(path: str) -> list:
+    """The on-disk retention chain for `path`, NEWEST FIRST: the bare
+    path, then the rotated ``.1``/``.2``/… generations
+    (PeriodicCheckpointer's keep_last suffixes). Only existing files
+    are returned."""
+    cands = [path] + [f"{path}.{i}" for i in range(1, 100)]
+    return [p for p in cands if os.path.exists(p)]
 
 
 def resume_solver_state(path: Optional[str], config: SVMConfig, n: int):
@@ -169,21 +230,76 @@ def resume_state(path: Optional[str], config: SVMConfig,
                  n: int) -> Optional[CheckpointState]:
     """The full-carry resume (the ooc driver's entry): the validated
     CheckpointState including the v2 ``f_err``/``rounds`` extras, or
-    None when `path` is unset or missing."""
-    if not path or not os.path.exists(path):
+    None when `path` is unset and no generation of it exists.
+
+    RETENTION FALLBACK (ISSUE 15 satellite): an unreadable or
+    non-finite newest generation falls back — with a LOUD warning —
+    to the next rotated generation (``path.1``, ``path.2``, …); only
+    when every existing generation is corrupt does the resume fail.
+    Compatibility refusals (wrong n, different hyper-parameters)
+    propagate immediately: an older generation of the same run would
+    refuse identically."""
+    import warnings
+
+    if not path:
         return None
-    st = load_checkpoint_state(path)
-    _validate_restore(st, path, config, n)
-    return st
+    cands = checkpoint_generations(path)
+    if not cands:
+        return None
+    last_err = None
+    for cand in cands:
+        try:
+            st = load_checkpoint_state(cand)
+            _check_integrity(st, cand)
+        except ValueError as e:
+            # CheckpointCorrupt, bad format_version, truncated npz
+            # (np.load raises ValueError/OSError/BadZipFile subclasses
+            # of these)…
+            warnings.warn(
+                f"checkpoint generation {cand!r} is UNUSABLE "
+                f"({type(e).__name__}: {e}); trying the next "
+                "retention generation", stacklevel=2)
+            last_err = e
+            continue
+        except Exception as e:
+            warnings.warn(
+                f"checkpoint generation {cand!r} is UNREADABLE "
+                f"({type(e).__name__}: {e}); trying the next "
+                "retention generation", stacklevel=2)
+            last_err = e
+            continue
+        _validate_restore(st, cand, config, n)
+        if cand != path:
+            warnings.warn(
+                f"RESUMING FROM OLDER CHECKPOINT GENERATION {cand!r} "
+                f"(newest {path!r} was missing or corrupt): up to "
+                "checkpoint_every iterations of progress are being "
+                "redone — expected after a fault that corrupted the "
+                "newest generation, alarming otherwise", stacklevel=2)
+        return st
+    raise ValueError(
+        f"every checkpoint generation of {path!r} is unloadable "
+        f"({len(cands)} tried); refusing to silently start fresh — "
+        f"remove them explicitly to do that (last error: {last_err})"
+    ) from last_err
 
 
 class PeriodicCheckpointer:
-    """Chunk-cadence checkpoint trigger shared by all solver backends."""
+    """Chunk-cadence checkpoint trigger shared by all solver backends.
+
+    ``config.checkpoint_keep = K`` (default 1 — the historical
+    overwrite-in-place) keeps K rotating generations: each save first
+    shifts ``path -> path.1 -> … -> path.(K-1)`` and then writes the
+    new state at ``path``, so a save that dies mid-window (the
+    ``ckpt_truncate`` seam: tmp written, rename never ran, or worse a
+    power loss that mangles the newest file) still leaves an older
+    restorable generation for ``resume_state``'s fallback."""
 
     def __init__(self, path: Optional[str], config: SVMConfig, start_iter: int = 0):
         self.path = path
         self.config = config
         self.every = config.checkpoint_every
+        self.keep = getattr(config, "checkpoint_keep", 1)
         self.last = start_iter
 
     @property
@@ -225,7 +341,24 @@ class PeriodicCheckpointer:
                 "previous checkpoint is kept as the restore point",
                 stacklevel=3)
             return False
+        self._rotate()
         save_checkpoint(self.path, alpha, f, iteration, b_hi, b_lo,
                         self.config, f_err=f_err, rounds=rounds)
         self.last = iteration
         return True
+
+    def _rotate(self) -> None:
+        """Shift the retention chain one slot older (newest last to
+        move, so a crash mid-rotation still leaves a contiguous
+        newest-first chain for the resume fallback), then prune
+        generations past `keep` — stale suffixes left by a reduced
+        keep must not become surprise fallback targets."""
+        if self.keep > 1 and os.path.exists(self.path):
+            for i in range(self.keep - 1, 0, -1):
+                src = self.path if i == 1 else f"{self.path}.{i - 1}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i}")
+        i = max(self.keep, 1)
+        while os.path.exists(f"{self.path}.{i}"):
+            os.unlink(f"{self.path}.{i}")
+            i += 1

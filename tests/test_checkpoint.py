@@ -167,3 +167,119 @@ def test_callback_abort_forces_checkpoint(tmp_path, blobs_small):
     assert it == res.iterations  # the abort state, not a stale cadence one
     import numpy as np
     np.testing.assert_array_equal(alpha, res.alpha)
+
+
+# ----------------------- durability + retention (ISSUE 15 satellites)
+
+def test_fsync_before_rename_ordering(tmp_path, monkeypatch):
+    """The power-loss durability pin: the tmp file's bytes must be
+    fsynced BEFORE the rename publishes its name, and the directory
+    entry fsynced AFTER — otherwise tmp+rename only survives killed
+    processes, not power loss."""
+    import os
+    import stat
+
+    calls = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(os, "fsync", lambda fd: (
+        calls.append(("fsync",
+                      "dir" if stat.S_ISDIR(os.fstat(fd).st_mode)
+                      else "file")), real_fsync(fd))[1])
+    monkeypatch.setattr(os, "replace", lambda a, b: (
+        calls.append(("replace", None)), real_replace(a, b))[1])
+    save_checkpoint(str(tmp_path / "ck.npz"), np.zeros(3, np.float32),
+                    np.zeros(3, np.float32), 1, 0.0, 0.0, CFG)
+    kinds = [(k, d) for k, d in calls]
+    assert ("fsync", "file") in kinds and ("fsync", "dir") in kinds
+    assert kinds.index(("fsync", "file")) \
+        < kinds.index(("replace", None)) \
+        < kinds.index(("fsync", "dir")), calls
+
+
+def test_retention_rotates_and_survives_mid_save_fault(tmp_path):
+    """checkpoint_keep=K keeps K rotating generations, and the exact
+    ckpt_truncate window (tmp written, rename never ran — AFTER the
+    rotation moved the newest aside) still leaves an older restorable
+    generation that resume falls back to with a loud warning."""
+    import os
+
+    from dpsvm_tpu.testing import faults
+    from dpsvm_tpu.utils.checkpoint import (PeriodicCheckpointer,
+                                            checkpoint_generations,
+                                            load_checkpoint_state,
+                                            resume_state)
+
+    n = 4
+    cfg = CFG.replace(checkpoint_every=1, checkpoint_keep=3)
+    p = str(tmp_path / "ck.npz")
+    ck = PeriodicCheckpointer(p, cfg)
+    for it in (10, 20, 30, 40):  # 4 saves -> 3 kept, oldest dropped
+        assert ck.save(it, np.full(n, it, np.float32),
+                       np.zeros(n, np.float32), 1.0, -1.0)
+    gens = checkpoint_generations(p)
+    assert [os.path.basename(g) for g in gens] == \
+        ["ck.npz", "ck.npz.1", "ck.npz.2"]
+    assert [load_checkpoint_state(g).iteration for g in gens] == \
+        [40, 30, 20]
+    # the fault being recovered from corrupts the NEWEST generation:
+    # rotation already moved 40 -> .1, then the save dies mid-window.
+    with faults.install(faults.FaultPlan.parse("ckpt_truncate")) as plan:
+        with pytest.raises(faults.FaultInjected):
+            ck.save(50, np.full(n, 50, np.float32),
+                    np.zeros(n, np.float32), 1.0, -1.0)
+    assert plan.fired["ckpt_truncate"] == 1
+    assert not os.path.exists(p)  # the rename never ran
+    with pytest.warns(UserWarning, match="OLDER CHECKPOINT GENERATION"):
+        st = resume_state(p, cfg, n)
+    assert st.iteration == 40  # the pre-fault newest, from .1
+    # a keep=1 checkpointer never rotates (the historical layout)
+    ck1 = PeriodicCheckpointer(str(tmp_path / "flat.npz"),
+                               CFG.replace(checkpoint_every=1))
+    ck1.save(1, np.zeros(n, np.float32), np.zeros(n, np.float32), 0, 0)
+    ck1.save(2, np.ones(n, np.float32), np.zeros(n, np.float32), 0, 0)
+    assert checkpoint_generations(str(tmp_path / "flat.npz")) == \
+        [str(tmp_path / "flat.npz")]
+    # REDUCING keep prunes the now-out-of-retention suffixes — stale
+    # generations must not become surprise fallback targets
+    ck2 = PeriodicCheckpointer(p, cfg.replace(checkpoint_keep=2))
+    ck2.save(60, np.full(n, 60, np.float32),
+             np.zeros(n, np.float32), 1.0, -1.0)
+    assert [os.path.basename(g) for g in checkpoint_generations(p)] \
+        == ["ck.npz", "ck.npz.1"]
+    with pytest.raises(ValueError, match=r"\[1, 99\]"):
+        cfg.replace(checkpoint_keep=150)
+
+
+def test_resume_falls_back_past_corrupt_generations(tmp_path):
+    """Every corrupt generation is skipped with a loud warning; only
+    when ALL are unloadable does resume refuse (never a silent fresh
+    start); compatibility mismatches still refuse immediately."""
+    from dpsvm_tpu.utils.checkpoint import (PeriodicCheckpointer,
+                                            resume_state)
+
+    n = 4
+    cfg = CFG.replace(checkpoint_every=1, checkpoint_keep=3)
+    p = str(tmp_path / "ck.npz")
+    ck = PeriodicCheckpointer(p, cfg)
+    for it in (10, 20, 30):
+        ck.save(it, np.full(n, it, np.float32),
+                np.zeros(n, np.float32), 1.0, -1.0)
+    for path in (p, p + ".1"):  # newest TWO generations corrupt
+        with open(path, "wb") as fh:
+            fh.write(b"not an npz")
+    # (pytest re-emits non-matching warnings, so the pattern covers
+    # both the per-generation skips and the final fallback notice)
+    with pytest.warns(UserWarning,
+                      match="UNUSABLE|UNREADABLE|OLDER CHECKPOINT"):
+        st = resume_state(p, cfg, n)
+    assert st.iteration == 10  # the oldest survivor
+    # hyper-parameter mismatch refuses loudly even with generations
+    with pytest.warns(UserWarning):
+        with pytest.raises(ValueError, match="refusing to resume"):
+            resume_state(p, cfg.replace(c=999.0), n)
+    # all generations corrupt -> refuse, never silently start fresh
+    with open(p + ".2", "wb") as fh:
+        fh.write(b"junk")
+    with pytest.warns(UserWarning):
+        with pytest.raises(ValueError, match="unloadable"):
+            resume_state(p, cfg, n)

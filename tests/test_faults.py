@@ -186,10 +186,13 @@ def test_nonfinite_state_never_checkpointed(tmp_path):
     with pytest.warns(UserWarning, match="SKIPPED"):
         assert not ck.save(20, alpha, bad_f, -0.5, 0.5)
     assert load_checkpoint_state(p).iteration == 10  # last good kept
-    # A non-finite file (written by some other tool) refuses resume.
+    # A non-finite file (written by some other tool) refuses resume —
+    # via the retention fallback's loud per-generation warning, since
+    # a corrupt newest generation first tries the (absent) older ones.
     save_checkpoint(str(tmp_path / "bad.npz"), alpha, bad_f, 20,
                     -0.5, 0.5, cfg)
-    with pytest.raises(ValueError, match="non-finite"):
+    with pytest.warns(UserWarning, match="UNUSABLE"), \
+            pytest.raises(ValueError, match="non-finite"):
         resume_state(str(tmp_path / "bad.npz"), cfg, 4)
 
 

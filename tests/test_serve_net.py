@@ -1,0 +1,621 @@
+"""Network front door tests (dpsvm_tpu/serving/{wire,server,client} —
+ISSUE 15): wire-codec round trips and refusals, socket-path parity
+with the model layer, clock-skew-safe deadline-budget propagation,
+admission rejects with retry hints, the client's compute-safe retry
+policy, slow-reader/slow-writer bounds, seeded protocol fuzz (no
+wedge, no thread leak, counters reconcile), graceful drain under
+offered load, and the `cli serve --listen` path.
+
+Budget discipline: plain sockets + one tiny module-scoped model; no
+new interpret-mode Pallas compiles (tier-1 sits near its ceiling)."""
+
+import socket
+import struct
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import ServeConfig, SVMConfig
+from dpsvm_tpu.models.multiclass import (decision_matrix,
+                                         predict_multiclass,
+                                         train_multiclass)
+from dpsvm_tpu.serving import ServeClient, ServeServer, ServingEngine
+from dpsvm_tpu.serving import wire
+from dpsvm_tpu.serving.client import (ConnectError, ConnectionDropped,
+                                      SendAborted, ServerDraining)
+from dpsvm_tpu.testing import faults
+
+CFG = SVMConfig(c=5.0, gamma=0.25, epsilon=1e-3, chunk_iters=256)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    rng = np.random.default_rng(17)
+    xs, ys = [], []
+    for k in range(3):
+        c = np.zeros(5, np.float32)
+        c[k] = 2.5
+        xs.append(rng.normal(size=(45, 5)).astype(np.float32) * 0.7 + c)
+        ys.append(np.full(45, k))
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    model, _ = train_multiclass(x, y, CFG, strategy="ovr")
+    return model, x
+
+
+def _served(**kw):
+    """(engine, server) with a small bucket ladder."""
+    kw.setdefault("buckets", (16, 64))
+    eng = ServingEngine(ServeConfig(**kw))
+    return eng, ServeServer(eng)
+
+
+def _no_net_threads(deadline_s=10.0):
+    """All dpsvm-net threads gone (the zero-leak acceptance)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        left = [t.name for t in threading.enumerate()
+                if t.name.startswith("dpsvm-net")]
+        if not left:
+            return []
+        time.sleep(0.02)
+    return left
+
+
+# ------------------------------------------------------------- wire codec
+
+def test_wire_request_verdict_roundtrip():
+    rows = np.arange(12, dtype=np.float32).reshape(3, 4) / 7
+    frame = wire.pack_request(42, rows, "mnist", 125.5,
+                              want_decision=True)
+    ftype, length = wire.parse_header(frame[:wire.HEADER_BYTES],
+                                      max_payload=1 << 20)
+    assert ftype == wire.T_REQUEST
+    req = wire.parse_request(frame[wire.HEADER_BYTES:])
+    assert (req.req_id, req.model, req.want_decision) == (42, "mnist",
+                                                          True)
+    assert req.budget_ms == 125.5
+    np.testing.assert_array_equal(req.rows, rows)  # bitwise through >f4
+    # no-deadline and no-model ride the sentinel encodings
+    bare = wire.parse_request(wire.pack_request(
+        1, rows, None, None)[wire.HEADER_BYTES:])
+    assert bare.model is None and bare.budget_ms is None
+
+    lab = np.array([1, -1, 7], np.int32)
+    v = wire.parse_verdict(wire.pack_verdict(
+        9, "late", model="m", version=3, latency_ms=12.25,
+        labels=lab)[wire.HEADER_BYTES:])
+    assert (v.verdict, v.model, v.version) == ("late", "m", 3)
+    np.testing.assert_array_equal(v.labels, lab)
+    dec = np.linspace(-2, 2, 6, dtype=np.float32).reshape(2, 3)
+    v2 = wire.parse_verdict(wire.pack_verdict(
+        8, "served", decision=dec)[wire.HEADER_BYTES:])
+    np.testing.assert_array_equal(v2.decision, dec)  # bitwise
+    v3 = wire.parse_verdict(wire.pack_verdict(
+        7, "rejected", retry_after_ms=80,
+        message="queue full")[wire.HEADER_BYTES:])
+    assert (v3.retry_after_ms, v3.message) == (80, "queue full")
+    assert v3.labels is None and v3.decision is None
+
+
+def test_wire_header_refusals():
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.parse_header(b"XX\x01\x01\x00\x00\x00\x00", 1 << 20)
+    with pytest.raises(wire.WireError, match="version"):
+        wire.parse_header(b"DS\x09\x01\x00\x00\x00\x00", 1 << 20)
+    with pytest.raises(wire.WireError, match="frame type"):
+        wire.parse_header(b"DS\x01\x77\x00\x00\x00\x00", 1 << 20)
+    with pytest.raises(wire.WireError, match="exceeds"):
+        # the hostile length prefix is refused BEFORE any allocation
+        wire.parse_header(struct.pack("!2sBBI", b"DS", 1,
+                                      wire.T_REQUEST, 1 << 31), 1 << 20)
+    with pytest.raises(wire.WireError, match="carries"):
+        # declared shape disagrees with the payload bytes
+        good = wire.pack_request(1, np.zeros((2, 3), np.float32), "m",
+                                 None)
+        wire.parse_request(good[wire.HEADER_BYTES:-4])
+    # hostile payload CONTENT surfaces as WireError too (never a raw
+    # UnicodeDecodeError/struct.error escaping the containment)
+    bad_name = (struct.pack("!IBdH", 1, 0, -1.0, 2) + b"\xff\xfe"
+                + struct.pack("!II", 0, 0))
+    with pytest.raises(wire.WireError, match="UTF-8"):
+        wire.parse_request(bad_name)
+    bad_verdict = (struct.pack("!IBIdIH", 1, 0, 0, 0.0, 0, 2)
+                   + b"\xff\xfe" + struct.pack("!BI", 0, 0))
+    with pytest.raises(wire.WireError, match="malformed VERDICT"):
+        wire.parse_verdict(bad_verdict)
+    with pytest.raises(wire.WireError, match="shorter"):
+        wire.parse_verdict(b"\x00\x01")
+    # the new net seams are part of the DPSVM_FAULTS grammar
+    plan = faults.FaultPlan.parse(
+        "net_accept,net_conn_drop@2,net_read_stall,net_partial_write")
+    assert len(plan.specs) == 4
+
+
+# --------------------------------------------------------- socket parity
+
+def test_socket_roundtrip_parity(tiny_model):
+    model, x = tiny_model
+    eng, srv = _served()
+    try:
+        eng.register("m", model)
+        q = np.asarray(x[:10], np.float32)
+        with ServeClient(srv.host, srv.port) as cli:
+            v = cli.request(q, model="m")
+            assert v.verdict == "served" and v.version == 1
+            np.testing.assert_array_equal(
+                v.labels, predict_multiclass(model, q))
+            np.testing.assert_allclose(
+                cli.decision(q, model="m"), decision_matrix(model, q),
+                rtol=1e-5, atol=1e-5)
+            # single registered model: the bare (no-name) route works
+            assert cli.request(q).verdict == "served"
+        snap = srv.net_snapshot()
+        assert snap["frames_accepted"] == 3
+        assert snap["verdicts"]["served"] == 3
+    finally:
+        srv.close()
+        eng.close()
+    assert _no_net_threads() == []
+
+
+def test_unknown_model_and_bad_width_fail_not_retry(tiny_model):
+    """Request-level failures are explicit 'failed' verdicts — never
+    retried (the frame is wrong, not the wire), never a dead
+    connection."""
+    model, x = tiny_model
+    eng, srv = _served()
+    try:
+        eng.register("m", model)
+        with ServeClient(srv.host, srv.port) as cli:
+            v = cli.request(np.zeros((2, 5), np.float32), model="ghost")
+            assert v.verdict == "failed" and "ghost" in v.message
+            assert cli.last_attempts == 1  # failed is NEVER retried
+            v = cli.request(np.zeros((2, 9), np.float32), model="m")
+            assert v.verdict == "failed" and "(n, 5)" in v.message
+            # the connection survived both
+            assert cli.request(np.zeros((2, 5), np.float32),
+                               model="m").verdict == "served"
+    finally:
+        srv.close()
+        eng.close()
+
+
+# ---------------------------------------------------- deadline propagation
+
+def test_deadline_budget_propagation(tiny_model):
+    """THE CLOCK CONTRACT: the wire carries a remaining BUDGET, and
+    the server anchors it to its own clock by passing it VERBATIM as
+    submit's relative deadline_ms — the client's wall clock never
+    enters. A negative/absent budget falls back to the server's
+    configured default."""
+    model, x = tiny_model
+    eng, srv = _served(deadline_ms=777.0)
+    try:
+        eng.register("m", model)
+        seen = []
+        orig = eng.submit
+
+        def _spy(rows, model=None, **kw):
+            seen.append(kw.get("deadline_ms", "absent"))
+            return orig(rows, model=model, **kw)
+
+        eng.submit = _spy
+        q = np.zeros((2, 5), np.float32)
+        with ServeClient(srv.host, srv.port) as cli:
+            cli.request(q, model="m", deadline_ms=123.0)
+            cli.request(q, model="m")  # no budget -> server default
+        # the client ships its REMAINING budget (anchor minus elapsed,
+        # which includes the connect) — a duration, never a timestamp:
+        # whatever arrives is <= the caller's budget and far from any
+        # wall-clock-looking number.
+        assert 60.0 < seen[0] <= 123.0, seen
+        assert seen[1] == "absent"  # engine applies config.deadline_ms
+    finally:
+        del eng.submit
+        srv.close()
+        eng.close()
+
+
+def test_expired_budget_gets_explicit_verdict(tiny_model):
+    """A zero remaining budget is still ANSWERED: the engine sheds it
+    at batch forming with an explicit 'expired' wire verdict (never a
+    silent drop, never silent service)."""
+    model, x = tiny_model
+    eng, srv = _served()
+    try:
+        eng.register("m", model)
+        with ServeClient(srv.host, srv.port) as cli:
+            v = cli.request(np.zeros((2, 5), np.float32), model="m",
+                            deadline_ms=0.0)
+            assert v.verdict == "expired"
+            assert v.labels is None
+        assert srv.net_snapshot()["verdicts"]["expired"] == 1
+        assert eng.expired.value == 1  # the engine counted it too
+    finally:
+        srv.close()
+        eng.close()
+
+
+# -------------------------------------------------------------- admission
+
+def test_admission_rejects_with_retry_hint(tiny_model):
+    """Saturation becomes an immediate 'rejected' verdict with a
+    retry_after_ms hint — never unbounded buffering, never a blocked
+    pump. Deterministic: the engine's pump is held, so queued rows
+    provably sit at the bound when the second request arrives."""
+    model, x = tiny_model
+    eng, srv = _served(admission_max_rows=8)
+    try:
+        eng.register("m", model)
+        eng.pump_real = eng.pump
+        eng.pump = lambda: 0  # hold the engine: queue cannot drain
+        first = {}
+
+        def _first():
+            with ServeClient(srv.host, srv.port, seed=1) as c:
+                first["v"] = c.request(np.zeros((8, 5), np.float32),
+                                       model="m")
+
+        th = threading.Thread(target=_first)
+        th.start()
+        deadline = time.monotonic() + 10
+        while eng.scheduler.queue_rows < 8 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.scheduler.queue_rows == 8  # admitted, held
+        with ServeClient(srv.host, srv.port, seed=2,
+                         reject_retries=0) as cli:
+            v = cli.request(np.zeros((2, 5), np.float32), model="m")
+        assert v.verdict == "rejected"
+        assert v.retry_after_ms > 0
+        assert "admission" in v.message
+        eng.pump = eng.pump_real  # release: the held request completes
+        th.join(timeout=60)
+        assert not th.is_alive()
+        assert first["v"].verdict == "served"
+        assert srv.net_snapshot()["verdicts"]["rejected"] == 1
+    finally:
+        eng.pump = eng.pump_real
+        srv.close()
+        eng.close()
+
+
+def test_client_retries_rejected_with_hint_backoff(tiny_model):
+    """The retry policy's positive half: 'rejected' IS retried (the
+    server promised it did no work), honoring the retry_after hint,
+    and succeeds once the saturation clears."""
+    model, x = tiny_model
+    eng, srv = _served(admission_max_rows=8, admission_retry_ms=20.0)
+    try:
+        eng.register("m", model)
+        eng.pump_real = eng.pump
+        eng.pump = lambda: 0
+        filler = {}
+
+        def _fill():
+            with ServeClient(srv.host, srv.port, seed=3) as c:
+                filler["v"] = c.request(np.zeros((8, 5), np.float32),
+                                        model="m")
+
+        th = threading.Thread(target=_fill)
+        th.start()
+        deadline = time.monotonic() + 10
+        while eng.scheduler.queue_rows < 8 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        release = threading.Timer(0.25, lambda: setattr(
+            eng, "pump", eng.pump_real))
+        release.start()
+        with ServeClient(srv.host, srv.port, seed=4, reject_retries=8,
+                         backoff_s=0.02) as cli:
+            v = cli.request(np.zeros((2, 5), np.float32), model="m")
+            assert v.verdict == "served"
+            assert cli.last_attempts > 1  # it really was rejected first
+            assert cli.verdicts_observed["rejected"] >= 1
+        th.join(timeout=60)
+        release.join()
+        assert filler["v"].verdict == "served"
+    finally:
+        eng.pump = eng.pump_real
+        srv.close()
+        eng.close()
+
+
+def test_connect_retry_bounded():
+    """Connect failures retry with bounded backoff, then raise — and
+    a server that never existed cannot have done work, so this is the
+    one place retrying is unconditionally safe."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here now
+    cli = ServeClient("127.0.0.1", port, connect_retries=2,
+                      backoff_s=0.01, timeout_s=2.0)
+    with pytest.raises(ConnectError, match="after 3 attempts"):
+        cli.request(np.zeros((1, 5), np.float32), model="m")
+
+
+# ------------------------------------------------- slow peers, both ways
+
+def test_send_with_deadline_bounds_stalled_reader():
+    """The whole-frame write deadline: a peer that stops reading
+    cannot hold a writer past conn_write_timeout_ms (socket timeouts
+    alone bound one syscall, not a trickled frame)."""
+    from dpsvm_tpu.serving.server import _send_with_deadline
+
+    a, b = socket.socketpair()
+    try:
+        a.settimeout(5.0)  # the front door's precondition: timeout mode
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        t0 = time.monotonic()
+        with pytest.raises(socket.timeout, match="exceeded"):
+            _send_with_deadline(a, b"\x00" * (4 << 20), 0.3)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_slow_reader_outbox_bound_kills_only_that_conn(tiny_model,
+                                                      monkeypatch):
+    """A reader stalled long enough to back up its outbox costs
+    exactly its own connection: killed, verdicts counted
+    undeliverable, every other client unaffected."""
+    from dpsvm_tpu.serving import server as server_mod
+
+    monkeypatch.setattr(server_mod, "OUTBOX_FRAMES", 2)
+    real_send = server_mod._send_with_deadline
+    monkeypatch.setattr(
+        server_mod, "_send_with_deadline",
+        lambda sock, data, t: (time.sleep(0.15),
+                               real_send(sock, data, t))[1])
+    model, x = tiny_model
+    eng, srv = _served()
+    try:
+        eng.register("m", model)
+        # a raw pipelining client that never reads its verdicts
+        sock = socket.create_connection((srv.host, srv.port),
+                                        timeout=10)
+        q = np.zeros((2, 5), np.float32)
+        for i in range(8):
+            sock.sendall(wire.pack_request(i + 1, q, "m", None))
+        deadline = time.monotonic() + 20
+        while srv.net_snapshot()["conns_killed"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        snap = srv.net_snapshot()
+        assert snap["conns_killed"] == 1, snap
+        assert snap["undeliverable_total"] > 0, snap
+        sock.close()
+        # …and a healthy client is untouched
+        with ServeClient(srv.host, srv.port, seed=9) as cli:
+            assert cli.request(q, model="m").verdict == "served"
+    finally:
+        srv.close()
+        eng.close()
+
+
+# ------------------------------------------------------------ protocol fuzz
+
+def test_protocol_fuzz_never_wedges(tiny_model):
+    """The satellite's seeded fuzz generator, in-suite: truncated
+    frames, hostile length prefixes, wrong magic, garbage, mid-frame
+    disconnects — the server never wedges, never leaks a thread, and
+    the error/abort counters reconcile EXACTLY with what was sent."""
+    from tools.loadgen import _fuzz_burst
+
+    model, x = tiny_model
+    eng, srv = _served()
+    try:
+        eng.register("m", model)
+        before = srv.net_snapshot()
+        sent = _fuzz_burst(srv.host, srv.port, seed=3)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            snap = srv.net_snapshot()
+            if (snap["protocol_errors"] - before["protocol_errors"]
+                    == sent["protocol"]
+                    and snap["conns_aborted"] - before["conns_aborted"]
+                    == sent["aborted"]
+                    and snap["open_connections"] == 0):
+                break
+            time.sleep(0.02)
+        snap = srv.net_snapshot()
+        assert snap["protocol_errors"] == sent["protocol"], snap
+        assert snap["conns_aborted"] == sent["aborted"], snap
+        assert snap["frames_accepted"] == 0, snap
+        assert snap["conns_opened"] == snap["conns_closed"], snap
+        # a garbage client gets the ERROR frame before the close
+        sock = socket.create_connection((srv.host, srv.port),
+                                        timeout=10)
+        head = wire.recv_exact(sock, wire.HEADER_BYTES)
+        assert wire.parse_header(head, 1 << 20)[0] == wire.T_HELLO
+        wire.recv_exact(sock, wire.parse_header(head, 1 << 20)[1])
+        sock.sendall(b"XXgarbage-frame!")
+        head = wire.recv_exact(sock, wire.HEADER_BYTES)
+        ftype, length = wire.parse_header(head, 1 << 20)
+        assert ftype == wire.T_ERROR
+        _, msg = wire.parse_error(wire.recv_exact(sock, length))
+        assert "magic" in msg
+        sock.close()
+        # the engine itself never noticed
+        with ServeClient(srv.host, srv.port, seed=5) as cli:
+            assert cli.request(np.zeros((2, 5), np.float32),
+                               model="m").verdict == "served"
+    finally:
+        srv.close()
+        eng.close()
+    assert _no_net_threads() == []
+
+
+# ------------------------------------------------------------------ drain
+
+def test_graceful_drain_under_load(tiny_model, tmp_path):
+    """SIGTERM semantics: under sustained offered load, drain yields
+    ONLY explicit outcomes (verdicts, a drain-rejected verdict, a
+    GOODBYE, or a refused reconnect — never a reset without a
+    verdict), conserves the frame accounting, and leaves zero server
+    threads."""
+    from dpsvm_tpu.serving.client import ServeClient as SC
+
+    model, x = tiny_model
+    jp = str(tmp_path / "registry.journal")
+    eng, srv = _served(journal_path=jp, deadline_ms=2000.0)
+    outcomes = []
+
+    def _loop(idx):
+        cli = SC(srv.host, srv.port, seed=idx, reject_retries=0,
+                 connect_retries=1, backoff_s=0.01)
+        rng = np.random.default_rng(idx)
+        try:
+            for _ in range(10_000):
+                rows = rng.random((int(rng.integers(1, 9)), 5),
+                                  dtype=np.float32)
+                try:
+                    v = cli.request(rows, model="m", deadline_ms=2000.0)
+                    if v.verdict == "rejected":
+                        outcomes.append("drain_rejected")
+                        return
+                except ServerDraining:
+                    outcomes.append("goodbyed")
+                    return
+                except ConnectError:
+                    outcomes.append("connect_refused")
+                    return
+                except (ConnectionDropped, SendAborted) as e:
+                    outcomes.append(f"IMPLICIT:{type(e).__name__}")
+                    return
+            outcomes.append("exhausted")
+        finally:
+            cli.close()
+
+    try:
+        # model saved to disk so the journal records it
+        mp = str(tmp_path / "m.npz")
+        model.save(mp)
+        eng.register("m", mp)
+        threads = [threading.Thread(target=_loop, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # offered load provably in flight
+        snap = srv.drain()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        assert len(outcomes) == 3 and not any(
+            o.startswith("IMPLICIT") or o == "exhausted"
+            for o in outcomes), outcomes
+        # conservation held right through the drain
+        assert snap["frames_accepted"] == sum(snap["verdicts"].values())
+        assert snap["goodbyes_sent"] >= 1 or \
+            "goodbyed" not in outcomes
+        # post-drain connects are refused, not reset mid-request
+        with pytest.raises(ConnectError):
+            SC(srv.host, srv.port, connect_retries=0,
+               timeout_s=2.0).request(np.zeros((1, 5), np.float32),
+                                      model="m")
+        # drain twice is idempotent
+        assert srv.drain()["frames_accepted"] == \
+            snap["frames_accepted"]
+    finally:
+        srv.close()
+        eng.close()
+    assert _no_net_threads() == []
+
+
+# ------------------------------------------------------------ CLI surface
+
+def test_cli_serve_listen_roundtrip(tiny_model, tmp_path):
+    """`cli serve --listen` end to end in-process: the run loop serves
+    wire clients until the stop event (the signal handler's seam),
+    then drains and closes the engine."""
+    from dpsvm_tpu import cli as cli_mod
+
+    model, x = tiny_model
+    mp = str(tmp_path / "m.npz")
+    model.save(mp)
+    config = ServeConfig(buckets=(16, 64), listen="127.0.0.1:0")
+    engine = ServingEngine(config)
+    engine.register("m", mp)
+    args = types.SimpleNamespace(quiet=True)
+    stop = threading.Event()
+    rc = {}
+
+    def _run():
+        rc["rc"] = cli_mod._serve_listen(args, engine, config,
+                                         stop_event=stop)
+
+    th = threading.Thread(target=_run)
+    th.start()
+    deadline = time.monotonic() + 10
+    while engine._front is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    srv = engine._front
+    assert srv is not None
+    q = np.asarray(x[:4], np.float32)
+    with ServeClient(srv.host, srv.port) as cli:
+        np.testing.assert_array_equal(cli.predict(q, model="m"),
+                                      predict_multiclass(model, q))
+    stop.set()
+    th.join(timeout=60)
+    assert not th.is_alive() and rc["rc"] == 0
+    assert engine._closed  # the listen loop owns engine teardown
+    assert _no_net_threads() == []
+
+
+def test_cli_listen_bad_spec(capsys):
+    from dpsvm_tpu import cli as cli_mod
+
+    rc = cli_mod.main(["serve", "--listen", "nohostport",
+                       "--registry", "m=/dev/null"])
+    assert rc == 2
+    assert "listen" in capsys.readouterr().err
+
+
+def test_serve_config_net_validation():
+    with pytest.raises(ValueError, match="listen"):
+        ServeConfig(listen="9100")  # no host
+    with pytest.raises(ValueError, match="admission_max_rows"):
+        ServeConfig(admission_max_rows=0)
+    with pytest.raises(ValueError, match="max_pending"):
+        ServeConfig(admission_max_rows=1 << 20)
+    with pytest.raises(ValueError, match="conn_read_timeout_ms"):
+        ServeConfig(conn_read_timeout_ms=0)
+    with pytest.raises(ValueError, match="max_frame_bytes"):
+        ServeConfig(max_frame_bytes=16)
+    assert ServeConfig(listen="0.0.0.0:9100").listen_addr() == \
+        ("0.0.0.0", 9100)
+
+
+# --------------------------------------------- /metrics + runlog surfaces
+
+def test_net_families_on_metrics_and_snapshot(tiny_model):
+    """The front door's counters ride the ENGINE's /metrics exposition
+    and snapshot() (one scrape, one truth — the chaos reconciliation
+    could be done from a scrape alone)."""
+    import urllib.request
+
+    model, x = tiny_model
+    eng, srv = _served(metrics_port=0)
+    try:
+        eng.register("m", model)
+        with ServeClient(srv.host, srv.port, seed=1,
+                         reject_retries=0) as cli:
+            cli.request(np.zeros((2, 5), np.float32), model="m")
+        with urllib.request.urlopen(eng.exporter.url,
+                                    timeout=10) as resp:
+            text = resp.read().decode()
+        assert "serving_net_frames_accepted_total 1" in text
+        assert 'serving_net_verdicts_total{verdict="served"} 1' in text
+        assert "serving_net_protocol_errors_total 0" in text
+        snap = eng.snapshot()
+        assert snap["net"]["frames_accepted"] == 1
+        assert snap["net"]["verdicts"]["served"] == 1
+    finally:
+        srv.close()
+        eng.close()

@@ -528,6 +528,109 @@ def test_scrape_racing_engine_close(two_versions):
     assert bodies  # the hammer actually scraped while live
 
 
+def test_scrape_racing_engine_drain(two_versions):
+    """The ISSUE 15 extension of the close-race contract to DRAIN: a
+    scrape concurrent with an active drain() under sustained submits
+    reads complete live expositions throughout — drain never flips
+    the _closing stub and never tears instrument state."""
+    m1, _, x = two_versions
+    eng = _engine(metrics_port=0)
+    eng.register("m", m1)
+    q = np.asarray(x[:4], np.float32)
+    url = eng.exporter.url
+    stop, errors, bodies = threading.Event(), [], []
+    hammer = threading.Thread(target=_hammer_scrapes,
+                              args=(url, stop, errors, bodies))
+    hammer.start()
+    try:
+        for _ in range(6):  # sustained submit -> drain cycles
+            for _ in range(8):
+                eng.submit(q)
+            eng.drain()
+        time.sleep(0.05)
+    finally:
+        stop.set()
+        hammer.join(timeout=5)
+    assert not errors, errors
+    assert bodies  # scrapes really ran during the drain windows
+    eng.close()
+
+
+def test_close_during_active_drain_is_idempotent(two_versions):
+    """ISSUE 15 satellite: close() arriving DURING an active drain()
+    waits for it on the lifecycle lock and tears down exactly once;
+    drain() after close is a no-op; double-close is a no-op — every
+    interleaving of the double-shutdown is safe."""
+    m1, _, x = two_versions
+    eng = _engine()
+    eng.register("m", m1)
+    q = np.asarray(x, np.float32)
+    tickets = [eng.submit(q[i * 4:(i + 1) * 4]) for i in range(12)]
+    done = {}
+    started = threading.Event()
+
+    real_pump = eng.pump
+
+    def _pump_marked():
+        started.set()  # close() below provably races an ACTIVE drain
+        return real_pump()
+
+    eng.pump = _pump_marked
+
+    def _drain():
+        done.update(eng.drain())
+
+    th = threading.Thread(target=_drain)
+    th.start()
+    started.wait(timeout=10)
+    eng.close()  # races the active drain; must wait, then close once
+    th.join(timeout=60)
+    assert not th.is_alive()
+    assert eng._closed
+    assert sorted(done) == sorted(tickets)  # the drain finished first
+    assert all(r.verdict == "ok" for r in done.values())
+    # post-close drain/close are no-ops, not errors
+    assert eng.drain() == {}
+    eng.close()
+
+
+def test_journal_write_fsyncs_before_rename(two_versions, tmp_path,
+                                            monkeypatch):
+    """ISSUE 15 satellite: the registry journal's atomic rewrite must
+    be DURABLE — tmp fsynced before the rename, directory after —
+    or the PR 13 crash-recovery guarantee stops at process kills and
+    silently excludes power loss."""
+    import os
+    import stat
+
+    m1, _, _ = two_versions
+    jp = str(tmp_path / "registry.journal")
+    calls = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(os, "fsync", lambda fd: (
+        calls.append(("fsync",
+                      "dir" if stat.S_ISDIR(os.fstat(fd).st_mode)
+                      else "file")), real_fsync(fd))[1])
+    monkeypatch.setattr(os, "replace", lambda a, b: (
+        calls.append(("replace", os.path.basename(b))),
+        real_replace(a, b))[1])
+    eng = _engine(journal_path=jp)
+    p1 = str(tmp_path / "v1.npz")
+    m1.save(p1)
+    calls.clear()  # isolate the register's journal write
+    eng.register("m", p1)
+    journal_calls = [c for i, c in enumerate(calls)
+                     if c[0] == "fsync"
+                     or c[1] == "registry.journal"]
+    assert journal_calls, calls
+    order = [k for k, _ in journal_calls]
+    assert order.index("fsync") < order.index("replace"), calls
+    kinds = [d for k, d in journal_calls if k == "fsync"]
+    assert "file" in kinds and "dir" in kinds, calls
+    assert journal_calls[-1] == ("fsync", "dir"), calls
+    eng.close()
+
+
 def test_scrape_racing_predict_server_close(two_versions):
     """The same ordering contract on the v1 PredictServer (the ISSUE 10
     close()-vs-exporter satellite): endpoint down FIRST, in-flight

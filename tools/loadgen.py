@@ -182,6 +182,453 @@ def _runlog_reconciliation(engine, rows_total: int) -> dict:
     }
 
 
+# --------------------------------------------------- network front door
+
+def _net_worker(host, port, idx, n_requests, traffic, dims, sizes,
+                deadline_ms, out, reject_retries=2):
+    """One closed-loop wire client: `n_requests` requests over a
+    persistent connection, every outcome tallied EXPLICITLY (observed
+    verdicts come from the client library's own counters, including
+    rejected verdicts its retry loop swallowed) — the reconciliation's
+    client side."""
+    from dpsvm_tpu.serving.client import (ConnectError,
+                                          ConnectionDropped,
+                                          SendAborted, ServeClient,
+                                          ServerDraining)
+
+    rng = np.random.default_rng(100 + idx)
+    names = [t[0] for t in traffic]
+    w = np.asarray([t[1] for t in traffic], np.float64)
+    w /= w.sum()
+    cli = ServeClient(host, port, seed=idx, timeout_s=60.0,
+                      reject_retries=reject_retries, connect_retries=3,
+                      backoff_s=0.01)
+    tally = {"requests": 0, "dropped": 0, "aborted_send": 0,
+             "goodbyed": 0, "connect_failed": 0}
+    for _ in range(n_requests):
+        name = names[int(rng.choice(len(names), p=w))]
+        rows = rng.random((int(rng.choice(sizes)), dims[name]),
+                          dtype=np.float32)
+        tally["requests"] += 1
+        try:
+            cli.request(rows, model=name, deadline_ms=deadline_ms)
+        except SendAborted:
+            tally["aborted_send"] += 1  # frame NOT fully sent
+        except ConnectionDropped:
+            tally["dropped"] += 1  # fully sent, verdict never read
+        except ServerDraining:
+            tally["goodbyed"] += 1
+        except ConnectError:
+            tally["connect_failed"] += 1
+    tally["frames_sent"] = cli.frames_sent
+    tally["observed"] = dict(cli.verdicts_observed)
+    cli.close()
+    out[idx] = tally
+
+
+def _drain_worker(host, port, idx, traffic, dims, deadline_ms, out):
+    """Sustained offered load until the server drains: loops requests
+    with NO reject retry; the loop ends only on an EXPLICIT drain
+    signal (a rejected-draining verdict, a GOODBYE frame, or a
+    refused reconnect). Anything else — a reset without a verdict —
+    lands in 'dropped'/'aborted_send' and fails the drain proof."""
+    from dpsvm_tpu.serving.client import (ConnectError,
+                                          ConnectionDropped,
+                                          SendAborted, ServeClient,
+                                          ServerDraining)
+
+    rng = np.random.default_rng(500 + idx)
+    names = [t[0] for t in traffic]
+    cli = ServeClient(host, port, seed=idx, timeout_s=60.0,
+                      reject_retries=0, connect_retries=2,
+                      backoff_s=0.01)
+    tally = {"requests": 0, "drain_rejected": 0, "goodbyed": 0,
+             "connect_refused": 0, "dropped": 0, "aborted_send": 0}
+    for _ in range(100_000):  # bounded: the drain ends the loop
+        name = names[int(rng.integers(len(names)))]
+        rows = rng.random((int(rng.integers(1, 17)), dims[name]),
+                          dtype=np.float32)
+        tally["requests"] += 1
+        try:
+            v = cli.request(rows, model=name, deadline_ms=deadline_ms)
+            if v.verdict == "rejected":
+                tally["drain_rejected"] += 1
+                break
+        except ServerDraining:
+            tally["goodbyed"] += 1
+            break
+        except ConnectError:
+            tally["connect_refused"] += 1
+            break
+        except ConnectionDropped:
+            tally["dropped"] += 1
+            break
+        except SendAborted:
+            tally["aborted_send"] += 1
+            break
+    tally["frames_sent"] = cli.frames_sent
+    tally["observed"] = dict(cli.verdicts_observed)
+    cli.close()
+    out[idx] = tally
+
+
+def _net_delta(before: dict, after: dict) -> dict:
+    out = {}
+    for k, v in after.items():
+        if isinstance(v, dict):
+            out[k] = {kk: v[kk] - before[k].get(kk, 0) for kk in v}
+        elif isinstance(v, int):
+            out[k] = v - before.get(k, 0)
+    return out
+
+
+def _reconcile_net(delta: dict, tallies: list, leg: str,
+                   clean: bool) -> dict:
+    """The conservation law, asserted EXACTLY: every frame the clients
+    fully sent was accepted; every accepted frame got exactly one
+    verdict; every verdict was observed by its client unless that
+    client provably abandoned the connection (dropped) or was drained
+    past a GOODBYE."""
+    from dpsvm_tpu.serving import wire
+
+    observed = {v: sum(t["observed"][v] for t in tallies)
+                for v in wire.VERDICTS}
+    sent = sum(t["frames_sent"] for t in tallies)
+    dropped = sum(t["dropped"] for t in tallies)
+    goodbyed = sum(t.get("goodbyed", 0) for t in tallies)
+    acc = delta["frames_accepted"]
+    checks = {
+        "server_conservation":
+            acc == sum(delta["verdicts"].values()),
+    }
+    if leg != "drain":
+        # Outside a drain every fully-sent frame is provably accepted
+        # and every accepted frame's verdict is either observed or
+        # belongs to a connection the client itself abandoned — both
+        # equalities are EXACT.
+        checks["frames_sent_match"] = sent == acc
+        checks["every_frame_accounted"] = (
+            sum(observed.values()) + dropped + goodbyed == acc)
+    else:
+        # During a drain two narrow races (a frame sent into a socket
+        # whose reader already exited; a GOODBYE surfacing mid-send)
+        # make sent/goodbyed upper bounds rather than equalities; the
+        # exact laws that DO survive a drain:
+        checks["frames_sent_bound"] = sent >= acc
+        # every delivered verdict was observed (no client abandoned a
+        # socket during drain)
+        checks["delivered_all_observed"] = (
+            sum(observed.values())
+            == sum(delta["verdicts"].values())
+            - delta["undeliverable_total"])
+    if clean:
+        checks["per_class_exact"] = observed == delta["verdicts"]
+        checks["zero_undeliverable"] = \
+            delta["undeliverable_total"] == 0
+    rec = {"leg": leg, "frames_sent": sent, "frames_accepted": acc,
+           "client_observed": observed,
+           "server_verdicts": delta["verdicts"],
+           "undeliverable": delta["undeliverable_total"],
+           "dropped": dropped, "goodbyed": goodbyed,
+           "checks": checks}
+    assert all(checks.values()), rec
+    return rec
+
+
+def _fuzz_burst(host, port, seed: int = 0) -> dict:
+    """Seeded protocol fuzz against a LIVE server: wrong magic,
+    hostile length prefix, truncated payload, garbage bytes, mid-frame
+    disconnect — each must cost exactly its own connection (ERROR
+    frame or a counted abort), never a wedge (ISSUE 15 satellite;
+    tests/test_serve_net.py runs the same generator in-suite)."""
+    import socket as socketlib
+    import struct
+
+    from dpsvm_tpu.serving import wire
+
+    rng = np.random.default_rng(seed)
+    sent = {"protocol": 0, "aborted": 0}
+    for i in range(12):
+        case = i % 4
+        sock = socketlib.create_connection((host, port), timeout=10)
+        try:
+            if case == 0:  # wrong magic
+                sock.sendall(b"XX" + bytes(rng.integers(
+                    0, 256, 14, dtype=np.uint8)))
+                sent["protocol"] += 1
+            elif case == 1:  # hostile length prefix
+                sock.sendall(struct.pack("!2sBBI", b"DS", 1,
+                                         wire.T_REQUEST, 1 << 31))
+                sent["protocol"] += 1
+            elif case == 2:  # truncated payload, mid-frame disconnect
+                sock.sendall(struct.pack("!2sBBI", b"DS", 1,
+                                         wire.T_REQUEST, 100)
+                             + b"\x00" * 10)
+                sent["aborted"] += 1
+            else:  # garbage that cannot be a header
+                junk = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+                sock.sendall(b"\x00\x00" + junk)
+                sent["protocol"] += 1
+            if case != 2:
+                sock.settimeout(10)
+                try:  # the ERROR frame (or clean close) must arrive
+                    sock.recv(4096)
+                except OSError:
+                    pass
+        finally:
+            sock.close()
+    return sent
+
+
+def _run_net(args, engine, paths, tmp, journal_path, sizes,
+             traffic) -> int:
+    """``loadgen --net``: the ISSUE 15 acceptance run. Clean leg with
+    per-class EXACT client/server verdict reconciliation; seeded
+    chaos leg (connection kills, a stalled reader, partial writes, an
+    accept drop, one mid-leg hot swap); protocol fuzz burst; graceful
+    drain under sustained offered load; journal rehydrate with
+    BITWISE-identical decisions re-proven through the socket path."""
+    import threading
+
+    import bench
+    from dpsvm_tpu.config import ObsConfig, ServeConfig
+    from dpsvm_tpu.serving import ServeServer, ServingEngine
+    from dpsvm_tpu.serving.client import ServeClient
+    from dpsvm_tpu.testing import faults as fault_harness
+
+    server = ServeServer(engine)
+    print(f"[loadgen] front door on {server.host}:{server.port}",
+          file=sys.stderr)
+    names = [t[0] for t in traffic]
+    dims = {n: engine.registry.get(n).d for n in names}
+    n_clients = 4 if args.smoke else 8
+    per_client = max(6, args.requests // n_clients)
+
+    def run_leg(tag, n_req, reject_retries=2):
+        before = server.net_snapshot()
+        out = [None] * n_clients
+        threads = [threading.Thread(
+            target=_net_worker,
+            args=(server.host, server.port, i, n_req, traffic, dims,
+                  sizes, args.deadline_ms, out, reject_retries),
+            name=f"loadgen-net-{tag}-{i}") for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        return before, out, threads, t0
+
+    # --- clean leg: per-class EXACT reconciliation.
+    before, out, threads, t0 = run_leg("clean", per_client)
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "clean-leg client wedged"
+    wall = time.perf_counter() - t0
+    clean = _reconcile_net(_net_delta(before, server.net_snapshot()),
+                           out, "clean", clean=True)
+    clean["wall_seconds"] = round(wall, 3)
+    clean["rows_per_second"] = None  # rows ride the engine counters
+    print(f"[loadgen] net clean leg: {clean['frames_accepted']} "
+          f"frames, verdicts {clean['server_verdicts']}, reconciled "
+          "EXACTLY", file=sys.stderr)
+
+    # --- chaos leg: seeded connection faults + one mid-leg hot swap.
+    fault_harness.NET_STALL_SECONDS = 0.4
+    plan = fault_harness.FaultPlan.parse(
+        "net_conn_drop@5x2,net_read_stall@9,net_partial_write@13,"
+        "net_accept@3", seed=7)
+    swap_done = {}
+
+    def _swap():
+        time.sleep(0.3)  # mid-leg: traffic provably in flight
+        entry = engine.swap("mnist", paths["mnist_v2"])
+        swap_done["version"] = entry.version
+
+    swap_th = threading.Thread(target=_swap, name="loadgen-net-swap")
+    with fault_harness.install(plan):
+        before, out, threads, t0 = run_leg("chaos", per_client)
+        swap_th.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "chaos-leg client wedged"
+        swap_th.join(timeout=120)
+    assert not swap_th.is_alive(), "mid-leg hot swap never finished"
+    delta = _net_delta(before, server.net_snapshot())
+    chaos = _reconcile_net(delta, out, "chaos", clean=False)
+    chaos["faults_fired"] = dict(plan.fired)
+    chaos["hot_swap_to_version"] = swap_done.get("version")
+    assert plan.fired["net_conn_drop"] == 2, plan.fired
+    assert plan.fired["net_partial_write"] == 1, plan.fired
+    assert plan.fired["net_read_stall"] == 1, plan.fired
+    assert plan.fired["net_accept"] == 1, plan.fired
+    assert chaos["dropped"] == 2, chaos  # the two killed connections
+    assert sum(t["aborted_send"] for t in out) == 1
+    assert delta["verdicts"]["failed"] == 0, delta  # drops never fail
+    assert swap_done.get("version") == 2
+    print(f"[loadgen] net chaos leg: fired {dict(plan.fired)}, "
+          f"swap -> v{swap_done['version']}, accounting closed "
+          f"({chaos['frames_accepted']} frames, {chaos['dropped']} "
+          "dropped, 0 unaccounted)", file=sys.stderr)
+
+    # --- protocol fuzz burst (the satellite's seeded generator).
+    before_fuzz = server.net_snapshot()
+    fuzz_sent = _fuzz_burst(server.host, server.port, seed=11)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        dfz = _net_delta(before_fuzz, server.net_snapshot())
+        if (dfz["protocol_errors"] == fuzz_sent["protocol"]
+                and dfz["conns_aborted"] == fuzz_sent["aborted"]
+                and dfz["conns_opened"] == dfz["conns_closed"]):
+            break
+        time.sleep(0.02)
+    assert dfz["protocol_errors"] == fuzz_sent["protocol"], (dfz,
+                                                             fuzz_sent)
+    assert dfz["conns_aborted"] == fuzz_sent["aborted"], (dfz,
+                                                          fuzz_sent)
+    assert dfz["frames_accepted"] == 0, dfz
+    # …and the server still serves cleanly after the abuse.
+    probe_cli = ServeClient(server.host, server.port, seed=99)
+    rng = np.random.default_rng(123)
+    probes = {n: rng.random((8, dims[n]), dtype=np.float32)
+              for n in names}
+    pre = {n: probe_cli.decision(probes[n], model=n) for n in names}
+    probe_cli.close()
+    print(f"[loadgen] net fuzz burst: {fuzz_sent} -> counters "
+          "reconciled, server healthy", file=sys.stderr)
+
+    # --- /metrics carries the front-door families (one scrape, one
+    # truth — the reconciliation above could have been done FROM a
+    # scrape).
+    scrape = _scrape(engine)
+    assert scrape["ok"], scrape
+    import urllib.request
+    with urllib.request.urlopen(engine.exporter.url, timeout=10) as r:
+        text = r.read().decode()
+    for fam in ("serving_net_frames_accepted",
+                "serving_net_protocol_errors",
+                'serving_net_verdicts_total{verdict="rejected"}'):
+        assert fam in text, fam
+
+    # --- graceful drain under sustained offered load.
+    before_drain = server.net_snapshot()
+    out_d = [None] * n_clients
+    threads = [threading.Thread(
+        target=_drain_worker,
+        args=(server.host, server.port, i, traffic, dims,
+              args.deadline_ms, out_d),
+        name=f"loadgen-net-drain-{i}") for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)  # offered load provably sustained
+    drain_snap = server.drain()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "drain-leg client wedged"
+    drain = _reconcile_net(_net_delta(before_drain, drain_snap),
+                           out_d, "drain", clean=False)
+    # THE DRAIN PROOF: every client loop ended on an EXPLICIT signal.
+    assert drain["dropped"] == 0, out_d
+    assert sum(t["aborted_send"] for t in out_d) == 0, out_d
+    ended = {k: sum(t[k] for t in out_d)
+             for k in ("drain_rejected", "goodbyed", "connect_refused")}
+    assert sum(ended.values()) == n_clients, (ended, out_d)
+    drain["ended_by"] = ended
+    print(f"[loadgen] net drain under load: {drain['frames_accepted']}"
+          f" frames during drain window, clients ended by {ended}, "
+          "zero resets without a verdict", file=sys.stderr)
+
+    # --- rehydrate proof through the socket path: a NEW engine on the
+    # same journal (the drained one is deliberately NOT closed first)
+    # must serve BITWISE-identical decisions over the wire.
+    eng2 = ServingEngine(ServeConfig(
+        deadline_ms=args.deadline_ms, journal_path=journal_path,
+        obs=ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir)))
+    srv2 = ServeServer(eng2)
+    cli2 = ServeClient(srv2.host, srv2.port, seed=7)
+    rehydrated_versions = {e.name: e.version
+                           for e in eng2.registry.entries()}
+    bitwise = {}
+    for n in names:
+        post = cli2.decision(probes[n], model=n)
+        bitwise[n] = bool(np.array_equal(pre[n], post))
+    cli2.close()
+    assert all(bitwise.values()), bitwise
+    assert rehydrated_versions.get("mnist") == 2, rehydrated_versions
+    srv2.close()
+    eng2.close()
+    print(f"[loadgen] net rehydrate: versions {rehydrated_versions}, "
+          "socket-path decisions BITWISE identical", file=sys.stderr)
+
+    runlog_rec = _net_runlog_reconciliation(engine, drain_snap)
+    result = {
+        "metric": ("network front door (ISSUE 15): wire-level serving "
+                   "over the v2 engine — clean/chaos/fuzz/drain legs "
+                   f"with {n_clients} persistent-connection clients, "
+                   "seeded connection faults, one mid-leg hot swap, "
+                   "graceful drain under load, journal rehydrate "
+                   "re-proven bitwise through the socket path"),
+        "listen": f"{server.host}:{server.port}",
+        "clients": n_clients,
+        "legs": {"clean": clean, "chaos": chaos, "drain": drain},
+        "fuzz": {**fuzz_sent, "counters": dfz},
+        "rehydrate": {"versions": rehydrated_versions,
+                      "decisions_bitwise": bitwise},
+        "server_final": drain_snap,
+        "metrics_scrape": {k: scrape[k] for k in
+                           ("status", "lines", "families", "ok")},
+        **runlog_rec,
+        **bench._device_fields(),
+        "schema_version": bench._schema_version(),
+        "smoke": bool(args.smoke),
+    }
+    engine.close()
+
+    # Zero server-thread leaks after drain (the acceptance criterion).
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("dpsvm-net")]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"leaked server threads: {leaked}"
+    result["thread_leaks"] = 0
+
+    art = args.out or os.path.join(tmp, "BENCH_SERVE_NET_smoke.json"
+                                   if args.smoke else
+                                   "BENCH_SERVE_NET.json")
+    with open(art, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps({"metric": "serve_net", "frames": {
+        leg: result["legs"][leg]["frames_accepted"]
+        for leg in result["legs"]}, "reconciled": True,
+        "thread_leaks": 0}))
+    print(f"[loadgen] wrote {art}", file=sys.stderr)
+    return 0
+
+
+def _net_runlog_reconciliation(engine, snap: dict) -> dict:
+    """Runlog side of the accounting: the serve run log's conn/drain
+    event records must agree with the server counters (empty when obs
+    is off)."""
+    if not engine._obs.live:
+        return {}
+    from dpsvm_tpu.obs.runlog import read_runlog, records_for
+
+    events = records_for(read_runlog(engine._obs.path),
+                         engine._obs.run_id, "event")
+    n_open = sum(1 for e in events if e.get("name") == "conn_open")
+    n_close = sum(1 for e in events if e.get("name") == "conn_close")
+    n_drain = sum(1 for e in events if e.get("name") == "drain")
+    ok = (n_open == snap["conns_opened"]
+          and n_close == snap["conns_closed"] and n_drain == 2)
+    rec = {"runlog": engine._obs.path,
+           "runlog_conn_open": n_open, "runlog_conn_close": n_close,
+           "runlog_drain_events": n_drain,
+           "runlog_net_reconciles": bool(ok)}
+    assert ok, rec
+    return rec
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--pool", type=int, default=2048,
@@ -198,6 +645,19 @@ def main(argv=None) -> int:
                          "tightens it)")
     ap.add_argument("--aux-share", type=float, default=0.15,
                     help="traffic share of the second registered model")
+    ap.add_argument("--net", action="store_true",
+                    help="drive the engine through the NETWORK FRONT "
+                         "DOOR (ISSUE 15) instead of in-process: a "
+                         "real localhost socket, persistent-"
+                         "connection wire clients, a seeded chaos "
+                         "leg (connection kills, a stalled reader, "
+                         "partial writes, an accept drop, one "
+                         "mid-leg hot swap), a protocol fuzz burst, "
+                         "a graceful drain under sustained load, and "
+                         "a journal rehydrate re-proven BITWISE "
+                         "through the socket path — client-observed "
+                         "verdict counts reconciled EXACTLY against "
+                         "server counters and the runlog")
     ap.add_argument("--chaos", action="store_true",
                     help="run the CHAOS leg after the sweep (ISSUE "
                          "13): a corrupted-file hot swap at the best "
@@ -275,6 +735,12 @@ def main(argv=None) -> int:
     sizes = [1, 2, 4, 8, 16, 32, 64, 128]
     traffic = [("mnist", 1.0 - args.aux_share), ("aux", args.aux_share)]
     levels = [int(t) for t in args.concurrency.split(",") if t]
+
+    if args.net:
+        # The ISSUE 15 acceptance run: the same engine, models and
+        # journal, but every request crosses a real localhost socket.
+        return _run_net(args, engine, paths, tmp, journal_path, sizes,
+                        traffic)
 
     # --- clean frontier sweep first: the latency/throughput frontier
     # point by point, including levels past the saturation knee (where
