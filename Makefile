@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke serve_replica_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -96,6 +96,15 @@ bench_fused_smoke:
 # this next to bench_serve_smoke and faults_smoke).
 serve_net_smoke:
 	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) tools/loadgen.py --net --smoke --obs
+
+# Replica scale-out smoke (ISSUE 16): a 2-replica ReplicaFleet behind
+# one front door on the same wire path — clean scale-out mini-sweep
+# (r=1 then r=2, aggregate throughput must clear the smoke frontier
+# floor with every replica pulling) plus a seeded chaos mini-leg with
+# a mid-leg CROSS-REPLICA hot swap, all reconciled exactly. Temp
+# artifact (tier1.yml runs this next to serve_net_smoke).
+serve_replica_smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/loadgen.py --net --replicas 2 --smoke
 
 # Fault-tolerance smoke (ISSUE 13): the deterministic fault-injection
 # harness self-test, a kill -9 mid-ooc-solve followed by a --resume

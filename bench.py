@@ -162,6 +162,17 @@ def _artifact_device_kind(doc: dict):
     return "cpu" if "cpu" in dev.lower() else None
 
 
+def _artifact_topology(doc: dict) -> tuple:
+    """A benchmark artifact's serving topology stamp (ISSUE 16):
+    ``(replicas, union_mesh_devices)``. Artifacts predating the stamp
+    are the single-engine single-chip layout by construction — every
+    committed BENCH_SERVE_r01/r02 ran one engine on one device — so
+    absent fields derive to (1, 1) and keep adjudicating against
+    same-topology runs instead of refusing history."""
+    return (int(doc.get("replicas") or 1),
+            int(doc.get("union_mesh_devices") or 1))
+
+
 def _session_calibration() -> dict:
     """Fixed-reference-kernel measurement for THIS session (VERDICT
     round-5 weak #1): a pinned compute kernel whose FLOP count never
@@ -330,7 +341,14 @@ def _regression_gate(current: dict, root: str,
                          cannot be ruled out, so the delta is RAW and
                          informational. Legacy CPU-harness baselines
                          (device 'TFRT_CPU_0') derive to 'cpu' and
-                         keep adjudicating against cpu runs."""
+                         keep adjudicating against cpu runs.
+      TOPOLOGY_MISMATCH— the artifacts ran different serving
+                         topologies (replicas or mesh width, ISSUE
+                         16): a 2-replica run "beating" a 1-replica
+                         baseline is the scaling claim, not a
+                         regression verdict — delta RAW, adjudicates
+                         nothing. Artifacts predating the stamps
+                         derive to (1, 1)."""
     path, prev = _latest_bench_artifact(root, pattern, key=key)
     if prev is None:
         return {"regression_gate": "NO_BASELINE"}
@@ -359,6 +377,26 @@ def _regression_gate(current: dict, root: str,
             "regression_gate": ("DEVICE_UNKNOWN" if prev_kind is None
                                 else "DEVICE_MISMATCH"),
             "previous_device_kind": prev_kind,
+            "raw_delta": round(cur_pps / prev[key] - 1.0, 4),
+        })
+        return out
+    # Topology refusal (ISSUE 16, same shape as the device-kind one):
+    # the calibration kernel cancels session speed on ONE chip — it
+    # says nothing about replica count or mesh width, so a 2-replica
+    # run drift-normalized against a 1-replica baseline would
+    # spuriously PASS its ~2x as "improvement" and bury the next real
+    # regression under a moved baseline. Cross-topology deltas are
+    # the SCALING claim (reported by the artifact's own frontier leg),
+    # not a regression verdict: refuse with the raw delta.
+    cur_topo = _artifact_topology(current)
+    prev_topo = _artifact_topology(prev)
+    if cur_topo != prev_topo:
+        out.update({
+            "regression_gate": "TOPOLOGY_MISMATCH",
+            "previous_topology": {"replicas": prev_topo[0],
+                                  "union_mesh_devices": prev_topo[1]},
+            "current_topology": {"replicas": cur_topo[0],
+                                 "union_mesh_devices": cur_topo[1]},
             "raw_delta": round(cur_pps / prev[key] - 1.0, 4),
         })
         return out

@@ -542,6 +542,34 @@ def serve_mesh_bucket():
                  _jaxpr_of(mapped, *args))]
 
 
+def serve_mesh_group():
+    """Mesh-sharded v2 union group (ISSUE 16): the engine_core
+    UnionGroup mesh variant's bucket dispatch, lowered through the v2
+    engine's own import path at the COALESCED multi-model column
+    width a union group actually dispatches. The budget pins the
+    sharded serving contract statically: ONE (nb, S_local) kernel
+    matmul over the LOCAL union shard + ONE psum combining partial
+    decision columns — per dispatch, regardless of how many models'
+    columns ride it — zero host callbacks, zero other collectives.
+    A change that snuck a second all-reduce (e.g. psumming the kernel
+    block instead of the contracted columns) or a host round-trip
+    into the sharded path would drift this budget. Same executor
+    family as serve_mesh_bucket (the v1 PredictServer lowering at
+    K_MODELS); this entry is the v2 engine's shape."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.serving.engine_core import _mesh_serve_executor
+
+    _, mapped = _mesh_serve_executor(DEVICE_COUNT, _kp(), "float32")
+    args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), jnp.float32),
+            _sds((S_UNION,), jnp.float32),
+            _sds((S_UNION, K_COALESCED), jnp.float32),
+            _sds((K_COALESCED,), jnp.float32))
+    return [Unit("batch", lambda: mapped.lower(*args),
+                 _jaxpr_of(mapped, *args))]
+
+
 def mesh_predict():
     """SV-row-sharded mesh decision (predict.decision_function_mesh):
     per-shard kernel rows + ONE psum of partial decision sums."""
@@ -576,5 +604,6 @@ MANIFEST = {
     "serve_bucket_bf16": serve_bucket_bf16,
     "serve_coalesced_bucket": serve_coalesced_bucket,
     "serve_mesh_bucket": serve_mesh_bucket,
+    "serve_mesh_group": serve_mesh_group,
     "mesh_predict": mesh_predict,
 }

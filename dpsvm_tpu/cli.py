@@ -315,6 +315,15 @@ def _build_serve_parser(sub) -> argparse.ArgumentParser:
                         "work by its own deadline, flush verdicts, "
                         "GOODBYE, close). Port 0 = ephemeral, printed "
                         "at startup")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="--listen: run N v2 engine replicas behind "
+                        "the one front door (ISSUE 16 ReplicaFleet): "
+                        "per-replica pump threads route the shared "
+                        "inbox to whichever replica has room, "
+                        "register/swap apply to every replica in "
+                        "lockstep over the shared --journal, and "
+                        "replicas drain individually for rolling "
+                        "restarts (default 1)")
     p.add_argument("--admission-max-rows", type=int, default=None,
                    help="--listen: queued-row saturation bound — a "
                         "request arriving past it is REJECTED "
@@ -1145,11 +1154,13 @@ def _cmd_serve(args) -> int:
     from dpsvm_tpu.config import ServeConfig
     from dpsvm_tpu.serve import PredictServer, offered_load_sweep
 
-    if args.registry or args.journal or args.listen:
+    if args.registry or args.journal or args.listen \
+            or args.replicas > 1:
         # --journal alone is a valid v2 start: a crash-restarted
         # engine rehydrates its whole model set from the journal.
         # --listen is v2-only (the network front door fronts the
-        # ServingEngine).
+        # ServingEngine); --replicas > 1 likewise (the fleet lives
+        # behind it) and fails loudly there instead of being ignored.
         return _cmd_serve_v2(args)
     if not args.model:
         print("error: -m/--model is required (or --registry NAME=PATH "
@@ -1288,11 +1299,6 @@ def _cmd_serve_v2(args) -> int:
               "(--precision auto semantics); the forced modes are the "
               "v1 server's", file=sys.stderr)
         return 2
-    if args.num_devices != 1:
-        print("error: the v2 engine is single-device (union sharding "
-              "over a mesh is the v1 server's --num-devices)",
-              file=sys.stderr)
-        return 2
     specs = []
     for spec in args.registry or []:
         name, sep, path = spec.partition("=")
@@ -1310,24 +1316,33 @@ def _cmd_serve_v2(args) -> int:
                             conn_write_timeout_ms=args.conn_timeout_ms)
         config = ServeConfig(
             buckets=buckets, dtype=args.dtype,
+            num_devices=args.num_devices,
             deadline_ms=args.deadline_ms,
             dispatch_timeout_ms=args.dispatch_timeout_ms,
             journal_path=args.journal, listen=args.listen,
+            replicas=args.replicas,
             admission_max_rows=args.admission_max_rows,
             metrics_port=args.metrics_port,
             metrics_host=args.metrics_host, slo_ms=args.slo_ms,
             obs=ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir),
             **timeouts)
         t0 = time.perf_counter()
-        engine = ServingEngine(config)
+        if config.replicas > 1:
+            from dpsvm_tpu.serving import ReplicaFleet
+
+            engine = ReplicaFleet(config)
+            eng0 = engine.engines[0]
+        else:
+            engine = ServingEngine(config)
+            eng0 = engine
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    if engine._rehydrated and not args.quiet:
-        print(f"rehydrated {len(engine._rehydrated)} model(s) from "
+    if eng0._rehydrated and not args.quiet:
+        print(f"rehydrated {len(eng0._rehydrated)} model(s) from "
               f"{config.journal_path}: "
               + ", ".join(f"{e.name} v{e.version}"
-                          for e in engine.registry.entries()),
+                          for e in eng0.registry.entries()),
               file=sys.stderr)
     try:
         for name, path in specs:
@@ -1342,7 +1357,7 @@ def _cmd_serve_v2(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         engine.close()
         return 2
-    if not engine.registry.names():
+    if not eng0.registry.names():
         print("error: no models to serve (--registry NAME=PATH, or a "
               "--journal with recorded models)", file=sys.stderr)
         engine.close()
@@ -1352,8 +1367,11 @@ def _cmd_serve_v2(args) -> int:
               file=sys.stderr)
     if not args.quiet:
         print(f"engine ready in {time.perf_counter() - t0:.2f}s: "
-              f"{len(specs)} models, deadline "
-              f"{config.deadline_ms or 'none'} ms", file=sys.stderr)
+              f"{len(specs)} models"
+              + (f" x {config.replicas} replicas"
+                 if config.replicas > 1 else "")
+              + f", deadline {config.deadline_ms or 'none'} ms",
+              file=sys.stderr)
 
     if args.listen:
         return _serve_listen(args, engine, config)

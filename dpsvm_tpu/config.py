@@ -841,7 +841,13 @@ class ServeConfig:
       "float64" forces the host path for every column.
     num_devices: >1 shards the SV union (rows) over a data mesh and
       psums partial decision columns — inference memory scales with
-      device count, like training's X sharding.
+      device count, like training's X sharding. Both serving engines
+      honor it: the v1 PredictServer at staging, and the v2
+      ServingEngine's union groups (each coalescing family's stacked
+      coefficient operand row-shards with its union; the bucket
+      dispatch stays ONE kernel matmul + one psum per batch,
+      bitwise-pinned against the single-chip group by
+      tests/test_serve_replicas.py).
     warm_start: pre-compile (and pre-touch) every bucket executor at
       construction so the first live request never pays a compile.
     max_pending: queued query rows before enqueue() forces a flush —
@@ -916,6 +922,29 @@ class ServeConfig:
       included) with zero operator action. Only file-backed models
       journal (in-memory model objects cannot be replayed). None
       (default) = no journal.
+    replicas: number of v2 ServingEngine replicas behind ONE network
+      front door (serving/replicas.py ReplicaFleet). Each replica owns
+      its scheduler, staged union groups and dispatcher; the front
+      door's pump/admission layer routes each accepted frame to one
+      replica, the shared registry journal keeps swap coordinated
+      across all of them, and per-replica drain makes rolling restarts
+      a policy instead of an outage. >1 requires ``listen`` (the fleet
+      exists to scale the wire endpoint; in-process callers hold one
+      engine). The five-verdict wire contract and the exact
+      frames_accepted == sum(verdicts) accounting are unchanged at any
+      replica count.
+    device_floor_us_per_row: serial per-dispatch device-time floor in
+      microseconds per PADDED row, applied at materialization by the
+      v2 engine's AsyncDispatcher. Models an accelerator whose device
+      time — not host orchestration — bounds throughput: each
+      replica's emulated device is serial (a dispatch starts after the
+      previous one's emulated completion). This is the CPU-harness
+      knob behind ``loadgen --net --replicas``: on a host-bound CI box
+      the replica frontier would otherwise measure host-CPU
+      contention, not front-door scale-out. The floor is stamped into
+      BENCH_SERVE artifacts (``device_emulation``) so a gated number
+      can never silently mix regimes. None (default) = no floor (real
+      device time only).
     """
 
     buckets: tuple = (16, 64, 256, 1024, 4096)
@@ -931,6 +960,8 @@ class ServeConfig:
     dispatch_timeout_ms: Optional[float] = None
     journal_path: Optional[str] = None
     listen: Optional[str] = None
+    replicas: int = 1
+    device_floor_us_per_row: Optional[float] = None
     admission_max_rows: Optional[int] = None
     admission_retry_ms: float = 50.0
     conn_read_timeout_ms: float = 30000.0
@@ -995,6 +1026,18 @@ class ServeConfig:
                 raise ValueError(
                     f"listen must be 'HOST:PORT' (port 0 = ephemeral), "
                     f"got {self.listen!r}")
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.replicas > 1 and self.listen is None:
+            raise ValueError(
+                "replicas > 1 requires listen (the replica fleet "
+                "scales the network front door; in-process callers "
+                "hold a single engine)")
+        if self.device_floor_us_per_row is not None \
+                and self.device_floor_us_per_row <= 0:
+            raise ValueError(
+                "device_floor_us_per_row must be > 0 (None = no "
+                "emulated device-time floor)")
         if self.admission_max_rows is not None:
             if self.admission_max_rows < 1:
                 raise ValueError(

@@ -141,6 +141,11 @@ def summarize_run(run: Run) -> dict:
         "utc": man.get("utc"),
         "git_sha": (man.get("git_sha") or "")[:12] or None,
         "engine": man.get("engine"),
+        # Replica identity (ISSUE 16): which engine of a ReplicaFleet
+        # wrote this run log; None for standalone engines and the
+        # fleet's own aggregate run.
+        "replica": man.get("replica"),
+        "replicas": fin.get("replicas") or man.get("replicas"),
         "n": man.get("n"), "d": man.get("d"),
         "n_devices": man.get("n_devices"),
         "chunks": len(run.chunks),
@@ -342,8 +347,17 @@ def _report_row(s: dict) -> list:
             else:
                 occ = s.get("batch_occupancy_mean")
                 net = s.get("net") or {}
+                # rep= tags a ReplicaFleet member's run with its
+                # replica index (ISSUE 16); a fleet-of-N aggregate
+                # run shows rep=xN instead.
+                rep = ""
+                if s.get("replica") is not None:
+                    rep = f"rep={s['replica']} "
+                elif (s.get("replicas") or 1) > 1:
+                    rep = f"rep=x{s['replicas']} "
                 row.append(
-                    f"miss={s['deadline_misses']} "
+                    rep
+                    + f"miss={s['deadline_misses']} "
                     f"swap={s.get('hot_swaps') or 0}"
                     + (f" fail={s['dispatch_failures']}"
                        if s.get("dispatch_failures") else "")

@@ -96,6 +96,20 @@ def shard_padded_rows(mesh: Mesh, arr, multiple: int = 8):
                           NamedSharding(mesh, P(DATA_AXIS)))
 
 
+def replicate_array(mesh: Mesh, arr):
+    """device_put `arr` fully replicated over the mesh (PartitionSpec()).
+
+    The companion of shard_padded_rows for the operands every shard
+    reads whole — query batches and bias rows in the serving decision.
+    ONE definition shared by serve.py's mesh staging and the v2
+    engine's mesh union groups, so both feed the SAME cached executor
+    with identically-placed operands."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, P()))
+
+
 def pad_rows(n: int, num_shards: int, multiple: int = 8) -> int:
     """Padded row count: divisible by num_shards and a lane-friendly
     multiple. Replaces the reference's uneven ceil-sharding

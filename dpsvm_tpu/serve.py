@@ -303,7 +303,6 @@ class PredictServer:
 
     # ------------------------------------------------------------ staging
     def _stage(self) -> None:
-        import jax
         import jax.numpy as jnp
 
         cfg = self.config
@@ -328,19 +327,17 @@ class PredictServer:
             self._call = None  # every column routes to the host path
             return
         if cfg.num_devices > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from dpsvm_tpu.parallel.mesh import shard_padded_rows
+            from dpsvm_tpu.parallel.mesh import (replicate_array,
+                                                 shard_padded_rows)
             mesh, mapped = _mesh_serve_executor(cfg.num_devices, self.kp,
                                                 cfg.dtype)
             sv_d = shard_padded_rows(mesh, sv_store)
             sv_sq_d = shard_padded_rows(mesh, sv_sq)
             coef_d = shard_padded_rows(mesh, coef)  # pad rows: coef 0
-            rep = NamedSharding(mesh, P())
-            b_d = jax.device_put(jnp.asarray(b), rep)
+            b_d = replicate_array(mesh, b)
 
-            def call(qb, _m=mapped, _rep=rep):
-                return _m(jax.device_put(jnp.asarray(qb), _rep),
+            def call(qb, _m=mapped, _mesh=mesh):
+                return _m(replicate_array(_mesh, qb),
                           sv_d, sv_sq_d, coef_d, b_d)
         else:
             batch = _dense_batch_factory()

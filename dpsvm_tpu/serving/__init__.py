@@ -32,6 +32,12 @@ The network front door (ISSUE 15) rides on top:
 * :mod:`dpsvm_tpu.serving.client`  — :class:`ServeClient`, bounded
   retry with backoff + jitter on connect/``rejected`` only (never on
   ``failed``/``expired`` — no duplicated compute).
+* :mod:`dpsvm_tpu.serving.replicas` — :class:`ReplicaFleet` (ISSUE
+  16), N engines behind one front door: lockstep model admin over a
+  shared registry journal, rolling restarts, fleet /metrics. The
+  engine core itself (union staging, bucket executors, async
+  dispatch) lives in :mod:`dpsvm_tpu.serving.engine_core`, including
+  the mesh-sharded union-group variant.
 
 The closed-loop load generator driving this engine through the bench
 regression gate is ``tools/loadgen.py`` (``--net`` drives it through
@@ -43,11 +49,12 @@ from dpsvm_tpu.serving.dispatch import ServeResult, ServingEngine
 from dpsvm_tpu.serving.registry import (LoadedModel, ModelLoadError,
                                         ModelRegistry, RegistryJournal,
                                         load_model_file)
+from dpsvm_tpu.serving.replicas import ReplicaFleet
 from dpsvm_tpu.serving.scheduler import Request, Scheduler
 from dpsvm_tpu.serving.server import ServeServer
 
 __all__ = [
     "ServingEngine", "ServeResult", "ModelRegistry", "RegistryJournal",
     "LoadedModel", "ModelLoadError", "load_model_file", "Scheduler",
-    "Request", "ServeServer", "ServeClient",
+    "Request", "ServeServer", "ServeClient", "ReplicaFleet",
 ]

@@ -1,0 +1,357 @@
+"""Engine-core for the v2 serving engine: union staging, bucket
+executors and decision contraction — the device half of
+serving/dispatch.py, split from the engine-host half (submit/pump/
+drain, registry, metrics) so each can scale on its own axis.
+
+* :class:`UnionGroup` — the staged device operands for one coalescing
+  family (registry.LoadedModel.group_key): ONE resident SV union +
+  sv_sq, and the member models' dual-coefficient matrices stacked
+  side by side into one (S, K_total) operand. A bucket dispatch then
+  answers requests for EVERY member model with a single kernel matmul
+  — the kernel work (the dominant term, serve.py's own motivation) is
+  shared; each request slices its model's columns from the result.
+  With ``ServeConfig.num_devices > 1`` the group stages MESH-sharded:
+  union rows (and the matching stacked-coefficient rows) shard over
+  the data mesh via parallel/mesh.py shard_padded_rows and one psum
+  combines the partial decision columns — the PredictServer mesh
+  machinery (serve._mesh_serve_executor, the SAME cached executor)
+  promoted into the v2 engine, so covtype-scale unions stop being
+  single-chip-bound. Zero pad rows carry zero coefficient rows, so
+  the sharded contraction is exact; the tpulint ``serve_mesh_group``
+  budget pins the dispatch to one psum + one kernel matmul and zero
+  host callbacks. Groups restage only on registry mutations, never on
+  the request path; the single-chip branch keeps reusing
+  serve._dense_batch_factory, so those compiled bucket executors are
+  the SAME programs tpulint budgets
+  (serve_bucket/serve_coalesced_bucket).
+* :class:`AsyncDispatcher` — at most one device batch in flight; the
+  next batch is FORMED AND DISPATCHED before the previous batch's
+  result is materialized, so host-side batch forming for batch t+1
+  overlaps device compute for batch t (jax dispatch is asynchronous;
+  ``np.asarray`` is the only blocking point — the ops/ooc.py
+  double-buffer discipline applied to serving). An optional SERIAL
+  device-time floor (ServeConfig.device_floor_us_per_row) emulates an
+  accelerator-bound dispatch timeline on host-bound CI hardware — the
+  replica-scaling benchmark's measurement regime.
+* :func:`suggest_buckets` — the occupancy-driven report-only bucket
+  advice (pure host function).
+* :func:`_overwrite_f64` — exact host float64 evaluation of
+  risk-routed columns (decision contraction's host tail).
+
+serving/dispatch.py (the engine-host) re-exports all of these under
+their historical names.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from dpsvm_tpu.config import ServeConfig
+from dpsvm_tpu.obs import compilelog
+from dpsvm_tpu.obs.trace import span
+from dpsvm_tpu.serve import (_dense_batch_factory, _mesh_serve_executor,
+                             effective_buckets)
+from dpsvm_tpu.serving.registry import LoadedModel
+from dpsvm_tpu.testing import faults
+
+
+class UnionGroup:
+    """Staged device operands for one coalescing family.
+
+    ``members`` is ordered; ``slices[entry]`` is entry's column range in
+    the stacked coefficient operand. Built OFF the request path (at
+    registration / swap prepare, before the routing flip) and warmed so
+    post-build traffic never traces or uploads.
+
+    ``mesh_devices`` is the number of devices the union rows shard
+    over: 1 for the single-chip staging, ``config.num_devices`` for
+    the mesh variant (whose decision columns the bitwise pin in
+    tests/test_serve_replicas.py holds to the single-chip group)."""
+
+    def __init__(self, key, members, config: ServeConfig):
+        import jax.numpy as jnp
+
+        self.key = key
+        self.members = list(members)
+        base = self.members[0].ens
+        self.kp = base.kernel
+        self.d = int(base.sv_union.shape[1])
+        self.s_rows = int(base.sv_union.shape[0])
+        self.buckets = effective_buckets(config.buckets, self.s_rows)
+        self.mesh_devices = 1
+        self.slices: dict = {}
+        lo = 0
+        coefs, bs = [], []
+        for m in self.members:
+            self.slices[m] = slice(lo, lo + m.k)
+            coefs.append(np.ascontiguousarray(m.ens.coef, np.float32))
+            bs.append(np.ascontiguousarray(m.ens.b, np.float32))
+            lo += m.k
+        self.k_total = lo
+        self.b_host = np.concatenate(bs)
+        if self.s_rows == 0:
+            # Degenerate all-empty union: the decision is exactly -b;
+            # no device operands, no executor.
+            self._call = None
+            return
+        sv = np.ascontiguousarray(base.sv_union, np.float32)
+        if config.dtype == "bfloat16":
+            import ml_dtypes
+            sv_store = sv.astype(ml_dtypes.bfloat16)
+            # Norms from the ROUNDED rows — the dot operands' values
+            # (the serve.py _stage discipline).
+            sv_sq = (sv_store.astype(np.float32) ** 2).sum(
+                1, dtype=np.float32)
+        else:
+            sv_store = sv
+            sv_sq = (sv * sv).sum(1, dtype=np.float32)
+        if config.num_devices > 1:
+            from dpsvm_tpu.parallel.mesh import (replicate_array,
+                                                 shard_padded_rows)
+
+            mesh, mapped = _mesh_serve_executor(
+                config.num_devices, self.kp, config.dtype)
+            self.mesh_devices = int(mesh.size)
+            # Pad rows are zeros with ZERO coefficient rows — inert in
+            # the psum'd contraction (the shard_padded_rows contract),
+            # so the sharded decision equals the single-chip one.
+            sv_d = shard_padded_rows(mesh, sv_store)
+            sv_sq_d = shard_padded_rows(mesh, sv_sq)
+            coef_d = shard_padded_rows(mesh, np.hstack(coefs))
+            b_d = replicate_array(mesh, self.b_host)
+
+            def call(qb, _m=mapped, _mesh=mesh):
+                return _m(replicate_array(_mesh, qb),
+                          sv_d, sv_sq_d, coef_d, b_d)
+        else:
+            batch = _dense_batch_factory()
+            sv_d = jnp.asarray(sv_store)
+            sv_sq_d = jnp.asarray(sv_sq)
+            coef_d = jnp.asarray(np.hstack(coefs))
+            b_d = jnp.asarray(self.b_host)
+
+            def call(qb, _kp=self.kp):
+                return batch(jnp.asarray(qb), sv_d, sv_sq_d, coef_d,
+                             b_d, _kp)
+
+        self._call = call
+
+    def member_set(self) -> set:
+        return set(self.members)
+
+    def warm(self) -> None:
+        """Compile + touch every bucket executor on zero queries so the
+        first live request after a (re)stage pays neither."""
+        for bucket in self.buckets:
+            np.asarray(self.dispatch(
+                np.zeros((bucket, self.d), np.float32), bucket))
+
+    def dispatch(self, qb: np.ndarray, bucket: int):
+        """One async bucket dispatch of a (bucket, d) padded batch ->
+        (bucket, K_total) decision columns (device array — NOT yet
+        materialized; np.asarray is the caller's blocking point)."""
+        if self._call is None:
+            return np.broadcast_to(
+                -self.b_host, (qb.shape[0], self.k_total)).astype(
+                np.float32)
+        with compilelog.label(f"serve/bucket{bucket}",
+                              f"({bucket},{self.d})"), \
+                span(f"serve/bucket{bucket}"):
+            return self._call(qb)
+
+
+class AsyncDispatcher:
+    """At most one in-flight device batch; issuing the next collects
+    the previous. The issue->collect interval spans the NEXT batch's
+    host-side forming — that overlap is the point — so the honest
+    per-dispatch cost recorded is the time actually spent BLOCKING on
+    materialization (``wait_s``), not the interval.
+
+    Completed items are 5-tuples ``(meta, rows, wait_s, window_s,
+    error)``: ``error`` is None on success, else a human-readable
+    reason and ``rows`` is None — the engine fails that batch with
+    explicit 'failed' verdicts and keeps serving (ISSUE 13). With
+    ``timeout_s`` set (ServeConfig.dispatch_timeout_ms), the blocking
+    materialization runs on a watchdog thread and a batch not
+    materialized within the bound is failed the same way — a wedged
+    device dispatch costs one batch, never the pump thread.
+
+    ``floor_us_per_row`` (ServeConfig.device_floor_us_per_row) imposes
+    a SERIAL emulated device timeline: each successful dispatch
+    completes no earlier than the previous one's emulated completion
+    plus ``padded_rows * floor`` — a sleep (GIL released), not spin —
+    so on host-bound CI hardware the dispatcher behaves like one
+    serial accelerator per engine and the replica frontier measures
+    front-door scale-out rather than host-CPU contention. The floor is
+    charged per PADDED row: on the emulated device, padding costs
+    device time exactly as it does on a real one."""
+
+    def __init__(self, timeout_s: Optional[float] = None,
+                 floor_us_per_row: Optional[float] = None):
+        self._inflight = None  # (device result, meta, t_issue, padded)
+        self._timeout = timeout_s
+        self._floor = (None if floor_us_per_row is None
+                       else floor_us_per_row / 1e6)
+        self._dev_free_t = 0.0  # emulated device's serial-free time
+
+    @property
+    def busy(self) -> bool:
+        return self._inflight is not None
+
+    def issue(self, group: UnionGroup, qb: np.ndarray, bucket: int,
+              meta) -> list:
+        """Dispatch (async), then materialize the PREVIOUS in-flight
+        batch. Returns the completed 5-tuples (0, 1 or — when this
+        batch's dispatch itself raises — 2 items)."""
+        prev = self._inflight
+        try:
+            # serve_dispatch fault seam: an injected dispatch
+            # exception at batch K (deliberately NOT armed inside
+            # UnionGroup.dispatch — warm-up calls must never fault).
+            if faults.arrive("serve_dispatch"):
+                raise RuntimeError(
+                    "injected fault at seam 'serve_dispatch'")
+            dev = group.dispatch(qb, bucket)
+        except Exception as e:
+            self._inflight = None
+            out = self._materialize(prev)
+            out.append((meta, None, 0.0, 0.0,
+                        f"dispatch raised {type(e).__name__}: {e}"))
+            return out
+        self._inflight = (dev, meta, time.perf_counter(), qb.shape[0])
+        return self._materialize(prev)
+
+    def drain(self) -> list:
+        out = self._materialize(self._inflight)
+        self._inflight = None
+        return out
+
+    def _materialize(self, item) -> list:
+        if item is None:
+            return []
+        dev, meta, t_issue, padded_rows = item
+        t0 = time.perf_counter()
+        if self._timeout is None:
+            try:
+                rows, err = np.asarray(dev), None
+            except Exception as e:
+                rows, err = None, (f"materialization raised "
+                                   f"{type(e).__name__}: {e}")
+        else:
+            # Bounded wait: the blocking np.asarray runs on a daemon
+            # watchdog thread. On timeout the batch is FAILED and the
+            # pump moves on; the orphaned thread finishes (or never
+            # does — a truly wedged runtime) without holding anything
+            # the engine needs. The serve_stall fault seam fires in
+            # the waiting thread, modeling exactly that wedge.
+            box: dict = {}
+
+            def _pull():
+                try:
+                    faults.serve_stall()
+                    box["rows"] = np.asarray(dev)
+                except Exception as e:  # pragma: no cover - rare path
+                    box["err"] = (f"materialization raised "
+                                  f"{type(e).__name__}: {e}")
+
+            th = threading.Thread(target=_pull, daemon=True,
+                                  name="dpsvm-dispatch-watchdog")
+            th.start()
+            th.join(self._timeout)
+            if th.is_alive():
+                rows, err = None, (
+                    f"dispatch watchdog: batch not materialized within "
+                    f"{self._timeout * 1e3:.0f} ms; failing the batch "
+                    "and serving on")
+            elif "err" in box:
+                rows, err = None, box["err"]
+            else:
+                rows, err = box["rows"], None
+        if self._floor is not None and err is None:
+            # Serial emulated device: this dispatch starts when the
+            # device went free (or when it was issued, if later) and
+            # takes floor * padded_rows of device time.
+            done_t = (max(t_issue, self._dev_free_t)
+                      + self._floor * padded_rows)
+            self._dev_free_t = done_t
+            now = time.perf_counter()
+            if done_t > now:
+                time.sleep(done_t - now)
+        t1 = time.perf_counter()
+        return [(meta, rows, t1 - t0, t1 - t_issue, err)]
+
+
+def suggest_buckets(row_samples, current_buckets) -> dict:
+    """Occupancy-driven ``ServeConfig.buckets`` suggestion (ISSUE 14
+    satellite — the ROADMAP item 2 stub closed, report-only).
+
+    `row_samples` are observed LIVE rows per dispatch (the engine's
+    batch_rows histogram window); `current_buckets` the configured
+    power-of-two ladder. The suggestion is the smallest ladder whose
+    rungs sit at the next power of two above the traffic's p25/p50/
+    p75/p95 marks (top bucket always kept — it caps segment size), and
+    the record carries the PROJECTED mean occupancy under both ladders
+    so the advice is adjudicable before anyone applies it.
+
+    Pure function of host-held values — unit-testable, zero device
+    work. Applying a suggestion stays behind the profile discipline:
+    the autotune ``serve_buckets`` probe measures whether dispatch
+    cost even tracks the bucket on this device (a latency-floored
+    device makes padding free, and then FEWER buckets win on compile
+    count)."""
+    rows = np.asarray(list(row_samples), np.float64)
+    rows = rows[rows > 0]
+    current = tuple(int(b) for b in current_buckets)
+    if rows.size == 0:
+        return {"current_buckets": list(current),
+                "suggested_buckets": None,
+                "note": "no dispatches observed"}
+    top = current[-1]
+
+    def pow2_at_least(v):
+        return 1 << max(0, int(np.ceil(np.log2(max(float(v), 1.0)))))
+
+    marks = {f"p{q}": float(np.percentile(rows, q))
+             for q in (25, 50, 75, 95)}
+    ladder = sorted({min(pow2_at_least(v), top)
+                     for v in marks.values()} | {top})
+
+    def projected_occupancy(buckets):
+        b = np.asarray(buckets, np.float64)
+        # First bucket that fits each dispatch (observed rows never
+        # exceed the top bucket: oversized requests are segmented).
+        idx = np.minimum(np.searchsorted(b, rows), len(b) - 1)
+        return round(float(np.mean(rows / b[idx])), 4)
+
+    return {
+        "current_buckets": list(current),
+        "suggested_buckets": [int(b) for b in ladder],
+        "observed_rows": {**{k: round(v, 1) for k, v in marks.items()},
+                          "max": int(rows.max()),
+                          "dispatches": int(rows.size)},
+        "projected_occupancy": {
+            "current": projected_occupancy(current),
+            "suggested": projected_occupancy(ladder)},
+        "note": ("report-only: apply via ServeConfig.buckets only "
+                 "where the autotune serve_buckets probe says "
+                 "right-sizing pays on this device"),
+    }
+
+
+def _overwrite_f64(entry: LoadedModel, q, dec: np.ndarray) -> None:
+    """Exact host float64 evaluation of an entry's risk-routed columns
+    (the serve.py _overwrite_f64 algebra via the one shared f64 kernel
+    definition). ``q`` is the CALLER'S rows — float64 requests stay
+    exact (unquantized) on these columns."""
+    from dpsvm_tpu.solver.reconstruct import gram_matvec_f64
+
+    q64 = np.asarray(q, np.float64)
+    for j in entry.f64_cols:
+        dec[:, j] = (gram_matvec_f64(entry.ens.sv_union,
+                                     entry.ens.coef[:, j], entry.kp,
+                                     queries=q64)
+                     - float(entry.ens.b[j])).astype(np.float32)

@@ -36,16 +36,30 @@ and every degraded behavior is a policy, not an accident:
   so the PR 13 rehydrate path needs nothing from the drain.
 
 THREADING MODEL: reader threads (one per connection, socketserver's)
-parse frames and enqueue them on an inbox; ONE pump thread owns the
-engine — admission, submit, pump, result routing all happen there (the
-engine is single-driver by design; only registry swaps may run on
-admin threads). Writer threads (one per connection) drain per-
-connection outboxes so a slow peer can never block verdict routing.
-All accounting counters share one lock and reconcile exactly:
-``frames_accepted == sum(verdicts)`` and every verdict is either
-delivered or counted undeliverable — the loadgen ``--net`` chaos leg
-asserts the whole conservation law against client-side tallies and the
-run log.
+parse frames and enqueue them on ONE shared inbox; ONE pump thread PER
+ENGINE REPLICA owns its engine — admission, submit, pump, result
+routing all happen there (each engine is single-driver by design; only
+registry swaps may run on admin threads). Writer threads (one per
+connection) drain per-connection outboxes so a slow peer can never
+block verdict routing. All accounting counters share one lock and
+reconcile exactly: ``frames_accepted == sum(verdicts)`` and every
+verdict is either delivered or counted undeliverable — the loadgen
+``--net`` chaos leg asserts the whole conservation law against
+client-side tallies and the run log.
+
+REPLICA ROUTING (serving/replicas.py ReplicaFleet behind this front
+door) is slotted into the pump/admission layer — the one place every
+frame already passes through: a replica's pump thread pops the shared
+inbox only while it will actually take new work (not draining, and its
+queue under the admission bound unless EVERY live replica is equally
+full — then any of them pops and the admission reject fires exactly as
+on a single engine). Work therefore flows to whichever replica has
+room, with no separate router thread, no per-frame routing decision
+outside the pump layer, and — at one replica — byte-for-byte the
+single-engine behavior. Per-replica ``drain_replica``/
+``resume_replica`` make rolling restarts a policy: the drained replica
+stops popping, finishes or sheds its queued work through the normal
+verdicts, and parks while its peers keep serving.
 """
 
 from __future__ import annotations
@@ -282,20 +296,24 @@ class _Handler(socketserver.BaseRequestHandler):
 
 
 class ServeServer:
-    """The TCP front door over one :class:`ServingEngine`.
+    """The TCP front door over one :class:`ServingEngine` — or over a
+    :class:`serving.replicas.ReplicaFleet` of them (anything exposing
+    ``engines``/``config``/``_obs``/``attach_net``).
 
     Construction binds the listener and starts the accept + pump
-    threads; the engine must already exist (models may register before
-    or after — submits resolve at frame time). ``host``/``port``
-    default to the engine config's ``listen`` spec, else loopback on
-    an ephemeral port (read ``server.port``).
+    threads (one pump thread per replica); the engine must already
+    exist (models may register before or after — submits resolve at
+    frame time). ``host``/``port`` default to the engine config's
+    ``listen`` spec, else loopback on an ephemeral port (read
+    ``server.port``).
 
     Lifecycle: :meth:`drain` is the graceful half (stop accepting,
-    flush verdicts, GOODBYE, close connections, stop the pump);
-    :meth:`close` is drain + listener teardown and is idempotent. The
-    server never closes the engine — the caller owns that ordering
-    (``cli serve --listen`` does drain → ``engine.close()`` on
-    SIGTERM)."""
+    flush verdicts, GOODBYE, close connections, stop the pumps);
+    :meth:`close` is drain + listener teardown and is idempotent;
+    :meth:`drain_replica`/:meth:`resume_replica` are the per-replica
+    rolling-restart half. The server never closes the engine — the
+    caller owns that ordering (``cli serve --listen`` does drain →
+    ``engine.close()`` on SIGTERM)."""
 
     def __init__(self, engine, host: Optional[str] = None,
                  port: Optional[int] = None):
@@ -305,12 +323,26 @@ class ServeServer:
                 host, port = config.listen_addr()
             else:
                 host, port = "127.0.0.1", 0
-        self._engine = engine
+        # One engine or a fleet of replicas; either way the obs run
+        # log and the /metrics attachment belong to the target, the
+        # per-replica pump threads to this front door.
+        self._fleet = engine if hasattr(engine, "engines") else None
+        self._engine = None if self._fleet is not None else engine
+        self._n_rep = (len(self._fleet.engines)
+                       if self._fleet is not None else 1)
+        self._obs = engine._obs
         self._stats = _NetStats()
         self._inbox: queue.Queue = queue.Queue()
         self._inbox_pending = 0  # put-but-not-yet-handled (drain gate)
         self._pending_lock = threading.Lock()
-        self._tickets: dict = {}  # ticket -> (conn, req_id, want_dec)
+        # Per replica: ticket -> (conn, req_id, want_dec) — tickets are
+        # per-engine counters, so the routing key is (replica, ticket).
+        self._tickets = [dict() for _ in range(self._n_rep)]
+        self._rep_draining = [False] * self._n_rep
+        self._rep_parked = [False] * self._n_rep
+        self._rep_lock = threading.Lock()
+        self._rep_verdicts = [{v: 0 for v in wire.VERDICTS}
+                              for _ in range(self._n_rep)]
         self._conns: dict = {}
         self._conns_lock = threading.Lock()
         self._next_cid = 0
@@ -334,13 +366,28 @@ class ServeServer:
         self._accept_thread = threading.Thread(
             target=self._tcp.serve_forever, kwargs={"poll_interval": 0.05},
             daemon=True, name="dpsvm-net-accept")
-        self._pump_thread = threading.Thread(
-            target=self._pump_loop, daemon=True, name="dpsvm-net-pump")
+        self._pump_threads = [
+            threading.Thread(
+                target=self._pump_loop, args=(i,), daemon=True,
+                name=("dpsvm-net-pump" if self._n_rep == 1
+                      else f"dpsvm-net-pump-{i}"))
+            for i in range(self._n_rep)]
+        self._pump_thread = self._pump_threads[0]
         engine.attach_net(self)
-        engine._obs.event("listen", host=self.host, port=self.port,
-                          admission_max_rows=self._admission_rows)
+        self._obs.event("listen", host=self.host, port=self.port,
+                        admission_max_rows=self._admission_rows,
+                        replicas=self._n_rep)
         self._accept_thread.start()
-        self._pump_thread.start()
+        for th in self._pump_threads:
+            th.start()
+
+    def _eng(self, rep: int = 0):
+        """The live engine for replica `rep` — read through the fleet
+        on every call so restart_replica's fresh engine is picked up
+        by the very next pump iteration."""
+        if self._fleet is not None:
+            return self._fleet.engines[rep]
+        return self._engine
 
     # -------------------------------------------------------- reader side
     def _serve_conn(self, sock: socket.socket, addr) -> None:
@@ -355,7 +402,7 @@ class ServeServer:
         with self._conns_lock:
             self._conns[cid] = conn
         self._stats.bump("conns_opened")
-        self._engine._obs.event("conn_open", conn=cid,
+        self._obs.event("conn_open", conn=cid,
                                 peer=f"{addr[0]}:{addr[1]}")
         # The HELLO banner: the client's proof this connection was
         # actually accepted (a handshake alone completes in the listen
@@ -408,61 +455,97 @@ class ServeServer:
         """A malformed frame kills ONLY its own connection, with an
         ERROR frame out first so the peer knows why."""
         self._stats.bump("protocol_errors")
-        self._engine._obs.event("protocol_error", conn=conn.cid,
+        self._obs.event("protocol_error", conn=conn.cid,
                                 error=msg[:200])
         conn.enqueue("error", wire.pack_error(0, msg))
 
     # ---------------------------------------------------------- pump side
-    def _pump_loop(self) -> None:
-        eng = self._engine
+    def _takes_new(self, rep: int) -> bool:
+        """Eligibility gate: may replica `rep`'s pump thread pop the
+        shared inbox right now?  A draining replica never pops; under
+        a server-wide drain anyone pops (the frame gets its drain
+        reject); otherwise pop while this replica's queue is under the
+        admission bound — and when it ISN'T, pop anyway only if every
+        live peer is equally full, so the admission reject fires
+        exactly as it would on a single engine instead of the frame
+        rotting in the inbox. At one replica this reduces to
+        unconditional popping — the pre-fleet behavior."""
+        if self._rep_draining[rep]:
+            return False
+        if self._draining:
+            return True
+        if self._eng(rep).scheduler.queue_rows < self._admission_rows:
+            return True
+        for i in range(self._n_rep):
+            if i == rep or self._rep_draining[i]:
+                continue
+            if self._eng(i).scheduler.queue_rows < self._admission_rows:
+                return False  # a peer with room will take it
+        return True  # everyone is full: reject rather than buffer
+
+    def _pump_loop(self, rep: int) -> None:
         while not self._stop_pump.is_set():
+            # Read the engine through the fleet EVERY iteration so a
+            # restart_replica swap is picked up immediately.
+            eng = self._eng(rep)
             handled = False
-            try:
-                conn, req = self._inbox.get(timeout=0.02)
-                handled = True
-            except queue.Empty:
-                conn = req = None
+            if self._takes_new(rep):
+                try:
+                    conn, req = self._inbox.get(timeout=0.02)
+                    handled = True
+                except queue.Empty:
+                    pass
             if handled:
                 try:
-                    self._handle_request(conn, req)
+                    self._handle_request(rep, eng, conn, req)
                 finally:
                     with self._pending_lock:
                         self._inbox_pending -= 1
-                # drain whatever else arrived without blocking
-                while True:
+                # drain whatever else arrived without blocking, while
+                # still eligible (queue may have crossed the bound)
+                while self._takes_new(rep):
                     try:
                         conn, req = self._inbox.get_nowait()
                     except queue.Empty:
                         break
                     try:
-                        self._handle_request(conn, req)
+                        self._handle_request(rep, eng, conn, req)
                     finally:
                         with self._pending_lock:
                             self._inbox_pending -= 1
-            if eng.scheduler.queue_depth or eng._dispatcher.busy:
+            busy = eng.scheduler.queue_depth or eng._dispatcher.busy
+            if busy:
                 eng.pump()
             for ticket, res in eng.results().items():
-                self._route(ticket, res)
+                self._route(rep, ticket, res)
+            if (self._rep_draining[rep] and not self._rep_parked[rep]
+                    and not self._tickets[rep]
+                    and not eng.scheduler.queue_depth
+                    and not eng._dispatcher.busy):
+                self._rep_parked[rep] = True  # drain_replica's signal
+            if not handled and not busy:
+                time.sleep(0.002)  # parked/ineligible: don't spin
         # Final sweep: a frame parsed between the drain's quiescence
         # check and the stop flag must still get its one verdict (a
         # drain-phase rejection, usually undeliverable past the
-        # GOODBYE — but COUNTED, never silently dropped).
+        # GOODBYE — but COUNTED, never silently dropped). Any pump
+        # thread may pop it; each frame is handled exactly once.
         while True:
             try:
                 conn, req = self._inbox.get_nowait()
             except queue.Empty:
                 break
             try:
-                self._handle_request(conn, req)
+                self._handle_request(rep, self._eng(rep), conn, req)
             finally:
                 with self._pending_lock:
                     self._inbox_pending -= 1
 
-    def _handle_request(self, conn: _Conn, req: wire.Request) -> None:
-        eng = self._engine
+    def _handle_request(self, rep: int, eng, conn: _Conn,
+                        req: wire.Request) -> None:
         self._stats.bump("frames_accepted")
         if self._draining:
-            self._reject(conn, req, "server draining",
+            self._reject(rep, conn, req, "server draining",
                          retry_ms=int(self._retry_base_ms))
             return
         queued = eng.scheduler.queue_rows
@@ -472,7 +555,7 @@ class ServeServer:
             # model service time.
             retry = int(self._retry_base_ms
                         * (1.0 + queued / self._admission_rows))
-            self._reject(conn, req,
+            self._reject(rep, conn, req,
                          f"admission: {queued} queued rows >= "
                          f"{self._admission_rows}", retry_ms=retry)
             return
@@ -492,18 +575,21 @@ class ServeServer:
             self._send_verdict(conn, wire.pack_verdict(
                 req.req_id, "failed", model=req.model or "",
                 latency_ms=(time.perf_counter() - t0) * 1e3,
-                message=str(e)[:300]), "failed")
+                message=str(e)[:300]), "failed", rep)
             return
-        self._tickets[ticket] = (conn, req.req_id, req.want_decision)
+        # Tickets are per-engine counters: the routing key is
+        # (replica, ticket), kept as one dict per replica.
+        self._tickets[rep][ticket] = (conn, req.req_id,
+                                      req.want_decision)
 
-    def _reject(self, conn: _Conn, req: wire.Request, reason: str,
-                retry_ms: int) -> None:
+    def _reject(self, rep: int, conn: _Conn, req: wire.Request,
+                reason: str, retry_ms: int) -> None:
         self._send_verdict(conn, wire.pack_verdict(
             req.req_id, "rejected", model=req.model or "",
-            retry_after_ms=retry_ms, message=reason), "rejected")
+            retry_after_ms=retry_ms, message=reason), "rejected", rep)
 
-    def _route(self, ticket: int, res) -> None:
-        meta = self._tickets.pop(ticket, None)
+    def _route(self, rep: int, ticket: int, res) -> None:
+        meta = self._tickets[rep].pop(ticket, None)
         if meta is None:
             return  # not a wire ticket (in-process submit on this engine)
         conn, req_id, want_dec = meta
@@ -519,14 +605,19 @@ class ServeServer:
         self._send_verdict(conn, wire.pack_verdict(
             req_id, verdict, model=res.model, version=res.version,
             latency_ms=res.latency_s * 1e3, labels=labels,
-            decision=decision), verdict)
+            decision=decision), verdict, rep)
 
     def _send_verdict(self, conn: _Conn, frame: bytes,
-                      verdict: str) -> None:
+                      verdict: str, rep: Optional[int] = None) -> None:
         """EVERY wire verdict passes here: counted at enqueue (the
         conservation law's left side); a dead/backed-up connection
-        counts it undeliverable instead."""
+        counts it undeliverable instead. `rep` additionally attributes
+        the verdict to the replica that produced it — the per-replica
+        counters sum exactly to the global ones."""
         self._stats.verdict(verdict)
+        if rep is not None:
+            with self._rep_lock:
+                self._rep_verdicts[rep][verdict] += 1
         if not conn.enqueue("verdict", frame, verdict):
             self._stats.undelivered(verdict)
 
@@ -536,7 +627,7 @@ class ServeServer:
             if self._conns.pop(conn.cid, None) is None:
                 return
         self._stats.bump("conns_closed")
-        self._engine._obs.event("conn_close", conn=conn.cid)
+        self._obs.event("conn_close", conn=conn.cid)
 
     def drain(self, timeout_s: float = 60.0) -> dict:
         """Graceful drain: stop accepting, let queued work finish or
@@ -548,21 +639,23 @@ class ServeServer:
             if self._drained:
                 return self._stats.snapshot()
             self._draining = True
-            self._engine._obs.event("drain", phase="begin",
-                                    conns=len(self._conns),
-                                    queued=self._engine.scheduler
-                                    .queue_depth)
+            self._obs.event("drain", phase="begin",
+                            conns=len(self._conns),
+                            queued=sum(self._eng(i).scheduler.queue_depth
+                                       for i in range(self._n_rep)))
             self._tcp.shutdown()  # accept loop exits; no new conns
             # Quiescence: nothing unparsed in the inbox, no un-routed
-            # ticket, engine queues empty, no in-flight device batch.
+            # ticket on ANY replica, every engine queue empty, no
+            # in-flight device batch anywhere.
             deadline = time.monotonic() + timeout_s
-            eng = self._engine
             while time.monotonic() < deadline:
                 with self._pending_lock:
                     pending = self._inbox_pending
-                if (pending == 0 and not self._tickets
-                        and not eng.scheduler.queue_depth
-                        and not eng._dispatcher.busy):
+                if (pending == 0
+                        and not any(self._tickets)
+                        and all(not self._eng(i).scheduler.queue_depth
+                                and not self._eng(i)._dispatcher.busy
+                                for i in range(self._n_rep))):
                     break
                 time.sleep(0.005)
             # Flush + goodbye. Verdicts already enqueued ride out
@@ -581,12 +674,13 @@ class ServeServer:
                 if conn.reader is not None:
                     conn.reader.join(timeout=5.0)
             self._stop_pump.set()
-            self._pump_thread.join(timeout=10.0)
+            for th in self._pump_threads:
+                th.join(timeout=10.0)
             self._tcp.server_close()
             self._accept_thread.join(timeout=5.0)
             self._drained = True
             snap = self._stats.snapshot()
-            self._engine._obs.event("drain", phase="end", **{
+            self._obs.event("drain", phase="end", **{
                 k: snap[k] for k in ("frames_accepted", "conns_opened",
                                      "conns_closed", "goodbyes_sent",
                                      "undeliverable_total")})
@@ -599,6 +693,54 @@ class ServeServer:
             snap = self.drain()
             self._closed = True
             return snap
+
+    def drain_replica(self, rep: int, timeout_s: float = 60.0) -> dict:
+        """Drain ONE replica for a rolling restart: its pump thread
+        stops popping the shared inbox, finishes or sheds its queued
+        work through the normal engine verdicts (deadlines still
+        honored), routes the final results, then PARKS — peers keep
+        serving throughout. Refuses to drain the last live replica
+        (that is :meth:`drain`'s job, with the GOODBYE protocol).
+        Returns the replica's parked-state snapshot; the engine itself
+        is NOT closed — :meth:`ReplicaFleet.restart_replica` owns
+        that ordering."""
+        if not 0 <= rep < self._n_rep:
+            raise ValueError(f"replica {rep} out of range "
+                             f"(0..{self._n_rep - 1})")
+        with self._life:
+            if self._draining:
+                raise RuntimeError(
+                    "server is draining; per-replica drain is moot")
+            live = [i for i in range(self._n_rep)
+                    if i != rep and not self._rep_draining[i]]
+            if not live:
+                raise RuntimeError(
+                    f"refusing to drain replica {rep}: it is the last "
+                    f"live replica (use drain() to stop serving)")
+            already = self._rep_draining[rep]
+            self._rep_draining[rep] = True
+            if not already:
+                self._rep_parked[rep] = False
+            self._obs.event("drain_replica", phase="begin", replica=rep,
+                            queued=self._eng(rep).scheduler.queue_depth)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and not self._rep_parked[rep]:
+            time.sleep(0.005)
+        self._obs.event("drain_replica", phase="end", replica=rep,
+                        parked=self._rep_parked[rep])
+        return {"replica": rep, "parked": self._rep_parked[rep],
+                "verdicts": dict(self._rep_verdicts[rep])}
+
+    def resume_replica(self, rep: int) -> None:
+        """Put a drained (or restarted) replica back in rotation — its
+        pump thread resumes popping on the very next iteration."""
+        if not 0 <= rep < self._n_rep:
+            raise ValueError(f"replica {rep} out of range "
+                             f"(0..{self._n_rep - 1})")
+        with self._life:
+            self._rep_draining[rep] = False
+            self._rep_parked[rep] = False
+        self._obs.event("resume_replica", replica=rep)
 
     def __enter__(self):
         return self
@@ -613,7 +755,30 @@ class ServeServer:
             open_conns = len(self._conns)
         return {**self._stats.snapshot(), "open_connections": open_conns,
                 "listen": f"{self.host}:{self.port}",
-                "draining": self._draining}
+                "draining": self._draining,
+                "replicas": self._n_rep}
+
+    def replica_snapshot(self) -> list:
+        """Per-replica routing state, one dict per replica. Kept OUT
+        of :meth:`net_snapshot` so the loadgen's field-wise delta
+        arithmetic over that flat dict stays valid; the per-replica
+        verdict counters here sum exactly to the global
+        ``verdicts`` (both counted at enqueue, under their locks)."""
+        out = []
+        with self._rep_lock:
+            verdicts = [dict(v) for v in self._rep_verdicts]
+        for i in range(self._n_rep):
+            eng = self._eng(i)
+            out.append({
+                "replica": i,
+                "queue_rows": eng.scheduler.queue_rows,
+                "queue_depth": eng.scheduler.queue_depth,
+                "inflight_tickets": len(self._tickets[i]),
+                "draining": self._rep_draining[i],
+                "parked": self._rep_parked[i],
+                "verdicts": verdicts[i],
+            })
+        return out
 
     def net_families(self) -> list:
         """OpenMetrics families the engine's /metrics render appends —
@@ -653,4 +818,40 @@ class ServeServer:
             om.gauge("serving_net_open_connections",
                      "currently open front-door connections",
                      [({}, s["open_connections"])]),
+            *self._replica_families(),
+        ]
+
+    def _replica_families(self) -> list:
+        """serving_replica_* families — one labeled sample per
+        replica, present even at one replica (rep="0") so dashboards
+        need no schema switch when a fleet appears."""
+        reps = self.replica_snapshot()
+        return [
+            om.gauge("serving_replica_queue_rows",
+                     "queued rows on each replica's scheduler (the "
+                     "admission/routing signal)",
+                     [({"rep": str(r["replica"])}, r["queue_rows"])
+                      for r in reps]),
+            om.gauge("serving_replica_queue_depth",
+                     "queued requests on each replica's scheduler",
+                     [({"rep": str(r["replica"])}, r["queue_depth"])
+                      for r in reps]),
+            om.gauge("serving_replica_inflight_tickets",
+                     "wire tickets submitted to a replica and not yet "
+                     "routed back", [({"rep": str(r["replica"])},
+                                      r["inflight_tickets"])
+                                     for r in reps]),
+            om.gauge("serving_replica_draining",
+                     "1 while the replica is draining for a rolling "
+                     "restart (2 once parked)",
+                     [({"rep": str(r["replica"])},
+                       (2 if r["parked"] else 1) if r["draining"] else 0)
+                      for r in reps]),
+            om.metric("serving_replica_verdicts", "counter",
+                      "wire verdicts by replica and class (sums to "
+                      "serving_net_verdicts)",
+                      [("_total", {"rep": str(r["replica"]),
+                                   "verdict": v}, c)
+                       for r in reps
+                       for v, c in sorted(r["verdicts"].items())]),
         ]

@@ -194,3 +194,53 @@ def test_bare_artifact_shape(tmp_path):
            wrap=False)
     out = bench._regression_gate(_cur(), str(tmp_path))
     assert out["regression_gate"] == "PASS"
+
+
+def test_topology_mismatch_refused(tmp_path):
+    """ISSUE 16 satellite: a 2-replica run "beating" a 1-replica
+    baseline is the horizontal-scaling claim, not a regression verdict
+    — the gate refuses cross-topology comparisons with the raw delta
+    as informational, for replica count and mesh width alike."""
+    _write(tmp_path, "BENCH_r06.json",
+           {**_cur(), "replicas": 1, "union_mesh_devices": 1})
+    out = bench._regression_gate(
+        {**_cur(pps=1_300_000), "replicas": 2,
+         "union_mesh_devices": 1}, str(tmp_path))
+    assert out["regression_gate"] == "TOPOLOGY_MISMATCH"
+    assert out["previous_topology"] == {"replicas": 1,
+                                        "union_mesh_devices": 1}
+    assert out["current_topology"] == {"replicas": 2,
+                                       "union_mesh_devices": 1}
+    assert "raw_delta" in out and "normalized_delta" not in out
+    # mesh width alone also refuses
+    out = bench._regression_gate(
+        {**_cur(), "replicas": 1, "union_mesh_devices": 8},
+        str(tmp_path))
+    assert out["regression_gate"] == "TOPOLOGY_MISMATCH"
+
+
+def test_topology_legacy_artifacts_derive_single_chip(tmp_path):
+    """Artifacts predating the stamps (every BENCH_SERVE_r01/r02) ran
+    one engine on one device by construction: absent fields derive to
+    (1, 1) and keep adjudicating same-topology runs instead of
+    refusing history."""
+    _write(tmp_path, "BENCH_r06.json", _cur())  # no topology stamp
+    out = bench._regression_gate(
+        {**_cur(), "replicas": 1, "union_mesh_devices": 1},
+        str(tmp_path))
+    assert out["regression_gate"] == "PASS"
+    # and a stamped 2-replica run against the legacy baseline refuses
+    out = bench._regression_gate(
+        {**_cur(pps=1_300_000), "replicas": 2}, str(tmp_path))
+    assert out["regression_gate"] == "TOPOLOGY_MISMATCH"
+    assert out["previous_topology"] == {"replicas": 1,
+                                        "union_mesh_devices": 1}
+
+
+def test_topology_match_still_adjudicates(tmp_path):
+    _write(tmp_path, "BENCH_r06.json",
+           {**_cur(), "replicas": 2, "union_mesh_devices": 1})
+    out = bench._regression_gate(
+        {**_cur(), "replicas": 2, "union_mesh_devices": 1},
+        str(tmp_path))
+    assert out["regression_gate"] == "PASS"

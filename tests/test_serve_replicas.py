@@ -1,0 +1,384 @@
+"""Replica fleet + mesh union group tests (ISSUE 16).
+
+Two scale axes, one contract:
+
+* SCALE-DOWN — the mesh-sharded union group
+  (dpsvm_tpu/serving/engine_core.py): union rows sharded across the
+  vdev mesh with a psum over partial decision columns, pinned BITWISE
+  against the single-chip group for the exact-in-f32 linear case and
+  allclose for rbf.
+* SCALE-OUT — the replica fleet (dpsvm_tpu/serving/replicas.py) behind
+  one front door (serving/server.py): lockstep model admin over the
+  shared registry journal (cross-replica swap consistency), rolling
+  restart of one replica under sustained wire load with zero lost or
+  duplicated frames, per-replica drain/resume lifecycle refusals, and
+  the serving_replica_*/serving_fleet_* metrics families.
+
+Budget discipline: tiny models, small bucket ladders, short sustained-
+load windows gated by the device-floor emulation knob; no new
+interpret-mode Pallas compiles (tier-1 sits near its ceiling)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import ServeConfig, SVMConfig
+from dpsvm_tpu.models.multiclass import train_multiclass
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.serving import ReplicaFleet, ServeClient, ServeServer
+from dpsvm_tpu.serving import ServingEngine
+from dpsvm_tpu.serving.dispatch import ServingEngine as _Engine
+
+CFG = SVMConfig(c=5.0, gamma=0.25, epsilon=1e-3, chunk_iters=256)
+D = 5
+
+
+@pytest.fixture(scope="module")
+def two_files(tmp_path_factory):
+    """v1/v2 model files trained on DIFFERENT subsets (distinct unions
+    — the realistic retrain swap), plus query features."""
+    tmp = tmp_path_factory.mktemp("replica_models")
+    rng = np.random.default_rng(23)
+    xs, ys = [], []
+    for k in range(3):
+        c = np.zeros(D, np.float32)
+        c[k] = 2.5
+        xs.append(rng.normal(size=(48, D)).astype(np.float32) * 0.7 + c)
+        ys.append(np.full(48, k))
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    m1, _ = train_multiclass(x[::2], y[::2], CFG, strategy="ovr")
+    m2, _ = train_multiclass(x[1::2], y[1::2], CFG, strategy="ovr")
+    p1, p2 = str(tmp / "m_v1.npz"), str(tmp / "m_v2.npz")
+    m1.save(p1)
+    m2.save(p2)
+    return p1, p2, x
+
+
+def _fleet(tmp_path, replicas=2, **kw):
+    """(fleet, server) on a loopback port with a shared journal."""
+    kw.setdefault("buckets", (16, 64))
+    kw.setdefault("deadline_ms", None)
+    kw.setdefault("journal_path", str(tmp_path / "registry.journal"))
+    cfg = ServeConfig(listen="127.0.0.1:0", replicas=replicas, **kw)
+    fleet = ReplicaFleet(cfg)
+    return fleet, ServeServer(fleet)
+
+
+def _no_net_threads(deadline_s=10.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        left = [t.name for t in threading.enumerate()
+                if t.name.startswith("dpsvm-net")]
+        if not left:
+            return []
+        time.sleep(0.02)
+    return left
+
+
+# ------------------------------------------------------ mesh union group
+
+def test_mesh_union_group_bitwise_vs_single_chip():
+    """The tentpole pin: with the linear kernel and small-integer
+    SVs/alphas/queries (every partial sum exact in f32), the mesh
+    union group — union rows sharded across the 8-vdev mesh, partial
+    decision columns combined by ONE psum — must be BITWISE identical
+    to the single-chip group. Sharding may reorder nothing: each
+    device owns a contiguous padded row block and the psum adds
+    exactly the per-shard partials the single matmul would have
+    accumulated."""
+    rng = np.random.default_rng(0)
+    n, d = 40, 12
+    x = rng.integers(-4, 5, size=(n, d)).astype(np.float32)
+    y = np.where(np.arange(n) % 2 == 0, 1.0, -1.0).astype(np.float32)
+    alpha = rng.integers(0, 4, size=n).astype(np.float32)
+    m = SVMModel.from_dense(x, y, alpha, b=3.0,
+                            kernel=KernelParams("linear"))
+    q = rng.integers(-4, 5, size=(17, d)).astype(np.float32)
+
+    e1 = _Engine(ServeConfig(buckets=(32,), num_devices=1))
+    e8 = _Engine(ServeConfig(buckets=(32,), num_devices=8))
+    try:
+        e1.register("m", m)
+        e8.register("m", m)
+        d1 = e1.decision(q, "m")
+        d8 = e8.decision(q, "m")
+        np.testing.assert_array_equal(d1, d8)  # bitwise
+        group = next(iter(e8._groups.values()))
+        assert group.mesh_devices == 8
+        assert e8.snapshot()["union_mesh_devices"] == 8
+        assert e1.snapshot()["union_mesh_devices"] == 1
+    finally:
+        e1.close()
+        e8.close()
+
+
+def test_mesh_union_group_rbf_allclose():
+    """rbf sums are not exact in f32, so the mesh pin is allclose —
+    the general-kernel contract behind the bitwise linear pin."""
+    rng = np.random.default_rng(7)
+    d = 12
+    m = SVMModel.from_dense(
+        rng.random((64, d)).astype(np.float32),
+        np.where(np.arange(64) % 2 == 0, 1.0, -1.0),
+        rng.random(64).astype(np.float32), b=0.25,
+        kernel=KernelParams("rbf", 0.3))
+    q = rng.random((23, d)).astype(np.float32)
+    ea = _Engine(ServeConfig(buckets=(32,), num_devices=1))
+    eb = _Engine(ServeConfig(buckets=(32,), num_devices=4))
+    try:
+        ea.register("m", m)
+        eb.register("m", m)
+        np.testing.assert_allclose(ea.decision(q, "m"),
+                                   eb.decision(q, "m"),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        ea.close()
+        eb.close()
+
+
+# ---------------------------------------------------- fleet model admin
+
+def test_fleet_lockstep_registration_and_journal(two_files, tmp_path):
+    """register/swap fan out to every replica at the SAME version, and
+    the shared journal holds the whole-set snapshot any replica would
+    write (the N byte-identical writes are idempotent)."""
+    p1, p2, _ = two_files
+    fleet, srv = _fleet(tmp_path)
+    try:
+        e = fleet.register("m", p1)
+        assert e.version == 1
+        assert [g.registry.get("m").version
+                for g in fleet.engines] == [1, 1]
+        e = fleet.swap("m", p2)
+        assert e.version == 2
+        assert [g.registry.get("m").version
+                for g in fleet.engines] == [2, 2]
+        # a cold engine rehydrates from the one shared journal to the
+        # exact versions the fleet serves
+        cold = ServingEngine(ServeConfig(
+            buckets=(16, 64),
+            journal_path=str(tmp_path / "registry.journal")))
+        try:
+            assert cold._rehydrated == ["m"]
+            assert cold.registry.get("m").version == 2
+        finally:
+            cold.close()
+    finally:
+        srv.close()
+        fleet.close()
+    assert _no_net_threads() == []
+
+
+def test_drain_replica_lifecycle_refusals(two_files, tmp_path):
+    """Per-replica drain: out-of-range raises; draining the LAST live
+    replica is refused (that is server drain's job); resume restores
+    eligibility so the other replica can then park."""
+    p1, _, _ = two_files
+    fleet, srv = _fleet(tmp_path)
+    try:
+        fleet.register("m", p1)
+        with pytest.raises(ValueError, match="out of range"):
+            srv.drain_replica(9)
+        out = srv.drain_replica(0)
+        assert out["parked"] is True
+        with pytest.raises(RuntimeError, match="last live replica"):
+            srv.drain_replica(1)
+        srv.resume_replica(0)
+        assert srv.drain_replica(1)["parked"] is True
+        srv.resume_replica(1)
+        # traffic still lands after the cycle
+        with ServeClient(srv.host, srv.port) as cli:
+            v = cli.request(np.zeros((2, D), np.float32), model="m")
+        assert v.verdict == "served"
+    finally:
+        srv.close()
+        fleet.close()
+    assert _no_net_threads() == []
+
+
+# --------------------------------------------- cross-replica swap / load
+
+def _load_clients(srv, n_clients, stop, records, errors, rows_lo=4,
+                  rows_hi=17):
+    """Closed-loop wire clients until `stop`; each records
+    (t_started, verdict, version) per request. Synchronous protocol:
+    every request ends in exactly one verdict or one exception —
+    the client-side half of the zero-lost/zero-dup ledger."""
+
+    def _loop(idx):
+        rng = np.random.default_rng(900 + idx)
+        try:
+            with ServeClient(srv.host, srv.port, seed=idx) as cli:
+                while not stop.is_set():
+                    rows = rng.random(
+                        (int(rng.integers(rows_lo, rows_hi)), D),
+                        dtype=np.float32)
+                    t0 = time.monotonic()
+                    v = cli.request(rows, model="m")
+                    records[idx].append((t0, v.verdict, v.version))
+        except Exception as e:  # noqa: BLE001 — ledgered, asserted ==[]
+            errors.append((idx, repr(e)))
+
+    threads = [threading.Thread(target=_loop, args=(i,),
+                                name=f"test-rep-client-{i}")
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def test_cross_replica_swap_consistency_under_load(two_files, tmp_path):
+    """Hot swap against a 2-replica fleet under sustained wire load:
+    in-flight work finishes on the old version, every request STARTED
+    after swap() returned answers from the new version on whichever
+    replica served it, both replicas carry post-swap traffic, and
+    afterwards every replica's decision surface is identical to a
+    reference engine serving v2."""
+    p1, p2, x = two_files
+    fleet, srv = _fleet(tmp_path, device_floor_us_per_row=150.0)
+    stop = threading.Event()
+    records = [[] for _ in range(3)]
+    errors = []
+    try:
+        fleet.register("m", p1)
+        threads = _load_clients(srv, 3, stop, records, errors)
+        time.sleep(0.3)  # v1 traffic provably in flight
+        entry = fleet.swap("m", p2)
+        t_swapped = time.monotonic()
+        assert entry.version == 2
+        time.sleep(0.4)  # v2 traffic on both replicas
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        assert errors == []
+        flat = [r for rec in records for r in rec]
+        assert flat and all(v == "served" for _, v, _ in flat)
+        versions = {ver for _, _, ver in flat}
+        assert versions == {1, 2}, versions  # old finished, new took over
+        late = [ver for t0, _, ver in flat if t0 > t_swapped]
+        assert late and all(ver == 2 for ver in late)
+        per_rep = srv.replica_snapshot()
+        assert all(s["verdicts"]["served"] > 0 for s in per_rep)
+        snap = srv.drain()
+        assert snap["frames_accepted"] == sum(snap["verdicts"].values())
+        # every replica now answers EXACTLY like a v2 reference engine
+        q = np.asarray(x[:8], np.float32)
+        ref = ServingEngine(ServeConfig(buckets=(16, 64)))
+        try:
+            ref.register("m", p2)
+            expect = ref.decision(q, "m")
+            for eng in fleet.engines:
+                assert eng.registry.get("m").version == 2
+                np.testing.assert_array_equal(eng.decision(q, "m"),
+                                              expect)
+        finally:
+            ref.close()
+    finally:
+        stop.set()
+        srv.close()
+        fleet.close()
+    assert _no_net_threads() == []
+
+
+def test_rolling_restart_zero_lost_frames(two_files, tmp_path):
+    """Rolling restart under sustained load: drain replica 0 through
+    the front door while its peer keeps serving, replace its engine
+    with a fresh one rehydrated from the shared journal, resume — and
+    the ledgers must balance exactly: zero client exceptions, every
+    request ends in one explicit served verdict, client-side served
+    count == server-side served verdicts, frames_accepted == sum of
+    all verdicts, and the restarted replica provably serves again."""
+    p1, _, _ = two_files
+    fleet, srv = _fleet(tmp_path, device_floor_us_per_row=150.0)
+    stop = threading.Event()
+    records = [[] for _ in range(3)]
+    errors = []
+    try:
+        fleet.register("m", p1)
+        old = fleet.engines[0]
+        threads = _load_clients(srv, 3, stop, records, errors)
+        time.sleep(0.25)  # offered load provably in flight
+        fresh = fleet.restart_replica(0, timeout_s=60.0)
+        served_at_restart = \
+            srv.replica_snapshot()[0]["verdicts"]["served"]
+        assert fresh is fleet.engines[0] and fresh is not old
+        assert fresh._rehydrated == ["m"]  # journal, not re-register
+        assert fresh.registry.get("m").version == 1
+        time.sleep(0.6)  # post-restart traffic must reach replica 0
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        assert errors == []
+        flat = [r for rec in records for r in rec]
+        assert flat and all(v == "served" for _, v, _ in flat)
+        per_rep = srv.replica_snapshot()
+        assert per_rep[0]["verdicts"]["served"] > served_at_restart, \
+            "restarted replica never served again"
+        snap = srv.drain()
+        # the zero-lost / zero-duplicated ledger, both directions
+        assert snap["verdicts"]["served"] == len(flat)
+        assert snap["frames_accepted"] == sum(snap["verdicts"].values())
+    finally:
+        stop.set()
+        srv.close()
+        fleet.close()
+    assert _no_net_threads() == []
+
+
+# ------------------------------------------------------------- telemetry
+
+def test_fleet_metrics_families(two_files, tmp_path):
+    """One scrape, whole fleet: serving_fleet_* aggregates with rep
+    labels plus the front door's serving_replica_* and serving_net_*
+    families."""
+    p1, _, _ = two_files
+    fleet, srv = _fleet(tmp_path)
+    try:
+        fleet.register("m", p1)
+        with ServeClient(srv.host, srv.port) as cli:
+            for _ in range(3):
+                v = cli.request(np.ones((2, D), np.float32), model="m")
+                assert v.verdict == "served"
+        text = fleet.render_openmetrics()
+        assert "serving_fleet_replicas 2" in text
+        for fam in ("serving_fleet_requests_total",
+                    "serving_fleet_rows_total",
+                    "serving_fleet_dispatches_total",
+                    "serving_replica_queue_rows",
+                    "serving_replica_inflight_tickets",
+                    "serving_replica_draining",
+                    "serving_replica_verdicts_total"):
+            assert f'{fam}{{rep="0"}}' in text or \
+                f'rep="0"' in text.split(fam, 1)[1][:200], fam
+            assert f'rep="1"' in text, fam
+        assert "serving_net_frames_accepted" in text
+        routing = srv.replica_snapshot()
+        assert [s["replica"] for s in routing] == [0, 1]
+        assert sum(s["verdicts"]["served"] for s in routing) == 3
+    finally:
+        srv.close()
+        fleet.close()
+    assert _no_net_threads() == []
+
+
+def test_single_replica_families_present():
+    """The serving_replica_* families exist even at replicas=1 (the
+    dashboard contract does not change shape when the fleet grows)."""
+    from dpsvm_tpu.obs import export as om
+
+    eng = ServingEngine(ServeConfig(buckets=(16,),
+                                    listen="127.0.0.1:0"))
+    srv = ServeServer(eng)
+    try:
+        text = om.render(srv.net_families())
+        assert "serving_replica_queue_rows" in text
+        assert 'rep="0"' in text
+    finally:
+        srv.close()
+        eng.close()
+    assert _no_net_threads() == []

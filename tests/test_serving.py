@@ -405,10 +405,16 @@ def test_empty_union_served():
     eng.close()
 
 
-def test_engine_rejects_mesh_and_bad_width(two_versions):
+def test_engine_mesh_config_and_bad_width(two_versions):
+    # num_devices>1 is no longer a refusal: it engages the mesh union
+    # group (ISSUE 16; the bitwise pin lives in test_serve_replicas).
     m1, _, x = two_versions
-    with pytest.raises(ValueError, match="single-device"):
-        ServingEngine(ServeConfig(num_devices=2))
+    mesh_eng = ServingEngine(ServeConfig(buckets=(16,), num_devices=2))
+    try:
+        mesh_eng.register("m", m1)
+        assert mesh_eng.snapshot()["union_mesh_devices"] == 2
+    finally:
+        mesh_eng.close()
     eng = _engine()
     eng.register("m", m1)
     with pytest.raises(ValueError, match="must be"):

@@ -606,6 +606,217 @@ def _run_net(args, engine, paths, tmp, journal_path, sizes,
     return 0
 
 
+#: Device-time floor for the replica scale-out legs, in µs per PADDED
+#: row (ServeConfig.device_floor_us_per_row). On the 1-core CI harness
+#: both legs of a replica comparison would otherwise contend for the
+#: same core and measure nothing but GIL arithmetic; the floor gives
+#: every engine a serial emulated accelerator timeline (sleep-based,
+#: GIL released) so dispatch is device-latency-bound — the TPU serving
+#: regime — and the frontier measures the front door's ROUTING AND
+#: OVERLAP of replica device timelines. A serialization bug still
+#: shows ~1x. The same floor applies to every leg and is stamped into
+#: the artifact under ``device_emulation``.
+REPLICA_FLOOR_US = 250.0
+
+
+def _run_replicas(args, paths, tmp, sizes, traffic) -> int:
+    """``loadgen --net --replicas N`` (ISSUE 16): the horizontal
+    scale-out frontier. One fleet per leg at r = 1..N replicas behind
+    one front door, identical workload and device-time floor, exact
+    client/server verdict reconciliation per leg; then a chaos leg at
+    r = N (seeded connection faults + one mid-leg FLEET-WIDE hot swap
+    — post-swap every replica must serve the new version). Headline:
+    aggregate served examples/s at r = N, with the r=1 leg as the
+    in-artifact scaling baseline."""
+    import threading
+
+    import bench
+    from dpsvm_tpu.config import ObsConfig, ServeConfig
+    from dpsvm_tpu.serving import ReplicaFleet, ServeServer
+    from dpsvm_tpu.testing import faults as fault_harness
+
+    calibration = bench._session_calibration()
+    names = [t[0] for t in traffic]
+    n_clients = 4 if args.smoke else 8
+    per_client = max(6, args.requests // n_clients)
+    floor = REPLICA_FLOOR_US
+
+    def fleet_leg(r, tag, n_req, plan=None, swap_mid=False):
+        """One measured leg: fresh fleet of r replicas, fresh journal,
+        closed-loop wire clients, exact reconciliation. Returns the
+        leg record (rates from the FLEET'S OWN row counters over the
+        client wall window, never a tool-local sum)."""
+        journal = os.path.join(tmp, f"registry_{tag}.journal")
+        cfg = ServeConfig(
+            listen="127.0.0.1:0", replicas=r,
+            device_floor_us_per_row=floor, deadline_ms=None,
+            journal_path=journal,
+            obs=ObsConfig(enabled=args.obs, runlog_dir=args.obs_dir))
+        fleet = ReplicaFleet(cfg)
+        server = ServeServer(fleet)
+        fleet.register("mnist", paths["mnist_v1"])
+        fleet.register("aux", paths["aux"])
+        dims = {n: fleet.engines[0].registry.get(n).d for n in names}
+        before = server.net_snapshot()
+        rows_before = fleet.snapshot()["rows"]
+        out = [None] * n_clients
+        threads = [threading.Thread(
+            target=_net_worker,
+            args=(server.host, server.port, i, n_req, traffic, dims,
+                  sizes, None, out),
+            name=f"loadgen-rep-{tag}-{i}") for i in range(n_clients)]
+        swap_done = {}
+        swap_th = None
+        if swap_mid:
+            def _swap():
+                time.sleep(0.4)  # mid-leg: traffic provably in flight
+                entry = fleet.swap("mnist", paths["mnist_v2"])
+                swap_done["version"] = entry.version
+
+            swap_th = threading.Thread(target=_swap,
+                                       name=f"loadgen-rep-swap-{tag}")
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if swap_th is not None:
+            swap_th.start()
+        for t in threads:
+            t.join(timeout=600)
+            assert not t.is_alive(), f"{tag} client wedged"
+        wall = time.perf_counter() - t0
+        if swap_th is not None:
+            swap_th.join(timeout=120)
+            assert not swap_th.is_alive(), "mid-leg fleet swap wedged"
+            # Cross-replica swap consistency: EVERY replica now serves
+            # the new version (the shared-journal lockstep contract).
+            vers = [eng.registry.get("mnist").version
+                    for eng in fleet.engines]
+            assert vers == [swap_done["version"]] * r, vers
+        rows = fleet.snapshot()["rows"] - rows_before
+        rec = _reconcile_net(_net_delta(before, server.net_snapshot()),
+                             out, tag, clean=(plan is None))
+        per_rep = server.replica_snapshot()
+        if r > 1:
+            # Near-linear needs every replica pulling: a routing bug
+            # that parks a replica shows up here, not just as a slow
+            # aggregate.
+            assert all(s["verdicts"]["served"] > 0 for s in per_rep), \
+                per_rep
+        server.close()
+        fleet.close()
+        leg = {
+            "replicas": r, "clients": n_clients,
+            "requests": n_clients * n_req,
+            "rows_served": int(rows),
+            "wall_seconds": round(wall, 3),
+            "examples_per_second": round(rows / wall, 1),
+            "reconciliation": rec,
+            "per_replica_served": [s["verdicts"]["served"]
+                                   for s in per_rep],
+            **({"hot_swap_to_version": swap_done.get("version")}
+               if swap_mid else {}),
+        }
+        print(f"[loadgen] replicas={r} ({tag}): "
+              f"{leg['examples_per_second']} ex/s aggregate "
+              f"({leg['rows_served']} rows / {leg['wall_seconds']}s), "
+              f"per-replica served {leg['per_replica_served']}",
+              file=sys.stderr)
+        return leg
+
+    # --- the scale-out frontier: r = 1..N, identical workload+floor.
+    frontier = [fleet_leg(r, f"clean_r{r}", per_client)
+                for r in range(1, args.replicas + 1)]
+    base = frontier[0]["examples_per_second"]
+    peak = frontier[-1]["examples_per_second"]
+    speedup = peak / base
+    print(f"[loadgen] scale-out frontier: "
+          + " -> ".join(f"r{lg['replicas']}="
+                        f"{lg['examples_per_second']}"
+                        for lg in frontier)
+          + f" ({speedup:.2f}x at r={args.replicas})",
+          file=sys.stderr)
+    floor_bound = speedup >= (1.2 if args.smoke else 1.6)
+    assert floor_bound, (
+        f"replica scale-out {speedup:.2f}x below bound — the front "
+        f"door is serializing replicas: {frontier}")
+
+    # --- chaos mini-leg at r = N: seeded connection faults + one
+    # mid-leg fleet-wide hot swap, accounting closed exactly.
+    fault_harness.NET_STALL_SECONDS = 0.4
+    plan = fault_harness.FaultPlan.parse(
+        "net_conn_drop@5,net_accept@3", seed=17)
+    with fault_harness.install(plan):
+        chaos = fleet_leg(args.replicas, f"chaos_r{args.replicas}",
+                          per_client, plan=plan, swap_mid=True)
+    chaos["faults_fired"] = dict(plan.fired)
+    assert plan.fired["net_conn_drop"] == 1, plan.fired
+    assert chaos["reconciliation"]["dropped"] == 1, chaos
+    assert chaos["hot_swap_to_version"] == 2, chaos
+
+    result = {
+        "metric": ("replica fleet scale-out (ISSUE 16): aggregate "
+                   "closed-loop served examples/s through ONE network "
+                   f"front door at 1..{args.replicas} engine replicas, "
+                   "identical workload and per-replica device-time "
+                   "floor; chaos leg with seeded connection faults "
+                   "and a mid-leg fleet-wide hot swap"),
+        "value": peak,
+        "unit": "examples/second",
+        "examples_per_second": peak,
+        "baseline_1_replica_examples_per_second": base,
+        "scaleout_speedup": round(speedup, 3),
+        "frontier": frontier,
+        "chaos_leg": chaos,
+        # Topology stamps (ISSUE 16 satellite): the regression gate
+        # refuses cross-topology comparisons on these.
+        "replicas": args.replicas,
+        "union_mesh_devices": 1,
+        # Transparency stamp: these are DEVICE-EMULATED numbers. The
+        # floor makes dispatch device-latency-bound on the 1-core CI
+        # harness so the frontier measures front-door scale-out;
+        # host-bound absolute throughput is the standard loadgen run.
+        "device_emulation": {
+            "device_floor_us_per_row": floor,
+            "charged_per": "padded row, serial per engine",
+            "reason": ("single-core CI harness: without an emulated "
+                       "device timeline both replicas contend for "
+                       "one core and the comparison measures "
+                       "nothing"),
+        },
+        **bench._device_fields(),
+        "device_numbers": ("pending — device-emulated CPU-harness "
+                          "run; a TPU session re-runs this sweep "
+                          "with real accelerator timelines"),
+        "schema_version": bench._schema_version(),
+        "session_calibration": calibration,
+        "smoke": bool(args.smoke),
+    }
+
+    gate = bench._regression_gate(result, REPO,
+                                  pattern="BENCH_SERVE_r*.json",
+                                  key="examples_per_second")
+    result.update(gate)
+    print(f"[loadgen] regression gate: {gate.get('regression_gate')} "
+          "(cross-topology runs refuse by design; same-topology "
+          "replica artifacts adjudicate normally)", file=sys.stderr)
+
+    if args.out:
+        art = args.out
+    elif args.smoke:
+        art = os.path.join(tmp, "BENCH_SERVE_replicas_smoke.json")
+    else:
+        nn = len(glob.glob(os.path.join(REPO,
+                                        "BENCH_SERVE_r*.json"))) + 1
+        art = os.path.join(REPO, f"BENCH_SERVE_r{nn:02d}.json")
+    with open(art, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps({k: result[k] for k in
+                      ("metric", "value", "unit", "scaleout_speedup",
+                       "regression_gate")}))
+    print(f"[loadgen] wrote {art}", file=sys.stderr)
+    return 0
+
+
 def _net_runlog_reconciliation(engine, snap: dict) -> dict:
     """Runlog side of the accounting: the serve run log's conn/drain
     event records must agree with the server counters (empty when obs
@@ -658,6 +869,17 @@ def main(argv=None) -> int:
                          "through the socket path — client-observed "
                          "verdict counts reconciled EXACTLY against "
                          "server counters and the runlog")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="with --net: run the ISSUE 16 horizontal "
+                         "scale-out sweep instead — one ReplicaFleet "
+                         "per leg at 1..N engine replicas behind one "
+                         "front door, identical workload and "
+                         "per-replica device-time floor "
+                         f"({'%g' % 250.0}us/padded row, stamped as "
+                         "device_emulation), aggregate served "
+                         "examples/s reconciled exactly per leg, "
+                         "plus a chaos leg with connection faults "
+                         "and a mid-leg fleet-wide hot swap")
     ap.add_argument("--chaos", action="store_true",
                     help="run the CHAOS leg after the sweep (ISSUE "
                          "13): a corrupted-file hot swap at the best "
@@ -686,6 +908,17 @@ def main(argv=None) -> int:
         args.pool = min(args.pool, 512)
         args.requests = min(args.requests, 96)
         args.concurrency = "4,16"
+    if args.replicas > 1:
+        if not args.net:
+            print("error: --replicas requires --net (the replica "
+                  "fleet lives behind the network front door)",
+                  file=sys.stderr)
+            return 2
+        # The replica sweep is DEVICE-floor-bound by design; a small
+        # pool keeps the host-side matmuls far under the emulated
+        # device time so the frontier measures routing, not the one
+        # CI core (stamped in the artifact as device_emulation).
+        args.pool = min(args.pool, 512)
 
     import jax
 
@@ -716,6 +949,15 @@ def main(argv=None) -> int:
         paths[name] = os.path.join(tmp, f"{name}.npz")
         m.save(paths[name])
 
+    sizes = [1, 2, 4, 8, 16, 32, 64, 128]
+    traffic = [("mnist", 1.0 - args.aux_share), ("aux", args.aux_share)]
+
+    if args.replicas > 1:
+        # The ISSUE 16 scale-out sweep builds one fleet per leg from
+        # the shared model files; the single-engine paths below never
+        # run.
+        return _run_replicas(args, paths, tmp, sizes, traffic)
+
     # The registry journal rides along from the start (free: one tiny
     # atomic JSON rewrite per register/swap) — it is what the chaos
     # leg's kill/rehydrate cycle replays.
@@ -732,8 +974,6 @@ def main(argv=None) -> int:
     print(f"[loadgen] registered 2 models in "
           f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
 
-    sizes = [1, 2, 4, 8, 16, 32, 64, 128]
-    traffic = [("mnist", 1.0 - args.aux_share), ("aux", args.aux_share)]
     levels = [int(t) for t in args.concurrency.split(",") if t]
 
     if args.net:
