@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke serve_replica_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke serve_replica_smoke serve_quant_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -105,6 +105,16 @@ serve_net_smoke:
 # artifact (tier1.yml runs this next to serve_net_smoke).
 serve_replica_smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/loadgen.py --net --replicas 2 --smoke
+
+# Quantized-serving smoke (ISSUE 17): the int8 union hot path proven
+# end-to-end — a guard-ACCEPTED model stages int8 (union bytes cut
+# >3x, decisions served clean through closed-loop traffic), a risky
+# model is REFUSED loudly and falls back without int8, and an f32 vs
+# int8 frontier leg runs through the real wire front door with exact
+# verdict reconciliation. Temp artifact (tier1.yml runs this next to
+# serve_replica_smoke).
+serve_quant_smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/loadgen.py --quant-smoke
 
 # Fault-tolerance smoke (ISSUE 13): the deterministic fault-injection
 # harness self-test, a kill -9 mid-ooc-solve followed by a --resume

@@ -173,6 +173,16 @@ def _artifact_topology(doc: dict) -> tuple:
             int(doc.get("union_mesh_devices") or 1))
 
 
+def _artifact_storage(doc: dict) -> str:
+    """A serving artifact's union storage stamp (ISSUE 17). Artifacts
+    predating the stamp staged f32 unions by construction — every
+    committed BENCH_SERVE_r01..r03 headline ran the f32 path — so an
+    absent field derives to 'f32' and keeps adjudicating against
+    same-storage runs instead of refusing history (the
+    _artifact_topology precedent)."""
+    return str(doc.get("union_storage") or "f32")
+
+
 def _session_calibration() -> dict:
     """Fixed-reference-kernel measurement for THIS session (VERDICT
     round-5 weak #1): a pinned compute kernel whose FLOP count never
@@ -348,7 +358,14 @@ def _regression_gate(current: dict, root: str,
                          baseline is the scaling claim, not a
                          regression verdict — delta RAW, adjudicates
                          nothing. Artifacts predating the stamps
-                         derive to (1, 1)."""
+                         derive to (1, 1).
+      STORAGE_MISMATCH — the artifacts staged different union
+                         storage dtypes (ISSUE 17): an int8 run vs
+                         an f32 baseline is the quantization claim
+                         (the artifact's own storage A/B leg), not a
+                         regression verdict — delta RAW, adjudicates
+                         nothing. Artifacts predating the
+                         union_storage stamp derive to 'f32'."""
     path, prev = _latest_bench_artifact(root, pattern, key=key)
     if prev is None:
         return {"regression_gate": "NO_BASELINE"}
@@ -397,6 +414,23 @@ def _regression_gate(current: dict, root: str,
                                   "union_mesh_devices": prev_topo[1]},
             "current_topology": {"replicas": cur_topo[0],
                                  "union_mesh_devices": cur_topo[1]},
+            "raw_delta": round(cur_pps / prev[key] - 1.0, 4),
+        })
+        return out
+    # Storage refusal (ISSUE 17, same shape again): an int8 run
+    # "beating" an f32 baseline is the quantization claim — the
+    # artifact's own storage A/B leg reports it at matched shape —
+    # not a regression verdict; and an int8 regression hidden under a
+    # faster storage's moved baseline would be invisible. Cross-
+    # storage deltas are RAW and adjudicate nothing. Artifacts
+    # predating the union_storage stamp derive to 'f32'.
+    cur_store = _artifact_storage(current)
+    prev_store = _artifact_storage(prev)
+    if cur_store != prev_store:
+        out.update({
+            "regression_gate": "STORAGE_MISMATCH",
+            "previous_union_storage": prev_store,
+            "current_union_storage": cur_store,
             "raw_delta": round(cur_pps / prev[key] - 1.0, 4),
         })
         return out

@@ -151,17 +151,37 @@ def dtype_facts(hlo_text: str) -> dict:
     f64 anywhere on a device path is a leak (the solvers' f64 legs are
     HOST paths by design); f32->bf16 converts are counted so a budget
     can pin exactly the INTENDED quantization points (e.g. the serving
-    engine's bf16 union storage) and any new one is a drift."""
+    engine's bf16 union storage) and any new one is a drift.
+
+    int8 quantization facts (the ISSUE 17 serving hot path) are
+    reported only when s8 values appear in the program at all, so
+    every pre-int8 budget stays byte-identical (i32->f32 converts by
+    themselves are ordinary — e.g. obs counters re-widening — and
+    must not sprout new fact keys across the manifest): f32->s8 is a
+    query quantization point, s32->f32 the dequant fuse re-widening
+    the i32-exact dot, s8->f32 a dequantized-operand read (e.g. the
+    quantized-query norms), and s8->s32 the CPU harness's int8-dot
+    emulation (0 where the MXU takes the s8 operands directly). An
+    int8 value APPEARING in a non-int8 entry surfaces as a new fact
+    key set — a drift, exactly as intended."""
     converts = _CONVERT_RE.findall(hlo_text)
+
+    def _n(to, frm):
+        return sum(1 for t, f in converts if t == to and f == frm)
+
+    int8_facts = {}
+    if "s8[" in hlo_text:
+        int8_facts = {"f32_to_int8_converts": _n("s8", "f32"),
+                      "int8_to_f32_converts": _n("f32", "s8"),
+                      "i32_to_f32_converts": _n("f32", "s32"),
+                      "int8_to_i32_converts": _n("s32", "s8")}
     return {
         "f64_result_ops": len(re.findall(r"= *f64\[", hlo_text)),
         "f64_present": "f64[" in hlo_text,
-        "f32_to_bf16_converts": sum(1 for to, frm in converts
-                                    if to == "bf16" and frm == "f32"),
-        "bf16_to_f32_converts": sum(1 for to, frm in converts
-                                    if to == "f32" and frm == "bf16"),
-        "f32_to_f64_converts": sum(1 for to, frm in converts
-                                   if to == "f64" and frm == "f32"),
+        "f32_to_bf16_converts": _n("bf16", "f32"),
+        "bf16_to_f32_converts": _n("f32", "bf16"),
+        "f32_to_f64_converts": _n("f64", "f32"),
+        **int8_facts,
     }
 
 

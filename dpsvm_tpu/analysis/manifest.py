@@ -510,6 +510,35 @@ def serve_bucket_bf16():
     return _serve_bucket_units("bfloat16")
 
 
+def serve_bucket_int8():
+    """Quantized serving hot path (ISSUE 17): the int8 bucket
+    executor. The budget pins the EXACT convert structure of the
+    calibrated quantization algebra — queries quantize to int8 once
+    on device (one f32->int8 convert), the union rows arrive int8
+    (no staging convert in the traced graph), ONE kernel matmul runs
+    int8 x int8 -> int32 on the MXU (i32-exact), and the dequant fuse
+    re-widens once (one i32->f32 convert) against the f32 row-scale
+    outer product. Any extra convert — a second rounding of the
+    queries, a dequant of the union before the dot — is a drift. The
+    memory facts pin the 4x union argument-bytes cut: the (S, D)
+    union argument is int8 (1 byte/elt vs serve_bucket's 4), plus the
+    (S,) f32 scales."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.serve import _dense_batch_int8_factory
+
+    batch = _dense_batch_int8_factory()
+    args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), jnp.int8),
+            _sds((S_UNION,), jnp.float32),
+            _sds((S_UNION,), jnp.float32),
+            _sds((S_UNION, K_MODELS), jnp.float32),
+            _sds((K_MODELS,), jnp.float32))
+    kw = dict(kp=_kp())
+    return [Unit("batch", lambda: batch.lower(*args, **kw),
+                 _jaxpr_of(batch, *args, **kw))]
+
+
 def serve_coalesced_bucket():
     """Serving v2 coalesced multi-model bucket (ISSUE 10): the SAME
     dense executor as serve_bucket, lowered at the stacked
@@ -535,6 +564,30 @@ def serve_mesh_bucket():
 
     _, mapped = _mesh_serve_executor(DEVICE_COUNT, _kp(), "float32")
     args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), jnp.float32),
+            _sds((S_UNION,), jnp.float32),
+            _sds((S_UNION, K_MODELS), jnp.float32),
+            _sds((K_MODELS,), jnp.float32))
+    return [Unit("batch", lambda: mapped.lower(*args),
+                 _jaxpr_of(mapped, *args))]
+
+
+def serve_mesh_bucket_int8():
+    """Mesh-sharded int8 serving executor (ISSUE 17): the quantized
+    union's row blocks AND their f32 scales shard together over the
+    data axis; each device runs the LOCAL int8 x int8 -> i32 dot and
+    dequant fuse, and the partial decision columns combine through the
+    SAME single psum as serve_mesh_bucket — quantization must add
+    converts, never collectives. Budget pins one local kernel matmul,
+    one psum, zero host callbacks, and the int8 local union shard in
+    the memory facts."""
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.serve import _mesh_serve_executor
+
+    _, mapped = _mesh_serve_executor(DEVICE_COUNT, _kp(), "int8")
+    args = (_sds((NB, D), jnp.float32), _sds((S_UNION, D), jnp.int8),
+            _sds((S_UNION,), jnp.float32),
             _sds((S_UNION,), jnp.float32),
             _sds((S_UNION, K_MODELS), jnp.float32),
             _sds((K_MODELS,), jnp.float32))
@@ -602,8 +655,10 @@ MANIFEST = {
     "compacted_decision": compacted_decision,
     "serve_bucket": serve_bucket,
     "serve_bucket_bf16": serve_bucket_bf16,
+    "serve_bucket_int8": serve_bucket_int8,
     "serve_coalesced_bucket": serve_coalesced_bucket,
     "serve_mesh_bucket": serve_mesh_bucket,
+    "serve_mesh_bucket_int8": serve_mesh_bucket_int8,
     "serve_mesh_group": serve_mesh_group,
     "mesh_predict": mesh_predict,
 }

@@ -57,7 +57,16 @@ PROBE_KNOBS = {
     "ring": "ring_exchange",
     "fused_round": "fused_round",
     "bf16_gram": None,  # the per-problem perturbation gate governs
-    "serve_buckets": None,  # report-only (ServeConfig.buckets advice)
+    # Graduated from report-only (ISSUE 17): an authoritative pays
+    # verdict arms the engine's between-legs bucket AUTO-APPLY when
+    # ServeConfig.buckets=None. CPU-harness verdicts stay pinned False
+    # (the honesty rule), so CI never auto-applies.
+    "serve_buckets": "serve_buckets",
+    # Informational only: whether the int8 union GEMM beats f32 on
+    # this device. The ACTUAL int8 gate is the per-model calibrated
+    # perturbation guard (serve.resolve_union_storage) — a device-wide
+    # speed verdict must never overrule a per-model accuracy bound.
+    "serve_quant": None,
 }
 
 
@@ -526,8 +535,10 @@ def probe_serve_buckets(ctx: ProbeContext) -> dict:
     (ratio well under 1), the engine's batch-occupancy histogram is
     actionable and ``suggest_buckets`` advice is worth applying; when
     it does not, padding is free and coarse buckets win on compile
-    count. Report-only — ServeConfig.buckets changes stay behind the
-    profile discipline."""
+    count. Graduated from report-only (ISSUE 17): an authoritative
+    pays verdict arms the serving engine's between-legs bucket
+    auto-apply when ``ServeConfig.buckets=None``; an explicit ladder
+    always wins."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -558,15 +569,83 @@ def probe_serve_buckets(ctx: ProbeContext) -> dict:
         "serve_buckets", ctx, f"bucket_{big}", f"bucket_{small}",
         times[big], times[small], authoritative=ctx.on_tpu(),
         threshold=float(small) / big + 0.25,
-        note="report-only: verdict True means dispatch cost tracks the "
-             "bucket (occupancy-driven bucket suggestions pay); "
-             "ServeConfig.buckets is never changed automatically")
+        note="verdict True means dispatch cost tracks the bucket "
+             "(occupancy-driven bucket suggestions pay): arms the "
+             "engine's between-legs auto-apply for buckets=None; an "
+             "explicit ServeConfig.buckets always wins")
     # This probe's record must describe ITS measurement, not the
     # solver-probe shapes the shared ctx carries: a (bucket, d) x
     # (d, sv_rows) dispatch GEMM at `reps` in-dispatch reps — the
     # committed profile is reconcilable from these fields.
     rec["shapes"] = {"d": ctx.d, "sv_rows": s_rows,
                      "bucket_a": big, "bucket_b": small, "reps": reps}
+    return rec
+
+
+def probe_serve_quant(ctx: ProbeContext) -> dict:
+    """f32 vs int8 union storage at the serve bucket's compute shape:
+    the quantized executor's roofline — on-device per-row query
+    quantization, an int8 x int8 -> i32 MXU dot, the f32 dequant fuse,
+    the coef contraction — against the plain f32 dispatch GEMM.
+
+    Informational ONLY (knob None): whether int8 is FAST here is a
+    device property, but whether it is SAFE is a per-model property —
+    the calibrated perturbation guard (serve.resolve_union_storage)
+    adjudicates storage, and a device-wide speed verdict must never
+    overrule an accuracy bound. The record lands in the DeviceProfile
+    so BENCH_SERVE frontiers and operators can see where the MXU's
+    int8 path pays; on the CPU harness the timing is emulation-shaped
+    and the verdict stays pinned False (the honesty rule)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(ctx.seed + 17)
+    s_rows = 256 if ctx.smoke else 1024  # SV-union rows
+    bucket = 64 if ctx.smoke else 256
+    reps = 256 if ctx.smoke else 2048
+    sv_f = rng.normal(size=(s_rows, ctx.d)).astype(np.float32)
+    from dpsvm_tpu.ops.kernels import quantize_rows_int8
+
+    sv_q_np, sv_scale_np = quantize_rows_int8(sv_f)
+    sv = jnp.asarray(sv_f)
+    sv_q = jnp.asarray(sv_q_np)
+    sv_scale = jnp.asarray(sv_scale_np)
+    coef = jnp.asarray(rng.normal(size=(s_rows,)), jnp.float32)
+    qb = jnp.asarray(rng.normal(size=(bucket, ctx.d)), jnp.float32)
+
+    def dispatch_f32(qb, sv, coef):
+        k = qb @ sv.T
+        dec = k @ coef
+        return qb + jnp.float32(1e-20) * dec[0], sv, coef
+
+    def dispatch_int8(qb, sv_q, sv_scale, coef):
+        # The int8 bucket executor's algebra, stripped of the kernel
+        # transform (same roofline as _dense_batch_int8_factory).
+        t = jnp.max(jnp.abs(qb), axis=1) / 127.0
+        t = jnp.where(t > 0, t, 1.0)
+        q_q = jnp.clip(jnp.round(qb / t[:, None]), -127, 127
+                       ).astype(jnp.int8)
+        idots = jnp.dot(q_q, sv_q.T,
+                        preferred_element_type=jnp.int32)
+        k = idots.astype(jnp.float32) * (t[:, None] * sv_scale[None, :])
+        dec = k @ coef
+        return qb + jnp.float32(1e-20) * dec[0], sv_q, sv_scale, coef
+
+    t_f32 = timed_loop(dispatch_f32, qb, sv, coef,
+                       reps=reps, timer=ctx.timer)
+    t_int8 = timed_loop(dispatch_int8, qb, sv_q, sv_scale, coef,
+                        reps=reps, timer=ctx.timer)
+    rec = _ab_record(
+        "serve_quant", ctx, "union_f32", "union_int8", t_f32, t_int8,
+        authoritative=ctx.on_tpu(),
+        note="informational: int8 union GEMM vs f32 at the serve "
+             "bucket shape; storage is adjudicated per-model by the "
+             "calibrated perturbation guard, never by this record"
+             + ("" if ctx.on_tpu() else
+                "; CPU harness: int8 dot emulated, verdict pinned "
+                "False"))
+    rec["shapes"] = {"d": ctx.d, "sv_rows": s_rows,
+                     "bucket": bucket, "reps": reps}
     return rec
 
 
@@ -579,6 +658,7 @@ PROBES = {
     "pipeline_mesh": probe_pipeline_mesh,
     "ring": probe_ring,
     "serve_buckets": probe_serve_buckets,
+    "serve_quant": probe_serve_quant,
 }
 
 

@@ -357,12 +357,29 @@ def _build_serve_parser(sub) -> argparse.ArgumentParser:
                         "model set (default: no journal)")
     p.add_argument("--buckets", default="16,64,256,1024,4096",
                    help="comma-separated power-of-two query buckets "
-                        "(pre-compiled at startup)")
+                        "(pre-compiled at startup), or 'auto' to "
+                        "resolve through the DeviceProfile "
+                        "serve_buckets verdict: the default ladder, "
+                        "with the engine's occupancy-driven "
+                        "suggestion auto-applied between legs only "
+                        "where the profile measured that right-"
+                        "sizing pays on this device")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="float32",
-                   help="SV-union storage dtype (bfloat16 halves the "
-                        "resident footprint; f32 accumulation; quality-"
-                        "guarded)")
+                   help="legacy SV-union storage dtype (subsumed by "
+                        "--union-storage, which wins when given)")
+    p.add_argument("--union-storage",
+                   choices=["f32", "bf16", "int8", "auto"],
+                   default=None,
+                   help="SV-union storage: f32; bf16 (half footprint, "
+                        "f32 accumulation, warn-if-risky); int8 "
+                        "(calibrated per-row symmetric quantization, "
+                        "~4x footprint cut, int8 MXU dot with f32 "
+                        "dequant — REFUSED with a loud warning and a "
+                        "wider fallback when the calibrated "
+                        "perturbation bound rejects this model); "
+                        "auto (narrowest storage the bound accepts, "
+                        "silent). Default: derived from --dtype")
     p.add_argument("--precision", choices=["auto", "float32", "float64"],
                    default="auto",
                    help="per-submodel evaluation routing (auto = "
@@ -1187,8 +1204,10 @@ def _cmd_serve(args) -> int:
     try:
         from dpsvm_tpu.config import ObsConfig
 
-        buckets = tuple(int(t) for t in args.buckets.split(",") if t)
+        buckets = (None if args.buckets.strip() == "auto" else
+                   tuple(int(t) for t in args.buckets.split(",") if t))
         config = ServeConfig(buckets=buckets, dtype=args.dtype,
+                             union_storage=args.union_storage,
                              precision=args.precision,
                              num_devices=args.num_devices,
                              metrics_port=args.metrics_port,
@@ -1212,8 +1231,8 @@ def _cmd_serve(args) -> int:
               f"{server.k} decision columns over a {ens.n_union}-row SV "
               f"union ({int(ens.counts.sum())} stacked SVs compacted; "
               f"{len(server.f64_cols)} float64-routed columns), "
-              f"buckets {server.buckets}, dtype {config.dtype}",
-              file=sys.stderr)
+              f"buckets {server.buckets}, union storage "
+              f"{server.union_storage}", file=sys.stderr)
 
     if args.server_bench:
         try:
@@ -1309,13 +1328,15 @@ def _cmd_serve_v2(args) -> int:
         specs.append((name, path))
 
     try:
-        buckets = tuple(int(t) for t in args.buckets.split(",") if t)
+        buckets = (None if args.buckets.strip() == "auto" else
+                   tuple(int(t) for t in args.buckets.split(",") if t))
         timeouts = {}
         if args.conn_timeout_ms is not None:
             timeouts = dict(conn_read_timeout_ms=args.conn_timeout_ms,
                             conn_write_timeout_ms=args.conn_timeout_ms)
         config = ServeConfig(
             buckets=buckets, dtype=args.dtype,
+            union_storage=args.union_storage,
             num_devices=args.num_devices,
             deadline_ms=args.deadline_ms,
             dispatch_timeout_ms=args.dispatch_timeout_ms,

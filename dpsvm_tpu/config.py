@@ -829,12 +829,32 @@ class ServeConfig:
       are shape-keyed: without bucketing, every distinct request size
       pays a fresh compile — the same discipline as training's pad_to
       buckets). Batches beyond the largest bucket loop over it.
-    dtype: SV-union storage dtype. "bfloat16" halves the resident-union
-      HBM footprint and kernel-matmul read bandwidth; dot products still
-      accumulate in float32 (preferred_element_type), and construction
-      runs the existing bf16 quality guard (ops/kernels.py
-      bf16_rbf_perturbation) — a loud warning when coefficient scale
-      amplifies storage rounding into O(1) decision changes.
+      ``None`` resolves through the DeviceProfile ``serve_buckets``
+      probe (serve.resolve_buckets — the solver's resolve_auto_gate
+      discipline, ISSUE 17): with an authoritative pays verdict the v2
+      engine AUTO-APPLIES its own occupancy suggestion
+      (engine_core.suggest_buckets) between serving legs, with full
+      provenance in the snapshot; without one it serves the default
+      ladder. An explicit tuple always wins — no profile, no
+      auto-apply.
+    union_storage: SV-union storage precision — "f32", "bf16", "int8"
+      or "auto" (ISSUE 17). Subsumes ``dtype``: None (default)
+      derives from it (float32 -> "f32", bfloat16 -> "bf16") so
+      existing configs behave identically. "bf16" halves the
+      resident-union HBM footprint and kernel-matmul read bandwidth;
+      "int8" (symmetric per-row quantization with f32 scales,
+      ops/kernels.quantize_rows_int8) cuts union bytes 4x over f32
+      with i32-exact MXU accumulation dequantized into the f32
+      decision algebra. Both sit behind the calibrated serving guard
+      (serve.resolve_union_storage): the decision-sum perturbation
+      bound max-column ``||coef||_1 * p90|dK|`` must clear
+      BF16_RISK_THRESHOLD or staging REFUSES the narrow storage —
+      loudly, falling back to f32 — per model. "auto" tries int8,
+      then bf16, then f32, accepting the narrowest storage the bound
+      clears (silently — auto is a request to pick, not a promise).
+      Risk-routed f64 columns always see the UNQUANTIZED union.
+    dtype: legacy SV-union storage dtype knob ("float32"/"bfloat16"),
+      kept for back-compat; ``union_storage`` supersedes it when set.
     precision: "auto" consults predict.decision_risk per submodel and
       routes extreme-|coef| columns to the exact host float64 path
       (predict.AUTO_F64_RISK); "float32" forces the device path;
@@ -947,8 +967,9 @@ class ServeConfig:
       device time only).
     """
 
-    buckets: tuple = (16, 64, 256, 1024, 4096)
+    buckets: Optional[tuple] = (16, 64, 256, 1024, 4096)
     dtype: str = "float32"
+    union_storage: Optional[str] = None
     precision: str = "auto"
     num_devices: int = 1
     warm_start: bool = True
@@ -974,28 +995,42 @@ class ServeConfig:
     obs: ObsConfig = ObsConfig()
 
     def __post_init__(self):
-        if not self.buckets:
-            raise ValueError("buckets must be non-empty")
-        bs = tuple(int(b) for b in self.buckets)
-        if any(b < 1 or (b & (b - 1)) for b in bs):
-            raise ValueError(
-                f"buckets must be powers of two, got {self.buckets!r} "
-                "(XLA executors are shape-keyed; arbitrary sizes would "
-                "compile per request size)")
-        if list(bs) != sorted(set(bs)):
-            raise ValueError("buckets must be strictly ascending")
-        object.__setattr__(self, "buckets", bs)
+        if self.buckets is not None:
+            if not self.buckets:
+                raise ValueError(
+                    "buckets must be non-empty (None = resolve via the "
+                    "autotune serve_buckets profile gate)")
+            bs = tuple(int(b) for b in self.buckets)
+            if any(b < 1 or (b & (b - 1)) for b in bs):
+                raise ValueError(
+                    f"buckets must be powers of two, got "
+                    f"{self.buckets!r} (XLA executors are shape-keyed; "
+                    "arbitrary sizes would compile per request size)")
+            if list(bs) != sorted(set(bs)):
+                raise ValueError("buckets must be strictly ascending")
+            object.__setattr__(self, "buckets", bs)
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError("dtype must be 'float32' or 'bfloat16'")
+        if self.union_storage is not None and self.union_storage not in (
+                "f32", "bf16", "int8", "auto"):
+            raise ValueError(
+                "union_storage must be 'f32', 'bf16', 'int8' or 'auto' "
+                "(None = derive from the legacy dtype knob)")
         if self.precision not in ("auto", "float32", "float64"):
             raise ValueError(
                 "precision must be 'auto', 'float32' or 'float64'")
         if self.num_devices < 1:
             raise ValueError("num_devices must be >= 1")
-        if self.max_pending < self.buckets[-1]:
+        if self.buckets is not None \
+                and self.max_pending < self.buckets[-1]:
             raise ValueError(
                 "max_pending must be at least the largest bucket "
                 f"({self.buckets[-1]})")
+        if self.buckets is None and self.max_pending < 4096:
+            raise ValueError(
+                "max_pending must be at least 4096 with buckets=None "
+                "(the auto-resolved ladder may include the default top "
+                "bucket)")
         if self.metrics_port is not None and not (
                 0 <= self.metrics_port <= 65535):
             raise ValueError(
@@ -1064,6 +1099,17 @@ class ServeConfig:
         """('host', port) from the validated listen spec."""
         host, _, port = str(self.listen).rpartition(":")
         return host, int(port)
+
+    def effective_union_storage(self) -> str:
+        """The REQUESTED union storage: the union_storage knob when
+        set, else derived from the legacy dtype knob (float32 ->
+        'f32', bfloat16 -> 'bf16') so pre-ISSUE-17 configs behave
+        identically. What actually stages is per model — the serving
+        storage guard (serve.resolve_union_storage) may refuse a
+        narrow request back to f32."""
+        if self.union_storage is not None:
+            return self.union_storage
+        return "bf16" if self.dtype == "bfloat16" else "f32"
 
     def replace(self, **kw) -> "ServeConfig":
         return dataclasses.replace(self, **kw)

@@ -200,6 +200,11 @@ def summarize_run(run: Run) -> dict:
         # snapshot's "net" sub-dict (connection / frame / verdict /
         # protocol-error counters) when a ServeServer was attached.
         "net": fin.get("net"),
+        # Union-storage accounting (ISSUE 17): the engine snapshot's
+        # per-model storage map and quantized-union count, so a
+        # quantized serving run is distinguishable in the report table.
+        "union_storage": fin.get("union_storage"),
+        "quantized_unions": fin.get("quantized_unions"),
         "batch_occupancy_mean": ((fin.get("batch_occupancy") or {})
                                  .get("mean")),
         # Auto-gate provenance (ISSUE 14): the manifest's autotune
@@ -355,8 +360,16 @@ def _report_row(s: dict) -> list:
                     rep = f"rep={s['replica']} "
                 elif (s.get("replicas") or 1) > 1:
                     rep = f"rep=x{s['replicas']} "
+                # st= tags a run whose union storage is narrower than
+                # f32 (ISSUE 17): one tag when every model agrees,
+                # st=mixed when a multi-model engine splits.
+                stores = set((s.get("union_storage") or {}).values())
+                st = ""
+                if stores and stores != {"f32"}:
+                    st = (f"st={stores.pop()} " if len(stores) == 1
+                          else "st=mixed ")
                 row.append(
-                    rep
+                    rep + st
                     + f"miss={s['deadline_misses']} "
                     f"swap={s.get('hot_swaps') or 0}"
                     + (f" fail={s['dispatch_failures']}"

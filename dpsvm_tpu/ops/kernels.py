@@ -245,9 +245,112 @@ def bf16_kernel_perturbation(x, params: KernelParams, sample: int = 2048,
     return float(np.percentile(np.abs(kvals(s) - kvals(sb)), 90))
 
 
+def quantize_rows_int8(x):
+    """Symmetric per-row int8 quantization of a feature matrix:
+    ``(values int8, scales float32)`` with ``values[i] =
+    round(x[i] / scales[i])`` clipped to [-127, 127] and ``scales[i] =
+    max|x[i]| / 127`` (1.0 for all-zero rows, so dequantization is
+    exact zeros instead of 0/0).
+
+    Per-ROW (not per-tensor) because the serving union stacks support
+    vectors from many submodels whose feature scales differ; a single
+    tensor scale would burn the int8 range on the largest row. The
+    symmetric zero-point-free form keeps the dequant fused dot a pure
+    rank-1 rescale: ``dots = (q_int8 @ sv_int8^T) * (t_q ⊗ s_sv)`` —
+    no zero-point correction terms. Host NumPy (staging-time, like the
+    bf16 cast in serve._stage)."""
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    amax = np.abs(x).max(axis=1, initial=0.0)
+    scales = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(x / scales[:, None]), -127, 127).astype(np.int8)
+    return q, scales
+
+
+def dequantize_rows_int8(q, scales):
+    """float32 rows from quantize_rows_int8 output — the values the
+    int8 dot operands actually carry; squared norms for the rbf
+    distance expansion must come from THESE rows (the serving bf16
+    path's norms-from-ROUNDED-rows discipline)."""
+    import numpy as np
+
+    return (np.asarray(q, np.float32)
+            * np.asarray(scales, np.float32)[:, None])
+
+
+def int8_kernel_perturbation(x, params: KernelParams, sample: int = 2048,
+                             pairs: int = 4096, seed: int = 0) -> float:
+    """p90 of |K_exact - K_int8-stored| over sampled pairs for any
+    feature kernel — the int8 sibling of bf16_kernel_perturbation,
+    sampling the SAME pair population with the same seed so the two
+    storage candidates are compared on identical pairs. The rounding
+    under test is symmetric per-row int8 quantization of the rows
+    (quantize_rows_int8 round-trip), matching how the serving int8
+    executor's dequant-fused dot sees the union: quantized operands,
+    f64-exact accumulation here standing in for the i32-exact MXU
+    accumulation (integer dots are EXACT — the only error is storage
+    rounding, which is what this samples). rbf norms come from the
+    dequantized rows, as the executor computes them. Host NumPy on a
+    seeded sample; ~ms cost; deterministic for fixed (x, params,
+    seed)."""
+    if params.kind == "precomputed":
+        raise ValueError(
+            "precomputed kernels carry values, not features; there is "
+            "no storage-rounding perturbation to sample")
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    rng = np.random.default_rng(seed)
+    n = x.shape[0]
+    idx = rng.choice(n, min(sample, n), replace=False)
+    s = x[idx].astype(np.float64)
+    q, scales = quantize_rows_int8(x[idx])
+    sq = dequantize_rows_int8(q, scales).astype(np.float64)
+    i = rng.integers(0, len(s), pairs)
+    j = rng.integers(0, len(s), pairs)
+
+    def kvals(a):
+        dots = np.einsum("nd,nd->n", a[i], a[j])
+        if params.kind == "linear":
+            return dots
+        if params.kind == "rbf":
+            nrm = (a ** 2).sum(1)
+            d2 = np.maximum(nrm[i] + nrm[j] - 2.0 * dots, 0.0)
+            return np.exp(-params.gamma * d2)
+        if params.kind == "poly":
+            return (params.gamma * dots + params.coef0) ** params.degree
+        if params.kind == "sigmoid":
+            return np.tanh(params.gamma * dots + params.coef0)
+        raise ValueError(f"unknown kernel kind {params.kind!r}")
+
+    return float(np.percentile(np.abs(kvals(s) - kvals(sq)), 90))
+
+
+def storage_perturbation(x, params: KernelParams, storage: str,
+                         sample: int = 2048, pairs: int = 4096,
+                         seed: int = 0) -> float:
+    """p90|dK| for a named union storage: the ONE sampler dispatch the
+    serving storage guard scales by its coefficient amplifier. 'f32'
+    is exactly 0.0 by definition (no storage rounding)."""
+    if storage == "f32":
+        return 0.0
+    if storage == "bf16":
+        return bf16_kernel_perturbation(x, params, sample=sample,
+                                        pairs=pairs, seed=seed)
+    if storage == "int8":
+        return int8_kernel_perturbation(x, params, sample=sample,
+                                        pairs=pairs, seed=seed)
+    raise ValueError(f"unknown union storage {storage!r}")
+
+
 # C * p90|dK| above this warns (see bf16_rbf_perturbation): calibrated
 # between the measured-failing covtype-stress value (0.46) and the
-# passing headline/adult configs (<= 0.001).
+# passing headline/adult configs (<= 0.001). The int8 serving guard
+# reuses the same threshold: the amplifier (max-column ||coef||_1 for
+# serving, C for training) times p90|dK| bounds the decision-sum
+# perturbation identically regardless of WHICH storage rounding
+# produced dK.
 BF16_RISK_THRESHOLD = 0.1
 
 

@@ -72,28 +72,183 @@ def effective_buckets(buckets, s_rows: int) -> tuple:
     return tuple(b for b in buckets if b <= cap) or (cap,)
 
 
-def warn_if_bf16_serving_risky(ens, kp, stacklevel: int = 4) -> None:
-    """The serving analog of ops/kernels.warn_if_bf16_degrades: the
-    decision-sum perturbation from bf16 feature rounding is bounded by
-    ||coef||_1 * |dK| per column, so the risk scale is the max column
-    L1 norm times the sampled p90 kernel perturbation (the training
-    guard's C plays the same amplifier role there). Shared by
-    PredictServer and the v2 engine's registration path."""
-    sv = np.asarray(ens.sv_union, np.float32)
-    if kp.kind != "rbf" or sv.shape[0] == 0:
-        return
+#: The ladder ``buckets=None`` starts from — the ServeConfig default.
+DEFAULT_BUCKETS = (16, 64, 256, 1024, 4096)
+
+
+def resolve_buckets(config: ServeConfig, device_kind: str = ""):
+    """``ServeConfig.buckets`` resolution (ISSUE 17 second axis — the
+    solver's ``resolve_auto_gate`` discipline applied to the bucket
+    ladder). Returns ``(ladder, provenance)``.
+
+    An explicit tuple ALWAYS wins: ``{"source": "config"}``, no
+    profile consulted, no auto-apply. ``buckets=None`` consults the
+    installed DeviceProfile's ``serve_buckets`` probe (graduated from
+    PR 14's report-only advice): the ladder starts at the default
+    either way — the probe measures whether dispatch cost tracks the
+    bucket on this device, not what traffic will arrive — and the
+    provenance carries ``auto_apply``, True only on an AUTHORITATIVE
+    pays verdict (the honesty rule: CPU-harness verdicts pin False,
+    so CI never auto-applies). With ``auto_apply`` the v2 engine
+    applies its own occupancy suggestion (engine_core.suggest_buckets)
+    between serving legs, recording what it applied in the same
+    provenance record."""
+    if config.buckets is not None:
+        return tuple(config.buckets), {
+            "source": "config",
+            "buckets": [int(b) for b in config.buckets]}
+    from dpsvm_tpu.autotune.profile import gate_decision
+    gd = gate_decision("serve_buckets", device_kind or None)
+    if gd is None:
+        return DEFAULT_BUCKETS, {
+            "source": "default", "buckets": list(DEFAULT_BUCKETS),
+            "auto_apply": False,
+            "note": "no profile decision for serve_buckets; "
+                    "default ladder"}
+    return DEFAULT_BUCKETS, {
+        "source": "profile", **gd,
+        "buckets": list(DEFAULT_BUCKETS),
+        "auto_apply": bool(gd["decision"])}
+
+
+def stage_union_host(sv_f32: np.ndarray, storage: str):
+    """Host-side union staging for one RESOLVED storage: returns
+    ``(sv_store, sv_scale, sv_sq)`` — the rows in their storage dtype,
+    the per-row f32 dequant scales (None except int8), and the squared
+    norms computed from the ROUNDED/DEQUANTIZED values the dot
+    operands actually carry (the serve.py norms discipline). ONE
+    definition shared by PredictServer._stage and the v2 engine's
+    UnionGroup."""
+    if storage == "bf16":
+        import ml_dtypes
+        sv_store = sv_f32.astype(ml_dtypes.bfloat16)
+        sv_sq = (sv_store.astype(np.float32) ** 2).sum(
+            1, dtype=np.float32)
+        return sv_store, None, sv_sq
+    if storage == "int8":
+        from dpsvm_tpu.ops.kernels import (dequantize_rows_int8,
+                                           quantize_rows_int8)
+        sv_q, scales = quantize_rows_int8(sv_f32)
+        deq = dequantize_rows_int8(sv_q, scales)
+        sv_sq = (deq * deq).sum(1, dtype=np.float32)
+        return sv_q, scales, sv_sq
+    if storage != "f32":
+        raise ValueError(f"unknown union storage {storage!r}")
+    return sv_f32, None, (sv_f32 * sv_f32).sum(1, dtype=np.float32)
+
+
+def union_nbytes(storage: str, s_rows: int, d: int) -> int:
+    """Resident union-operand bytes at a storage: rows plus (for int8)
+    the per-row f32 dequant scales — the serving_union_bytes gauge's
+    one definition, and the 4x-cut arithmetic the bench leg reports."""
+    per_elem = {"f32": 4, "bf16": 2, "int8": 1}[storage]
+    return s_rows * d * per_elem + (4 * s_rows
+                                    if storage == "int8" else 0)
+
+
+def resolve_union_storage(ens, kp, requested: str,
+                          stacklevel: int = 4):
+    """The ONE serving storage guard (ISSUE 17): decide what precision
+    the SV union actually stages at for THIS model, given the
+    REQUESTED ``ServeConfig.union_storage`` ('f32'|'bf16'|'int8'|
+    'auto'). The decision-sum perturbation from storage rounding is
+    bounded per column by ``||coef||_1 * |dK|``, so the risk scale is
+    the max column L1 norm times the sampled p90 kernel perturbation
+    (ops/kernels.storage_perturbation — bf16 cast or int8 per-row
+    quantization round-trip, every feature kernel family), against the
+    same calibrated threshold as training's bf16-Gram gate.
+
+    Semantics per request:
+      * 'f32'  — trivially accepted (no storage rounding).
+      * 'bf16' — legacy warn-but-proceed (the pre-int8 dtype=
+        'bfloat16' contract, pinned by tests): stages bf16 either
+        way, with a LOUD warning + note when the bound refuses.
+      * 'int8' — the bound ADJUDICATES: refused int8 falls back to
+        the widest narrower storage the same bound accepts (bf16,
+        else f32) with a loud warning + note — quantized serving is
+        never silently wrong, and never silently degrades either.
+      * 'auto' — narrowest storage the bound accepts (int8 -> bf16 ->
+        f32), silently: auto is a request to pick, not a promise.
+
+    Precomputed-kernel ensembles and empty unions have no feature rows
+    to round — they resolve to 'f32'. Risk-routed f64 columns always
+    see the unquantized union regardless (the _overwrite_f64 paths
+    read ``ens.sv_union`` raw).
+
+    Returns ``(effective_storage, entry)`` where ``entry`` is the
+    JSON-able guard record (requested/effective/risks/threshold and a
+    loud ``note`` on refusal) that staging merges into its stats.
+    Shared by PredictServer._stage and the v2 engine's registration
+    path (serving/dispatch._prepare_entry)."""
     from dpsvm_tpu.ops.kernels import (BF16_RISK_THRESHOLD,
-                                       bf16_rbf_perturbation)
+                                       storage_perturbation)
+    if requested not in ("f32", "bf16", "int8", "auto"):
+        raise ValueError(f"unknown union storage {requested!r}")
+    entry = {"requested": requested,
+             "threshold": BF16_RISK_THRESHOLD}
+    if requested == "f32":
+        entry.update(effective="f32", risks={"f32": 0.0})
+        return "f32", entry
+    sv = np.asarray(ens.sv_union, np.float32)
+    if kp.kind == "precomputed" or sv.shape[0] == 0:
+        entry.update(effective="f32", risks={},
+                     note="no feature rows to quantize (precomputed "
+                          "kernel or empty union); union stays f32")
+        return "f32", entry
     l1 = float(np.abs(ens.coef).sum(axis=0).max())
-    risk = l1 * bf16_rbf_perturbation(sv, kp.gamma)
-    if risk > BF16_RISK_THRESHOLD:
-        warnings.warn(
-            f"dtype='bfloat16' is likely to perturb decision values "
-            f"for this model: max-column ||coef||_1 * p90|dK| = "
-            f"{risk:.3f} > {BF16_RISK_THRESHOLD} (same amplification "
-            f"mechanism as training's bf16 guard, ops/kernels.py). "
-            f"Use dtype='float32' for this ensemble.",
-            stacklevel=stacklevel)
+    risks: dict = {}
+
+    def accepts(storage: str) -> bool:
+        risks[storage] = round(
+            l1 * storage_perturbation(sv, kp, storage), 6)
+        return risks[storage] <= BF16_RISK_THRESHOLD
+
+    if requested == "auto":
+        for st in ("int8", "bf16"):
+            if accepts(st):
+                entry.update(effective=st, risks=risks)
+                return st, entry
+        entry.update(effective="f32", risks=risks,
+                     note="auto storage: int8 and bf16 both exceed "
+                          "the perturbation bound; union stays f32")
+        return "f32", entry
+    if accepts(requested):
+        entry.update(effective=requested, risks=risks)
+        return requested, entry
+    if requested == "int8":
+        effective = "bf16" if accepts("bf16") else "f32"
+        note = (
+            f"union_storage='int8' REFUSED for this model: max-column "
+            f"||coef||_1 * p90|dK| = {risks['int8']:.4g} > "
+            f"{BF16_RISK_THRESHOLD} — per-row int8 quantization at "
+            f"this (coef, kernel, data) risks O(1) decision changes "
+            f"(the training bf16 guard's amplification mechanism, "
+            f"ops/kernels.py); union stays {effective}")
+        entry.update(effective=effective, risks=risks, note=note)
+        warnings.warn(note, stacklevel=stacklevel)
+        return effective, entry
+    # requested == "bf16": legacy warn-but-proceed contract.
+    note = (
+        f"dtype='bfloat16' is likely to perturb decision values "
+        f"for this model: max-column ||coef||_1 * p90|dK| = "
+        f"{risks['bf16']:.3f} > {BF16_RISK_THRESHOLD} (same "
+        f"amplification mechanism as training's bf16 guard, "
+        f"ops/kernels.py). Use dtype='float32' for this ensemble.")
+    entry.update(effective="bf16", risks=risks, note=note)
+    warnings.warn(note, stacklevel=stacklevel)
+    return "bf16", entry
+
+
+def warn_if_bf16_serving_risky(ens, kp, stacklevel: int = 4) -> None:
+    """The serving analog of ops/kernels.warn_if_bf16_degrades,
+    generalized off rbf-only onto every feature kernel family (ISSUE
+    17 satellite — linear/poly/sigmoid serving previously skipped the
+    guard silently): delegates to the shared storage guard's 'bf16'
+    arm, which warns loudly when max-column ||coef||_1 * p90|dK|
+    crosses the calibrated threshold."""
+    if kp.kind == "precomputed":
+        return
+    resolve_union_storage(ens, kp, "bf16", stacklevel=stacklevel + 1)
 
 
 def _dense_batch_factory():
@@ -125,13 +280,69 @@ def _dense_batch_factory():
     return batch
 
 
+_DENSE_BATCH_INT8 = None
+
+
+def _dense_batch_int8_factory():
+    """Single-device jitted int8 serving executor (ISSUE 17): the
+    dequant-fused sibling of _dense_batch_factory. The union rows
+    arrive PRE-quantized (staging-time, ops/kernels.quantize_rows_int8
+    — symmetric per-row, f32 scales); queries quantize per-row ON
+    DEVICE, the dot runs int8 x int8 on the MXU with i32 accumulation
+    (EXACT — integer dots carry no rounding), and one fused rank-1
+    rescale ``i32 * (t_q ⊗ s_sv)`` dequantizes straight into the f32
+    decision algebra. HBM reads of the union are 1/4 of f32 storage.
+    rbf norms come from the DEQUANTIZED values on both sides — the
+    dot operands' values — or the |q|^2 + |s|^2 - 2 q.s expansion is
+    inconsistent (the bf16 path's norms-from-ROUNDED-rows
+    discipline); sv_sq is precomputed host-side from the dequantized
+    union at staging."""
+    global _DENSE_BATCH_INT8
+    if _DENSE_BATCH_INT8 is not None:
+        return _DENSE_BATCH_INT8
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import kernel_from_dots
+
+    @partial(jax.jit, static_argnames=("kp",))
+    def batch(qb, sv_q, sv_scale, sv_sq, coef, b, kp):
+        qf = qb.astype(jnp.float32)
+        t = jnp.max(jnp.abs(qf), axis=1) / 127.0
+        t = jnp.where(t > 0, t, 1.0)
+        q_q = jnp.clip(jnp.round(qf / t[:, None]),
+                       -127, 127).astype(jnp.int8)
+        idots = jnp.dot(q_q, sv_q.T, preferred_element_type=jnp.int32)
+        dots = idots.astype(jnp.float32) * (t[:, None]
+                                            * sv_scale[None, :])
+        qd = q_q.astype(jnp.float32) * t[:, None]
+        kv = kernel_from_dots(dots, sv_sq,
+                              jnp.einsum("nd,nd->n", qd, qd), kp)
+        return kv @ coef - b[None, :]
+
+    _DENSE_BATCH_INT8 = batch
+    return batch
+
+
 @functools.lru_cache(maxsize=16)
 def _mesh_serve_executor(n_dev: int, kp, dtype_str: str):
     """(mesh, mapped) for the union-sharded serving decision: each device
     holds S/n_dev union rows (+ matching coefficient rows) and computes a
     partial (n, k) contraction; one psum combines the columns. Cached per
     mesh-width/kernel/storage-dtype (jit caches by function identity —
-    the predict._mesh_decision_executor discipline)."""
+    the predict._mesh_decision_executor discipline).
+
+    ``dtype_str == 'int8'`` selects the quantized variant (ISSUE 17):
+    the operand tuple gains the per-row f32 scales, which SHARD WITH
+    their union row blocks (same P(DATA_AXIS) spec — scale i belongs
+    to row i wherever that row lands); queries quantize per-row on
+    device identically on every mesh member (replicated input, same
+    values), the local dequant-fused partial contraction is the
+    single-chip algebra on the local rows, and the psum combine is
+    UNCHANGED. Pad rows are zeros with zero coefficient rows, so they
+    stay inert exactly as in the f32/bf16 shardings (their scale pads
+    to 0, zeroing the pad dots before the kernel map; the zero coef
+    rows zero the contraction regardless)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -142,6 +353,30 @@ def _mesh_serve_executor(n_dev: int, kp, dtype_str: str):
                                          mesh_shard_map)
 
     mesh = make_data_mesh(n_dev)
+
+    if dtype_str == "int8":
+        def shard_fn_int8(qb, sv_q_loc, sv_scale_loc, sv_sq_loc,
+                          coef_loc, b):
+            qf = qb.astype(jnp.float32)
+            t = jnp.max(jnp.abs(qf), axis=1) / 127.0
+            t = jnp.where(t > 0, t, 1.0)
+            q_q = jnp.clip(jnp.round(qf / t[:, None]),
+                           -127, 127).astype(jnp.int8)
+            idots = jnp.dot(q_q, sv_q_loc.T,
+                            preferred_element_type=jnp.int32)
+            dots = idots.astype(jnp.float32) * (
+                t[:, None] * sv_scale_loc[None, :])
+            qd = q_q.astype(jnp.float32) * t[:, None]
+            kv = kernel_from_dots(dots, sv_sq_loc,
+                                  jnp.einsum("nd,nd->n", qd, qd), kp)
+            return lax.psum(kv @ coef_loc, DATA_AXIS) - b[None, :]
+
+        mapped = jax.jit(mesh_shard_map(
+            shard_fn_int8, mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P()),
+            out_specs=P()))
+        return mesh, mapped
 
     def shard_fn(qb, sv_loc, sv_sq_loc, coef_loc, b):
         qc = qb.astype(sv_loc.dtype)
@@ -205,13 +440,17 @@ class PredictServer:
             self.f64_cols = np.zeros((0,), np.int64)
         self._all_f64 = len(self.f64_cols) == self.k
 
-        # --- effective buckets: cap the per-dispatch (bucket, S) kernel
-        # tile at the same ~1 GB budget the model-layer paths bound
-        # their tiles to (multiclass._compacted_decision) — a
-        # covtype-scale union must trim the large default buckets
-        # instead of OOMing during warm-up.
+        # --- effective buckets: explicit config wins; buckets=None
+        # resolves through the DeviceProfile serve_buckets gate with
+        # full provenance (resolve_buckets). Then cap the per-dispatch
+        # (bucket, S) kernel tile at the same ~1 GB budget the
+        # model-layer paths bound their tiles to
+        # (multiclass._compacted_decision) — a covtype-scale union
+        # must trim the large default buckets instead of OOMing
+        # during warm-up.
         s_rows = int(self.ens.sv_union.shape[0])
-        self.buckets = effective_buckets(config.buckets, s_rows)
+        ladder, self.bucket_provenance = resolve_buckets(config)
+        self.buckets = effective_buckets(ladder, s_rows)
 
         # --- device staging (once; resident for the server lifetime) -
         self._stage()
@@ -265,6 +504,12 @@ class PredictServer:
                 b: self.metrics.histogram(f"serve.bucket_seconds.{b}")
                 for b in self.buckets},
             "warm_seconds": {}, "f64_columns": len(self.f64_cols),
+            # Storage guard outcome (resolve_union_storage): what the
+            # union actually staged at, with the risk record — a
+            # refused narrow request is never silent in the stats.
+            "union_storage": self.union_storage,
+            "storage_guard": self.storage_guard,
+            "bucket_provenance": self.bucket_provenance,
         }
         # Run-log layer (off unless config.obs / DPSVM_OBS enables it):
         # manifest at construction; close() writes the final snapshot.
@@ -273,7 +518,8 @@ class PredictServer:
                                   "n_union": int(self.ens.n_union),
                                   "strategy": self.strategy,
                                   "buckets": list(self.buckets),
-                                  "f64_columns": len(self.f64_cols)})
+                                  "f64_columns": len(self.f64_cols),
+                                  "union_storage": self.union_storage})
         self._pending: list = []  # (ticket, (n, d) rows)
         self._pending_rows = 0
         self._done: dict = {}
@@ -306,17 +552,16 @@ class PredictServer:
         import jax.numpy as jnp
 
         cfg = self.config
+        # The ONE storage guard (resolve_union_storage): what the
+        # union actually stages at for THIS model — refused narrow
+        # requests fall back loudly; auto picks the narrowest storage
+        # the perturbation bound accepts.
+        self.union_storage, self.storage_guard = resolve_union_storage(
+            self.ens, self.kp, cfg.effective_union_storage(),
+            stacklevel=5)
         sv = np.ascontiguousarray(self.ens.sv_union, np.float32)
-        if cfg.dtype == "bfloat16":
-            self._bf16_guard(sv)
-            import ml_dtypes
-            sv_store = sv.astype(ml_dtypes.bfloat16)
-            # Norms from the ROUNDED rows — the dot operands' values.
-            sv_sq = (sv_store.astype(np.float32) ** 2).sum(
-                1, dtype=np.float32)
-        else:
-            sv_store = sv
-            sv_sq = (sv * sv).sum(1, dtype=np.float32)
+        sv_store, sv_scale, sv_sq = stage_union_host(
+            sv, self.union_storage)
         coef = np.ascontiguousarray(self.ens.coef, np.float32)
         b = np.ascontiguousarray(self.ens.b, np.float32)
 
@@ -330,32 +575,42 @@ class PredictServer:
             from dpsvm_tpu.parallel.mesh import (replicate_array,
                                                  shard_padded_rows)
             mesh, mapped = _mesh_serve_executor(cfg.num_devices, self.kp,
-                                                cfg.dtype)
+                                                self.union_storage)
             sv_d = shard_padded_rows(mesh, sv_store)
             sv_sq_d = shard_padded_rows(mesh, sv_sq)
             coef_d = shard_padded_rows(mesh, coef)  # pad rows: coef 0
             b_d = replicate_array(mesh, b)
+            if self.union_storage == "int8":
+                # Scales shard WITH their row blocks; pad scales are
+                # zeros (inert — zero coef rows already silence pads).
+                scale_d = shard_padded_rows(mesh, sv_scale)
 
-            def call(qb, _m=mapped, _mesh=mesh):
-                return _m(replicate_array(_mesh, qb),
-                          sv_d, sv_sq_d, coef_d, b_d)
+                def call(qb, _m=mapped, _mesh=mesh):
+                    return _m(replicate_array(_mesh, qb), sv_d,
+                              scale_d, sv_sq_d, coef_d, b_d)
+            else:
+                def call(qb, _m=mapped, _mesh=mesh):
+                    return _m(replicate_array(_mesh, qb),
+                              sv_d, sv_sq_d, coef_d, b_d)
         else:
-            batch = _dense_batch_factory()
             sv_d = jnp.asarray(sv_store)
             sv_sq_d = jnp.asarray(sv_sq)
             coef_d = jnp.asarray(coef)
             b_d = jnp.asarray(b)
+            if self.union_storage == "int8":
+                batch = _dense_batch_int8_factory()
+                scale_d = jnp.asarray(sv_scale)
 
-            def call(qb, _kp=self.kp):
-                return batch(jnp.asarray(qb), sv_d, sv_sq_d, coef_d,
-                             b_d, _kp)
+                def call(qb, _kp=self.kp):
+                    return batch(jnp.asarray(qb), sv_d, scale_d,
+                                 sv_sq_d, coef_d, b_d, _kp)
+            else:
+                batch = _dense_batch_factory()
+
+                def call(qb, _kp=self.kp):
+                    return batch(jnp.asarray(qb), sv_d, sv_sq_d,
+                                 coef_d, b_d, _kp)
         self._call = call
-
-    def _bf16_guard(self, sv: np.ndarray) -> None:
-        """Delegates to the shared serving bf16 guard (module level —
-        the v2 engine's registration path runs the same check)."""
-        del sv  # the shared guard reads the ensemble's own union rows
-        warn_if_bf16_serving_risky(self.ens, self.kp, stacklevel=5)
 
     # ------------------------------------------------------------- warmup
     def warm(self) -> dict:
@@ -565,6 +820,14 @@ class PredictServer:
             om.gauge("serve_sv_union_rows",
                      "resident SV-union rows",
                      [(model_lb, int(self.ens.n_union))]),
+            om.gauge("serve_union_bytes",
+                     "resident SV-union operand bytes at the staged "
+                     "storage (rows + int8 dequant scales)",
+                     [({**model_lb,
+                        "union_storage": self.union_storage},
+                       union_nbytes(self.union_storage,
+                                    int(self.ens.sv_union.shape[0]),
+                                    self.d))]),
         ]
         if len(self.request_seconds):
             fams.append(om.summary(

@@ -26,7 +26,8 @@ from dpsvm_tpu.config import ServeConfig
 from dpsvm_tpu.obs import compilelog, run_obs
 from dpsvm_tpu.obs import export as openmetrics
 from dpsvm_tpu.obs.metrics import Registry
-from dpsvm_tpu.serve import warn_if_bf16_serving_risky
+from dpsvm_tpu.serve import (resolve_buckets, resolve_union_storage,
+                             union_nbytes)
 from dpsvm_tpu.serving.engine_core import (AsyncDispatcher,  # noqa: F401
                                            UnionGroup, _overwrite_f64,
                                            suggest_buckets)
@@ -106,6 +107,14 @@ class ServingEngine:
                  replica: Optional[int] = None):
         self.config = config
         self.replica = None if replica is None else int(replica)
+        # Bucket-ladder resolution (ISSUE 17 second axis): explicit
+        # config wins; buckets=None resolves through the DeviceProfile
+        # serve_buckets gate. The provenance records the source, and —
+        # with an authoritative pays verdict — arms the occupancy
+        # auto-apply (maybe_apply_bucket_suggestion, run between
+        # serving legs by drain()).
+        ladder, self.bucket_provenance = resolve_buckets(config)
+        self._bucket_ladder = tuple(ladder)
         self.scheduler = Scheduler()
         self.registry = ModelRegistry(prepare=self._prepare_entry,
                                       on_swap=self._on_swap)
@@ -191,8 +200,12 @@ class ServingEngine:
 
         self._obs = run_obs("serve", config,
                             meta={"engine": "serving_v2",
-                                  "buckets": list(config.buckets),
+                                  "buckets": list(self._bucket_ladder),
+                                  "bucket_source":
+                                      self.bucket_provenance["source"],
                                   "dtype": config.dtype,
+                                  "union_storage":
+                                      config.effective_union_storage(),
                                   "deadline_ms": config.deadline_ms,
                                   **({"replica": self.replica}
                                      if self.replica is not None
@@ -255,6 +268,35 @@ class ServingEngine:
                 raise
 
     # ------------------------------------------------------ registration
+    def _storage_of(self, entry: LoadedModel) -> str:
+        """The entry's RESOLVED union storage (ISSUE 17): the config's
+        requested storage adjudicated per model by the shared guard
+        (serve.resolve_union_storage — a refused int8 request falls
+        back loudly; auto picks the narrowest accepted storage).
+        Resolved ONCE per entry and cached on it: the token is part of
+        the entry's group key, so two models whose guard verdicts
+        differ stage in DIFFERENT groups and a hot swap between
+        storage dtypes restages correctly."""
+        st = getattr(entry, "union_storage", None)
+        if st is None:
+            st, guard = resolve_union_storage(
+                entry.ens, entry.kp,
+                self.config.effective_union_storage(), stacklevel=7)
+            entry.union_storage = st
+            entry.storage_guard = guard
+            if guard.get("note"):
+                self._obs.event("storage_guard", model=entry.name,
+                                version=entry.version, **guard)
+        return st
+
+    def _group_config(self) -> ServeConfig:
+        """The config union groups stage under: the engine's CURRENT
+        bucket ladder substituted for a ``buckets=None`` marker (the
+        auto-apply path swaps the ladder between legs)."""
+        if self.config.buckets == self._bucket_ladder:
+            return self.config
+        return self.config.replace(buckets=self._bucket_ladder)
+
     def _members_for(self, key, extra=None) -> list:
         """Current membership of a union group: live registry entries
         plus entries still holding queued work (an old version keeps
@@ -262,10 +304,12 @@ class ServingEngine:
         the incoming entry when preparing a swap."""
         seen: list = []
         for e in self.registry.entries():
-            if e.group_key(self.config.dtype) == key and e not in seen:
+            if e.group_key(self._storage_of(e)) == key \
+                    and e not in seen:
                 seen.append(e)
         for e in self.scheduler.pending_entries():
-            if e.group_key(self.config.dtype) == key and e not in seen:
+            if e.group_key(self._storage_of(e)) == key \
+                    and e not in seen:
                 seen.append(e)
         if extra is not None and extra not in seen:
             seen.append(extra)
@@ -274,18 +318,18 @@ class ServingEngine:
     def _prepare_entry(self, entry: LoadedModel) -> None:
         """Registry prepare hook: stage + warm the incoming version's
         union group BEFORE the routing pointer flips — the
-        zero-downtime half of the hot-swap contract. Runs the bf16
-        quality guard when the engine stores unions in bfloat16."""
-        if self.config.dtype == "bfloat16":
-            warn_if_bf16_serving_risky(entry.ens, entry.kp,
-                                       stacklevel=6)
+        zero-downtime half of the hot-swap contract. Storage
+        resolution (_storage_of) runs the quality guard here: a
+        refused narrow storage warns during registration, off the
+        request path."""
+        storage = self._storage_of(entry)
         with self._prep_lock:
             self._preparing += 1  # parks _gc_groups: the GC must not
         try:                      # shrink away a group being prepared
-            key = entry.group_key(self.config.dtype)
+            key = entry.group_key(storage)
             group = UnionGroup(key,
                                self._members_for(key, extra=entry),
-                               self.config)
+                               self._group_config(), storage=storage)
             self._tl.in_dispatch = True
             try:
                 group.warm()
@@ -371,7 +415,7 @@ class ServingEngine:
         self.scheduler.submit(
             entry, q, now,
             None if deadline_ms is None else deadline_ms / 1e3,
-            ticket, self.config.dtype)
+            ticket, self._storage_of(entry))
         mm = self._model_metrics(entry.name)
         mm["requests"].add(1)
         mm["rows"].add(q.shape[0])
@@ -425,6 +469,10 @@ class ServingEngine:
             while self.scheduler.queue_depth or self._dispatcher.busy:
                 self.pump()
             self._gc_groups()
+            # Between-legs idle moment: the only place the profile-
+            # gated bucket auto-apply may swap the ladder (queues are
+            # empty, nothing staged is mid-flight).
+            self.maybe_apply_bucket_suggestion()
             return self.results()
 
     def results(self) -> dict:
@@ -436,12 +484,14 @@ class ServingEngine:
     def _group_for(self, key) -> UnionGroup:
         """The staged group for a key — normally staged by the prepare
         hook; restaged here only if a queued request's entry is not in
-        the staged member set (possible after an unregister)."""
+        the staged member set (possible after an unregister), or if
+        the bucket ladder changed under the auto-apply."""
         group = self._groups.get(key)
         needed = {e for e in self.scheduler.pending_entries()
-                  if e.group_key(self.config.dtype) == key}
+                  if e.group_key(self._storage_of(e)) == key}
         if group is None or not needed <= group.member_set():
-            group = UnionGroup(key, self._members_for(key), self.config)
+            group = UnionGroup(key, self._members_for(key),
+                               self._group_config(), storage=key[-1])
             self._tl.in_dispatch = True
             try:
                 group.warm()
@@ -463,13 +513,14 @@ class ServingEngine:
         live_keys: dict = {}
         for e in self.registry.entries():
             live_keys.setdefault(
-                e.group_key(self.config.dtype), []).append(e)
+                e.group_key(self._storage_of(e)), []).append(e)
         for key in list(self._groups):
             members = live_keys.get(key)
             if members is None:
                 del self._groups[key]
             elif set(members) != self._groups[key].member_set():
-                group = UnionGroup(key, members, self.config)
+                group = UnionGroup(key, members, self._group_config(),
+                                   storage=key[-1])
                 self._tl.in_dispatch = True
                 try:
                     group.warm()
@@ -670,21 +721,59 @@ class ServingEngine:
         self._front = front
 
     def bucket_suggestion(self) -> dict:
-        """Report-only ``ServeConfig.buckets`` advice from the
+        """Occupancy-driven ``ServeConfig.buckets`` advice from the
         engine's own dispatch telemetry (ISSUE 14 satellite; closes
         the ROADMAP item 2 occupancy-autotuning stub). Pure host read
-        of the batch_rows histogram window — never applied
-        automatically: whether right-sizing pays at all is a DEVICE
-        property (the autotune ``serve_buckets`` probe measures it),
-        so applying the suggestion stays behind the profile
+        of the batch_rows histogram window. Report-only UNLESS
+        ``buckets=None`` resolved to an armed auto-apply
+        (maybe_apply_bucket_suggestion): whether right-sizing pays at
+        all is a DEVICE property (the autotune ``serve_buckets``
+        probe measures it), so applying stays behind the profile
         discipline."""
         return suggest_buckets(self.batch_rows.window_values(),
-                               self.config.buckets)
+                               self._bucket_ladder)
+
+    def maybe_apply_bucket_suggestion(self):
+        """Profile-gated bucket AUTO-APPLY (ISSUE 17 second axis —
+        PR 14's report-only advice graduated). No-op — returns None —
+        unless ALL of:
+          * ``config.buckets is None`` (an explicit ladder always
+            wins: the resolve_auto_gate discipline),
+          * the resolved provenance carries ``auto_apply`` (an
+            AUTHORITATIVE serve_buckets pays verdict in the active
+            DeviceProfile — CPU-harness verdicts pin False, so CI
+            never flips this),
+          * the occupancy suggestion exists and differs from the
+            current ladder.
+        On apply: swaps the engine's ladder, drops staged groups (they
+        restage lazily, off the idle moment this runs in — drain()
+        calls this between serving legs, with queues empty), and
+        extends the provenance with what was applied so the snapshot
+        carries the full decision trail."""
+        if self.config.buckets is not None \
+                or not self.bucket_provenance.get("auto_apply"):
+            return None
+        sug = self.bucket_suggestion()
+        ladder = sug.get("suggested_buckets")
+        if not ladder or tuple(ladder) == self._bucket_ladder:
+            return None
+        self._bucket_ladder = tuple(int(b) for b in ladder)
+        self.bucket_provenance = {
+            **self.bucket_provenance,
+            "applied_buckets": list(self._bucket_ladder),
+            "suggestion": sug}
+        self._groups.clear()
+        self._obs.event("buckets_auto_applied",
+                        buckets=list(self._bucket_ladder),
+                        occupancy=sug.get("projected_occupancy"))
+        return list(self._bucket_ladder)
 
     def snapshot(self) -> dict:
         """JSON-able engine state: counters, queue state, histogram
         snapshots, per-model breakdown — the serve run log's final
         record and the loadgen artifact both consume this shape."""
+        storage_by_model = {e.name: self._storage_of(e)
+                            for e in self.registry.entries()}
         per_model = {}
         for name, mm in sorted(self._per_model.items()):
             per_model[name] = {
@@ -695,7 +784,10 @@ class ServingEngine:
                 "swaps": mm["swaps"].value,
                 "dispatch_failures": mm["failures"].value,
                 "request_seconds": mm["latency"].snapshot(),
+                **({"union_storage": storage_by_model[name]}
+                   if name in storage_by_model else {}),
             }
+        staged = list(self._groups.values())
         return {
             "models": self.registry.names(),
             "versions": {e.name: e.version
@@ -719,6 +811,12 @@ class ServingEngine:
             "batch_occupancy": self.batch_occupancy.snapshot(),
             "dispatch_seconds": self.dispatch_seconds.snapshot(),
             "request_seconds": self.request_seconds.snapshot(),
+            "union_bytes": sum(g.union_bytes for g in staged),
+            "quantized_unions": sum(
+                1 for g in staged if g.union_storage == "int8"),
+            "union_storage": storage_by_model,
+            "buckets": list(self._bucket_ladder),
+            "bucket_provenance": self.bucket_provenance,
             "per_model": per_model,
             **({"net": self._front.net_snapshot()}
                if self._front is not None else {}),
@@ -787,6 +885,21 @@ class ServingEngine:
             om.counter("serving_compiles",
                        "bucket executors compiled while serving",
                        self.compiles.value),
+            om.gauge("serving_union_bytes",
+                     "staged union argument bytes per model at its "
+                     "resolved storage (int8 includes the f32 row "
+                     "scales)",
+                     [({"model": e.name,
+                        "union_storage": self._storage_of(e)},
+                       union_nbytes(self._storage_of(e),
+                                    int(e.ens.sv_union.shape[0]),
+                                    int(e.ens.sv_union.shape[1])))
+                      for e in sorted(self.registry.entries(),
+                                      key=lambda e: e.name)]),
+            om.gauge("serving_quantized_unions",
+                     "staged union groups serving from int8 rows",
+                     [({}, sum(1 for g in self._groups.values()
+                               if g.union_storage == "int8"))]),
         ]
         if lat_samples:
             fams.append(om.metric(
@@ -805,15 +918,16 @@ class ServingEngine:
                 "recent window", self.dispatch_seconds))
         sug = self.bucket_suggestion()
         if sug.get("suggested_buckets"):
-            # Report-only occupancy-driven bucket advice (ISSUE 14):
-            # one gauge sample per suggested ladder slot, so an
-            # operator's dashboard can see the suggestion drift under
-            # live traffic without log scraping. Never self-applied.
+            # Occupancy-driven bucket advice (ISSUE 14): one gauge
+            # sample per suggested ladder slot, so an operator's
+            # dashboard can see the suggestion drift under live
+            # traffic without log scraping. Self-applied only when
+            # buckets=None resolved to an armed auto-apply (ISSUE 17).
             fams.append(om.gauge(
                 "serving_suggested_bucket",
                 "occupancy-driven ServeConfig.buckets suggestion "
-                "(report-only; apply behind the autotune profile "
-                "discipline)",
+                "(applied between legs only under the profile-gated "
+                "auto-apply; otherwise report-only)",
                 [({"slot": str(i)}, b)
                  for i, b in enumerate(sug["suggested_buckets"])]))
         if self._front is not None:

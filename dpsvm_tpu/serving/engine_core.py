@@ -53,8 +53,11 @@ import numpy as np
 from dpsvm_tpu.config import ServeConfig
 from dpsvm_tpu.obs import compilelog
 from dpsvm_tpu.obs.trace import span
-from dpsvm_tpu.serve import (_dense_batch_factory, _mesh_serve_executor,
-                             effective_buckets)
+from dpsvm_tpu.serve import (_dense_batch_factory,
+                             _dense_batch_int8_factory,
+                             _mesh_serve_executor, effective_buckets,
+                             resolve_union_storage, stage_union_host,
+                             union_nbytes)
 from dpsvm_tpu.serving.registry import LoadedModel
 from dpsvm_tpu.testing import faults
 
@@ -70,9 +73,20 @@ class UnionGroup:
     ``mesh_devices`` is the number of devices the union rows shard
     over: 1 for the single-chip staging, ``config.num_devices`` for
     the mesh variant (whose decision columns the bitwise pin in
-    tests/test_serve_replicas.py holds to the single-chip group)."""
+    tests/test_serve_replicas.py holds to the single-chip group).
 
-    def __init__(self, key, members, config: ServeConfig):
+    ``storage`` is the RESOLVED union storage token ('f32'|'bf16'|
+    'int8') — dispatch.py resolves it per entry through the shared
+    guard (serve.resolve_union_storage) and bakes it into the group
+    key, so every member of a group staged here already accepted this
+    storage; None (direct construction, tests) resolves here from the
+    config request against the base member. int8 groups stage the
+    per-row dequant scales alongside the rows — mesh-sharded WITH
+    their row blocks (same P(DATA_AXIS) placement; the psum combine
+    is unchanged)."""
+
+    def __init__(self, key, members, config: ServeConfig,
+                 storage: str = None):
         import jax.numpy as jnp
 
         self.key = key
@@ -81,8 +95,17 @@ class UnionGroup:
         self.kp = base.kernel
         self.d = int(base.sv_union.shape[1])
         self.s_rows = int(base.sv_union.shape[0])
-        self.buckets = effective_buckets(config.buckets, self.s_rows)
+        buckets = config.buckets
+        if buckets is None:
+            from dpsvm_tpu.serve import resolve_buckets
+            buckets, _ = resolve_buckets(config)
+        self.buckets = effective_buckets(buckets, self.s_rows)
         self.mesh_devices = 1
+        if storage is None:
+            storage, _ = resolve_union_storage(
+                base, self.kp, config.effective_union_storage())
+        self.union_storage = storage
+        self.union_bytes = union_nbytes(storage, self.s_rows, self.d)
         self.slices: dict = {}
         lo = 0
         coefs, bs = [], []
@@ -99,22 +122,15 @@ class UnionGroup:
             self._call = None
             return
         sv = np.ascontiguousarray(base.sv_union, np.float32)
-        if config.dtype == "bfloat16":
-            import ml_dtypes
-            sv_store = sv.astype(ml_dtypes.bfloat16)
-            # Norms from the ROUNDED rows — the dot operands' values
-            # (the serve.py _stage discipline).
-            sv_sq = (sv_store.astype(np.float32) ** 2).sum(
-                1, dtype=np.float32)
-        else:
-            sv_store = sv
-            sv_sq = (sv * sv).sum(1, dtype=np.float32)
+        # Norms from the ROUNDED/DEQUANTIZED rows — the dot operands'
+        # values (the serve.py _stage discipline, shared helper).
+        sv_store, sv_scale, sv_sq = stage_union_host(sv, storage)
         if config.num_devices > 1:
             from dpsvm_tpu.parallel.mesh import (replicate_array,
                                                  shard_padded_rows)
 
             mesh, mapped = _mesh_serve_executor(
-                config.num_devices, self.kp, config.dtype)
+                config.num_devices, self.kp, storage)
             self.mesh_devices = int(mesh.size)
             # Pad rows are zeros with ZERO coefficient rows — inert in
             # the psum'd contraction (the shard_padded_rows contract),
@@ -123,20 +139,34 @@ class UnionGroup:
             sv_sq_d = shard_padded_rows(mesh, sv_sq)
             coef_d = shard_padded_rows(mesh, np.hstack(coefs))
             b_d = replicate_array(mesh, self.b_host)
+            if storage == "int8":
+                scale_d = shard_padded_rows(mesh, sv_scale)
 
-            def call(qb, _m=mapped, _mesh=mesh):
-                return _m(replicate_array(_mesh, qb),
-                          sv_d, sv_sq_d, coef_d, b_d)
+                def call(qb, _m=mapped, _mesh=mesh):
+                    return _m(replicate_array(_mesh, qb), sv_d,
+                              scale_d, sv_sq_d, coef_d, b_d)
+            else:
+                def call(qb, _m=mapped, _mesh=mesh):
+                    return _m(replicate_array(_mesh, qb),
+                              sv_d, sv_sq_d, coef_d, b_d)
         else:
-            batch = _dense_batch_factory()
             sv_d = jnp.asarray(sv_store)
             sv_sq_d = jnp.asarray(sv_sq)
             coef_d = jnp.asarray(np.hstack(coefs))
             b_d = jnp.asarray(self.b_host)
+            if storage == "int8":
+                batch = _dense_batch_int8_factory()
+                scale_d = jnp.asarray(sv_scale)
 
-            def call(qb, _kp=self.kp):
-                return batch(jnp.asarray(qb), sv_d, sv_sq_d, coef_d,
-                             b_d, _kp)
+                def call(qb, _kp=self.kp):
+                    return batch(jnp.asarray(qb), sv_d, scale_d,
+                                 sv_sq_d, coef_d, b_d, _kp)
+            else:
+                batch = _dense_batch_factory()
+
+                def call(qb, _kp=self.kp):
+                    return batch(jnp.asarray(qb), sv_d, sv_sq_d,
+                                 coef_d, b_d, _kp)
 
         self._call = call
 
@@ -336,9 +366,10 @@ def suggest_buckets(row_samples, current_buckets) -> dict:
         "projected_occupancy": {
             "current": projected_occupancy(current),
             "suggested": projected_occupancy(ladder)},
-        "note": ("report-only: apply via ServeConfig.buckets only "
-                 "where the autotune serve_buckets probe says "
-                 "right-sizing pays on this device"),
+        "note": ("applied automatically between legs only when "
+                 "buckets=None and the autotune serve_buckets probe "
+                 "says right-sizing pays on this device; otherwise "
+                 "report-only"),
     }
 
 

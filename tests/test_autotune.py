@@ -86,8 +86,10 @@ def test_probe_pass_deterministic_and_runlogged(tmp_path):
     assert stable_view(profs[0]) == stable_view(profs[1])
     assert profs[0].probes == profs[1].probes  # fake clock: bytewise
     # CPU probes are never authoritative -> decisions match the
-    # hand-measured OFF defaults by construction.
-    assert profs[0].decisions == {"pipeline_rounds": False}
+    # hand-measured OFF defaults by construction (serve_buckets is a
+    # graduated knob now, pinned False off-TPU — the honesty rule).
+    assert profs[0].decisions == {"pipeline_rounds": False,
+                                  "serve_buckets": False}
 
     path, = tmp_path.glob("autotune-*.jsonl")
     recs = read_runlog(str(path))

@@ -244,3 +244,44 @@ def test_topology_match_still_adjudicates(tmp_path):
         {**_cur(), "replicas": 2, "union_mesh_devices": 1},
         str(tmp_path))
     assert out["regression_gate"] == "PASS"
+
+
+# ------------------------------------------ union-storage gate (ISSUE 17)
+
+def test_storage_mismatch_refused(tmp_path):
+    """A throughput delta between runs staged at different union
+    storages is an apples-to-oranges comparison: the gate refuses with
+    STORAGE_MISMATCH and both stamps, reporting the raw delta
+    informationally (the TOPOLOGY_MISMATCH discipline)."""
+    _write(tmp_path, "BENCH_r06.json",
+           {**_cur(), "union_storage": "f32"})
+    out = bench._regression_gate(
+        {**_cur(pps=1_300_000), "union_storage": "int8"},
+        str(tmp_path))
+    assert out["regression_gate"] == "STORAGE_MISMATCH"
+    assert out["previous_union_storage"] == "f32"
+    assert out["current_union_storage"] == "int8"
+    assert "raw_delta" in out and "normalized_delta" not in out
+
+
+def test_storage_legacy_artifacts_derive_f32(tmp_path):
+    """Artifacts predating the stamp ran f32 unions by construction:
+    absent derives to 'f32' and same-storage runs keep adjudicating
+    instead of refusing history."""
+    _write(tmp_path, "BENCH_r06.json", _cur())  # no storage stamp
+    out = bench._regression_gate(
+        {**_cur(), "union_storage": "f32"}, str(tmp_path))
+    assert out["regression_gate"] == "PASS"
+    out = bench._regression_gate(
+        {**_cur(pps=1_300_000), "union_storage": "int8"},
+        str(tmp_path))
+    assert out["regression_gate"] == "STORAGE_MISMATCH"
+    assert out["previous_union_storage"] == "f32"
+
+
+def test_storage_match_still_adjudicates(tmp_path):
+    _write(tmp_path, "BENCH_r06.json",
+           {**_cur(), "union_storage": "int8"})
+    out = bench._regression_gate(
+        {**_cur(), "union_storage": "int8"}, str(tmp_path))
+    assert out["regression_gate"] == "PASS"

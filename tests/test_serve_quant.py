@@ -1,0 +1,398 @@
+"""int8 quantized serving hot path (ISSUE 17): the calibrated
+union-storage guard (accept / refuse / fallback / auto semantics,
+generalized over every feature kernel family), decision parity of the
+dequant-fused int8 executor against the f32 path within the guard's
+own bound, the mesh-sharded int8 union, mixed-storage union groups on
+the v2 engine across a hot swap, the profile-gated bucket auto-apply,
+and the committed int8 budget's mutation drift."""
+
+import copy
+import types
+import warnings
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import ServeConfig, SVMConfig
+from dpsvm_tpu.models.multiclass import (decision_matrix,
+                                         predict_multiclass,
+                                         train_multiclass)
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import (BF16_RISK_THRESHOLD, KernelParams,
+                                   dequantize_rows_int8,
+                                   quantize_rows_int8,
+                                   storage_perturbation)
+from dpsvm_tpu.serve import (DEFAULT_BUCKETS, PredictServer,
+                             resolve_buckets, resolve_union_storage,
+                             stage_union_host, union_nbytes)
+from dpsvm_tpu.serving import ServingEngine
+
+KERNELS = {
+    "linear": KernelParams("linear"),
+    "rbf": KernelParams("rbf", 0.3),
+    "poly": KernelParams("poly", 0.2, 3, 1.0),
+    "sigmoid": KernelParams("sigmoid", 0.1, 0, 0.25),
+}
+
+
+def _binary(kp, n_sv=60, d=6, coef_scale=1.0, seed=0):
+    rng = np.random.default_rng(seed)
+    return SVMModel(
+        sv_x=rng.normal(size=(n_sv, d)).astype(np.float32),
+        sv_alpha=(rng.random(n_sv).astype(np.float32) + 0.01)
+        * coef_scale,
+        sv_y=np.where(rng.random(n_sv) < 0.5, 1, -1).astype(np.int32),
+        b=0.05, kernel=kp)
+
+
+@pytest.fixture(scope="module")
+def three_class():
+    rng = np.random.default_rng(31)
+    xs, ys = [], []
+    for k in range(3):
+        c = np.zeros(5, np.float32)
+        c[k] = 2.5
+        xs.append(rng.normal(size=(70, 5)).astype(np.float32) * 0.7 + c)
+        ys.append(np.full(70, k))
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+@pytest.fixture(scope="module")
+def trained(three_class):
+    x, y = three_class
+    cfg = SVMConfig(c=5.0, gamma=0.25, epsilon=1e-3, chunk_iters=256)
+    m, _ = train_multiclass(x, y, cfg, strategy="ovr")
+    return m, x
+
+
+# ------------------------------------------ guard: accept per family
+
+@pytest.mark.parametrize("kind", sorted(KERNELS))
+def test_int8_accepted_and_close_per_kernel_family(kind):
+    """A moderate-coefficient model accepts int8 on EVERY feature
+    kernel family (the guard is no longer rbf-only), and the quantized
+    decisions track the f32 path within the guard's own calibrated
+    risk bound."""
+    m = _binary(KERNELS[kind], coef_scale=0.05, seed=3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # acceptance must be silent
+        srv = PredictServer(m, ServeConfig(buckets=(32,),
+                                           union_storage="int8"))
+    assert srv.union_storage == "int8"
+    guard = srv.stats["storage_guard"]
+    assert guard["requested"] == "int8"
+    assert guard["effective"] == "int8"
+    assert guard["risks"]["int8"] <= guard["threshold"]
+
+    from dpsvm_tpu.predict import decision_function
+
+    rng = np.random.default_rng(5)
+    q = rng.normal(size=(48, 6)).astype(np.float32)
+    ref = np.asarray(decision_function(m, q)).ravel()
+    got = np.asarray(srv.decision(q)).ravel()
+    # The guard's contract: decision-sum perturbation is bounded by
+    # max-column ||coef||_1 * p90|dK| (risk). Query quantization adds
+    # one more rounding of the same magnitude — 4x covers the p90->max
+    # gap of the sampled bound on every family here.
+    tol = max(4.0 * guard["risks"]["int8"], 1e-4)
+    assert np.max(np.abs(got - ref)) <= tol
+    # Sign agreement wherever f32 is confidently off zero.
+    confident = np.abs(ref) > tol
+    assert np.array_equal(np.sign(got[confident]),
+                          np.sign(ref[confident]))
+
+
+def test_int8_refused_falls_back_loudly():
+    """The bound ADJUDICATES for int8: a risky (large-coefficient)
+    model is refused with a loud warning and falls back to the widest
+    narrower storage the same bound accepts."""
+    big = _binary(KERNELS["rbf"], n_sv=500, d=8, coef_scale=100.0,
+                  seed=4)
+    with pytest.warns(UserWarning, match="REFUSED"):
+        srv = PredictServer(big, ServeConfig(buckets=(16,),
+                                             union_storage="int8",
+                                             warm_start=False))
+    assert srv.union_storage in ("bf16", "f32")
+    guard = srv.stats["storage_guard"]
+    assert guard["requested"] == "int8"
+    assert guard["effective"] != "int8"
+    assert guard["risks"]["int8"] > BF16_RISK_THRESHOLD
+    assert guard["note"].startswith("union_storage='int8' REFUSED")
+
+
+def test_auto_picks_narrowest_silently(trained):
+    """'auto' is a request to pick, not a promise: the narrowest
+    accepted storage stages with NO warning either way."""
+    m, _ = trained
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        srv = PredictServer(m, ServeConfig(buckets=(32,),
+                                           union_storage="auto"))
+    assert srv.union_storage == "int8"  # moderate model: int8 accepted
+
+    big = _binary(KERNELS["rbf"], n_sv=500, d=8, coef_scale=100.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        srv2 = PredictServer(big, ServeConfig(buckets=(16,),
+                                              union_storage="auto",
+                                              warm_start=False))
+    assert srv2.union_storage == "f32"  # both narrow storages refused
+    assert "auto storage" in srv2.stats["storage_guard"]["note"]
+
+
+def test_precomputed_and_empty_unions_stay_f32():
+    """No feature rows to round: precomputed-kernel ensembles and
+    empty unions resolve to f32 whatever was requested."""
+    pre = types.SimpleNamespace(
+        sv_union=np.ones((5, 4), np.float32),
+        coef=np.ones((5, 1), np.float32))
+    st, entry = resolve_union_storage(pre, KernelParams("precomputed"),
+                                      "int8")
+    assert st == "f32" and "no feature rows" in entry["note"]
+
+    empty = types.SimpleNamespace(
+        sv_union=np.zeros((0, 4), np.float32),
+        coef=np.zeros((0, 1), np.float32))
+    st, entry = resolve_union_storage(empty, KernelParams("rbf", 0.5),
+                                      "auto")
+    assert st == "f32" and "no feature rows" in entry["note"]
+
+
+def test_unknown_storage_rejected(trained):
+    m, _ = trained
+    with pytest.raises(ValueError, match="unknown union storage"):
+        resolve_union_storage(m.compacted, KernelParams("rbf", 0.5),
+                              "fp4")
+
+
+# ------------------------------------- staging algebra + byte account
+
+def test_stage_union_host_int8_invariants():
+    """Staged int8 rows round-trip through the published algebra:
+    values = round(row/scale) in [-127, 127], scale = max|row|/127,
+    and the squared norms come from the DEQUANTIZED rows the dot
+    operands actually carry (norms-from-rounded discipline)."""
+    rng = np.random.default_rng(11)
+    sv = rng.normal(size=(40, 7)).astype(np.float32) * \
+        rng.gamma(1.0, 5.0, size=(40, 1)).astype(np.float32)
+    sv[3] = 0.0  # all-zero row: scale must be 1.0, not 0/0
+    store, scales, sq = stage_union_host(sv, "int8")
+    assert store.dtype == np.int8 and scales.dtype == np.float32
+    q, s = quantize_rows_int8(sv)
+    np.testing.assert_array_equal(store, q)
+    np.testing.assert_array_equal(scales, s)
+    assert s[3] == 1.0 and not store[3].any()
+    deq = dequantize_rows_int8(q, s)
+    np.testing.assert_allclose(sq, (deq * deq).sum(1), rtol=1e-6)
+    # Per-row quantization error is bounded by scale/2 per element.
+    assert np.max(np.abs(deq - sv)) <= (s.max() / 2) + 1e-6
+    # The gauge arithmetic: int8 rows + f32 scales vs 4-byte rows.
+    # The near-4x cut needs d large enough to amortize the per-row
+    # scale (at covtype's d=54: 58 bytes/row vs 216).
+    assert union_nbytes("int8", 40, 7) == 40 * 7 + 4 * 40
+    assert union_nbytes("f32", 40, 7) == 40 * 7 * 4
+    assert union_nbytes("int8", 40, 54) * 3 < union_nbytes("f32", 40, 54)
+
+
+def test_storage_perturbation_orders():
+    """The sampler the guard scales: int8 perturbs at least as much as
+    bf16 on the same pair population, and f32 is exactly zero."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(300, 8)).astype(np.float32)
+    kp = KernelParams("rbf", 0.4)
+    assert storage_perturbation(x, kp, "f32") == 0.0
+    b = storage_perturbation(x, kp, "bf16")
+    i = storage_perturbation(x, kp, "int8")
+    assert 0.0 < b and 0.0 < i
+    with pytest.raises(ValueError, match="unknown union storage"):
+        storage_perturbation(x, kp, "fp8")
+
+
+# ----------------------------------------------------------- mesh path
+
+def test_mesh_int8_matches_single_device(trained):
+    """The mesh-sharded int8 union (rows AND scales sharded together,
+    one psum) answers within float tolerance of the single-device int8
+    executor — quantization adds converts, never collectives or
+    drift."""
+    m, x = trained
+    q = np.asarray(x[:40], np.float32)
+    single = PredictServer(m, ServeConfig(buckets=(64,),
+                                          union_storage="int8"))
+    mesh = PredictServer(m, ServeConfig(buckets=(64,), num_devices=8,
+                                        union_storage="int8"))
+    assert single.union_storage == mesh.union_storage == "int8"
+    np.testing.assert_allclose(mesh.decision(q), single.decision(q),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(mesh.predict(q),
+                                  predict_multiclass(m, q))
+
+
+# ------------------------------------------- v2 engine: mixed storage
+
+def test_engine_mixed_storage_groups_and_hot_swap(trained):
+    """One engine, one requested storage, two verdicts: the guard
+    resolves per MODEL, the storage token is part of the union-group
+    key (different verdicts stage in different groups), and a hot swap
+    between storage dtypes restages correctly."""
+    m, x = trained
+    risky = _binary(KERNELS["rbf"], n_sv=500, d=5, coef_scale=100.0,
+                    seed=9)
+    eng = ServingEngine(ServeConfig(buckets=(16, 64),
+                                    union_storage="int8"))
+    try:
+        eng.register("good", m)
+        with pytest.warns(UserWarning, match="REFUSED"):
+            eng.register("risky", risky)
+        snap = eng.snapshot()
+        assert snap["union_storage"]["good"] == "int8"
+        assert snap["union_storage"]["risky"] in ("bf16", "f32")
+        assert snap["quantized_unions"] >= 1
+        # Different storages NEVER share a union group.
+        stores = {g.union_storage for g in eng._groups.values()}
+        assert "int8" in stores and len(eng._groups) >= 2
+
+        q = np.asarray(x[:30], np.float32)
+        np.testing.assert_allclose(eng.decision(q, model="good"),
+                                   decision_matrix(m, q),
+                                   rtol=0.02, atol=0.02)
+
+        # Swap "good" for a risky retrain: the new version's guard
+        # refuses int8 and the entry restages under the wider key.
+        risky5 = _binary(KernelParams("rbf", 0.25), n_sv=400, d=5,
+                         coef_scale=100.0, seed=12)
+        with pytest.warns(UserWarning, match="REFUSED"):
+            eng.swap("good", risky5)
+        snap = eng.snapshot()
+        assert snap["union_storage"]["good"] != "int8"
+        assert eng.hot_swaps.value == 1
+    finally:
+        eng.close()
+
+
+# ------------------------------------------ profile-gated auto-apply
+
+def _serve_buckets_profile(verdict, authoritative=True):
+    import jax
+
+    from dpsvm_tpu.autotune import DeviceProfile
+
+    return DeviceProfile(
+        device_kind="cpu", backend="cpu", n_devices=8,
+        jax=jax.__version__, utc="2026-08-04T00:00:00Z",
+        git_sha="deadbeef", seed=0,
+        probes={"serve_buckets": {
+            "probe": "serve_buckets", "knob": "serve_buckets",
+            "seed": 0, "shapes": {"s_rows": 256},
+            "a_seconds": 1.0, "b_seconds": 0.5, "ratio": 0.5,
+            "threshold": 0.9, "authoritative": authoritative,
+            "verdict": bool(verdict)}},
+        decisions={"serve_buckets": bool(verdict)})
+
+
+def test_resolve_buckets_provenance():
+    """Explicit config ALWAYS wins (no profile consulted); buckets=None
+    consults the graduated serve_buckets gate; no profile means
+    default ladder with auto_apply False."""
+    from dpsvm_tpu.autotune import use_profile
+
+    ladder, prov = resolve_buckets(ServeConfig(buckets=(16, 64)))
+    assert ladder == (16, 64) and prov["source"] == "config"
+    assert "auto_apply" not in prov
+
+    with use_profile(None):
+        ladder, prov = resolve_buckets(ServeConfig(buckets=None))
+    assert ladder == DEFAULT_BUCKETS
+    assert prov["source"] == "default" and prov["auto_apply"] is False
+
+    with use_profile(_serve_buckets_profile(True)):
+        ladder, prov = resolve_buckets(ServeConfig(buckets=None))
+    assert ladder == DEFAULT_BUCKETS  # the ladder STARTS default
+    assert prov["source"] == "profile" and prov["auto_apply"] is True
+
+    with use_profile(_serve_buckets_profile(False)):
+        _, prov = resolve_buckets(ServeConfig(buckets=None))
+    assert prov["auto_apply"] is False  # honesty rule: CPU pins False
+
+
+def test_engine_auto_applies_buckets_between_legs(trained):
+    """buckets=None + an authoritative pays-verdict profile: the
+    engine applies its own occupancy suggestion at the drain() leg
+    boundary, records the applied ladder in the provenance, and keeps
+    answering correctly from the restaged groups."""
+    from dpsvm_tpu.autotune import use_profile
+
+    m, x = trained
+    q = np.asarray(x[:3], np.float32)
+    with use_profile(_serve_buckets_profile(True)):
+        eng = ServingEngine(ServeConfig(buckets=None))
+        try:
+            assert eng.bucket_provenance["auto_apply"] is True
+            eng.register("m", m)
+            for _ in range(6):  # 3-row traffic under a 16.. ladder
+                eng.decision(q)
+            eng.drain()
+            prov = eng.snapshot()["bucket_provenance"]
+            assert prov["applied_buckets"] == \
+                prov["suggestion"]["suggested_buckets"]
+            assert prov["applied_buckets"][0] == 4  # pow2 above p25=3
+            assert tuple(prov["applied_buckets"]) == eng._bucket_ladder
+            # The restaged ladder still serves the same answers.
+            np.testing.assert_allclose(eng.decision(q),
+                                       decision_matrix(m, q),
+                                       rtol=1e-5, atol=1e-5)
+        finally:
+            eng.close()
+
+
+def test_engine_explicit_buckets_never_auto_apply(trained):
+    """An explicit ladder is an operator decision: no auto-apply even
+    with the pays-verdict profile installed."""
+    from dpsvm_tpu.autotune import use_profile
+
+    m, x = trained
+    q = np.asarray(x[:3], np.float32)
+    with use_profile(_serve_buckets_profile(True)):
+        eng = ServingEngine(ServeConfig(buckets=(16, 64)))
+        try:
+            eng.register("m", m)
+            for _ in range(6):
+                eng.decision(q)
+            eng.drain()
+            assert eng.maybe_apply_bucket_suggestion() is None
+            prov = eng.snapshot()["bucket_provenance"]
+            assert prov["source"] == "config"
+            assert "applied_buckets" not in prov
+            assert eng._bucket_ladder == (16, 64)
+        finally:
+            eng.close()
+
+
+# --------------------------------------------- budget mutation drift
+
+def test_int8_budget_pins_convert_structure(tmp_path):
+    """The committed serve_bucket_int8 budget is mutation-sensitive:
+    re-extracted facts PASS against a fresh write, and perturbing an
+    int8 convert count (as an extra quantization point would) DRIFTs
+    naming the exact fact."""
+    from dpsvm_tpu.analysis import budget, manifest
+    from dpsvm_tpu.analysis.extract import entry_facts
+
+    facts = entry_facts(manifest.serve_bucket_int8())
+    dt = facts["units"]["batch"]["dtypes"]
+    # The algebra's exact quantization points (manifest docstring).
+    assert dt["f32_to_int8_converts"] == 2
+    assert dt["int8_to_f32_converts"] == 1
+    assert dt["i32_to_f32_converts"] == 1
+    budget.write_budget("serve_bucket_int8", facts, tmp_path)
+    assert budget.check_entry("serve_bucket_int8", facts,
+                              tmp_path)["verdict"] == budget.PASS
+
+    drifted = copy.deepcopy(facts)
+    drifted["units"]["batch"]["dtypes"]["f32_to_int8_converts"] += 1
+    res = budget.check_entry("serve_bucket_int8", drifted, tmp_path)
+    assert res["verdict"] == budget.DRIFT
+    assert any(p == "units.batch.dtypes.f32_to_int8_converts"
+               for p, _, _ in res["diffs"])

@@ -46,7 +46,7 @@ sys.path.insert(0, REPO)
 
 def _synthetic_multiclass(n_classes: int, d: int, pool: int,
                           sv_frac: float, strategy: str, gamma: float,
-                          seed: int):
+                          seed: int, alpha_scale: float = 1.0):
     """A realistic shared-SV ensemble WITHOUT a training run: pool rows
     play the training matrix, each submodel's SVs are a sampled subset
     of its classes' rows (ascending row order, exactly what
@@ -75,7 +75,8 @@ def _synthetic_multiclass(n_classes: int, d: int, pool: int,
         n_sv = len(idx)
         models.append(SVMModel(
             sv_x=x[idx],
-            sv_alpha=rng.random(n_sv).astype(np.float32) + 0.01,
+            sv_alpha=(rng.random(n_sv).astype(np.float32) + 0.01)
+            * np.float32(alpha_scale),
             sv_y=np.where(rng.random(n_sv) < 0.5, 1, -1).astype(np.int32),
             b=float(rng.normal() * 0.1),
             kernel=kp))
@@ -158,6 +159,60 @@ def _ab_record(m, nb: int, label: str) -> dict:
         "wall_seconds_compacted_best3": round(t_compact, 4),
         "bit_identical": bool(parity),
     }
+
+
+def _storage_ab(serve_cfg, requests: int, pool: int) -> list:
+    """f32-vs-bf16-vs-int8 union-storage frontier at ONE matched
+    ensemble shape (ISSUE 17): same synthetic covtype-OvR ensemble,
+    three PredictServers differing ONLY in ServeConfig.union_storage,
+    each reporting staged union bytes and sweep throughput. Moderate
+    dual coefficients by construction (alpha_scale) so the calibrated
+    guard ACCEPTS every storage — a refused leg would silently measure
+    the fallback and the frontier would compare nothing; the guard's
+    accept/refuse behavior itself is pinned by tests and the loadgen
+    quant smoke, not here. Decision agreement across the frontier is
+    checked against the f32 leg within the guard's own calibrated
+    bound."""
+    import warnings
+
+    from dpsvm_tpu.serve import (PredictServer, offered_load_sweep,
+                                 union_nbytes)
+
+    sizes = [1, 2, 4, 8, 16, 32, 64, 128]
+    q = np.random.default_rng(7).random((64, 54), np.float32)
+    legs, dec_ref = [], None
+    for storage in ("f32", "bf16", "int8"):
+        m = _synthetic_multiclass(
+            n_classes=7, d=54, pool=pool, sv_frac=0.4,
+            strategy="ovr", gamma=0.5, seed=4, alpha_scale=1e-3)
+        cfg = serve_cfg.replace(union_storage=storage,
+                                metrics_port=None)
+        with warnings.catch_warnings():
+            warnings.simplefilter("always")
+            server = PredictServer(m, cfg)
+        dec = server.decision(q)
+        if storage == "f32":
+            dec_ref = dec
+        sweep = offered_load_sweep(server, sizes, requests,
+                                   group=8, seed=0)
+        s_rows = int(server.ens.sv_union.shape[0])
+        guard = server.stats.get("storage_guard") or {}
+        leg = {
+            "requested_storage": storage,
+            "effective_storage": server.union_storage,
+            "union_bytes": union_nbytes(server.union_storage,
+                                        s_rows, server.d),
+            "examples_per_second": sweep["rows_per_second"],
+            "request_p50_s": sweep["request_latency"]["p50"],
+            "guard_risk": (guard.get("risks") or {}).get(storage),
+            "max_abs_decision_delta_vs_f32": (
+                None if dec_ref is dec else
+                round(float(np.max(np.abs(dec - dec_ref))), 6)),
+        }
+        server.close()
+        assert leg["effective_storage"] == storage, leg
+        legs.append(leg)
+    return legs
 
 
 def _scrape_metrics(server) -> dict:
@@ -246,6 +301,18 @@ def main(argv=None) -> int:
     assert ab[0]["kernel_flop_reduction"] >= 3.0, ab[0]
     assert all(r["bit_identical"] for r in ab), ab
 
+    # --- union-storage frontier at matched shape (ISSUE 17) --------
+    storage_ab = _storage_ab(serve_cfg, max(args.requests // 4, 64),
+                             pool=max(args.pool // 2, 512))
+    for leg in storage_ab:
+        print(f"[bench_serve] storage {leg['requested_storage']}: "
+              f"{leg['union_bytes']} union bytes, "
+              f"{leg['examples_per_second']} ex/s, "
+              f"|dDec|max={leg['max_abs_decision_delta_vs_f32']}",
+              file=sys.stderr)
+    assert storage_ab[2]["union_bytes"] * 3 < storage_ab[0]["union_bytes"], \
+        storage_ab  # the ~4x union-bytes cut (int8 rows + f32 scales)
+
     # --- offered-load sweep through the serving engine -------------
     sizes = [1, 2, 4, 8, 16, 32, 64, 128]
     server = PredictServer(mnist_ovo, serve_cfg)
@@ -284,6 +351,12 @@ def main(argv=None) -> int:
         "bucket_latency": sweep_mnist["bucket_latency"],
         "sweep_covtype_ovr": sweep_cov,
         "compacted_vs_stacked": ab,
+        # Union-storage stamp (ISSUE 17): the headline sweep stages
+        # the default f32 union; the regression gate refuses cross-
+        # storage comparisons (STORAGE_MISMATCH) the same way it
+        # refuses cross-topology ones.
+        "union_storage": server.union_storage,
+        "storage_frontier": storage_ab,
         "warm_seconds": {str(k): round(v, 4) for k, v in
                          server.stats["warm_seconds"].items()},
         # Device-identity stamp (ISSUE 14 satellite): the regression
@@ -342,6 +415,15 @@ def main(argv=None) -> int:
                 f"{r['sv_union']} | {r['kernel_flop_reduction']}x | "
                 f"{r['xla_flop_reduction']}x | {r['bit_identical']} |"
                 for r in ab)
+            + "\n\n## Union-storage frontier (covtype-OvR shape, "
+            "matched ensemble, guard-accepted legs)\n\n"
+            "| storage | union bytes | ex/s | p50 s | "
+            "max |dDec| vs f32 |\n|---|---|---|---|---|\n"
+            + "\n".join(
+                f"| {r['effective_storage']} | {r['union_bytes']} | "
+                f"{r['examples_per_second']} | {r['request_p50_s']} | "
+                f"{r['max_abs_decision_delta_vs_f32']} |"
+                for r in storage_ab)
             + "\n\n## Offered-load sweep (MNIST-OvO shape)\n\n```json\n"
             + json.dumps({k: result[k] for k in
                           ("value", "unit", "request_latency",

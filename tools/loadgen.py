@@ -840,6 +840,152 @@ def _net_runlog_reconciliation(engine, snap: dict) -> dict:
     return rec
 
 
+def _run_quant_smoke(args) -> int:
+    """``loadgen --quant-smoke`` (ISSUE 17 CI leg). Three phases:
+
+    1. ACCEPT — a moderate-coefficient ensemble requested at
+       ``union_storage='int8'`` must stage int8 (guard risk under the
+       threshold), visible in the engine snapshot and the quantized-
+       unions gauge, and carry traffic with zero failures.
+    2. REFUSE — a large-coefficient ensemble requested at int8 must
+       be REFUSED by the calibrated guard (loud UserWarning, effective
+       storage falls back to a bound-accepted wider dtype) and the
+       fallback must keep serving cleanly — a refusal is a safe
+       downgrade, never an outage.
+    3. FRONTIER — an f32-vs-int8 mini-sweep at matched shape driven
+       through the WIRE front door (ServeServer + persistent-
+       connection clients), client verdicts reconciled, per-leg
+       union storage asserted from the engine's own snapshot.
+    """
+    import tempfile
+    import threading
+    import warnings
+
+    from dpsvm_tpu.config import ServeConfig
+    from dpsvm_tpu.serving import ServeServer, ServingEngine
+    from tools.bench_serve import _synthetic_multiclass
+
+    tmp = tempfile.mkdtemp(prefix="dpsvm_quant_smoke_")
+    pool = min(args.pool, 512)
+    sizes = [1, 4, 16, 64]
+    moderate = os.path.join(tmp, "moderate.npz")
+    _synthetic_multiclass(7, 54, pool, 0.4, "ovr", 0.5, seed=4,
+                          alpha_scale=1e-3).save(moderate)
+    risky = os.path.join(tmp, "risky.npz")
+    _synthetic_multiclass(7, 54, pool, 0.4, "ovr", 0.5, seed=5,
+                          alpha_scale=50.0).save(risky)
+
+    # --- 1. accept leg -------------------------------------------
+    eng = ServingEngine(ServeConfig(union_storage="int8"))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng.register("q", moderate)
+        accept_warned = [str(w.message) for w in caught
+                         if "int8" in str(w.message)]
+    snap = eng.snapshot()
+    assert snap["union_storage"]["q"] == "int8", snap["union_storage"]
+    assert snap["quantized_unions"] >= 1, snap
+    assert not accept_warned, accept_warned
+    accept = closed_loop(eng, 48, 4, sizes, [("q", 1.0)], seed=0)
+    assert accept["failed"] == 0 \
+        and accept["verdicts"]["failed"] == 0, accept
+    accept_bytes = eng.snapshot()["union_bytes"]
+    eng.close()
+    print(f"[loadgen] quant accept leg: staged int8 "
+          f"({accept_bytes} union bytes), "
+          f"{accept['rows_per_second']} rows/s, zero failures",
+          file=sys.stderr)
+
+    # --- 2. refuse leg -------------------------------------------
+    eng = ServingEngine(ServeConfig(union_storage="int8"))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng.register("q", risky)
+        refusals = [str(w.message) for w in caught
+                    if "REFUSED" in str(w.message)]
+    assert refusals, "risky int8 request was not refused"
+    fallback = eng.snapshot()["union_storage"]["q"]
+    assert fallback != "int8", fallback
+    refuse = closed_loop(eng, 48, 4, sizes, [("q", 1.0)], seed=1)
+    assert refuse["failed"] == 0 \
+        and refuse["verdicts"]["failed"] == 0, refuse
+    eng.close()
+    print(f"[loadgen] quant refuse leg: int8 REFUSED, fell back to "
+          f"{fallback}, fallback served "
+          f"{refuse['rows_per_second']} rows/s cleanly",
+          file=sys.stderr)
+
+    # --- 3. wire-front-door frontier mini-sweep ------------------
+    frontier = []
+    for storage in ("f32", "int8"):
+        eng = ServingEngine(ServeConfig(union_storage=storage))
+        eng.register("q", moderate)
+        server = ServeServer(eng)
+        dims = {"q": eng.registry.get("q").d}
+        n_clients, per_client = 2, 24
+        out = [None] * n_clients
+        rows_base = eng._rows_total
+        threads = [threading.Thread(
+            target=_net_worker,
+            args=(server.host, server.port, i, per_client,
+                  [("q", 1.0)], dims, sizes, None, out),
+            name=f"quant-net-{storage}-{i}")
+            for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), f"{storage} wire client wedged"
+        wall = time.perf_counter() - t0
+        rows = eng._rows_total - rows_base
+        ok = sum(t_["observed"].get("served", 0) for t_ in out if t_)
+        snap = eng.snapshot()
+        leg = {
+            "union_storage": snap["union_storage"]["q"],
+            "union_bytes": snap["union_bytes"],
+            "quantized_unions": snap["quantized_unions"],
+            "rows": int(rows),
+            "rows_per_second": round(rows / max(wall, 1e-9)),
+            "client_ok_verdicts": int(ok),
+            "requests": n_clients * per_client,
+        }
+        assert leg["union_storage"] == storage, leg
+        assert leg["client_ok_verdicts"] == leg["requests"], leg
+        server.close()
+        eng.close()
+        frontier.append(leg)
+        print(f"[loadgen] quant wire leg {storage}: "
+              f"{leg['union_bytes']} union bytes, "
+              f"{leg['rows_per_second']} rows/s, "
+              f"{leg['client_ok_verdicts']}/{leg['requests']} ok",
+              file=sys.stderr)
+    assert frontier[1]["union_bytes"] * 3 < frontier[0]["union_bytes"], \
+        frontier
+
+    result = {
+        "quant_smoke": {
+            "accept_leg": {"union_bytes": accept_bytes,
+                           **{k: accept[k] for k in
+                              ("rows_per_second", "verdicts",
+                               "failed")}},
+            "refuse_leg": {"fallback_storage": fallback,
+                           "refusal_warning": refusals[0][:200],
+                           **{k: refuse[k] for k in
+                              ("rows_per_second", "verdicts",
+                               "failed")}},
+            "wire_frontier": frontier,
+        },
+        "pool": pool,
+        "smoke": True,
+    }
+    art = args.out or os.path.join(tmp, "BENCH_SERVE_quant_smoke.json")
+    with open(art, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(f"[loadgen] quant smoke PASSED; wrote {art}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--pool", type=int, default=2048,
@@ -894,6 +1040,15 @@ def main(argv=None) -> int:
                          "--out (never the committed r<NN> series), no "
                          "BENCH_SERVE.md rewrite; the gate and runlog "
                          "reconciliation still run")
+    ap.add_argument("--quant-smoke", action="store_true",
+                    help="ISSUE 17 CI leg: the int8 storage guard's "
+                         "accept AND refuse behavior on real engines "
+                         "(moderate-coef model staged int8; risky-"
+                         "coef model refused int8 with the fallback "
+                         "still serving), plus an f32-vs-int8 "
+                         "frontier mini-sweep driven through the "
+                         "wire front door; artifact to --out or a "
+                         "temp file, never the committed series")
     ap.add_argument("--out", default=None,
                     help="artifact path override (default: repo-root "
                          "BENCH_SERVE_r<NN>.json, or a temp file with "
@@ -904,6 +1059,8 @@ def main(argv=None) -> int:
                          "row totals)")
     ap.add_argument("--obs-dir", default=None)
     args = ap.parse_args(argv)
+    if args.quant_smoke:
+        return _run_quant_smoke(args)
     if args.smoke:
         args.pool = min(args.pool, 512)
         args.requests = min(args.requests, 96)
@@ -1172,6 +1329,10 @@ def main(argv=None) -> int:
                          ("concurrency", "requests", "expired",
                           "deadline_miss_rate", "verdicts")},
         **({"chaos": chaos} if chaos is not None else {}),
+        # Union-storage stamp (ISSUE 17): the regression gate refuses
+        # cross-storage comparisons (STORAGE_MISMATCH) the way it
+        # refuses cross-topology ones; absent stamps derive to f32.
+        "union_storage": config.effective_union_storage(),
         "engine": engine.snapshot(),
         # Occupancy-driven bucket advice (ISSUE 14 satellite; ROADMAP
         # item 2's stub closed): report-only — applying it stays
