@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke serve_replica_smoke serve_quant_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke serve_replica_smoke serve_quant_smoke learn_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -115,6 +115,16 @@ serve_replica_smoke:
 # serve_replica_smoke).
 serve_quant_smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/loadgen.py --quant-smoke
+
+# Continuous-learning smoke (ISSUE 18): `cli learn --smoke` — a tiny
+# drifting two-generation stream retrained warm from the previous
+# generation's support vectors (solver/cascade.py), each generation
+# published into an in-process serving engine via hot swap. Asserts
+# the warm retrain saved pairs > 0 vs the MEASURED cold baseline and
+# that the post-swap probe serves ok (tier1.yml runs this next to
+# serve_quant_smoke). Models go to a temp dir, never committed.
+learn_smoke:
+	JAX_PLATFORMS=cpu DPSVM_OBS=1 $(PY) -m dpsvm_tpu.cli learn --smoke --model-dir $$(mktemp -d)
 
 # Fault-tolerance smoke (ISSUE 13): the deterministic fault-injection
 # harness self-test, a kill -9 mid-ooc-solve followed by a --resume

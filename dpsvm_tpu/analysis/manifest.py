@@ -461,6 +461,61 @@ def ooc_fold_tile(n_total: int = N):
                  _jaxpr_of(fold, *args, **kw))]
 
 
+def warm_f_rebuild(n_total: int = N):
+    """Warm-start gradient reconstruction (ISSUE 18): the programs that
+    rebuild f = K (alpha*y) - y from a repaired seed in ONE streamed
+    pass over X before a warm-started solve.
+
+    Two units pin the two engine forms:
+
+    * ``fold_tile`` — the single-chip/ooc streamed form: the SAME
+      ops/ooc.ooc_fold_tile program as the ooc_fold_tile entry, lowered
+      at the warm path's variant point (want_dots=False — the rebuild
+      folds seed-block kernel rows into f and never materializes dots)
+      and the warm path's (Q_BLOCK,) fixed query-block width
+      (solver/warmstart.py zero-pads the seed tail with INERT zero
+      coefficients so compiles are a pure function of (T_TILE, D,
+      Q_BLOCK)). Zero collectives, donated f carry (missed=0), and —
+      like the ooc entry — ``n_total`` reaches only the tile clamp, so
+      the memory facts' n-independence at fixed tile shape is
+      mutation-testable by n-doubling.
+    * ``mesh`` — the sharded rebuild (warmstart._warm_fold_mesh_factory):
+      each device contributes its local rows to the seed block through
+      ONE psum of the packed (Q_BLOCK, d+2) [qx | qsq | coef] operand,
+      then folds the local kernel rows into its donated f shard — one
+      collective per seed block, nothing else. Lowered at the canonical
+      (N, D) sharded shapes (a one-shot rebuild over the resident
+      shards is inherently n-sized; the n-independence claim is scoped
+      to the streamed fold_tile form).
+    """
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.ops.ooc import ooc_fold_tile as fold
+    from dpsvm_tpu.solver.warmstart import (Q_BLOCK,
+                                            _warm_fold_mesh_factory)
+
+    t = min(T_TILE, n_total)  # a tile never exceeds the data
+    tile_args = (_sds((t, D), jnp.float32), _sds((t,), jnp.float32),
+                 _sds((t,), jnp.float32), None,
+                 _sds((Q_BLOCK, D), jnp.float32),
+                 _sds((Q_BLOCK,), jnp.float32),
+                 _sds((Q_BLOCK,), jnp.float32))
+    tile_kw = dict(kp=_kp(), want_dots=False, compensated=False)
+
+    _, mapped = _warm_fold_mesh_factory(DEVICE_COUNT, _kp(), D,
+                                        q_block=Q_BLOCK)
+    mesh_args = (_sds((N, D), jnp.float32), _sds((N,), jnp.float32),
+                 _sds((N,), jnp.float32), _sds((N, Q_BLOCK), jnp.float32),
+                 _sds((N,), jnp.float32))
+    return [
+        Unit("fold_tile", lambda: fold.lower(*tile_args, **tile_kw),
+             _jaxpr_of(fold, *tile_args, **tile_kw)),
+        Unit("mesh", lambda: mapped.lower(*mesh_args),
+             _jaxpr_of(mapped, *mesh_args)),
+    ]
+
+
 def compacted_decision():
     """Shared-SV compacted multiclass decision (PR 3): ONE feature-dim
     kernel matmul per query block, NO rank-3 stacked product."""
@@ -652,6 +707,7 @@ MANIFEST = {
     "shardlocal_chunk_ring": shardlocal_chunk_ring,
     "block_chunk_bf16gram": block_chunk_bf16gram,
     "ooc_fold_tile": ooc_fold_tile,
+    "warm_f_rebuild": warm_f_rebuild,
     "compacted_decision": compacted_decision,
     "serve_bucket": serve_bucket,
     "serve_bucket_bf16": serve_bucket_bf16,

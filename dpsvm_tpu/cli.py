@@ -465,8 +465,29 @@ def _build_autotune_parser(sub) -> argparse.ArgumentParser:
              "dpsvm_tpu.cli autotune run --help`")
 
 
+def _build_learn_parser(sub):
+    # Forwarding stub only (the lint/obs/autotune discipline): main()
+    # hands the `learn ...` argv verbatim to dpsvm_tpu/learn.run_cli —
+    # one flag surface.
+    return sub.add_parser(
+        "learn", add_help=False,
+        help="continuous-learning loop (dpsvm_tpu/learn): ingest a row "
+             "stream, retrain each increment warm-started from the "
+             "previous generation's support vectors "
+             "(solver/cascade.py), and publish every refreshed "
+             "generation into a live serving registry via hot swap; "
+             "`learn --smoke` is the CI shape; flags as in `python -m "
+             "dpsvm_tpu.cli learn --help`")
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["learn"]:
+        # Forwarded verbatim so `cli learn` and the library surface
+        # share one flag set (learn._build_parser owns the flags).
+        from dpsvm_tpu.learn import run_cli
+
+        return run_cli(argv[1:])
     if argv[:1] == ["autotune"]:
         # Forwarded verbatim (the lint/obs discipline) so `cli
         # autotune` and the library surface share one flag set.
@@ -496,6 +517,7 @@ def main(argv=None) -> int:
     _build_lint_parser(sub)
     _build_obs_parser(sub)
     _build_autotune_parser(sub)
+    _build_learn_parser(sub)
     p = sub.add_parser("smoke", help="device/mesh environment smoke test")
     p.add_argument("--num-devices", type=int, default=None)
     args = parser.parse_args(argv)

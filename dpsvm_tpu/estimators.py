@@ -368,7 +368,7 @@ class SVC(ClassifierMixin, BaseEstimator):
         return _weighted_accuracy(self.predict(X), y, sample_weight)
 
 
-def svc_c_sweep(X, y, Cs, **svc_params) -> list:
+def svc_c_sweep(X, y, Cs, warm=False, **svc_params) -> list:
     """Fit one binary ``SVC`` per value in `Cs` with ALL the solves
     batched through the fleet executor (solver/fleet.py): the box bound
     is a traced per-problem value, so every C shares one compiled
@@ -377,6 +377,15 @@ def svc_c_sweep(X, y, Cs, **svc_params) -> list:
     instead of len(Cs) — the hyperparameter-search shape GridSearchCV
     drives as sequential fits.
 
+    ``warm=True`` switches to the regularization-path walk: the C grid
+    is visited in ascending order, each solve seeded from the previous
+    C's alphas (solver/warmstart.py repairs the seed into the new box
+    and rebuilds the gradient in one streamed pass) instead of
+    cold-starting the fleet.  Sequential by construction — each fit
+    depends on the last — so it trades the fleet's batched dispatches
+    for a large cut in total optimization pairs; `tools/bench_learn.py`
+    measures the trade.  Results are still returned in `Cs` order.
+
     Returns fitted SVC estimators in `Cs` order (each with its own
     ``fit_result_``; per-problem convergence masking means a
     fast-converging C never waits on a hard one's iterations beyond
@@ -384,11 +393,11 @@ def svc_c_sweep(X, y, Cs, **svc_params) -> list:
     binary labels only, and probability / class_weight / precomputed
     kernels are not supported under the sweep.
 
-    SINGLE-CHIP by construction (the fleet is one device's executor):
-    backend='auto' resolves to one device here — explicit mesh /
-    reference / native backends are refused, and a problem sized to fit
-    only as mesh shards must be swept per-C with
-    ``SVC(backend='mesh')``.
+    SINGLE-CHIP by construction (the fleet is one device's executor,
+    and the warm walk runs the single-chip solver): backend='auto'
+    resolves to one device here — explicit mesh / reference / native
+    backends are refused, and a problem sized to fit only as mesh
+    shards must be swept per-C with ``SVC(backend='mesh')``.
     """
     from dpsvm_tpu.models.svm_model import SVMModel
     from dpsvm_tpu.ops.kernels import KernelParams
@@ -423,7 +432,7 @@ def svc_c_sweep(X, y, Cs, **svc_params) -> list:
                 "the single-chip sweep, or fit per-C with SVC")
     from dpsvm_tpu.solver.fleet import fleet_routing_reasons
 
-    reasons = fleet_routing_reasons(_base_config(template, 1.0))
+    reasons = [] if warm else fleet_routing_reasons(_base_config(template, 1.0))
     if reasons:
         # The gate train_multiclass(use_fleet=True) enforces, from the
         # same shared predicate: silently training a requested
@@ -450,10 +459,29 @@ def svc_c_sweep(X, y, Cs, **svc_params) -> list:
     cfg = _base_config(template, _resolve_gamma(template.gamma, X))
     kp = KernelParams(cfg.kernel, cfg.resolve_gamma(X.shape[1]),
                       cfg.degree, cfg.coef0)
-    problems = [FleetProblem(y=y_pm, c=c, tag=("C", c)) for c in Cs]
-    results = []
-    for chunk in fleet_chunks(problems, cfg.fleet_size):
-        results.extend(solve_fleet(X, chunk, cfg))
+    if warm:
+        # Regularization-path walk: ascending C, each solve seeded from
+        # the previous C's alphas.  Ascending means the previous optimum
+        # always sits inside the next (larger) box, so the repair stage
+        # only has to absorb rounding — no clipping mass is lost.
+        from dpsvm_tpu.solver.smo import solve
+        from dpsvm_tpu.solver.warmstart import WarmStart
+
+        order = np.argsort(Cs, kind="stable")
+        results = [None] * len(Cs)
+        prev_alpha = None
+        for pos in order:
+            cfg_c = cfg.replace(c=Cs[pos])
+            ws = (WarmStart(alpha=prev_alpha)
+                  if prev_alpha is not None and prev_alpha.any() else None)
+            res = solve(X, y_pm, cfg_c, warm_start=ws)
+            prev_alpha = np.asarray(res.alpha, np.float64)
+            results[pos] = res
+    else:
+        problems = [FleetProblem(y=y_pm, c=c, tag=("C", c)) for c in Cs]
+        results = []
+        for chunk in fleet_chunks(problems, cfg.fleet_size):
+            results.extend(solve_fleet(X, chunk, cfg))
 
     fitted = []
     for c, res in zip(Cs, results):

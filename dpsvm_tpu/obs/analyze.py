@@ -212,6 +212,18 @@ def summarize_run(run: Run) -> dict:
         # None-valued accelerator knobs, and what each decided.
         "autotune": man.get("autotune"),
     }
+    # Continuous-learning accounting (ISSUE 18): `generation` events
+    # from the cli learn loop — one per refreshed model generation,
+    # carrying the warm-start seed size and pairs saved vs cold.
+    gens = [e for e in run.events if e.get("name") == "generation"]
+    out["generations"] = len(gens) if gens else None
+    out["learn_pairs_saved"] = (sum(int(e.get("pairs_saved") or 0)
+                                    for e in gens if e.get("gen"))
+                                if gens else None)
+    out["learn_seed_sv_last"] = (int(gens[-1].get("seed_sv") or 0)
+                                 if gens else None)
+    out["learn_estimated"] = (any(e.get("estimated") for e in gens)
+                              if gens else None)
     return out
 
 
@@ -309,7 +321,7 @@ _REPORT_COLS = (
     ("n", "n"), ("d", "d"), ("chunks", "chunks"), ("pairs", "pairs"),
     ("device_s", "device_seconds"), ("pairs/s", "pairs_per_second"),
     ("gap last", "gap_last"), ("stalls", None), ("compiles", "compiles"),
-    ("cache", None), ("serve", None), ("faults", None),
+    ("cache", None), ("serve", None), ("learn", None), ("faults", None),
     ("profile", None), ("phases", None), ("done", None),
 )
 
@@ -379,6 +391,19 @@ def _report_row(s: dict) -> list:
                     + (f" perr={net['protocol_errors']}"
                        if net.get("protocol_errors") else "")
                     + (f" occ={occ:.2f}" if occ is not None else ""))
+        elif head == "learn":
+            # Continuous-learning column (ISSUE 18): generation count,
+            # last seed SV size and pairs saved vs cold for cli learn
+            # runs ("~" marks a rate-ESTIMATED cold baseline, not a
+            # measured one); "-" for everything else.
+            if s.get("generations") is None:
+                row.append("-")
+            else:
+                est = "~" if s.get("learn_estimated") else ""
+                row.append(
+                    f"gen={s['generations']} "
+                    f"seed={s.get('learn_seed_sv_last') or 0} "
+                    f"saved={est}{s.get('learn_pairs_saved') or 0}")
         elif head == "profile":
             # Auto-gate provenance column (ISSUE 14): "-" for runs
             # that consulted no auto gate, "default" when the gates

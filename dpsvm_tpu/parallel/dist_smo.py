@@ -395,11 +395,18 @@ def solve_mesh(
     resume: bool = False,
     alpha_init=None,
     f_init=None,
+    warm_start=None,
 ) -> SolveResult:
     """Train binary C-SVC sharded over the mesh's `data` axis.
 
     `alpha_init` / `f_init` override the standard start point exactly as in
     solver.smo.solve — the hook the SVR / one-class reductions use.
+    `warm_start` is the high-level seed (solver/warmstart.py,
+    ISSUE 18): repaired into this config's constraints, its gradient
+    rebuilt through the ONE-PSUM mesh fold (seed rows gathered from the
+    row-sharded X, local fold per shard — the warm_f_rebuild mesh
+    budget), then delegated to alpha_init/f_init. An all-zero repaired
+    seed routes bit-identically through the cold path.
     `callback` follows solve()'s contract, including abort-on-truthy-return
     at chunk boundaries and the donation caveat — the received state is
     donated to the next chunk, so copy what outlives the call (see
@@ -433,6 +440,22 @@ def solve_mesh(
             "ooc (out-of-core streaming) is single-chip: the tile "
             "stream is fed by one host process (solver/ooc.py) — use "
             "backend='single', or drop --ooc for the mesh engines")
+    if warm_start is not None:
+        if alpha_init is not None or f_init is not None:
+            raise ValueError(
+                "pass either warm_start or alpha_init/f_init, not both")
+        from dpsvm_tpu.solver.warmstart import prepare_warm_start
+
+        n_dev = (int(mesh.size) if mesh is not None
+                 else int(num_devices or len(jax.devices())))
+        a0, f0, wstats = prepare_warm_start(x, y, config, warm_start,
+                                            mesh_devices=n_dev)
+        res = solve_mesh(x, y, config, num_devices=num_devices,
+                         mesh=mesh, callback=callback,
+                         checkpoint_path=checkpoint_path, resume=resume,
+                         alpha_init=a0, f_init=f0)
+        res.stats["warm_start"] = wstats
+        return res
     if config.reconstruct_every:
         # f64 reconstruction legs around the mesh solve — same scheme as
         # the single-chip delegation (solver/reconstruct.py).

@@ -83,12 +83,23 @@ class FleetProblem:
                 pair; None = the config's c_bounds(). Traced, so a C
                 sweep shares one compiled executor.
     tag      -- caller bookkeeping, returned in stats["tag"].
+    alpha_init / f_init -- per-problem warm-start carry (ISSUE 18):
+                (n,) float arrays over the shared row set, BOTH given
+                or both None. Callers must pass a seed that is already
+                feasibility-repaired against THIS problem's box with a
+                matching rebuilt gradient
+                (solver/warmstart.prepare_warm_start — values outside
+                `row_mask` must be zero / cold). When every problem in
+                a chunk is seedless the stacked carry is constructed
+                exactly as before, so cold fleets stay bit-identical.
     """
 
     y: np.ndarray
     row_mask: Optional[np.ndarray] = None
     c: object = None
     tag: object = None
+    alpha_init: Optional[np.ndarray] = None
+    f_init: Optional[np.ndarray] = None
 
 
 class FleetState(NamedTuple):
@@ -366,13 +377,43 @@ def solve_fleet(
             valid_stack[j, :n] = mask
             cb[j] = _problem_bounds(p, config)
             masks.append(mask)
+            if (p.alpha_init is None) != (p.f_init is None):
+                raise ValueError(
+                    f"problem {j}: alpha_init and f_init come together "
+                    "(solver/warmstart.prepare_warm_start builds the "
+                    "pair)")
 
         y_dev = jax.device_put(jnp.asarray(y_stack), device)
         valid_dev = jax.device_put(jnp.asarray(valid_stack), device)
         cb_dev = jax.device_put(jnp.asarray(cb), device)
+        if any(p.alpha_init is not None for p in problems):
+            # Warm-start carry (ISSUE 18): seeded problems write their
+            # repaired alpha / rebuilt f rows into the stacked numpy
+            # carries before upload; seedless problems keep the exact
+            # cold rows (alpha = 0, f = -y).
+            alpha_stack = np.zeros((k_pad, n_pad), np.float32)
+            f_stack = (-y_stack).astype(np.float32)
+            for j, p in enumerate(problems):
+                if p.alpha_init is None:
+                    continue
+                a_j = np.asarray(p.alpha_init, np.float32)
+                f_j = np.asarray(p.f_init, np.float32)
+                if a_j.shape != (n,) or f_j.shape != (n,):
+                    raise ValueError(
+                        f"problem {j}: alpha_init/f_init must be ({n},) "
+                        f"over the shared row set, got {a_j.shape} / "
+                        f"{f_j.shape}")
+                mask = masks[j]
+                alpha_stack[j, :n] = np.where(mask, a_j, 0.0)
+                f_stack[j, :n] = np.where(mask, f_j, f_stack[j, :n])
+            alpha0 = jnp.asarray(alpha_stack)
+            f0 = jnp.asarray(f_stack)
+        else:
+            alpha0 = jnp.zeros((k_pad, n_pad), jnp.float32)
+            f0 = jnp.asarray(-y_stack)  # f = -y at alpha = 0
         state = FleetState(
-            alpha=jnp.zeros((k_pad, n_pad), jnp.float32),
-            f=jnp.asarray(-y_stack),  # f = -y at alpha = 0
+            alpha=alpha0,
+            f=f0,
             b_hi=jnp.full((k_pad,), -jnp.inf, jnp.float32),
             b_lo=jnp.full((k_pad,), jnp.inf, jnp.float32),
             it=jnp.zeros((k_pad,), jnp.int32),

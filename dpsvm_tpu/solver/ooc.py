@@ -219,6 +219,7 @@ def solve_ooc(
     alpha_init=None,
     f_init=None,
     pad_to: Optional[int] = None,
+    warm_start=None,
 ) -> SolveResult:
     """Train binary C-SVC with host-resident X (config.ooc). Same
     result contract as solver/smo.solve; `x` may be any array-like the
@@ -242,8 +243,29 @@ def solve_ooc(
     Fault retries ride the shared run_with_fault_retry machinery and
     resume from the last checkpoint this run wrote (else restart from
     scratch) — host-scale ooc runs are exactly the multi-hour jobs
-    that get preempted."""
+    that get preempted.
+
+    `warm_start` (solver/warmstart.py, ISSUE 18): the seed is repaired
+    and its gradient rebuilt by the SAME streamed tile fold this
+    driver's rounds dispatch (one extra pass over host X, double-
+    buffered), then delegated to alpha_init/f_init. An all-zero
+    repaired seed routes bit-identically through the cold path; a
+    checkpoint resume, when present, still takes precedence."""
     from dpsvm_tpu.solver.smo import _precision_ctx
+
+    if warm_start is not None:
+        if alpha_init is not None or f_init is not None:
+            raise ValueError(
+                "pass either warm_start or alpha_init/f_init, not both")
+        from dpsvm_tpu.solver.warmstart import prepare_warm_start
+
+        a0, f0, wstats = prepare_warm_start(x, y, config, warm_start,
+                                            device=device)
+        res = solve_ooc(x, y, config, callback=callback, device=device,
+                        checkpoint_path=checkpoint_path, resume=resume,
+                        alpha_init=a0, f_init=f0, pad_to=pad_to)
+        res.stats["warm_start"] = wstats
+        return res
 
     def attempt(cfg_k, res_k, _k):
         return _solve_ooc_impl(x, y, cfg_k, callback, device,

@@ -1074,6 +1074,7 @@ def solve(
     alpha_init=None,
     f_init=None,
     pad_to: Optional[int] = None,
+    warm_start=None,
 ) -> SolveResult:
     """Train binary C-SVC on one chip. Returns SolveResult.
 
@@ -1108,9 +1109,31 @@ def solve(
     uses the 2n-variable expansion with f_init = [eps - z; -eps - z]
     (models/svr.py), one-class SVM a nonzero alpha_init (models/oneclass.py).
     A checkpoint resume, when present, takes precedence over both.
+
+    `warm_start` (ISSUE 18) is the high-level seed: a
+    solver.warmstart.WarmStart carry (a prior model's SVs or a raw
+    alpha vector) that is feasibility-repaired into THIS config's box/
+    equality constraints and whose gradient is rebuilt in one streamed
+    pass over X before delegating to the alpha_init/f_init plumbing. A
+    seed that repairs to all-zeros routes bit-identically through the
+    cold path (prepare_warm_start returns None). Mutually exclusive
+    with alpha_init/f_init.
     """
     import numpy as np
 
+    if warm_start is not None:
+        if alpha_init is not None or f_init is not None:
+            raise ValueError(
+                "pass either warm_start or alpha_init/f_init, not both")
+        from dpsvm_tpu.solver.warmstart import prepare_warm_start
+
+        a0, f0, wstats = prepare_warm_start(x, y, config, warm_start,
+                                            device=device)
+        res = solve(x, y, config, callback=callback, device=device,
+                    checkpoint_path=checkpoint_path, resume=resume,
+                    alpha_init=a0, f_init=f0, pad_to=pad_to)
+        res.stats["warm_start"] = wstats
+        return res
     if config.selection == "nu" and alpha_init is None:
         # The nu rule pairs within one class; from the C-SVC zero start no
         # class has both an I_up and an I_low member, so the gap reads

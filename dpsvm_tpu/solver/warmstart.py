@@ -1,0 +1,370 @@
+"""Warm-start carries for the solver stack (ISSUE 18).
+
+Every retrain used to start from the C-SVC cold point (alpha = 0,
+f = -y) and re-pay the full SMO trajectory even when a converged model
+for nearly the same data already existed. Graf et al.'s Cascade SVM
+(PAPERS.md) is the observation this module productizes: a solve seeded
+from the support vectors of a previous solution converges in a small
+fraction of the iterations. Three pieces:
+
+* :class:`WarmStart` — the carry format: seed alpha values plus an
+  optional row map placing them in the NEW training set (the previous
+  generation's SVs typically occupy rows ``0..n_sv-1`` when the new
+  increment is ``concat(prev.sv_x, fresh_rows)`` —
+  :func:`seed_from_model` builds exactly that).
+* :func:`repair_seed` — host-f64 feasibility repair: clip the seeded
+  alphas into the NEW per-class box (``config.c_bounds()`` — the box
+  may have shrunk across generations), rescale the heavier class side
+  so both sides carry the same mass, then zero the remaining
+  round-off residual of ``sum(alpha_i y_i)`` on a slack coordinate.
+  The repaired seed satisfies BOTH dual constraints.
+* :func:`warm_f_rebuild` — the gradient from the repaired seed in ONE
+  streamed pass over X, reusing the out-of-core tile fold
+  (:func:`dpsvm_tpu.ops.ooc.ooc_fold_tile`, ``want_dots=False``) under
+  the solver/ooc.py double-buffer structure: tile t+1's host->HBM put
+  is issued before tile t's fold dispatch, and every device operand is
+  tile- or seed-block-sized, so the same code path serves in-core and
+  out-of-core X. There is deliberately NO second Gram-pass
+  implementation here: the f64 certification leg is
+  :func:`dpsvm_tpu.solver.reconstruct.gram_matvec_f64` (the one shared
+  host-f64 kernel definition) and the streamed leg is the one shared
+  tile fold — the dedup contract tests/test_warmstart.py pins.
+* :func:`warm_rebuild_mesh` — the mesh form: seed rows are gathered
+  from the row-sharded X through ONE psum (a one-hot selector matmul,
+  the parallel/dist_smo.py ``_gather_row`` discipline widened to the
+  whole seed block), then each shard folds its local gradient slice
+  with zero further collectives. The tpulint ``warm_f_rebuild`` budget
+  pins both forms statically.
+
+The zero-seed contract: a seed that repairs to all-zeros (including
+``warm_start=None``) must route BIT-IDENTICALLY through today's cold
+path — :func:`prepare_warm_start` returns ``(None, None, stats)`` in
+that case so the solvers' existing ``alpha_init is None`` branches run
+untouched (pinned per engine in tests/test_warmstart.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+# Seed rows are folded in device blocks of this many query rows: a
+# FIXED block size (zero-padded tail) so a warm rebuild compiles one
+# fold shape per (tile, d) regardless of how many SVs the seed carries.
+Q_BLOCK = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class WarmStart:
+    """A solver seed: ``alpha[i]`` seeds training row ``rows[i]``.
+
+    ``rows=None`` means ``alpha`` is a full ``(n,)`` vector over the new
+    training set. Values are repaired (box + equality) before use, so a
+    carry from a DIFFERENT C / class-weight configuration is legal —
+    that is the cascade/C-sweep case.
+    """
+
+    alpha: np.ndarray
+    rows: Optional[np.ndarray] = None
+
+    def dense(self, n: int) -> np.ndarray:
+        """The seed as a float64 ``(n,)`` vector."""
+        a = np.asarray(self.alpha, np.float64).ravel()
+        if self.rows is None:
+            if a.shape[0] != n:
+                raise ValueError(
+                    f"WarmStart without rows wants a full ({n},) alpha "
+                    f"vector, got shape {a.shape}")
+            return a.copy()
+        rows = np.asarray(self.rows, np.int64).ravel()
+        if rows.shape != a.shape:
+            raise ValueError(
+                f"WarmStart rows/alpha length mismatch: {rows.shape} "
+                f"vs {a.shape}")
+        if rows.size and (rows.min() < 0 or rows.max() >= n):
+            raise ValueError(
+                f"WarmStart rows out of range for n={n}: "
+                f"[{rows.min()}, {rows.max()}]")
+        out = np.zeros(n, np.float64)
+        out[rows] = a
+        return out
+
+
+def seed_from_model(model) -> WarmStart:
+    """The generation-to-generation carry: a prior :class:`SVMModel`'s
+    SV alphas seeding rows ``0..n_sv-1`` — the layout of a new
+    increment built as ``concat(model.sv_x, fresh_rows)`` (the `cli
+    learn` loop's construction)."""
+    n_sv = int(model.sv_alpha.shape[0])
+    return WarmStart(alpha=np.asarray(model.sv_alpha, np.float64),
+                     rows=np.arange(n_sv, dtype=np.int64))
+
+
+def repair_seed(alpha: np.ndarray, y: np.ndarray, c_bounds: tuple,
+                max_fix_rounds: int = 8):
+    """Feasibility repair in host float64.
+
+    Returns ``(repaired (n,) f64, stats)`` with the repaired seed
+    satisfying ``0 <= a_i <= box_i`` (``box_i = c_pos`` for ``y_i=+1``
+    rows, ``c_neg`` for ``y_i=-1`` — the c_of discipline) and
+    ``sum(a_i y_i) = 0`` to f64 round-off, driven to exactly 0.0 by a
+    slack-coordinate correction loop in the generic case.
+
+    Repair order matters: clipping into a SHRUNK box (a new generation
+    trained at smaller C) can unbalance the class sides, so the
+    equality restore runs AFTER the clip — each side is scaled DOWN to
+    the lighter side's mass (scaling down never leaves the box), then
+    the residual lands on one coordinate with room.
+    """
+    y64 = np.asarray(y, np.float64)
+    a = np.asarray(alpha, np.float64).copy()
+    n = a.shape[0]
+    if y64.shape[0] != n:
+        raise ValueError(f"alpha/y length mismatch: {n} vs {y64.shape[0]}")
+    c_pos, c_neg = float(c_bounds[0]), float(c_bounds[1])
+    box = np.where(y64 > 0, c_pos, c_neg)
+    clipped = np.clip(a, 0.0, box)
+    n_clipped = int(np.count_nonzero(clipped != a))
+    a = clipped
+    pos, neg = y64 > 0, y64 <= 0
+    s_pos = float(a[pos].sum())
+    s_neg = float(a[neg].sum())
+    target = min(s_pos, s_neg)
+    if target <= 0.0:
+        # One side carries no mass: the only feasible point reachable by
+        # scaling down is alpha = 0 — the cold start.
+        a[:] = 0.0
+        return a, {"seed_nnz": 0, "clipped": n_clipped,
+                   "side_sums": (s_pos, s_neg), "scaled_to": 0.0,
+                   "residual": 0.0, "zero_seed": True}
+    if s_pos > target:
+        a[pos] *= target / s_pos
+    if s_neg > target:
+        a[neg] *= target / s_neg
+    # Round-off residual: scaling leaves |sum(a y)| at f64 noise; push
+    # it onto coordinates with slack until the recomputed sum is
+    # exactly zero (typically one pass).
+    residual = float(np.dot(a, y64))
+    for _ in range(max_fix_rounds):
+        if residual == 0.0:
+            break
+        # a_j -> a_j - r*y_j zeroes the sum iff the move stays in box.
+        need = residual * y64  # per-coordinate move, sign-resolved
+        ok = (a - need >= 0.0) & (a - need <= box)
+        cand = np.nonzero(ok & (a > 0.0))[0]
+        if cand.size == 0:
+            cand = np.nonzero(ok)[0]
+        if cand.size == 0:  # pragma: no cover - degenerate box
+            break
+        j = int(cand[np.argmax(a[cand])])
+        a[j] -= residual * y64[j]
+        residual = float(np.dot(a, y64))
+    nnz = int(np.count_nonzero(a))
+    return a, {"seed_nnz": nnz, "clipped": n_clipped,
+               "side_sums": (s_pos, s_neg), "scaled_to": target,
+               "residual": residual, "zero_seed": nnz == 0}
+
+
+def _row_norms_f32(blk: np.ndarray) -> np.ndarray:
+    return np.einsum("ij,ij->i", blk, blk).astype(np.float32)
+
+
+def warm_f_rebuild(x, y, alpha: np.ndarray, kp, device=None,
+                   tile_rows: int = 8192,
+                   q_block: int = Q_BLOCK) -> np.ndarray:
+    """The C-SVC gradient ``f = K (alpha*y) - y`` from a repaired seed,
+    in ONE streamed pass over X.
+
+    Structure is the solver/ooc.py round stream: host X is read once in
+    ``tile_rows`` blocks through the same ``_tile_host`` reader, tile
+    t+1's ``device_put`` is issued before tile t's fold dispatches (the
+    double buffer), and each tile's gradient slice is folded by the ONE
+    shared tile kernel — :func:`dpsvm_tpu.ops.ooc.ooc_fold_tile` with
+    ``want_dots=False`` (no cache currency; the warm path never
+    materializes dot rows). Seed rows ride as device-resident
+    ``q_block``-sized query blocks (zero coefficient padding is inert in
+    ``coef @ K``), so the compiled fold is a pure function of
+    ``(tile_rows, d, q_block)`` — never of n or of the SV count.
+
+    Works identically for in-core and out-of-core callers: both hold X
+    on the host at solve() entry; only who keeps it resident afterwards
+    differs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.ooc import ooc_fold_tile
+    from dpsvm_tpu.solver.ooc import _tile_host
+
+    x = np.asarray(x)
+    n, d = x.shape
+    y_np = np.asarray(y, np.float32)
+    coef = (np.asarray(alpha, np.float64)
+            * np.asarray(y, np.float64)).astype(np.float32)
+    f = (-y_np).astype(np.float32).copy()
+    nz = np.nonzero(coef != 0.0)[0]
+    if nz.size == 0:
+        return f
+    if device is None:
+        device = jax.devices()[0]
+
+    # Seed query blocks: gathered host-side, padded to q_block, resident
+    # on device across the whole tile stream (SV counts are small next
+    # to n — the cascade premise).
+    qblocks = []
+    for s in range(0, nz.size, q_block):
+        idx = nz[s:s + q_block]
+        qx = np.zeros((q_block, d), np.float32)
+        qx[:idx.size] = np.asarray(x[idx], np.float32)
+        qc = np.zeros((q_block,), np.float32)
+        qc[:idx.size] = coef[idx]
+        qblocks.append((jax.device_put(jnp.asarray(qx), device),
+                        jax.device_put(jnp.asarray(_row_norms_f32(qx)),
+                                       device),
+                        jax.device_put(jnp.asarray(qc), device)))
+
+    tile = max(1, min(int(tile_rows), n))
+    tiles = -(-n // tile)
+
+    def _put(i):
+        blk = _tile_host(x, i * tile, tile, n, d)
+        return (jax.device_put(jnp.asarray(blk), device),
+                jax.device_put(jnp.asarray(_row_norms_f32(blk)), device))
+
+    nxt = _put(0)
+    for i in range(tiles):
+        cur, nxt = nxt, (_put(i + 1) if i + 1 < tiles else None)
+        s = i * tile
+        t_real = min(tile, n - s)
+        ft = jnp.zeros((tile,), jnp.float32)
+        ft = ft.at[:t_real].set(f[s:s + t_real])
+        for qx_d, qsq_d, qc_d in qblocks:
+            ft, _, _ = ooc_fold_tile(cur[0], cur[1], ft, None,
+                                     qx_d, qsq_d, qc_d, kp=kp,
+                                     want_dots=False, compensated=False)
+        f[s:s + t_real] = np.asarray(ft)[:t_real]
+    return f
+
+
+def _warm_fold_mesh_factory(num_devices: int, kp, d: int,
+                            q_block: int = Q_BLOCK):
+    """The mesh warm-rebuild program: gather the seed block from the
+    row-sharded X through ONE psum, then fold each shard's gradient
+    slice locally.
+
+    Per dispatch: ``selT_loc`` is the (n_loc, q_block) one-hot seed
+    selector columns this shard owns; the packed local contribution
+    ``selT_loc.T @ [x_loc | xsq_loc | coef_loc]`` psums into the full
+    (q_block, d+2) seed operand on every device — the ONLY collective —
+    and the local fold ``f_loc + qcoef @ kernel(qx, x_loc)`` needs none.
+    The carried gradient shard is donated (the tile fold's donation
+    discipline; tpulint pins missed=0).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from dpsvm_tpu.ops.kernels import kernel_from_dots
+    from dpsvm_tpu.parallel.mesh import (DATA_AXIS, make_data_mesh,
+                                         mesh_shard_map)
+
+    mesh = make_data_mesh(num_devices)
+
+    def body(x_loc, xsq_loc, f_loc, selT_loc, coef_loc):
+        with jax.named_scope("warm_fold_mesh"):
+            packed = jnp.concatenate(
+                [x_loc, xsq_loc[:, None], coef_loc[:, None]], axis=1)
+            seed = jax.lax.psum(
+                jnp.dot(selT_loc.T, packed,
+                        preferred_element_type=jnp.float32), DATA_AXIS)
+            qx, qsq, qcoef = seed[:, :d], seed[:, d], seed[:, d + 1]
+            dots = jnp.dot(qx, x_loc.T,
+                           preferred_element_type=jnp.float32)
+            k = kernel_from_dots(dots, xsq_loc, qsq, kp)
+            return f_loc + qcoef @ k
+
+    mapped = jax.jit(mesh_shard_map(
+        body, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS), check=False), donate_argnums=(2,))
+    return mesh, mapped
+
+
+def warm_rebuild_mesh(x, y, alpha: np.ndarray, kp,
+                      num_devices: int,
+                      q_block: int = Q_BLOCK) -> np.ndarray:
+    """Mesh form of :func:`warm_f_rebuild`: same contract, gradient
+    computed shard-resident with exactly one psum per seed block. Rows
+    pad to the mesh's multiple with zero selector/coefficient columns
+    (inert in both the psum'd gather and the fold)."""
+    import numpy as _np
+
+    from dpsvm_tpu.parallel.mesh import shard_padded_rows
+
+    x = _np.asarray(x, _np.float32)
+    n, d = x.shape
+    y_np = _np.asarray(y, _np.float32)
+    coef = (_np.asarray(alpha, _np.float64)
+            * _np.asarray(y, _np.float64)).astype(_np.float32)
+    f = (-y_np).astype(_np.float32).copy()
+    nz = _np.nonzero(coef != 0.0)[0]
+    if nz.size == 0:
+        return f
+    mesh, mapped = _warm_fold_mesh_factory(num_devices, kp, d,
+                                           q_block=q_block)
+    xsq = _row_norms_f32(x)
+    x_d = shard_padded_rows(mesh, x)
+    xsq_d = shard_padded_rows(mesh, xsq)
+    n_pad = int(x_d.shape[0])
+    f_pad = _np.zeros(n_pad, _np.float32)
+    f_pad[:n] = f
+    f_d = shard_padded_rows(mesh, f_pad)
+    coef_pad = _np.zeros(n_pad, _np.float32)
+    coef_pad[:n] = coef
+    coef_d = shard_padded_rows(mesh, coef_pad)
+    for s in range(0, nz.size, q_block):
+        idx = nz[s:s + q_block]
+        selT = _np.zeros((n_pad, q_block), _np.float32)
+        selT[idx, _np.arange(idx.size)] = 1.0
+        f_d = mapped(x_d, xsq_d, f_d, shard_padded_rows(mesh, selT),
+                     coef_d)
+    return _np.asarray(f_d)[:n]
+
+
+def prepare_warm_start(x, y, config, warm: Optional[WarmStart],
+                       device=None, mesh_devices: Optional[int] = None):
+    """Repair + rebuild: the solvers' warm front door.
+
+    Returns ``(alpha_init, f_init, stats)`` as float32 host arrays ready
+    for the existing ``alpha_init``/``f_init`` plumbing — or
+    ``(None, None, stats)`` when the repaired seed is all-zero, so the
+    caller's ``alpha_init is None`` branch routes BIT-IDENTICALLY
+    through today's cold path (the pinned contract).
+
+    ``mesh_devices > 1`` rebuilds through the one-psum mesh fold
+    (solve_mesh's path); otherwise the single-chip tile stream.
+    """
+    x = np.asarray(x)
+    n, d = x.shape
+    stats: dict = {"seed_rows": 0}
+    if warm is None:
+        return None, None, {**stats, "zero_seed": True}
+    dense = warm.dense(n)
+    stats["seed_rows"] = int(np.count_nonzero(dense))
+    repaired, rstats = repair_seed(dense, y, config.c_bounds())
+    stats.update(rstats)
+    if rstats["zero_seed"]:
+        return None, None, stats
+    gamma = config.resolve_gamma(d)
+    from dpsvm_tpu.ops.kernels import KernelParams
+
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    if mesh_devices and mesh_devices > 1:
+        f = warm_rebuild_mesh(x, y, repaired, kp, mesh_devices)
+    else:
+        f = warm_f_rebuild(x, y, repaired, kp, device=device,
+                           tile_rows=int(config.ooc_tile_rows))
+    return repaired.astype(np.float32), f, stats

@@ -1,0 +1,200 @@
+"""The continuous-learning loop (dpsvm_tpu/learn.py — ISSUE 18):
+stream ingestion, warm generation-over-generation retraining, hot-swap
+publishing into a live serving engine, and the obs surface (generation
+events, `learn` report column, /metrics counters)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu import learn
+from dpsvm_tpu.config import ServeConfig, SVMConfig
+from dpsvm_tpu.ops.kernels import KernelParams
+
+CFG = SVMConfig(c=1.0, gamma=1.0 / 6, epsilon=1e-3, max_iter=50_000)
+KP = KernelParams(CFG.kernel, 1.0 / 6, CFG.degree, CFG.coef0)
+
+
+def _stream(gens=2, rows=160, d=6, seed=0, drift=0.15):
+    return learn.synthetic_stream(seed, d, rows, gens, drift)
+
+
+# ------------------------------------------------------------ streams
+
+def test_synthetic_stream_shapes_and_drift():
+    incs = list(_stream(gens=3, rows=50, d=4))
+    assert len(incs) == 3
+    for x, y in incs:
+        assert x.shape == (50, 4) and y.shape == (50,)
+        assert set(np.unique(y)) <= {-1, 1}
+    # drift: the increments are NOT identical draws
+    assert not np.array_equal(incs[0][1], incs[1][1])
+
+
+def test_file_stream_chunks_and_validation(tmp_path):
+    x = np.arange(30, dtype=np.float32).reshape(10, 3)
+    y = np.array([0, 1] * 5)
+    p = tmp_path / "stream.npz"
+    np.savez(p, x=x, y=y)
+    chunks = list(learn.file_stream(str(p), 4))
+    assert [c[0].shape[0] for c in chunks] == [4, 4, 2]
+    np.testing.assert_array_equal(np.concatenate([c[0] for c in chunks]),
+                                  x)
+    assert set(np.unique(np.concatenate([c[1] for c in chunks]))) == {-1, 1}
+
+    np.savez(tmp_path / "bad.npz", x=x, y=np.arange(10) % 3)
+    with pytest.raises(ValueError, match="binary-only"):
+        list(learn.file_stream(str(tmp_path / "bad.npz"), 4))
+    np.savez(tmp_path / "short.npz", x=x, y=y[:5])
+    with pytest.raises(ValueError, match="rows"):
+        list(learn.file_stream(str(tmp_path / "short.npz"), 4))
+
+
+# ----------------------------------------------------- the warm loop
+
+def test_run_learn_warm_generations_save_pairs(tmp_path):
+    """Two drifting generations with a MEASURED cold baseline: the
+    warm retrain (seeded from gen 0's SVs) spends fewer pairs than the
+    cold solve of the same increment."""
+    summary = learn.run_learn(_stream(gens=2, rows=200), CFG,
+                              str(tmp_path / "models"), KP,
+                              cold_baseline=True)
+    assert summary["generations"] == 2
+    g0, g1 = summary["gens"]
+    assert g0["seed_sv"] == 0 and not g0["estimated"]
+    assert g1["seed_sv"] > 0 and not g1["estimated"]
+    assert g1["rows"] == g1["seed_sv"] + 200  # concat(prev SVs, fresh)
+    assert g1["pairs_saved"] == g1["pairs_cold"] - g1["pairs"]
+    assert g1["pairs_saved"] > 0
+    assert summary["pairs_saved_total"] == g1["pairs_saved"]
+    # one model file per generation, loadable by the registry layer
+    for g in (0, 1):
+        assert os.path.exists(tmp_path / "models" / f"gen_{g:04d}.npz")
+
+
+def test_run_learn_estimated_baseline_flagged(tmp_path):
+    """Without --cold-baseline the cold pairs are RATE-ESTIMATED from
+    generation 0 — and must be flagged, never read as a measurement."""
+    summary = learn.run_learn(_stream(gens=2, rows=120), CFG,
+                              str(tmp_path / "m"), KP,
+                              cold_baseline=False)
+    g1 = summary["gens"][1]
+    assert g1["estimated"] is True
+    g0 = summary["gens"][0]
+    rate = g0["pairs"] / g0["rows"]
+    assert g1["pairs_cold"] == int(round(rate * g1["rows"]))
+
+
+# ------------------------------------- publishing: hot swap, no drops
+
+def test_run_learn_publishes_with_zero_downtime(tmp_path):
+    """The serving integration: every generation is published through
+    register/swap, the post-swap probe answers ok, requests IN FLIGHT
+    across the swap are neither dropped nor failed, and the
+    per-generation counters land on the engine's /metrics registry."""
+    from dpsvm_tpu.serving import ServingEngine
+
+    eng = ServingEngine(ServeConfig(buckets=(16, 64)))
+    inflight = {}
+    done = {}
+    orig_drain = eng.drain
+
+    def drain_accumulating():
+        # run_learn's per-generation probe drains too — fold every
+        # drained ticket into one ledger so none is "lost" to the test.
+        out = orig_drain()
+        done.update(out)
+        return out
+
+    eng.drain = drain_accumulating
+
+    def hammer(g, model, info):
+        # Enqueue WITHOUT draining: these ride across the next swap.
+        for i in range(3):
+            q = np.asarray(model.sv_x[:4], np.float32)
+            inflight[eng.submit(q, model="learn")] = g
+        eng.pump()
+
+    try:
+        summary = learn.run_learn(_stream(gens=3, rows=120), CFG,
+                                  str(tmp_path / "m"), KP,
+                                  cold_baseline=True, engine=eng,
+                                  model_name="learn",
+                                  on_generation=hammer)
+        eng.drain()
+    finally:
+        eng.close()
+
+    assert summary["generations"] == 3
+    assert all(g["probe_verdict"] == "ok" for g in summary["gens"])
+    assert eng.hot_swaps.value == 2  # gen 0 registers, 1 and 2 swap
+    # zero downtime: every in-flight ticket answered, none failed
+    for t, g in inflight.items():
+        assert t in done, f"ticket from gen {g} dropped across swap"
+        assert done[t].verdict == "ok"
+    snap = eng.metrics.snapshot()
+    assert snap["learn.generations_total"] == 3
+    assert snap["learn.pairs_total"] == summary["pairs_total"]
+    assert snap["learn.pairs_saved_total"] == summary["pairs_saved_total"]
+
+
+# --------------------------------------------------- obs: runlog + report
+
+def test_generation_events_and_learn_report_column(tmp_path, monkeypatch):
+    """DPSVM_OBS=1: the loop writes one `learn` runlog with a
+    `generation` event per model, summarize_run surfaces the learn
+    fields, and `cli obs report` renders the learn column."""
+    from dpsvm_tpu.obs import analyze
+
+    monkeypatch.setenv("DPSVM_OBS", "1")
+    monkeypatch.chdir(tmp_path)
+    learn.run_learn(_stream(gens=2, rows=120), CFG,
+                    str(tmp_path / "m"), KP, cold_baseline=True)
+    runs = analyze.load_runs([str(tmp_path / "obs_runs")])
+    (run,) = [r for r in runs if r.manifest["tool"] == "learn"]
+    events = [e for e in run.events if e.get("name") == "generation"]
+    assert len(events) == 2
+    for e in events:
+        for k in ("gen", "rows", "seed_sv", "sv", "pairs", "pairs_cold",
+                  "pairs_saved", "estimated"):
+            assert k in e
+    s = analyze.summarize_run(run)
+    assert s["generations"] == 2
+    assert s["learn_seed_sv_last"] == events[-1]["seed_sv"] > 0
+    assert s["learn_pairs_saved"] == events[-1]["pairs_saved"]
+    assert s["learn_estimated"] is False
+    json.dumps(s)
+
+    txt = analyze.render_report([s])
+    assert "learn" in txt
+    assert f"gen=2 seed={s['learn_seed_sv_last']}" in txt
+
+
+# ----------------------------------------------------------- the CLI
+
+def test_cli_learn_smoke(tmp_path, monkeypatch, capsys):
+    """`cli learn --smoke` — the make learn_smoke / tier-1 shape: two
+    generations, measured cold baseline, in-process engine, asserts
+    pairs saved > 0 and post-swap probes serve."""
+    monkeypatch.chdir(tmp_path)
+    assert learn.run_cli(["--smoke",
+                          "--model-dir", str(tmp_path / "m")]) == 0
+    out = capsys.readouterr().out
+    assert "learn smoke PASS" in out
+    assert "saved" in out
+
+
+def test_cli_forwards_learn(tmp_path, monkeypatch, capsys):
+    from dpsvm_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(["learn", "--generations", "2", "--rows", "96",
+                   "--d", "4", "--cold-baseline", "--json",
+                   "--model-dir", str(tmp_path / "m")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out.strip().splitlines()[-1])
+    assert payload["generations"] == 2
+    assert payload["gens"][1]["seed_sv"] > 0

@@ -1,0 +1,332 @@
+"""Warm-start carries (solver/warmstart.py, solver/cascade.py —
+ISSUE 18).
+
+Three load-bearing contracts:
+
+* ZERO-SEED ROUTING: a seed that repairs to all-zeros (including the
+  literal zero vector) must route BIT-IDENTICALLY through the cold
+  path on every engine — same iterations, same alpha bits, same
+  gradient bits.  prepare_warm_start returns (None, None, stats) so the
+  solvers' existing ``alpha_init is None`` branches run untouched.
+* FEASIBILITY REPAIR: for ANY seed — out-of-box, unbalanced,
+  carried from a larger C into a shrunk box — the repaired alphas sit
+  inside the per-class box and satisfy sum(alpha_i y_i) = 0.
+* ONE SHARED FOLD: the warm gradient rebuild streams through
+  ops/ooc.ooc_fold_tile (want_dots=False) — no second Gram-pass
+  implementation — and the mesh rebuild (one psum per seed block) is
+  BITWISE equal to the single-chip tile stream.
+"""
+
+import numpy as np
+import pytest
+
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synth import make_blobs_binary
+from dpsvm_tpu.models.svm_model import SVMModel
+from dpsvm_tpu.ops.kernels import KernelParams
+from dpsvm_tpu.solver.smo import solve
+from dpsvm_tpu.solver.warmstart import (WarmStart, prepare_warm_start,
+                                        repair_seed, seed_from_model,
+                                        warm_f_rebuild, warm_rebuild_mesh)
+
+CFG = SVMConfig(c=1.5, epsilon=1e-3, max_iter=50_000)
+
+
+def _kp(cfg, d):
+    return KernelParams(cfg.kernel, cfg.resolve_gamma(d), cfg.degree,
+                        cfg.coef0)
+
+
+def _assert_bitwise(a, b):
+    assert a.iterations == b.iterations
+    assert a.converged == b.converged
+    assert a.b_hi == b.b_hi and a.b_lo == b.b_lo
+    np.testing.assert_array_equal(a.alpha, b.alpha)
+    np.testing.assert_array_equal(a.stats["f"], b.stats["f"])
+
+
+@pytest.fixture(scope="module")
+def data():
+    return make_blobs_binary(n=256, d=8, seed=3, sep=0.9)
+
+
+# --------------------------------------------- zero-seed routing pins
+
+def test_zero_seed_bitwise_single(data):
+    x, y = data
+    cold = solve(x, y, CFG)
+    warm = solve(x, y, CFG, warm_start=WarmStart(alpha=np.zeros(len(y))))
+    _assert_bitwise(cold, warm)
+    assert warm.stats["warm_start"]["zero_seed"] is True
+
+
+def test_zero_seed_bitwise_mesh(data):
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = data
+    cold = solve_mesh(x, y, CFG, num_devices=8)
+    warm = solve_mesh(x, y, CFG, num_devices=8,
+                      warm_start=WarmStart(alpha=np.zeros(len(y))))
+    _assert_bitwise(cold, warm)
+    assert warm.stats["warm_start"]["zero_seed"] is True
+
+
+def test_zero_seed_bitwise_ooc(data):
+    x, y = data
+    cfg = CFG.replace(engine="block", working_set_size=64, ooc=True,
+                      ooc_tile_rows=64)
+    cold = solve(x, y, cfg)
+    warm = solve(x, y, cfg, warm_start=WarmStart(alpha=np.zeros(len(y))))
+    _assert_bitwise(cold, warm)
+    assert warm.stats["warm_start"]["zero_seed"] is True
+
+
+def test_zero_seed_bitwise_fleet(data):
+    """The fleet's carry is per-problem alpha_init/f_init; the zero
+    carry (alpha=0, f=-y) IS the cold start and must not perturb a
+    single bit of the trajectory."""
+    from dpsvm_tpu.solver.fleet import FleetProblem, solve_fleet
+
+    x, y = data
+    cfg = SVMConfig(c=1.5, epsilon=1e-3, max_iter=50_000)
+    cold = solve_fleet(x, [FleetProblem(y=y)], cfg)[0]
+    warm = solve_fleet(x, [FleetProblem(
+        y=y, alpha_init=np.zeros(len(y), np.float32),
+        f_init=(-np.asarray(y)).astype(np.float32))], cfg)[0]
+    assert cold.iterations == warm.iterations
+    np.testing.assert_array_equal(cold.alpha, warm.alpha)
+    np.testing.assert_array_equal(cold.stats["f"], warm.stats["f"])
+
+
+def test_seed_rows_out_of_range_rejected(data):
+    x, y = data
+    bad = WarmStart(alpha=np.ones(4), rows=np.array([0, 1, 2, len(y)]))
+    with pytest.raises(ValueError, match="out of range"):
+        solve(x, y, CFG, warm_start=bad)
+    with pytest.raises(ValueError, match="not both"):
+        solve(x, y, CFG, warm_start=WarmStart(alpha=np.zeros(len(y))),
+              alpha_init=np.zeros(len(y), np.float32),
+              f_init=np.zeros(len(y), np.float32))
+
+
+# ------------------------------------------- feasibility-repair laws
+
+def _check_feasible(a, y, c_bounds):
+    c_pos, c_neg = c_bounds
+    box = np.where(np.asarray(y, np.float64) > 0, c_pos, c_neg)
+    assert np.all(a >= 0.0) and np.all(a <= box + 1e-12)
+    assert abs(float(np.dot(a, np.asarray(y, np.float64)))) < 1e-9
+
+
+def test_repair_adversarial_seeds_property():
+    """Random out-of-box, negative, unbalanced seeds against random
+    (asymmetric) boxes: the repaired seed always satisfies BOTH dual
+    constraints."""
+    rng = np.random.default_rng(7)
+    for trial in range(20):
+        n = int(rng.integers(8, 200))
+        y = np.where(rng.random(n) < 0.5, 1, -1).astype(np.int32)
+        if np.all(y == y[0]):  # degenerate single-class draw
+            y[0] = -y[0]
+        c_bounds = (float(rng.uniform(0.1, 3.0)),
+                    float(rng.uniform(0.1, 3.0)))
+        seed = rng.uniform(-2.0, 4.0, size=n)
+        a, st = repair_seed(seed, y, c_bounds)
+        _check_feasible(a, y, c_bounds)
+        assert st["seed_nnz"] == int(np.count_nonzero(a))
+        # Idempotence: repairing a feasible point is (near-)identity.
+        a2, _ = repair_seed(a, y, c_bounds)
+        np.testing.assert_allclose(a2, a, rtol=0, atol=1e-12)
+
+
+def test_repair_c_shrink_across_generations(data):
+    """The cascade/C-sweep case: a converged solution at C=4 carried
+    into a generation trained at C=0.25 — clipping into the shrunk box
+    unbalances the class sides; the repair must restore equality."""
+    x, y = data
+    big = solve(x, y, CFG.replace(c=4.0))
+    shrunk = SVMConfig(c=0.25)
+    a, st = repair_seed(np.asarray(big.alpha, np.float64), y,
+                        shrunk.c_bounds())
+    _check_feasible(a, y, shrunk.c_bounds())
+    assert st["clipped"] > 0 and not st["zero_seed"]
+    # And the solver accepts the carry end-to-end.
+    res = solve(x, y, CFG.replace(c=0.25),
+                warm_start=WarmStart(alpha=np.asarray(big.alpha,
+                                                      np.float64)))
+    assert res.converged
+    # The solver iterates in f32 — its output satisfies the equality
+    # to f32 round-off (the repair's exact-zero bar is f64-only).
+    a_out = np.asarray(res.alpha, np.float64)
+    box = np.where(np.asarray(y, np.float64) > 0,
+                   shrunk.c_bounds()[0], shrunk.c_bounds()[1])
+    assert np.all(a_out >= 0.0) and np.all(a_out <= box + 1e-6)
+    assert abs(float(np.dot(a_out, np.asarray(y, np.float64)))) < 1e-4
+
+
+def test_repair_one_sided_seed_is_cold():
+    """Mass on one class only: no feasible rescale exists except
+    alpha=0 — the repair must declare a zero seed (which the solvers
+    route through the cold path)."""
+    y = np.array([1, 1, -1, -1], np.int32)
+    a, st = repair_seed(np.array([1.0, 0.5, 0.0, 0.0]), y, (1.0, 1.0))
+    assert st["zero_seed"] and np.all(a == 0.0)
+    a0, f0, st2 = prepare_warm_start(
+        np.zeros((4, 2), np.float32), y, SVMConfig(c=1.0),
+        WarmStart(alpha=np.array([1.0, 0.5, 0.0, 0.0])))
+    assert a0 is None and f0 is None and st2["zero_seed"]
+
+
+# ------------------------------------- the ONE streamed gradient fold
+
+def test_warm_rebuild_matches_f64_reference_and_shares_fold(monkeypatch):
+    """f = K (alpha*y) - y from the tile stream matches the host-f64
+    kernel evaluation, and every device fold routes through the ONE
+    shared tile kernel — ops/ooc.ooc_fold_tile with want_dots=False
+    (the dedup contract: no second Gram-pass implementation)."""
+    import dpsvm_tpu.ops.ooc as ooc_mod
+
+    x, y = make_blobs_binary(n=300, d=12, seed=5, sep=0.8)
+    res = solve(x, y, CFG)
+    a, _ = repair_seed(np.asarray(res.alpha, np.float64), y,
+                       CFG.c_bounds())
+    kp = _kp(CFG, 12)
+
+    calls = []
+    orig = ooc_mod.ooc_fold_tile
+
+    def spy(*args, **kw):
+        calls.append(kw)
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(ooc_mod, "ooc_fold_tile", spy)
+    f = warm_f_rebuild(x, y, a, kp, tile_rows=128)
+    assert calls and all(k.get("want_dots") is False for k in calls)
+
+    # Host-f64 reference: the one shared f64 kernel definition.
+    from dpsvm_tpu.solver.reconstruct import gram_matvec_f64
+
+    coef = a * np.asarray(y, np.float64)
+    f_ref = gram_matvec_f64(x, coef, kp) - np.asarray(y, np.float64)
+    np.testing.assert_allclose(f, f_ref, rtol=0, atol=5e-5)
+
+
+def test_mesh_rebuild_bitwise_vs_single_chip():
+    """The one-psum mesh rebuild reproduces the single-chip tile
+    stream BIT-FOR-BIT: the one-hot psum gather is f32-exact, and the
+    per-row fold contracts over the same q_block operands in both
+    forms."""
+    x, y = make_blobs_binary(n=700, d=12, seed=9, sep=0.8)
+    rng = np.random.default_rng(0)
+    seed = rng.uniform(0.0, 1.5, size=700) * (rng.random(700) < 0.2)
+    a, _ = repair_seed(seed, y, (1.5, 1.5))
+    kp = _kp(CFG, 12)
+    f_single = warm_f_rebuild(x, y, a, kp, tile_rows=128)
+    f_mesh = warm_rebuild_mesh(x, y, a, kp, num_devices=8)
+    np.testing.assert_array_equal(f_single, f_mesh)
+
+
+# ------------------------------- warm-vs-cold model agreement (mnist)
+
+def test_warm_vs_cold_same_model_mnist_shape():
+    """The increment retrain on mnist-shaped synth (d=784): warm solve
+    seeded from the previous generation's SVs reaches the same model as
+    the cold solve of the increment — within tolerance — for fewer
+    pairs."""
+    rng = np.random.default_rng(11)
+    d, n0, n1 = 784, 192, 96
+    centers = rng.normal(size=(2, d)) * 0.35
+
+    def draw(n):
+        lab = rng.integers(0, 2, size=n)
+        xs = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+        return xs, np.where(lab > 0, 1, -1).astype(np.int32)
+
+    x0, y0 = draw(n0)
+    xf, yf = draw(n1)
+    cfg = SVMConfig(c=1.0, epsilon=1e-3, max_iter=50_000)
+    kp = _kp(cfg, d)
+    base = solve(x0, y0, cfg)
+    m0 = SVMModel.from_dense(x0, y0, base.alpha, base.b, kp)
+
+    x_inc = np.concatenate([np.asarray(m0.sv_x, np.float32), xf])
+    y_inc = np.concatenate([np.asarray(m0.sv_y, np.int32), yf])
+    cold = solve(x_inc, y_inc, cfg)
+    warm = solve(x_inc, y_inc, cfg, warm_start=seed_from_model(m0))
+    assert warm.converged and cold.converged
+    assert warm.iterations < cold.iterations  # the perf claim, in small
+    assert warm.stats["warm_start"]["seed_rows"] > 0
+
+    import importlib
+
+    predict = importlib.import_module("dpsvm_tpu.predict")
+    mc = SVMModel.from_dense(x_inc, y_inc, cold.alpha, cold.b, kp)
+    mw = SVMModel.from_dense(x_inc, y_inc, warm.alpha, warm.b, kp)
+    xt, _ = draw(128)
+    agree = float(np.mean(predict.predict(mc, xt)
+                          == predict.predict(mw, xt)))
+    assert agree >= 0.97
+
+
+# --------------------------------------------------- cascade merging
+
+def test_cascade_partition_covers_exactly_once():
+    from dpsvm_tpu.solver.cascade import cascade_partition
+
+    for n, b in [(1000, 256), (256, 256), (257, 256), (5, 64)]:
+        blocks = cascade_partition(n, b)
+        allidx = np.concatenate(blocks)
+        assert sorted(allidx.tolist()) == list(range(n))
+        sizes = {len(blk) for blk in blocks}
+        assert max(sizes) - min(sizes) <= 1  # strided => balanced
+
+
+def test_cascade_solve_agrees_with_flat_solve():
+    from dpsvm_tpu.solver.cascade import cascade_solve
+
+    x, y = make_blobs_binary(n=400, d=10, seed=13, sep=0.8)
+    cfg = SVMConfig(c=1.0, epsilon=1e-3, max_iter=50_000)
+    kp = _kp(cfg, 10)
+    flat = solve(x, y, cfg)
+    res, st = cascade_solve(x, y, cfg, block_rows=128)
+    assert res.converged
+    assert st["blocks"] and st["final_iterations"] >= 0
+    assert res.stats["cascade"] is st
+
+    import importlib
+
+    predict = importlib.import_module("dpsvm_tpu.predict")
+    mf = SVMModel.from_dense(x, y, flat.alpha, flat.b, kp)
+    mc = SVMModel.from_dense(x, y, res.alpha, res.b, kp)
+    xt, _ = make_blobs_binary(n=200, d=10, seed=14, sep=0.8)
+    agree = float(np.mean(predict.predict(mf, xt)
+                          == predict.predict(mc, xt)))
+    assert agree >= 0.97
+
+
+def test_cascade_degenerates_to_single_warm_solve(data):
+    """Increments at or under block_rows run as ONE warm solve — no
+    block stage, one seeded final solve (the cli learn default)."""
+    from dpsvm_tpu.solver.cascade import cascade_solve
+
+    x, y = data
+    res, st = cascade_solve(x, y, CFG, block_rows=4096)
+    assert len(st["blocks"]) <= 1
+    flat = solve(x, y, CFG)
+    _assert_bitwise(flat, res)  # seedless degenerate IS the cold solve
+
+
+# ------------------------------------------------- warm C-sweep walk
+
+def test_svc_c_sweep_warm_walk_matches_cold():
+    from dpsvm_tpu.estimators import svc_c_sweep
+
+    x, y = make_blobs_binary(n=160, d=8, seed=17, sep=0.8)
+    cs = [2.0, 0.5, 1.0]  # unsorted: results must come back in Cs order
+    cold = svc_c_sweep(x, y, cs, gamma=0.2, tol=1e-3, backend="single")
+    warm = svc_c_sweep(x, y, cs, gamma=0.2, tol=1e-3, backend="single",
+                       warm=True)
+    assert [e.C for e in warm] == cs
+    for ec, ew in zip(cold, warm):
+        agree = float(np.mean(ec.predict(x) == ew.predict(x)))
+        assert agree >= 0.95

@@ -1,0 +1,363 @@
+"""Continuous-learning benchmark: warm-start vs cold retraining A/B.
+
+The warm-start machinery (solver/warmstart.py + solver/cascade.py,
+ISSUE 18) claims that retraining an increment FROM THE PREVIOUS
+GENERATION'S SUPPORT VECTORS reaches cold-start accuracy with fewer
+optimization pairs and less wall clock.  This tool measures that claim
+three ways:
+
+* **Increment A/B** (the headline): train generation 0 cold on an
+  MNIST-shaped synthetic base (d=784), form the continuous-learning
+  increment ``concat(gen0 SVs, fresh drifted rows)``, and solve it
+  BOTH ways — cold from scratch vs warm through the cascade.  Both
+  legs see the IDENTICAL increment (drift matched by construction —
+  the drift-normalization the cross-session gate needs), and the A/B
+  only counts if both models reach the same held-out accuracy within
+  the stated tolerance.  Headline metric: percent pairs saved.
+* **C-sweep walk**: ``svc_c_sweep(..., warm=True)`` across a >=5-point
+  C grid vs the cold fleet sweep — total-pairs cut at per-C prediction
+  agreement.
+* **Drifting-distribution serving leg**: a live ServingEngine serves
+  the generation-0 model under closed-loop load (tools/loadgen.py
+  closed_loop) while the loop retrains generation 1 warm on drifted
+  rows and hot-swaps it in at the halfway point — the acceptance
+  contract is ZERO failed/lost requests across the mid-traffic swap.
+
+Writes BENCH_LEARN_r<NN>.json at the repo root (commit it — the
+artifact, not the commit message, is the evidence) and REWRITES
+BENCH_LEARN.md.  The headline pairs-cut percent runs through the same
+drift-normalized cross-session regression gate as every other bench
+family (bench._regression_gate over BENCH_LEARN_r*.json).  Pair counts
+are platform-independent; wall clocks on a CPU harness carry
+device_numbers=pending until a TPU session re-runs this tool.
+
+Run: `python tools/bench_learn.py [--rows N] [--d D]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _accuracy(model, x, y) -> float:
+    import importlib
+
+    predict = importlib.import_module("dpsvm_tpu.predict")
+    return float((predict.predict(model, x) == np.asarray(y)).mean())
+
+
+def _increment_ab(rows: int, d: int, drift: float, acc_tol: float,
+                  seed: int = 5) -> dict:
+    """The headline A/B: one warm-started increment retrain vs the cold
+    solve of the identical increment, at matched held-out accuracy."""
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.learn import synthetic_stream
+    from dpsvm_tpu.models.svm_model import SVMModel
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.solver.cascade import cascade_solve
+    from dpsvm_tpu.solver.smo import solve
+    from dpsvm_tpu.solver.warmstart import seed_from_model
+
+    cfg = SVMConfig(c=1.0, gamma=1.0 / d, epsilon=1e-3,
+                    max_iter=200_000)
+    kp = KernelParams("rbf", 1.0 / d)
+    gens = list(synthetic_stream(seed, d, rows, 3, drift))
+    (x0, y0), (x1, y1), (xt, yt) = gens  # base, fresh, held-out test
+
+    t0 = time.perf_counter()
+    r0 = solve(x0, y0, cfg)
+    gen0_seconds = time.perf_counter() - t0
+    m0 = SVMModel.from_dense(x0, y0, r0.alpha, r0.b, kp)
+
+    x_inc = np.concatenate([np.asarray(m0.sv_x, np.float32), x1])
+    y_inc = np.concatenate([np.asarray(m0.sv_y, np.int32), y1])
+
+    t0 = time.perf_counter()
+    cold = solve(x_inc, y_inc, cfg)
+    cold_seconds = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm, st = cascade_solve(x_inc, y_inc, cfg,
+                             seed=seed_from_model(m0))
+    warm_seconds = time.perf_counter() - t0
+
+    mc = SVMModel.from_dense(x_inc, y_inc, cold.alpha, cold.b, kp)
+    mw = SVMModel.from_dense(x_inc, y_inc, warm.alpha, warm.b, kp)
+    acc_cold = _accuracy(mc, xt, yt)
+    acc_warm = _accuracy(mw, xt, yt)
+    pairs_cold = int(cold.iterations)
+    pairs_warm = int(st["total_iterations"])
+    return {
+        "rows_base": int(x0.shape[0]), "rows_fresh": int(x1.shape[0]),
+        "rows_increment": int(x_inc.shape[0]), "d": int(d),
+        "drift_radians_per_generation": float(drift),
+        "gen0_pairs": int(r0.iterations),
+        "gen0_seconds": round(gen0_seconds, 4),
+        "seed_sv": int(m0.sv_x.shape[0]),
+        "pairs_cold": pairs_cold, "pairs_warm": pairs_warm,
+        "pairs_saved": pairs_cold - pairs_warm,
+        "pairs_cut_percent": round(
+            100.0 * (1.0 - pairs_warm / pairs_cold), 2),
+        "wall_seconds_cold": round(cold_seconds, 4),
+        "wall_seconds_warm": round(warm_seconds, 4),
+        "wall_cut_percent": round(
+            100.0 * (1.0 - warm_seconds / cold_seconds), 2),
+        "holdout_accuracy_cold": round(acc_cold, 4),
+        "holdout_accuracy_warm": round(acc_warm, 4),
+        "accuracy_tolerance": acc_tol,
+        "accuracy_matched": bool(abs(acc_warm - acc_cold) <= acc_tol),
+        "warm_start_stats": warm.stats.get("warm_start"),
+    }
+
+
+def _c_sweep_ab(seed: int = 6) -> dict:
+    """Warm regularization-path walk vs the cold fleet sweep across a
+    5-point C grid: total pairs, per-C prediction agreement."""
+    from dpsvm_tpu.estimators import svc_c_sweep
+
+    rng = np.random.default_rng(seed)
+    n, d = 512, 16
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.where(x[:, 0] + 0.4 * rng.normal(size=n) > 0, 1, -1)
+    xt = rng.normal(size=(512, d)).astype(np.float32)
+    Cs = [0.1, 0.3, 1.0, 3.0, 10.0]
+
+    t0 = time.perf_counter()
+    cold = svc_c_sweep(x, y, Cs, backend="single", gamma=1.0 / d)
+    cold_seconds = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm = svc_c_sweep(x, y, Cs, warm=True, backend="single",
+                       gamma=1.0 / d)
+    warm_seconds = time.perf_counter() - t0
+
+    pairs_cold = int(sum(e.n_iter_ for e in cold))
+    pairs_warm = int(sum(e.n_iter_ for e in warm))
+    agreement = [round(float((c.predict(xt) == w.predict(xt)).mean()), 4)
+                 for c, w in zip(cold, warm)]
+    return {
+        "n": n, "d": d, "Cs": Cs,
+        "pairs_per_c_cold": [int(e.n_iter_) for e in cold],
+        "pairs_per_c_warm": [int(e.n_iter_) for e in warm],
+        "pairs_cold_total": pairs_cold, "pairs_warm_total": pairs_warm,
+        "pairs_cut_percent": round(
+            100.0 * (1.0 - pairs_warm / pairs_cold), 2),
+        "wall_seconds_cold": round(cold_seconds, 4),
+        "wall_seconds_warm": round(warm_seconds, 4),
+        "prediction_agreement_per_c": agreement,
+        "min_agreement": min(agreement),
+    }
+
+
+def _drift_serving_leg(tmp: str, requests: int, seed: int = 7) -> dict:
+    """The live loop under load: generation 0 serves while generation 1
+    retrains warm on drifted rows and hot-swaps in mid-traffic.  Zero
+    failed/lost requests across the swap is the acceptance contract."""
+    from tools.loadgen import closed_loop
+
+    from dpsvm_tpu.config import ServeConfig, SVMConfig
+    from dpsvm_tpu.learn import synthetic_stream, train_generation
+    from dpsvm_tpu.ops.kernels import KernelParams
+    from dpsvm_tpu.serving import ServingEngine
+
+    d = 24
+    cfg = SVMConfig(c=1.0, gamma=1.0 / d, epsilon=1e-3,
+                    max_iter=100_000)
+    kp = KernelParams("rbf", 1.0 / d)
+    gens = list(synthetic_stream(seed, d, 384, 2, 0.15))
+    model0, info0 = train_generation(None, gens[0][0], gens[0][1],
+                                     cfg, kp)
+    p0 = os.path.join(tmp, "gen_0000.npz")
+    model0.save(p0)
+
+    engine = ServingEngine(ServeConfig(buckets=(64,)))
+    try:
+        engine.register("learn", p0)
+        swap_info = {}
+
+        def retrain_and_swap():
+            t0 = time.perf_counter()
+            model1, info1 = train_generation(
+                model0, gens[1][0], gens[1][1], cfg, kp,
+                cold_baseline=True)
+            p1 = os.path.join(tmp, "gen_0001.npz")
+            model1.save(p1)
+            engine.swap("learn", p1)
+            engine.metrics.counter("learn.generations_total").add(1)
+            engine.metrics.counter("learn.pairs_total").add(
+                info1["pairs"])
+            engine.metrics.counter("learn.pairs_saved_total").add(
+                max(0, info1["pairs_saved"]))
+            swap_info.update(
+                gen1_pairs=info1["pairs"],
+                gen1_pairs_cold=info1["pairs_cold"],
+                gen1_pairs_saved=info1["pairs_saved"],
+                retrain_and_swap_seconds=round(
+                    time.perf_counter() - t0, 4))
+
+        leg = closed_loop(engine, requests, concurrency=4,
+                          sizes=[1, 4, 16], traffic=[("learn", 1.0)],
+                          seed=seed, swap_at=0.5,
+                          swap_fn=retrain_and_swap)
+        snap_counters = {
+            name: engine.metrics.counter(name).value
+            for name in ("learn.generations_total", "learn.pairs_total",
+                         "learn.pairs_saved_total")}
+    finally:
+        engine.close()
+    return {
+        "gen0_pairs": info0["pairs"],
+        "swap": swap_info,
+        "loadgen": {k: leg[k] for k in
+                    ("requests", "rows", "wall_seconds",
+                     "rows_per_second", "verdicts", "failed",
+                     "deadline_misses")},
+        "learn_metrics": snap_counters,
+        "zero_loss_across_swap": bool(
+            leg["failed"] == 0 and leg["verdicts"]["failed"] == 0),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int, default=768,
+                    help="base rows for the MNIST-shaped increment A/B "
+                         "(CPU-harness friendly default; raise on TPU)")
+    ap.add_argument("--d", type=int, default=784)
+    ap.add_argument("--drift", type=float, default=0.1,
+                    help="radians of boundary rotation per generation")
+    ap.add_argument("--acc-tol", type=float, default=0.02,
+                    help="matched-accuracy tolerance for the A/B")
+    ap.add_argument("--requests", type=int, default=96,
+                    help="closed-loop requests for the serving leg")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    import jax
+
+    import bench
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    calibration = bench._session_calibration()
+    print(f"[bench_learn] device={dev} "
+          f"calibration={json.dumps(calibration)}", file=sys.stderr)
+
+    ab = _increment_ab(args.rows, args.d, args.drift, args.acc_tol)
+    print(f"[bench_learn] increment A/B: cold={ab['pairs_cold']} "
+          f"warm={ab['pairs_warm']} pairs "
+          f"({ab['pairs_cut_percent']}% cut, "
+          f"wall {ab['wall_cut_percent']}% cut), acc "
+          f"{ab['holdout_accuracy_cold']} vs "
+          f"{ab['holdout_accuracy_warm']}", file=sys.stderr)
+    assert ab["accuracy_matched"], ab
+    assert ab["pairs_saved"] > 0, ab
+    assert ab["wall_cut_percent"] > 0, ab
+
+    sweep = _c_sweep_ab()
+    print(f"[bench_learn] C-sweep walk: "
+          f"cold={sweep['pairs_cold_total']} "
+          f"warm={sweep['pairs_warm_total']} pairs "
+          f"({sweep['pairs_cut_percent']}% cut), min agreement "
+          f"{sweep['min_agreement']}", file=sys.stderr)
+    assert sweep["pairs_warm_total"] < sweep["pairs_cold_total"], sweep
+    assert sweep["min_agreement"] >= 0.98, sweep
+
+    with tempfile.TemporaryDirectory() as tmp:
+        drift_leg = _drift_serving_leg(tmp, args.requests)
+    print(f"[bench_learn] drifting serving leg: "
+          f"{drift_leg['loadgen']['rows_per_second']} rows/s, "
+          f"swap saved {drift_leg['swap'].get('gen1_pairs_saved')} "
+          f"pairs, zero_loss={drift_leg['zero_loss_across_swap']}",
+          file=sys.stderr)
+    assert drift_leg["zero_loss_across_swap"], drift_leg
+
+    result = {
+        "metric": ("warm-start increment retraining vs cold, "
+                   f"MNIST-shaped synth (d={args.d}, "
+                   f"base={ab['rows_base']} rows, increment="
+                   f"{ab['rows_increment']} rows, drift="
+                   f"{args.drift} rad/gen), pairs saved at matched "
+                   f"held-out accuracy (tol {args.acc_tol})"),
+        "value": ab["pairs_cut_percent"],
+        "unit": "percent pairs saved vs cold",
+        "pairs_cut_percent": ab["pairs_cut_percent"],
+        "increment_ab": ab,
+        "c_sweep": sweep,
+        "drift_serving": drift_leg,
+        **bench._device_fields(),
+        "device_numbers": ("measured" if on_tpu else
+                           "pending — no TPU reachable this session; "
+                           "pair counts are platform-independent, "
+                           "CPU-harness wall clocks are directional"),
+        "schema_version": bench._schema_version(),
+        "session_calibration": calibration,
+    }
+    gate = bench._regression_gate(result, REPO,
+                                  pattern="BENCH_LEARN_r*.json",
+                                  key="pairs_cut_percent")
+    result.update(gate)
+    print(f"[bench_learn] regression gate: "
+          f"{gate.get('regression_gate')}", file=sys.stderr)
+
+    nn = len(glob.glob(os.path.join(REPO, "BENCH_LEARN_r*.json"))) + 1
+    art = os.path.join(REPO, f"BENCH_LEARN_r{nn:02d}.json")
+    with open(art, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps({k: result[k] for k in
+                      ("metric", "value", "unit", "regression_gate")}))
+
+    with open(os.path.join(REPO, "BENCH_LEARN.md"), "w") as fh:
+        fh.write(
+            "# BENCH_LEARN — cascade warm-start continuous learning\n\n"
+            "Command: `python tools/bench_learn.py` (artifact "
+            f"`{os.path.basename(art)}`; history lives in git). "
+            "Warm-started increment retraining (solver/warmstart.py + "
+            "solver/cascade.py) A/B'd against cold retraining of the "
+            "IDENTICAL increment — drift matched by construction, "
+            "counted only at matched held-out accuracy. Pair counts "
+            "are platform-independent; wall clocks on a CPU harness "
+            "carry device_numbers=pending until a TPU session re-runs "
+            "this tool.\n\n"
+            "## Increment A/B (headline)\n\n"
+            "| leg | pairs | wall s | held-out acc |\n|---|---|---|---|\n"
+            f"| cold | {ab['pairs_cold']} | "
+            f"{ab['wall_seconds_cold']} | "
+            f"{ab['holdout_accuracy_cold']} |\n"
+            f"| warm | {ab['pairs_warm']} | "
+            f"{ab['wall_seconds_warm']} | "
+            f"{ab['holdout_accuracy_warm']} |\n\n"
+            f"**{ab['pairs_cut_percent']}% pairs saved, "
+            f"{ab['wall_cut_percent']}% wall saved** (seed "
+            f"{ab['seed_sv']} SVs into a "
+            f"{ab['rows_increment']}-row increment).\n\n"
+            "## C-sweep regularization-path walk\n\n"
+            f"Cs={sweep['Cs']}: cold fleet "
+            f"{sweep['pairs_cold_total']} pairs, warm walk "
+            f"{sweep['pairs_warm_total']} pairs "
+            f"(**{sweep['pairs_cut_percent']}% cut**), per-C "
+            f"prediction agreement >= {sweep['min_agreement']}.\n\n"
+            "## Drifting-distribution serving leg\n\n```json\n"
+            + json.dumps(drift_leg, indent=1)
+            + "\n```\n\n## Gate\n\n```json\n"
+            + json.dumps({k: result[k] for k in
+                          ("value", "unit", "device",
+                           "device_numbers", "regression_gate")},
+                         indent=1)
+            + "\n```\n")
+    print(f"[bench_learn] wrote {art} and BENCH_LEARN.md",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
