@@ -10,7 +10,7 @@ DATA ?= data
 # pinned verbatim from ROADMAP.md, which assumes bash).
 SHELL := /bin/bash
 
-.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke serve_replica_smoke serve_quant_smoke learn_smoke faults_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
+.PHONY: test test_all verify lint lint_budgets autotune autotune_smoke bench bench_ooc_smoke bench_fused_smoke bench_predict bench_serve bench_serve_smoke serve_net_smoke serve_replica_smoke serve_quant_smoke learn_smoke faults_smoke ooc_mesh_smoke loadgen fetch_real_data smoke tpu_smoke multihost_check parity parity_full native run_mnist run_cover run_adult run_test_mnist run_test_adult run_synth
 
 # Quick loop (slow-marked parity/scale tests deselected); test_all is the
 # full suite the CI/driver runs. JAX_PLATFORMS=cpu is exported at the
@@ -133,6 +133,13 @@ learn_smoke:
 # the engine serving (tier1.yml runs this next to bench_serve_smoke).
 faults_smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/faults_smoke.py
+
+# Mesh out-of-core smoke (ISSUE 19): solve_mesh + ooc at 2 virtual
+# devices proven BITWISE equal to the single-chip ooc stream, and the
+# ooc_tile_put fault seam proven to cover the mesh stream's H2D path
+# (transient fault + retry lands on the same bitwise state).
+ooc_mesh_smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 $(PY) tools/ooc_mesh_smoke.py
 
 smoke:
 	$(PY) -m dpsvm_tpu.cli smoke

@@ -594,7 +594,14 @@ def ooc_main(args=None) -> int:
     as the headline. The artifact embeds the stream/cache counters
     (tiles_streamed, tile_bytes_h2d, cache_hit_rate, cached_rounds)
     and, with --obs, reconciles against the run log whose chunk
-    records carry the per-round tile/cache fields."""
+    records carry the per-round tile/cache fields.
+
+    A second, late-training leg (ISSUE 19) continues the budget model
+    for the same budget again, warm-started on f-sorted rows, with the
+    shrunken tile stream on vs off at identical budgets — recording
+    tiles_skipped / bytes_streamed, the in-cycle byte cut, and a
+    holdout-accuracy guard, gated on its own
+    ooc_shrink_pairs_per_second key."""
     import os
 
     from dpsvm_tpu.config import SVMConfig
@@ -646,6 +653,117 @@ def ooc_main(args=None) -> int:
         "session_calibration": calibration,
     }
     result.update(_runlog_reconciliation(best, pps))
+
+    # ---- shrunken-stream continuation leg (ISSUE 19). The budget
+    # model above is mid-training: the LATE-training phase is measured
+    # by continuing it for the same pair budget, warm-started from its
+    # alphas, on rows sorted by its gradient f — the selection ranks
+    # rows by f-extremeness, so an f-sorted layout puts the working
+    # sets at the two ENDS of the tile range and gives the tile-
+    # granular skip the index locality a random layout never has. The
+    # shrink arm and the full-stream arm run the IDENTICAL continuation
+    # (same warm seed, same layout, same budget), so the byte columns
+    # are apples-to-apples; the late-phase cut is
+    # (in-cycle tiles + skipped) / in-cycle tiles with the cycle
+    # reconstruction passes charged to the shrink arm.
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dpsvm_tpu.ops.kernels import KernelParams, kernel_matrix
+    from dpsvm_tpu.solver.warmstart import WarmStart
+
+    sv = best.alpha > 0
+    kp = KernelParams(kind="rbf", gamma=cfg.gamma)
+    km = np.asarray(kernel_matrix(jnp.asarray(x), jnp.asarray(x[sv]),
+                                  kp))
+    f_a = km @ (best.alpha[sv] * y[sv]) - y
+    order = np.argsort(f_a)
+    inv = np.empty(n, dtype=np.int64)
+    inv[order] = np.arange(n)
+    xs = np.ascontiguousarray(x[order])
+    ys = np.ascontiguousarray(y[order])
+    svi = np.nonzero(sv)[0]
+    warm = WarmStart(alpha=best.alpha[svi], rows=inv[svi])
+    shrink_m = 2048
+    cont_tile = 512
+    cfg_shrink = cfg.replace(ooc_tile_rows=cont_tile, ooc_shrink=True,
+                             active_set_size=shrink_m)
+    cfg_cont = cfg.replace(ooc_tile_rows=cont_tile)
+    solve(xs, ys, cfg_shrink.replace(max_iter=64), warm_start=warm)
+    solve(xs, ys, cfg_cont.replace(max_iter=64), warm_start=warm)
+    shr = min([solve(xs, ys, cfg_shrink, warm_start=warm)
+               for _ in range(2)], key=lambda r: r.train_seconds)
+    cont = min([solve(xs, ys, cfg_cont, warm_start=warm)
+                for _ in range(2)], key=lambda r: r.train_seconds)
+    sst, cst = shr.stats, cont.stats
+    s_pps = shr.iterations / max(shr.train_seconds, 1e-9)
+    in_cyc = sst.get("shrink_tiles_in_cycle", 0)
+    skipped = sst.get("tiles_skipped", 0)
+    late_cut = ((in_cyc + skipped) / in_cyc) if in_cyc else 0.0
+    # Model-quality guard: both arms spent the same budget from the
+    # same warm point — holdout accuracy must agree (the shrunken
+    # stream reorders work, it must not degrade the model).
+    from dpsvm_tpu.data import make_covtype_like as _mk
+    xh, yh = _mk(4096, d, seed=7)
+    kmh = np.asarray(kernel_matrix(jnp.asarray(xh), jnp.asarray(xs),
+                                   kp))
+
+    def _acc(r):
+        dec = kmh @ (r.alpha * ys) + r.b
+        return float((np.sign(dec) == yh).mean())
+
+    acc_s, acc_f = _acc(shr), _acc(cont)
+    result.update({
+        "ooc_shrink_pairs_per_second": round(s_pps),
+        "tiles_skipped": skipped,
+        "bytes_streamed": sst.get("tile_bytes_h2d"),
+        "shrink": {
+            "metric": (f"late-training continuation: {budget} more "
+                       f"pairs warm-started from the budget model on "
+                       f"f-sorted rows (tile_rows={cont_tile}, "
+                       f"active_set_size={shrink_m}), shrink arm vs "
+                       f"full-stream arm at the identical budget"),
+            "active_set_size": shrink_m,
+            "tile_rows": cont_tile,
+            "pair_updates": int(shr.iterations),
+            "seconds": round(shr.train_seconds, 3),
+            "tiles_streamed": sst.get("tiles_streamed"),
+            "tiles_skipped": skipped,
+            "bytes_streamed": sst.get("tile_bytes_h2d"),
+            "bytes_skipped": sst.get("tile_bytes_skipped"),
+            "late_phase_tiles": in_cyc,
+            "late_phase_byte_cut": round(late_cut, 3),
+            "cycles": sst.get("shrink_cycles"),
+            "reconstructions": sst.get("shrink_reconstructions"),
+            "demoted": sst.get("shrink_demoted"),
+            "holdout_accuracy": round(acc_s, 4),
+            "full_arm": {
+                "pair_updates": int(cont.iterations),
+                "seconds": round(cont.train_seconds, 3),
+                "tiles_streamed": cst.get("tiles_streamed"),
+                "bytes_streamed": cst.get("tile_bytes_h2d"),
+                "holdout_accuracy": round(acc_f, 4),
+            },
+        },
+    })
+    # The shrunken column gates against its OWN key: r01 carries no
+    # ooc_shrink_pairs_per_second, so the first stamped run reads
+    # NO_BASELINE instead of normalizing against full-stream rows
+    # (and the device_kind stamp refuses cross-device adjudication).
+    sgate = _regression_gate(result,
+                             os.path.dirname(os.path.abspath(__file__)),
+                             pattern="BENCH_OOC_r*.json",
+                             key="ooc_shrink_pairs_per_second")
+    result["shrink_gate"] = sgate.get("regression_gate")
+    print(f"[bench --ooc] shrink continuation: {shr.iterations} pairs "
+          f"in {shr.train_seconds:.3f}s ({s_pps:.0f}/s); "
+          f"{sst.get('tiles_streamed')} tiles streamed / {skipped} "
+          f"skipped (full arm {cst.get('tiles_streamed')}), late-phase "
+          f"byte cut {late_cut:.2f}x, holdout {acc_s:.4f} vs "
+          f"{acc_f:.4f}; gate: {sgate.get('regression_gate')}",
+          file=sys.stderr)
+
     gate = _regression_gate(result,
                             os.path.dirname(os.path.abspath(__file__)),
                             pattern="BENCH_OOC_r*.json",

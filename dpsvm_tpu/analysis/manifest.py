@@ -461,6 +461,148 @@ def ooc_fold_tile(n_total: int = N):
                  _jaxpr_of(fold, *args, **kw))]
 
 
+def ooc_fold_tile_shrink(n_total: int = N, masked: bool = False):
+    """SHRUNKEN-stream per-tile fold (ISSUE 19): the program an
+    in-cycle ooc round dispatches per LIVE tile. It is the SAME
+    ops/ooc.ooc_fold_tile program as the ooc_fold_tile entry, lowered
+    at the shrunken round's variant point: ``want_dots=False`` — the
+    block cache never refreshes mid-cycle (a partial dot row would
+    poison the full-width LRU), so the in-cycle program must not
+    materialize the (Q, T) dots.
+
+    The budget pins the skip contract statically: a skipped tile is a
+    DISPATCH THAT NEVER HAPPENS, not a masked kernel — so this
+    program's facts stay a pure function of (T_TILE, D, Q), zero
+    collectives, zero transfers, donated gradient slice, and
+    ``n_total`` never reaches a shape (n-doubling must be
+    byte-identical, the ooc_fold_tile discipline).
+
+    ``masked=True`` builds the REJECTED alternative the drift test
+    uses (tests/test_tpulint.py): one program folding every tile of a
+    device-resident (n_total, D) X under a live-tile mask. Its
+    argument bytes are n-sized — exactly the out-of-core violation the
+    budget exists to catch — so its facts must DRIFT."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.ops.ooc import fold_tile_body
+    from dpsvm_tpu.ops.ooc import ooc_fold_tile as fold
+
+    t = min(T_TILE, n_total)  # a tile never exceeds the data
+
+    if masked:
+        tiles = n_total // t
+
+        def masked_fold(x_full, x_sq_full, f_full, qx, qsq, coef,
+                        live):
+            def body(i, f_full):
+                s = i * t
+                x_t = lax.dynamic_slice(x_full, (s, 0), (t, D))
+                xsq_t = lax.dynamic_slice(x_sq_full, (s,), (t,))
+                f_t = lax.dynamic_slice(f_full, (s,), (t,))
+                f_n, _, _ = fold_tile_body(x_t, xsq_t, f_t, None, qx,
+                                           qsq, coef, _kp(),
+                                           want_dots=False,
+                                           compensated=False)
+                f_n = jnp.where(live[i], f_n, f_t)
+                return lax.dynamic_update_slice(f_full, f_n, (s,))
+
+            return lax.fori_loop(0, tiles, body, f_full)
+
+        m_j = jax.jit(masked_fold, donate_argnums=(2,))
+        margs = (_sds((n_total, D), jnp.float32),
+                 _sds((n_total,), jnp.float32),
+                 _sds((n_total,), jnp.float32),
+                 _sds((Q, D), jnp.float32), _sds((Q,), jnp.float32),
+                 _sds((Q,), jnp.float32), _sds((tiles,), jnp.bool_))
+        return [Unit("fold_tile", lambda: m_j.lower(*margs),
+                     _jaxpr_of(m_j, *margs))]
+
+    args = (_sds((t, D), jnp.float32), _sds((t,), jnp.float32),
+            _sds((t,), jnp.float32), None,
+            _sds((Q, D), jnp.float32), _sds((Q,), jnp.float32),
+            _sds((Q,), jnp.float32))
+    kw = dict(kp=_kp(), want_dots=False, compensated=False)
+    return [Unit("fold_tile", lambda: fold.lower(*args, **kw),
+                 _jaxpr_of(fold, *args, **kw))]
+
+
+def ooc_mesh_fold(extra_psum: bool = False):
+    """Mesh out-of-core stream programs (ISSUE 19,
+    parallel/dist_block.py make_ooc_mesh_programs): two units pin the
+    mesh stream's collective budget statically.
+
+    * ``fold`` — one stream step's per-device local fold: ZERO
+      collectives. Each device folds only its own shard's tile; a
+      stray per-tile collective reintroduced by a refactor is exactly
+      the regression this unit DRIFTs on (``extra_psum=True`` builds
+      that mutated form for tests/test_tpulint.py — the same fold
+      body plus one per-step psum).
+    * ``select`` — the round's ONLY collectives: the candidate
+      all_gather pair inside the distributed selection plus ONE
+      (Q, 5) psum replicating the working-set scalars. The (q, q)
+      subproblem runs replicated outside these programs, so the whole
+      round's collective budget is what this unit records."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec
+
+    from dpsvm_tpu.analysis.extract import Unit
+    from dpsvm_tpu.parallel.dist_block import make_ooc_mesh_programs
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS, mesh_shard_map
+
+    mesh = _mesh()
+    n_loc = N // DEVICE_COUNT
+    tile = min(T_TILE, n_loc)
+    progs = make_ooc_mesh_programs(mesh, _kp(), C_BOUNDS, Q, n_loc,
+                                   tile, selection="mvp",
+                                   compensated=False)
+
+    fold_args = (_sds((DEVICE_COUNT * tile, D), jnp.float32),
+                 _sds((N,), jnp.float32), _sds((N,), jnp.float32),
+                 _sds((Q, D), jnp.float32), _sds((Q,), jnp.float32),
+                 _sds((Q,), jnp.float32), _sds((), jnp.int32))
+    sel_args = (_sds((N,), jnp.float32), _sds((N,), jnp.float32),
+                _sds((N,), jnp.float32), _sds((N,), jnp.float32),
+                _sds((N,), jnp.float32), _sds((N,), jnp.bool_))
+
+    if extra_psum:
+        from dpsvm_tpu.ops.ooc import fold_tile_body
+
+        shard = PartitionSpec(DATA_AXIS)
+        rep = PartitionSpec()
+
+        def _mut_body(x_blk, x_sq_loc, f_loc, qx, qsq, coef, j):
+            s = j * tile
+            f_t = lax.dynamic_slice(f_loc, (s,), (tile,))
+            xsq_t = lax.dynamic_slice(x_sq_loc, (s,), (tile,))
+            f_n, _, _ = fold_tile_body(x_blk, xsq_t, f_t, None, qx,
+                                       qsq, coef, _kp(),
+                                       want_dots=False,
+                                       compensated=False)
+            # The stray per-step collective the fold budget forbids.
+            leak = lax.psum(jnp.sum(f_n), DATA_AXIS)
+            f_n = f_n + 0.0 * leak
+            return lax.dynamic_update_slice(f_loc, f_n, (s,))
+
+        mut = jax.jit(mesh_shard_map(
+            _mut_body, mesh=mesh,
+            in_specs=(shard, shard, shard, rep, rep, rep, rep),
+            out_specs=shard, check=False), donate_argnums=(2,))
+        return [Unit("fold", lambda: mut.lower(*fold_args),
+                     _jaxpr_of(mut, *fold_args)),
+                Unit("select", lambda: progs["select"].lower(*sel_args),
+                     _jaxpr_of(progs["select"], *sel_args))]
+
+    return [Unit("fold", lambda: progs["fold"].lower(*fold_args),
+                 _jaxpr_of(progs["fold"], *fold_args)),
+            Unit("select", lambda: progs["select"].lower(*sel_args),
+                 _jaxpr_of(progs["select"], *sel_args))]
+
+
 def warm_f_rebuild(n_total: int = N):
     """Warm-start gradient reconstruction (ISSUE 18): the programs that
     rebuild f = K (alpha*y) - y from a repaired seed in ONE streamed
@@ -707,6 +849,8 @@ MANIFEST = {
     "shardlocal_chunk_ring": shardlocal_chunk_ring,
     "block_chunk_bf16gram": block_chunk_bf16gram,
     "ooc_fold_tile": ooc_fold_tile,
+    "ooc_fold_tile_shrink": ooc_fold_tile_shrink,
+    "ooc_mesh_fold": ooc_mesh_fold,
     "warm_f_rebuild": warm_f_rebuild,
     "compacted_decision": compacted_decision,
     "serve_bucket": serve_bucket,

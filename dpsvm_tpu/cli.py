@@ -160,11 +160,23 @@ def _build_train_parser(sub) -> argparse.ArgumentParser:
                         "LRU; a round whose whole working set hits "
                         "skips the tile stream entirely). 0 = off; "
                         "must be >= --working-set-size")
+    p.add_argument("--ooc-shrink", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="--ooc: shrunken tile stream — in-cycle rounds "
+                        "keep a static-shape active view of the m "
+                        "most-violating rows and stream ONLY the tiles "
+                        "intersecting it, with periodic full-stream "
+                        "reconstruction + endgame demotion so the final "
+                        "model meets the unshrunken convergence "
+                        "criterion (SVMConfig.ooc_shrink; auto = the "
+                        "autotune 'ooc_shrink' gate decides; single-"
+                        "chip only)")
     p.add_argument("--active-set-size", type=int, default=0,
                    help="block engine: shrink per-round work to the m "
                         "most-violating rows, reconciling the full "
-                        "gradient in batches (0 = off; single-chip and "
-                        "mesh)")
+                        "gradient in batches (0 = off; single-chip, "
+                        "mesh, and single-chip --ooc, where m sizes the "
+                        "shrunken tile stream's active view)")
     p.add_argument("--reconcile-rounds", type=int, default=8,
                    help="block engine shrinking: rounds between full-"
                         "gradient reconciliations (default 8)")
@@ -663,6 +675,8 @@ def _cmd_train(args) -> int:
             reconcile_rounds=args.reconcile_rounds,
             ooc=args.ooc, ooc_tile_rows=args.ooc_tile_rows,
             ooc_cache_lines=args.ooc_cache_lines,
+            ooc_shrink={"auto": None, "on": True,
+                        "off": False}[args.ooc_shrink],
             dtype=args.dtype, chunk_iters=args.chunk_iters,
             checkpoint_every=args.checkpoint_every,
             checkpoint_keep=args.checkpoint_keep,

@@ -310,6 +310,15 @@ class SVMConfig:
     # static-shape re-derivation of LibSVM's do_shrinking). Exact: same
     # optimum and stopping rule; pays off when n is large enough that the
     # full-n fold dominates the round (n >> active_set_size).
+    #
+    # With config.ooc the same knob sizes the OUT-OF-CORE shrunken
+    # stream (ISSUE 19, solver/ooc.py): cycles of `reconcile_rounds`
+    # rounds restrict selection to the active_set_size most-violating
+    # rows and stream ONLY the tiles the active view intersects; each
+    # cycle ends with one full-stream gradient reconstruction (the
+    # warmstart fold), so the FINAL model meets the identical stopping
+    # rule. 0 there defers to ooc_shrink (the auto gate) with an
+    # auto-sized view.
     active_set_size: int = 0
     reconcile_rounds: int = 8
 
@@ -361,9 +370,32 @@ class SVMConfig:
     # convergence the selection concentrates on a stable set of support
     # vectors, exactly the regime Joachims' shrinking exploits. 0 = off;
     # must be >= working_set_size so one round's misses always fit.
+    #
+    # ooc_shrink (ISSUE 19): Joachims-style active-set shrinking for
+    # the TILE STREAM itself — cycles of `reconcile_rounds` rounds keep
+    # a static-shape active view of the most-violating rows
+    # (active_set_size when > 0, else auto-sized) and stream only the
+    # tiles that view intersects; every cycle ends with one
+    # full-stream gradient reconstruction (solver/warmstart.py
+    # warm_f_rebuild — the same streamed fold), and the engine demotes
+    # itself to the exact full-stream path when the gap stalls or
+    # nears epsilon, so the final model meets the identical
+    # convergence criterion. None = auto (autotune 'ooc_shrink' gate;
+    # the CPU seed profile resolves OFF — solver/block.py
+    # ooc_shrink_pays); True forces on; False forces off. Single-chip
+    # backend only (the mesh tile stream keeps full streams).
+    #
+    # Running ooc under backend='mesh' (solve_mesh) shards the stream
+    # instead: each device owns a padded row shard's tiles (per-device
+    # double-buffered H2D), folds locally, and joins the round with one
+    # psum of the working set's (q, 5) scalar rows — bitwise equal to
+    # the single-chip ooc trajectory (tests/test_ooc.py pins it at 2
+    # devices). The mesh stream rejects ooc_cache_lines and shrinking
+    # (validated in parallel/dist_smo.py).
     ooc: bool = False
     ooc_tile_rows: int = 8192
     ooc_cache_lines: int = 0
+    ooc_shrink: Optional[bool] = None
 
     # Resident-Gram acceleration for the per-pair engine (no reference
     # equivalent — it is the 100%-hit-rate limit of the reference's LRU
@@ -613,9 +645,10 @@ class SVMConfig:
                     "collective-light)")
             if self.ooc:
                 raise ValueError(
-                    "ring_exchange does not compose with ooc (ooc is "
-                    "single-chip — tiles stream from one host process; "
-                    "there is no mesh exchange to ring)")
+                    "ring_exchange does not compose with ooc (the mesh "
+                    "ooc round folds host-streamed tiles — kernel rows "
+                    "never live on device long enough for a candidate "
+                    "ring to carry them)")
             if self.active_set_size:
                 raise ValueError(
                     "ring_exchange does not compose with "
@@ -739,13 +772,12 @@ class SVMConfig:
                     "ooc and gram_resident are opposite regimes (the "
                     "resident Gram assumes O(n^2) fits HBM; ooc assumes "
                     "even O(n d) does not) — use one or the other")
-            if self.active_set_size:
+            if self.active_set_size and self.ooc_shrink is False:
                 raise ValueError(
-                    "ooc does not compose with active_set_size (the ooc "
-                    "round already touches only the working set between "
-                    "folds; the active cycle's deferred reconciliation "
-                    "would need a second full stream) — use one or the "
-                    "other")
+                    "active_set_size > 0 with ooc REQUESTS the shrunken "
+                    "tile stream (it sizes the active view); "
+                    "ooc_shrink=False forces it off — drop one of the "
+                    "two")
             if self.pipeline_rounds:
                 raise ValueError(
                     "ooc does not compose with pipeline_rounds (the ooc "
@@ -760,13 +792,19 @@ class SVMConfig:
                     "design) — leave fused_fold unset")
             if self.local_working_sets is not None:
                 raise ValueError(
-                    "ooc is single-chip (tiles stream from one host "
-                    "process); leave local_working_sets unset")
+                    "the ooc round keeps ONE global working set (the "
+                    "mesh ooc stream shards tiles, not selection); "
+                    "leave local_working_sets unset")
             if self.reconstruct_every:
                 raise ValueError(
                     "ooc does not compose with reconstruct_every (the "
                     "f64 reconstruction legs re-gather the full X "
                     "host-side; run them on the in-core engines)")
+        if self.ooc_shrink is not None and not self.ooc:
+            raise ValueError(
+                "ooc_shrink gates the ooc shrunken tile stream; set "
+                "ooc=True (in-core shrinking is active_set_size on the "
+                "block engine)")
         if self.ooc_tile_rows < 8:
             raise ValueError("ooc_tile_rows must be >= 8")
         if self.ooc_cache_lines < 0:

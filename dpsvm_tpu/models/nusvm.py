@@ -70,7 +70,10 @@ def _warn_nu_fallbacks(config: SVMConfig, trainer: str) -> None:
     config that looks tuned but trains on the fallback."""
     dropped = []
     if config.ooc:
-        dropped.append("ooc (in-core solve)")
+        dropped.append(
+            "ooc (in-core solve)" if not (config.ooc_shrink
+                                          or config.active_set_size)
+            else "ooc + shrunken stream (in-core solve, no shrinking)")
     if config.pair_batch > 1:
         dropped.append(f"pair_batch={config.pair_batch} "
                        "(single-pair updates)")

@@ -177,6 +177,16 @@ def summarize_run(run: Run) -> dict:
         "cache_lookups": fin.get("cache_lookups"),
         "cache_evictions": fin.get("cache_evictions"),
         "tiles_streamed": fin.get("tiles_streamed"),
+        # Shrunken-stream accounting (ISSUE 19): the ooc solver's
+        # active-set shrinking — active-view fraction of n, full-stream
+        # reconstructions, and the tiles/bytes the live-tile skip never
+        # streamed; None/absent when the run carried no shrinking.
+        "ooc_shrink": fin.get("ooc_shrink"),
+        "shrink_active_fraction": fin.get("shrink_active_fraction"),
+        "shrink_reconstructions": fin.get("shrink_reconstructions"),
+        "shrink_demoted": fin.get("shrink_demoted"),
+        "tiles_skipped": fin.get("tiles_skipped"),
+        "tile_bytes_skipped": fin.get("tile_bytes_skipped"),
         # Fault-tolerance accounting (ISSUE 13 satellite): counts of
         # the fault-story event records — injected/real transient
         # faults, retry attempts, safe-config demotions, journal
@@ -321,7 +331,8 @@ _REPORT_COLS = (
     ("n", "n"), ("d", "d"), ("chunks", "chunks"), ("pairs", "pairs"),
     ("device_s", "device_seconds"), ("pairs/s", "pairs_per_second"),
     ("gap last", "gap_last"), ("stalls", None), ("compiles", "compiles"),
-    ("cache", None), ("serve", None), ("learn", None), ("faults", None),
+    ("cache", None), ("shrink", None), ("serve", None), ("learn", None),
+    ("faults", None),
     ("profile", None), ("phases", None), ("done", None),
 )
 
@@ -352,6 +363,26 @@ def _report_row(s: dict) -> list:
             # whichever kernel-row cache the run carried (per-pair LRU
             # or the ooc block cache), "-" when none.
             row.append(f"{100 * hr:.1f}%" if hr is not None else "-")
+        elif head == "shrink":
+            # Shrunken-stream column (ISSUE 19): active-view fraction,
+            # full-stream reconstructions, and tiles the live-tile
+            # skip never streamed (with the bytes they would have
+            # cost); "-" for runs without ooc shrinking. A trailing
+            # "dem" tags a run the endgame demoted back to the exact
+            # full stream.
+            if not s.get("ooc_shrink"):
+                row.append("-")
+            else:
+                frac = s.get("shrink_active_fraction")
+                txt = (f"act={frac:.2f} " if frac is not None else "")
+                txt += (f"rec={s.get('shrink_reconstructions') or 0} "
+                        f"skip={s.get('tiles_skipped') or 0}t")
+                gb = (s.get("tile_bytes_skipped") or 0) / 2**30
+                if gb >= 0.01:
+                    txt += f"/{gb:.2f}GiB"
+                if s.get("shrink_demoted"):
+                    txt += " dem"
+                row.append(txt)
         elif head == "serve":
             # Serving-engine column (ISSUE 10 satellite): deadline
             # misses / hot swaps / mean batch occupancy for v2 serve

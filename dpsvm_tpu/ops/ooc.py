@@ -35,6 +35,32 @@ import jax.numpy as jnp
 from dpsvm_tpu.ops.kernels import KernelParams, kernel_from_dots
 
 
+def fold_tile_body(x_tile, xsq_tile, f_tile, err_tile, qx, qsq, coef,
+                   kp: KernelParams, want_dots: bool = False,
+                   compensated: bool = False):
+    """The fold algebra, traceable from any enclosing program.
+
+    ``ooc_fold_tile`` below jits it per tile on the single-chip path;
+    the MESH ooc stream (parallel/dist_block.py make_ooc_mesh_programs)
+    traces the SAME body inside its shard_map fold so the per-slot op
+    sequence — dot, kernel transform, coef @ K, the (possibly Kahan)
+    accumulate — is the identical XLA program at the identical shapes,
+    which is what makes the mesh trajectory bitwise equal to the
+    single-chip one (tests/test_ooc.py pins it at 2 devices)."""
+    from dpsvm_tpu.solver.smo import kahan_add
+
+    with jax.named_scope("ooc_fold_tile"):
+        dots = jnp.dot(qx.astype(x_tile.dtype), x_tile.T,
+                       preferred_element_type=jnp.float32)  # (q, T)
+        k = kernel_from_dots(dots, xsq_tile, qsq, kp)  # (q, T) f32
+        delta = coef @ k  # (T,) f32
+        if compensated:
+            f_new, err_new = kahan_add(f_tile, err_tile, delta)
+        else:
+            f_new, err_new = f_tile + delta, None
+    return f_new, err_new, (dots if want_dots else None)
+
+
 @partial(jax.jit, donate_argnames=("f_tile", "err_tile"),
          static_argnames=("kp", "want_dots", "compensated"))
 def ooc_fold_tile(x_tile, xsq_tile, f_tile, err_tile, qx, qsq, coef,
@@ -57,16 +83,15 @@ def ooc_fold_tile(x_tile, xsq_tile, f_tile, err_tile, qx, qsq, coef,
     rows and re-applies the kernel transform per use, the reference
     cache.cu discipline); None otherwise, so the cache-off program
     never materializes them.
-    """
-    from dpsvm_tpu.solver.smo import kahan_add
 
-    with jax.named_scope("ooc_fold_tile"):
-        dots = jnp.dot(qx.astype(x_tile.dtype), x_tile.T,
-                       preferred_element_type=jnp.float32)  # (q, T)
-        k = kernel_from_dots(dots, xsq_tile, qsq, kp)  # (q, T) f32
-        delta = coef @ k  # (T,) f32
-        if compensated:
-            f_new, err_new = kahan_add(f_tile, err_tile, delta)
-        else:
-            f_new, err_new = f_tile + delta, None
-    return f_new, err_new, (dots if want_dots else None)
+    The SHRUNKEN stream (config.ooc_shrink / active_set_size with ooc,
+    solver/ooc.py) never reaches this program for a skipped tile: the
+    driver holds a host-side live-tile set and the skipped tiles' f
+    slices pass through the round untouched — the skip is a dispatch
+    that never happens, not a masked kernel, so this budget
+    (``ooc_fold_tile`` / ``ooc_fold_tile_shrink``) is identical under
+    shrinking.
+    """
+    return fold_tile_body(x_tile, xsq_tile, f_tile, err_tile, qx, qsq,
+                          coef, kp, want_dots=want_dots,
+                          compensated=compensated)

@@ -224,6 +224,32 @@ def refresh_extrema_host(f, alpha, y, c, epsilon: float, rule: str = "mvp"):
     return b_hi, b_lo, not (b_lo > b_hi + 2.0 * epsilon)
 
 
+def shrink_view(w, slot_ok, n: int, n_pad: int, tile: int):
+    """Host-side active view from a shrink-cycle m-select (the ooc
+    shrunken stream, solver/ooc.py — Joachims' SVMlight shrinking
+    re-derived for a streamed fold).
+
+    ``w``/``slot_ok`` are the pulled (m,) selection outputs: the m
+    most-violating rows under the SAME up/low set definitions every
+    other selection here uses (select_block with q=m — violation-
+    ordered by construction, so no new ranking machinery). Returns
+
+      (active, live_tiles): ``active`` an (n_pad,) bool mask over the
+      selected REAL rows (dead slots and any index past n dropped —
+      padded lanes can never enter the view), ``live_tiles`` the
+      sorted unique indices of the (tile,)-row stream tiles the view
+      intersects — the tiles a shrunken round actually streams; every
+      other tile's H2D put and fold dispatch simply never happen.
+    """
+    import numpy as np
+
+    ids = np.asarray(w)[np.asarray(slot_ok, bool)]
+    ids = ids[(ids >= 0) & (ids < n)]
+    active = np.zeros((n_pad,), bool)
+    active[ids] = True
+    return active, np.unique(ids // tile)
+
+
 def select_working_set_batched(
     f: jax.Array,
     alpha: jax.Array,

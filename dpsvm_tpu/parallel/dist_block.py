@@ -1044,3 +1044,129 @@ def make_block_active_chunk_runner(mesh: Mesh, kp: KernelParams, c,
         check=False,  # while_loop carries defeat the replication checker
     )
     return _jit_runner(mapped, donate_state)
+
+
+def make_ooc_mesh_programs(mesh: Mesh, kp: KernelParams, c, q: int,
+                           n_loc: int, tile: int, selection: str = "mvp",
+                           compensated: bool = False):
+    """The per-device OOC TILE STREAM's device programs (ISSUE 19):
+    solve_ooc_mesh (solver/ooc.py) drives these four jitted shard_maps
+    while the host feeds every device its row shard's tiles.
+
+    Row layout: device k owns global rows [k*n_loc, (k+1)*n_loc) —
+    n_loc = tile * ceil(n / (P*tile)), so every shard is a whole number
+    of stream tiles and stream step j carries each device's tile j as
+    one (P*tile, d) host block put with a row-sharded NamedSharding.
+
+    Collective budget per ROUND (the ``ooc_mesh_fold`` tpulint
+    manifest pins it): selection's candidate all_gather pair plus ONE
+    (q, 5) psum of the working-set scalars — and the FOLD has ZERO
+    collectives (each device folds only its own rows; a stray per-tile
+    collective is exactly the regression the budget DRIFTs on). The
+    (q, q) subproblem itself runs replicated OUTSIDE these programs
+    (solver/ooc.py _ooc_mesh_subproblem — the host round-trips its
+    working-set rows anyway).
+
+    Bitwise equality with the single-chip ooc trajectory (tests/
+    test_ooc.py pins it at 2 devices): the fold traces the SAME
+    ops/ooc.py fold_tile_body op sequence at the same (tile,) shapes,
+    each gradient lane is updated exactly once per round (cross-tile
+    order is irrelevant), the scalar psum gathers exactly one nonzero
+    f32 term per slot (exact), and _select_block_mesh's device-major
+    gather + exact top_k merge preserves select_block's tie-break.
+
+    Returns dict(select=..., fold=..., scatter=..., norms=...):
+      select(f, f_err?, alpha, y, x_sq, k_diag, valid)
+          -> (w, slot_ok, b_hi, b_lo, scal (q, 5)) — all replicated;
+          scal columns are [x_sq, k_diag, alpha, y, f_eff] at W.
+      fold(x_blk, x_sq, f, f_err?, qx, qsq, coef, j)
+          -> f[, f_err] — stream step j's local fold, f/f_err donated.
+      scatter(alpha, w, slot_ok, a_w) -> alpha — owned slots only
+          (inert index n_loc, the at[].set mode="drop" idiom), donated.
+      norms(x_blk, x_sq, j) -> x_sq — setup-stream squared norms,
+          computed ON DEVICE per (tile, d) block (the same jitted
+          reduction shape as the single-chip setup pass, which is what
+          makes x_sq — and everything downstream — bit-identical).
+    """
+    from dpsvm_tpu.ops.kernels import squared_norms
+    from dpsvm_tpu.ops.ooc import fold_tile_body
+
+    shard = P(DATA_AXIS)
+    rep = P()
+
+    def _select_core(f_cur, alpha_loc, y_loc, x_sq_loc, k_diag_loc,
+                     valid_loc):
+        w, slot_ok, b_hi, b_lo = _select_block_mesh(
+            f_cur, alpha_loc, y_loc, valid_loc, c, q, rule=selection)
+        _, own, l_safe = _ws_owners(w, slot_ok, n_loc)
+        scal_loc = jnp.stack([x_sq_loc, k_diag_loc, alpha_loc, y_loc,
+                              f_cur], axis=1)
+        scal = _psum_scal(scal_loc, own, l_safe)
+        return w, slot_ok, b_hi, b_lo, scal
+
+    if compensated:
+        def _sel_body(f_loc, err_loc, alpha_loc, y_loc, x_sq_loc,
+                      k_diag_loc, valid_loc):
+            return _select_core(f_loc - err_loc, alpha_loc, y_loc,
+                                x_sq_loc, k_diag_loc, valid_loc)
+        sel_in = (shard,) * 7
+    else:
+        def _sel_body(f_loc, alpha_loc, y_loc, x_sq_loc, k_diag_loc,
+                      valid_loc):
+            return _select_core(f_loc, alpha_loc, y_loc, x_sq_loc,
+                                k_diag_loc, valid_loc)
+        sel_in = (shard,) * 6
+    select = jax.jit(mesh_shard_map(
+        _sel_body, mesh=mesh, in_specs=sel_in,
+        out_specs=(rep, rep, rep, rep, rep), check=False))
+
+    if compensated:
+        def _fold_body(x_blk, x_sq_loc, f_loc, err_loc, qx, qsq, coef,
+                       j):
+            s = j * tile
+            f_t = lax.dynamic_slice(f_loc, (s,), (tile,))
+            e_t = lax.dynamic_slice(err_loc, (s,), (tile,))
+            xsq_t = lax.dynamic_slice(x_sq_loc, (s,), (tile,))
+            f_n, e_n, _ = fold_tile_body(x_blk, xsq_t, f_t, e_t, qx,
+                                         qsq, coef, kp,
+                                         want_dots=False,
+                                         compensated=True)
+            return (lax.dynamic_update_slice(f_loc, f_n, (s,)),
+                    lax.dynamic_update_slice(err_loc, e_n, (s,)))
+        fold = jax.jit(mesh_shard_map(
+            _fold_body, mesh=mesh,
+            in_specs=(shard, shard, shard, shard, rep, rep, rep, rep),
+            out_specs=(shard, shard), check=False),
+            donate_argnums=(2, 3))
+    else:
+        def _fold_body(x_blk, x_sq_loc, f_loc, qx, qsq, coef, j):
+            s = j * tile
+            f_t = lax.dynamic_slice(f_loc, (s,), (tile,))
+            xsq_t = lax.dynamic_slice(x_sq_loc, (s,), (tile,))
+            f_n, _, _ = fold_tile_body(x_blk, xsq_t, f_t, None, qx,
+                                       qsq, coef, kp, want_dots=False,
+                                       compensated=False)
+            return lax.dynamic_update_slice(f_loc, f_n, (s,))
+        fold = jax.jit(mesh_shard_map(
+            _fold_body, mesh=mesh,
+            in_specs=(shard, shard, shard, rep, rep, rep, rep),
+            out_specs=shard, check=False),
+            donate_argnums=(2,))
+
+    def _scatter_body(alpha_loc, w, slot_ok, a_w):
+        l, own, _ = _ws_owners(w, slot_ok, n_loc)
+        l_scatter = jnp.where(own, l, jnp.int32(n_loc))
+        return alpha_loc.at[l_scatter].set(
+            jnp.where(own, a_w, 0.0), mode="drop")
+    scatter = jax.jit(mesh_shard_map(
+        _scatter_body, mesh=mesh, in_specs=(shard, rep, rep, rep),
+        out_specs=shard, check=False), donate_argnums=(0,))
+
+    def _norms_body(x_blk, x_sq_loc, j):
+        return lax.dynamic_update_slice(
+            x_sq_loc, squared_norms(x_blk), (j * tile,))
+    norms = jax.jit(mesh_shard_map(
+        _norms_body, mesh=mesh, in_specs=(shard, shard, rep),
+        out_specs=shard, check=False), donate_argnums=(1,))
+
+    return dict(select=select, fold=fold, scatter=scatter, norms=norms)

@@ -436,10 +436,22 @@ def solve_mesh(
             "selection='nu' is internal to the nu duals — call "
             "train_nusvc/train_nusvr (models/nusvm.py) instead")
     if config.ooc:
-        raise ValueError(
-            "ooc (out-of-core streaming) is single-chip: the tile "
-            "stream is fed by one host process (solver/ooc.py) — use "
-            "backend='single', or drop --ooc for the mesh engines")
+        # Out-of-core tile stream over the mesh (ISSUE 19): each device
+        # owns a padded row shard's tiles — the host feeds every device
+        # its shard's tile per double-buffered sharded put, folds are
+        # local (zero collectives), and the round joins on ONE psum
+        # inside selection. Bitwise equal to the single-chip stream
+        # (solver/ooc.py solve_ooc_mesh; tests/test_ooc.py pins it at
+        # 2 devices). Routed BEFORE the warm-start recursion below so
+        # the ooc driver owns seed repair (its gradient rebuild is the
+        # streamed fold, not the in-core one).
+        from dpsvm_tpu.solver.ooc import solve_ooc_mesh
+
+        return solve_ooc_mesh(x, y, config, num_devices=num_devices,
+                              mesh=mesh, callback=callback,
+                              checkpoint_path=checkpoint_path,
+                              resume=resume, alpha_init=alpha_init,
+                              f_init=f_init, warm_start=warm_start)
     if warm_start is not None:
         if alpha_init is not None or f_init is not None:
             raise ValueError(

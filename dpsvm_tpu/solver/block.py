@@ -193,6 +193,35 @@ def pipeline_pays(n_rows: int, d: int) -> bool:
     return False
 
 
+def ooc_shrink_pays(n_rows: int, d: int) -> bool:
+    """Auto-gate for the ooc SHRUNKEN tile stream (solver/ooc.py;
+    config.ooc_shrink — Joachims-style active-set shrinking over the
+    out-of-core fold). Same single-source discipline as
+    fused_round_pays / ring_pays: the gate constants come from a device
+    measurement or the gate stays off.
+
+    Status (2026-08-07): the shrunken stream is implemented and
+    CPU-verified exact (tests/test_ooc.py: shrink-on solves meet the
+    identical stopping rule via per-cycle full-stream reconstruction
+    and the endgame demotion; resume is bitwise), the tile-skip
+    structure is pinned by the tpulint ooc_fold_tile_shrink budget,
+    and the A/B probe exists (autotune/probes.py probe_ooc_shrink,
+    tools/profile_round.py --ooc-shrink) — but no TPU was reachable
+    this session, so there is no measured crossover and the honest
+    auto default is OFF everywhere (config.ooc_shrink=True or
+    active_set_size>0 forces it on for measurement and for the CPU
+    tests). Expected shape of the eventual gate: pays late in training
+    on H2D-bound streams — large n*d where most rows sit at bound and
+    the skipped tile bytes dwarf the per-cycle reconstruction stream
+    (roughly when the active fraction drops under
+    1 - tile_cost_ratio); does NOT pay at small n (the full stream is
+    one tile anyway) or when the working set churns across the whole
+    index space and re-shrinks thrash. This is the NO-PROFILE default:
+    an installed DeviceProfile's measured verdict (dpsvm_tpu/autotune)
+    overrides it via resolve_auto_gate."""
+    return False
+
+
 def resolve_auto_gate(knob: str, default: bool,
                       device_kind: str = "") -> tuple:
     """Resolve one ``None``-valued (auto) accelerator knob: the

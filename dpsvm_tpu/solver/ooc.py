@@ -37,6 +37,33 @@ trajectory is bit-identical to the in-core block engine's on shapes
 where both fit: selection, subproblem and fold all reduce over the
 same axes in the same order (tests/test_ooc.py pins exact equality,
 including a memmap-backed X leg).
+
+Two stream geometries ride on top of the base round (ISSUE 19):
+
+* SHRUNKEN stream (config.ooc_shrink / active_set_size with ooc) —
+  Joachims' SVMlight shrinking re-derived for a streamed fold. A
+  shrink CYCLE opens with one m-select over the full problem (m =
+  active_set_size, or auto-sized): its extrema are the exact global
+  KKT gap (the only place convergence is ever decided), and its m
+  most-violating rows become a static-shape active view. In-cycle
+  rounds select from the view and stream ONLY the tiles it intersects
+  — a skipped tile's H2D put and fold dispatch never happen, so its
+  gradient slice goes stale by exactly the skipped deltas. Exactness
+  via the shardlocal-engine precedent: a periodic full reconstruction
+  rebuilds f over all n from alpha (the warmstart one-streamed-pass
+  fold — it IS this program), and the endgame demotes permanently to
+  the exact full stream when the gap stalls or nears eps, so the
+  FINAL model meets the identical convergence criterion.
+* MESH stream (solve_ooc_mesh, backend='mesh' + ooc) — each device
+  owns a padded row shard's tiles; one host-driven double-buffered
+  ``device_put`` per step feeds every device its (tile, d) block, each
+  folds its shard locally (zero collectives), and the round joins on
+  ONE psum of the (q, 5) working-set scalars inside selection
+  (parallel/dist_block.py make_ooc_mesh_programs). The trajectory is
+  BITWISE equal to the single-chip ooc one (tests/test_ooc.py pins it
+  at 2 devices): each lane's fold is the same fold_tile_body op
+  sequence at the same shapes, and the psum gathers exactly one
+  nonzero term per slot — exact, not just close.
 """
 
 from __future__ import annotations
@@ -53,8 +80,10 @@ from dpsvm_tpu.config import SVMConfig
 from dpsvm_tpu.ops.kernels import (KernelParams, kernel_diag,
                                    kernel_from_dots, squared_norms)
 from dpsvm_tpu.ops.ooc import ooc_fold_tile
-from dpsvm_tpu.ops.select import refresh_extrema_host
-from dpsvm_tpu.solver.block import dispatch_subproblem, select_block
+from dpsvm_tpu.ops.select import refresh_extrema_host, shrink_view
+from dpsvm_tpu.solver.block import (autotune_gate_resolver,
+                                    dispatch_subproblem, ooc_shrink_pays,
+                                    select_block)
 from dpsvm_tpu.solver.cache import (CacheState, init_cache, probe_rows,
                                     refresh_rows)
 from dpsvm_tpu.solver.result import SolveResult
@@ -78,6 +107,30 @@ class OocState(NamedTuple):
 
 
 _tile_sq = jax.jit(squared_norms)
+
+# ---- shrunken-stream cycle tuning (ISSUE 19). A cycle's reconstruction
+# costs one full streamed pass (ceil(n/tile) tiles), so the cycle must
+# run long enough that the per-round tile savings amortize it; 32 rounds
+# against the view keeps the amortized overhead a few percent while
+# re-deriving the view often enough that it tracks the working set
+# (SVMlight re-checks shrinking every ~100 cheap per-pair iterations; an
+# ooc ROUND is a q-sized batch, so 32 rounds is the same order of
+# progress between re-shrinks).
+_SHRINK_CYCLE_ROUNDS = 32
+# Endgame demotion: the final model must meet the IDENTICAL convergence
+# criterion as the full stream, so shrinking hands over to the exact
+# path once the global gap is within 10x of 2*eps (the view would churn
+# on near-satisfied rows) or the gap stalls — fails to shrink by >= 5%
+# over a cycle — for TWO cycles in a row (the active set stopped
+# capturing the true violators — stalling on a stale view burns
+# reconstruction passes for nothing). One stalled cycle is not enough
+# to demote: hard regions legitimately plateau for a cycle and then
+# resume progress, and a premature permanent demotion forfeits the
+# whole stream saving; the streak resets on any cycle that makes the
+# cut.
+_SHRINK_DEMOTE_EPS_MULT = 10.0
+_SHRINK_STALL_FACTOR = 0.95
+_SHRINK_STALL_CYCLES = 2
 
 
 @partial(jax.jit, static_argnames=("c", "q", "selection"))
@@ -330,6 +383,32 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
     lines = int(config.ooc_cache_lines)
     use_cache = lines > 0
 
+    # ---- shrunken-stream resolution (ISSUE 19). active_set_size is an
+    # explicit request (config validates it against ooc_shrink=False)
+    # and also sizes the view; ooc_shrink=True asks for the auto-sized
+    # view; ooc_shrink=None consults the autotune gate with the
+    # hand-measured default (solver/block.py ooc_shrink_pays — the CPU
+    # seed profile resolves OFF; only an authoritative real-TPU probe
+    # verdict turns it on, the ISSUE 14 honesty rule).
+    _auto_gate, _autotune_embed = autotune_gate_resolver(device)
+    if config.active_set_size:
+        use_shrink = True
+        shrink_m = int(config.active_set_size)
+    elif config.ooc_shrink is not None:
+        use_shrink = bool(config.ooc_shrink)
+        shrink_m = 0
+    else:
+        use_shrink = bool(_auto_gate("ooc_shrink",
+                                     ooc_shrink_pays(n, d)))
+        shrink_m = 0
+    if use_shrink:
+        if shrink_m <= 0:
+            # Auto view: big enough that several rounds' working sets
+            # fit inside one view, small enough to actually skip tiles.
+            shrink_m = max(4 * q, n_pad // 8)
+        shrink_m = max(q, min(shrink_m, n_pad))
+        shrink_m -= shrink_m % gran
+
     # ---- device-side O(n) state. y/valid pad exactly as the in-core
     # driver does (solver/smo.py _solve_impl) so selections see the
     # identical masked problem.
@@ -357,7 +436,9 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
                         "engine": config.engine, "kernel": config.kernel,
                         "selection": config.selection, "ooc": True,
                         "ooc_tile_rows": tile, "ooc_tiles": tiles,
-                        "ooc_cache_lines": lines})
+                        "ooc_cache_lines": lines,
+                        "ooc_shrink": use_shrink,
+                        "shrink_m": shrink_m})
     drain_pending_obs_events(obs)
 
     with obs.span("solver/ooc_setup_stream"):
@@ -395,6 +476,9 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
     start_pairs = 0
     start_rounds = 0
     resumed_from = None
+    resume_demoted = False
+    resume_gap = None
+    resume_stall = 0
     if resume:
         from dpsvm_tpu.utils.checkpoint import resume_state
 
@@ -419,10 +503,21 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
             start_pairs = st.iteration
             start_rounds = st.rounds
             resumed_from = st.iteration
+            # Shrink carry (ISSUE 19): ooc checkpoints are written at
+            # shrink-cycle boundaries only, so the view itself never
+            # needs persisting — but the demotion latch (permanent) and
+            # the previous cycle-start gap (the stall test's baseline)
+            # both steer the next cycle, and restoring them is what
+            # keeps a shrinking resume BITWISE on the uninterrupted
+            # trajectory (tests/test_ooc.py pins it).
+            resume_demoted = bool(st.shrink_demoted)
+            resume_gap = st.shrink_gap
+            resume_stall = int(st.shrink_stall)
             obs.event("resume", iteration=start_pairs,
                       rounds=start_rounds,
                       format_version=st.format_version,
-                      cache_cold_restart=bool(use_cache))
+                      cache_cold_restart=bool(use_cache),
+                      shrink_demoted=resume_demoted)
 
     # The block kernel-row cache restarts COLD on resume (an (L, n)
     # HBM cache is not worth persisting next to the O(n) carry); the
@@ -460,6 +555,30 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
     train_seconds = 0.0
     keys_arg = cache.keys if use_cache else None
 
+    # ---- shrunken-stream cycle state (ISSUE 19). `active_dev` is the
+    # device-side view mask while a cycle is open (None between
+    # cycles); `stale` flips the first time a round skips a tile, and
+    # only a full reconstruction clears it — every exit path
+    # reconstructs while stale, so finalize (and any checkpoint) only
+    # ever sees an exact gradient.
+    shrink_live = use_shrink and not resume_demoted
+    shrink_demoted = use_shrink and resume_demoted
+    last_cycle_gap = resume_gap
+    stall_streak = resume_stall
+    active_dev = None
+    live_list = []
+    cycle_rounds = 0
+    stale = False
+    shrink_cycles = 0
+    reconstructions = 0
+    tiles_skipped = 0
+    bytes_skipped = 0
+    # Tiles actually streamed during shrink-active rounds (cadence
+    # reconstructions included — they are the price of the cycle).
+    # With tiles_skipped this gives the late-phase byte cut the bench
+    # records: (in_cycle + skipped) / in_cycle.
+    tiles_in_cycle = 0
+
     if obs.live:
         c_tiles = obs.registry.counter("solve.ooc_tiles_total")
         c_bytes = obs.registry.counter("solve.ooc_tile_bytes_total")
@@ -467,16 +586,126 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
         c_looks = obs.registry.counter("solve.cache_lookups_total")
         c_evict = obs.registry.counter("solve.cache_evictions_total")
         c_saved = obs.registry.counter("solve.ooc_cached_rounds_total")
+        c_skip = obs.registry.counter("solve.ooc_tiles_skipped_total")
+        c_recon = obs.registry.counter(
+            "solve.shrink_reconstructions_total")
+
+    def _reconstruct(reason: str) -> int:
+        """Full-stream rebuild of f from alpha — the warmstart fold
+        (solver/warmstart.py warm_f_rebuild IS this program: one
+        double-buffered streamed pass over host X), clearing whatever
+        staleness the skipped tiles accumulated. The Kahan residual
+        restarts at zero (the rebuilt f is exact; there is nothing to
+        compensate). Counts its ceil(n/tile) tiles into the stream
+        totals and returns the count for the round's chunk record."""
+        nonlocal f, f_err, stale, reconstructions, tiles_streamed, \
+            bytes_h2d
+        from dpsvm_tpu.solver.warmstart import warm_f_rebuild
+
+        alpha_h = np.asarray(alpha)[:n]
+        f_np = warm_f_rebuild(x, y_np, alpha_h, kp, device=device,
+                              tile_rows=tile)
+        f_pad = (-y_p).astype(np.float32)
+        f_pad[:n] = f_np
+        f = jax.device_put(jnp.asarray(f_pad), device)
+        if f_err is not None:
+            f_err = jax.device_put(jnp.zeros((n_pad,), jnp.float32),
+                                   device)
+        stale = False
+        reconstructions += 1
+        # warm_f_rebuild short-circuits (no stream) on an all-zero
+        # alpha; only count tiles the pass actually streamed.
+        tr = -(-n // tile) if np.any(alpha_h != 0.0) else 0
+        tiles_streamed += tr
+        bytes_h2d += tr * tile_bytes
+        obs.event("shrink_reconstruct", reason=reason, rounds=rounds,
+                  pairs=pairs, tiles=tr)
+        if obs.live:
+            c_recon.add(1)
+            c_tiles.add(tr)
+            c_bytes.add(tr * tile_bytes)
+        return tr
 
     while True:
         _sp = obs.span("solver/ooc_round")
         _sp.__enter__()
         try:
             t0 = time.perf_counter()
+            round_hits = 0
+            round_evicts = 0
+            round_tiles = 0
+            round_skipped = 0
+            recon_tiles = 0
+            all_hit = False
+            t = 0
+            recon_only = False
+
+            # ---- shrink cycle start (between cycles): ONE m-select
+            # over the FULL problem plays three roles — the exact
+            # global stopping test (the only place convergence is ever
+            # decided while shrinking; f is never stale here), the
+            # endgame demotion decision, and the next active view.
+            if shrink_live and active_dev is None:
+                dispatches += 1
+                faults.device_fault(
+                    "dispatch", f"ooc shrink cycle {shrink_cycles + 1}")
+                w_m, ok_m, bh_d, bl_d, _, _ = _ooc_select(
+                    f, f_err, alpha, y_dev, valid_dev, None,
+                    c=c, q=shrink_m, selection=config.selection)
+                b_hi = float(np.asarray(bh_d))
+                b_lo = float(np.asarray(bl_d))
+                b_hi, b_lo = faults.poison_obs(b_hi, b_lo)
+                check_obs_finite(b_hi, b_lo, pairs, "ooc")
+                converged = not (b_lo > b_hi + 2.0 * eps_run)
+                if converged or pairs >= max_iter:
+                    round_dt = time.perf_counter() - t0
+                    train_seconds += round_dt
+                    break
+                gap_now = b_lo - b_hi
+                demote = None
+                if gap_now <= _SHRINK_DEMOTE_EPS_MULT * eps_run:
+                    demote = "near_eps"
+                else:
+                    if (last_cycle_gap is not None and
+                            gap_now > _SHRINK_STALL_FACTOR
+                            * last_cycle_gap):
+                        stall_streak += 1
+                        if stall_streak >= _SHRINK_STALL_CYCLES:
+                            demote = "stalled"
+                    else:
+                        stall_streak = 0
+                if demote is None:
+                    active_np, live_tiles = shrink_view(
+                        np.asarray(w_m), np.asarray(ok_m), n, n_pad,
+                        tile)
+                    if live_tiles.size >= tiles:
+                        # The view spans every tile: a cycle would
+                        # stream everything anyway and still pay the
+                        # reconstruction — pure overhead.
+                        demote = "full_view"
+                if demote is not None:
+                    # Permanent handoff to the exact full-stream path
+                    # (resume restores it via the checkpoint's
+                    # shrink_demoted latch).
+                    shrink_live = False
+                    shrink_demoted = True
+                    obs.event("shrink_demote", reason=demote,
+                              rounds=rounds, pairs=pairs, gap=gap_now)
+                else:
+                    last_cycle_gap = gap_now
+                    active_dev = jax.device_put(jnp.asarray(active_np),
+                                                device)
+                    live_list = [int(i) for i in live_tiles]
+                    cycle_rounds = 0
+                    shrink_cycles += 1
+
+            in_cycle = shrink_live and active_dev is not None
+
             dispatches += 1
             faults.device_fault("dispatch", f"ooc round {rounds + 1}")
             w_d, ok_d, bh_d, bl_d, hit_d, slot_d = _ooc_select(
-                f, f_err, alpha, y_dev, valid_dev, keys_arg,
+                f, f_err, alpha, y_dev,
+                active_dev if in_cycle else valid_dev, keys_arg,
                 c=c, q=q, selection=config.selection)
             b_hi = float(np.asarray(bh_d))
             b_lo = float(np.asarray(bl_d))
@@ -487,112 +716,169 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
             # produce.
             b_hi, b_lo = faults.poison_obs(b_hi, b_lo)
             check_obs_finite(b_hi, b_lo, pairs, "ooc")
-            converged = not (b_lo > b_hi + 2.0 * eps_run)
-            if converged or pairs >= max_iter:
-                round_dt = time.perf_counter() - t0
-                train_seconds += round_dt
-                break
-
-            round_hits = 0
-            round_evicts = 0
-            round_tiles = 0
-            ok_np = np.asarray(ok_d)
-            live = int(ok_np.sum())
-            hit_np = np.asarray(hit_d)
-            all_hit = use_cache and live > 0 \
-                and bool(np.all(hit_np[ok_np]))
-            budget_left = jnp.int32(max_iter - pairs)
-            stamp = jnp.int32(rounds + 1)
-            if all_hit:
-                # All live slots cached: one dispatch, zero stream.
-                dispatches += 1
-                f, f_err, alpha, ticks, t_d = _ooc_round_cached(
-                    f, f_err, alpha, y_dev, x_sq, k_diag, cache.data,
-                    cache.ticks, w_d, ok_d, slot_d, bh_d, bl_d,
-                    budget_left, stamp, **sub_kw)
-                cache = CacheState(cache.data, cache.keys, ticks)
-                round_hits = live
-                cached_rounds += 1
-                t = int(np.asarray(t_d))
+            gap_closed = not (b_lo > b_hi + 2.0 * eps_run)
+            if not in_cycle:
+                converged = gap_closed
+                if converged or pairs >= max_iter:
+                    round_dt = time.perf_counter() - t0
+                    train_seconds += round_dt
+                    break
             else:
-                # Stream round: host-gather the working-set rows, run
-                # the subproblem, then fold over double-buffered tiles.
-                w_np = np.clip(np.asarray(w_d), 0, n - 1)
-                # Fancy row indexing reads exactly q rows from host X
-                # (ndarray and memmap alike — this plus _tile_host are
-                # the only reads of X's bulk).
-                qx = jax.device_put(
-                    jnp.asarray(np.ascontiguousarray(
-                        np.asarray(x[w_np], np.float32)), dtype),
-                    device)
-                dispatches += 1
-                a_w, coef, t_d, qsq = _ooc_subproblem(
-                    qx, w_d, ok_d, f, f_err, alpha, y_dev, x_sq, k_diag,
-                    bh_d, bl_d, budget_left, **sub_kw)
-                # Double-buffered tile stream: issue tile i+1's async
-                # H2D put BEFORE dispatching tile i's fold so the DMA
-                # overlaps the matmul (the two-slot tile pool — all
-                # tiles share one shape, so the allocator recycles the
-                # freed slots). Each fold consumes its slice of the
-                # carried gradient and returns the folded slice — the
-                # accumulate stays fused with the matmul, which is
-                # what keeps the trajectory bit-identical to the
-                # in-core engine.
-                f_tiles = []
-                err_tiles = [] if f_err is not None else None
-                dots = []
-                nxt = _put_tile(x, 0, tile, n, d, dtype, device)
-                for i in range(tiles):
-                    cur, nxt = nxt, (
-                        _put_tile(x, (i + 1) * tile, tile, n, d,
-                                  dtype, device)
-                        if i + 1 < tiles else None)
+                # In-cycle extrema are the ACTIVE VIEW's: they steer
+                # the view, never the stopping test (that belongs to
+                # the cycle-start full select above).
+                converged = False
+                if pairs >= max_iter:
+                    if stale:
+                        recon_tiles += _reconstruct("budget")
+                    active_dev = None
+                    round_dt = time.perf_counter() - t0
+                    train_seconds += round_dt
+                    break
+                if gap_closed:
+                    # The view is solved to tolerance: rebuild the
+                    # exact gradient and open the next cycle from it.
+                    if stale:
+                        recon_tiles += _reconstruct("view_converged")
+                    active_dev = None
+                    recon_only = True
+
+            in_cycle = in_cycle and not recon_only
+            if not recon_only:
+                ok_np = np.asarray(ok_d)
+                live = int(ok_np.sum())
+                hit_np = np.asarray(hit_d)
+                all_hit = use_cache and live > 0 \
+                    and bool(np.all(hit_np[ok_np]))
+                budget_left = jnp.int32(max_iter - pairs)
+                stamp = jnp.int32(rounds + 1)
+                if all_hit:
+                    # All live slots cached: one dispatch, zero stream.
+                    # Cached rows are full (q, n_pad) width, so this
+                    # round is exact over EVERY lane even mid-cycle —
+                    # stale lanes advance by the exact delta and stay
+                    # consistently stale by only the skipped rounds.
                     dispatches += 1
-                    s = i * tile
-                    ft, et, dots_i = ooc_fold_tile(
-                        cur, xsq_tiles[i], f[s:s + tile],
-                        f_err[s:s + tile] if f_err is not None else None,
-                        qx, qsq, coef, kp=kp, want_dots=use_cache,
-                        compensated=f_err is not None)
-                    f_tiles.append(ft)
-                    if err_tiles is not None:
-                        err_tiles.append(et)
-                    if use_cache:
-                        dots.append(dots_i)
-                # Tile-stream bytes only (the q*d working-set gather is
-                # separate, small, and not part of the stream) — keeps
-                # this stat and the solve.ooc_tile_bytes_total registry
-                # counter the same sum.
-                round_tiles = tiles
-                tiles_streamed += tiles
-                bytes_h2d += tiles * tile_bytes
-                dispatches += 1
-                if use_cache:
-                    (f, f_err, alpha, data, keys, ticks,
-                     stats_d) = _ooc_apply_cached(
-                        tuple(f_tiles),
-                        tuple(err_tiles) if err_tiles is not None
-                        else None,
-                        alpha, cache.data, cache.keys, cache.ticks,
-                        w_d, ok_d, a_w, tuple(dots), stamp)
-                    cache = CacheState(data, keys, ticks)
-                    keys_arg = keys
-                    stats_np = np.asarray(stats_d)
-                    round_hits = int(stats_np[0])
-                    round_evicts = int(stats_np[1])
+                    f, f_err, alpha, ticks, t_d = _ooc_round_cached(
+                        f, f_err, alpha, y_dev, x_sq, k_diag, cache.data,
+                        cache.ticks, w_d, ok_d, slot_d, bh_d, bl_d,
+                        budget_left, stamp, **sub_kw)
+                    cache = CacheState(cache.data, cache.keys, ticks)
+                    round_hits = live
+                    cached_rounds += 1
+                    t = int(np.asarray(t_d))
                 else:
-                    f, f_err, alpha = _ooc_apply(
-                        tuple(f_tiles),
-                        tuple(err_tiles) if err_tiles is not None
-                        else None,
-                        alpha, w_d, ok_d, a_w)
-                t = int(np.asarray(t_d))
-            pairs += t
-            rounds += 1
-            if use_cache:
-                cache_lookups += live
-                cache_hits += round_hits
-                cache_evictions += round_evicts
+                    # Stream round: host-gather the working-set rows,
+                    # run the subproblem, then fold over
+                    # double-buffered tiles.
+                    w_np = np.clip(np.asarray(w_d), 0, n - 1)
+                    # Fancy row indexing reads exactly q rows from host
+                    # X (ndarray and memmap alike — this plus
+                    # _tile_host are the only reads of X's bulk).
+                    qx = jax.device_put(
+                        jnp.asarray(np.ascontiguousarray(
+                            np.asarray(x[w_np], np.float32)), dtype),
+                        device)
+                    dispatches += 1
+                    a_w, coef, t_d, qsq = _ooc_subproblem(
+                        qx, w_d, ok_d, f, f_err, alpha, y_dev, x_sq,
+                        k_diag, bh_d, bl_d, budget_left, **sub_kw)
+                    # Double-buffered tile stream: issue the next live
+                    # tile's async H2D put BEFORE dispatching this
+                    # one's fold so the DMA overlaps the matmul (the
+                    # two-slot tile pool — all tiles share one shape,
+                    # so the allocator recycles the freed slots). Each
+                    # fold consumes its slice of the carried gradient
+                    # and returns the folded slice — the accumulate
+                    # stays fused with the matmul, which is what keeps
+                    # the trajectory bit-identical to the in-core
+                    # engine. A SHRUNKEN round walks only the active
+                    # view's tiles (the skip is a dispatch that never
+                    # happens, not a masked kernel); a skipped tile's
+                    # f slice passes through below untouched, and the
+                    # cache refresh is skipped too — a partial dot row
+                    # would poison the full-width LRU.
+                    order = live_list if in_cycle else list(range(tiles))
+                    want_dots = use_cache and not in_cycle
+                    f_tiles = [None] * tiles
+                    err_tiles = ([None] * tiles
+                                 if f_err is not None else None)
+                    dots = []
+                    nxt = _put_tile(x, order[0] * tile, tile, n, d,
+                                    dtype, device)
+                    for oi, i in enumerate(order):
+                        cur, nxt = nxt, (
+                            _put_tile(x, order[oi + 1] * tile, tile, n,
+                                      d, dtype, device)
+                            if oi + 1 < len(order) else None)
+                        dispatches += 1
+                        s = i * tile
+                        ft, et, dots_i = ooc_fold_tile(
+                            cur, xsq_tiles[i], f[s:s + tile],
+                            f_err[s:s + tile] if f_err is not None
+                            else None,
+                            qx, qsq, coef, kp=kp, want_dots=want_dots,
+                            compensated=f_err is not None)
+                        f_tiles[i] = ft
+                        if err_tiles is not None:
+                            err_tiles[i] = et
+                        if want_dots:
+                            dots.append(dots_i)
+                    for i in range(tiles):
+                        if f_tiles[i] is None:
+                            s = i * tile
+                            f_tiles[i] = f[s:s + tile]
+                            if err_tiles is not None:
+                                err_tiles[i] = f_err[s:s + tile]
+                    # Tile-stream bytes only (the q*d working-set
+                    # gather is separate, small, and not part of the
+                    # stream) — keeps this stat and the
+                    # solve.ooc_tile_bytes_total registry counter the
+                    # same sum.
+                    round_tiles = len(order)
+                    round_skipped = tiles - len(order)
+                    if round_skipped:
+                        stale = True
+                        tiles_skipped += round_skipped
+                        bytes_skipped += round_skipped * tile_bytes
+                    tiles_streamed += round_tiles
+                    bytes_h2d += round_tiles * tile_bytes
+                    dispatches += 1
+                    if want_dots:
+                        (f, f_err, alpha, data, keys, ticks,
+                         stats_d) = _ooc_apply_cached(
+                            tuple(f_tiles),
+                            tuple(err_tiles) if err_tiles is not None
+                            else None,
+                            alpha, cache.data, cache.keys, cache.ticks,
+                            w_d, ok_d, a_w, tuple(dots), stamp)
+                        cache = CacheState(data, keys, ticks)
+                        keys_arg = keys
+                        stats_np = np.asarray(stats_d)
+                        round_hits = int(stats_np[0])
+                        round_evicts = int(stats_np[1])
+                    else:
+                        f, f_err, alpha = _ooc_apply(
+                            tuple(f_tiles),
+                            tuple(err_tiles) if err_tiles is not None
+                            else None,
+                            alpha, w_d, ok_d, a_w)
+                    t = int(np.asarray(t_d))
+                pairs += t
+                rounds += 1
+                if use_cache:
+                    cache_lookups += live
+                    cache_hits += round_hits
+                    cache_evictions += round_evicts
+                if in_cycle:
+                    cycle_rounds += 1
+                    if cycle_rounds >= _SHRINK_CYCLE_ROUNDS:
+                        # Re-shrink cadence: close the cycle so the
+                        # next round re-derives the view from an exact
+                        # gradient (and so a checkpoint can land).
+                        if stale:
+                            recon_tiles += _reconstruct("cadence")
+                        active_dev = None
             round_dt = time.perf_counter() - t0
             train_seconds += round_dt
         finally:
@@ -602,13 +888,19 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
         # The chunk record's device_seconds is EXACTLY the round time
         # train_seconds accumulated — the bench runlog reconciliation
         # (<= 1%) depends on the two being the same sum.
+        if in_cycle:
+            tiles_in_cycle += round_tiles + recon_tiles
         obs.chunk(pairs=pairs, b_hi=b_hi, b_lo=b_lo,
                   device_seconds=round_dt,
-                  dispatch=dispatches, tiles=round_tiles,
-                  cached_round=bool(all_hit), cache_hits=round_hits)
+                  dispatch=dispatches, tiles=round_tiles + recon_tiles,
+                  cached_round=bool(all_hit), cache_hits=round_hits,
+                  tiles_skipped=round_skipped,
+                  shrink_active=bool(in_cycle))
         if obs.live:
             c_tiles.add(round_tiles)
             c_bytes.add(tile_bytes * round_tiles)
+            if round_skipped:
+                c_skip.add(round_skipped)
             if use_cache:
                 c_hits.add(round_hits)
                 c_looks.add(live)
@@ -625,22 +917,40 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
             assert_finite_state(OocState(alpha, f, b_hi, b_lo, pairs,
                                          rounds, cache_hits),
                                 pairs, "ooc")
-        if ckpt.due(pairs) or (abort and ckpt.active):
+        if abort and shrink_live and active_dev is not None:
+            # Abort mid-cycle: leave nothing stale behind — the
+            # checkpoint below and finalize both need the exact f.
+            if stale:
+                _reconstruct("abort")
+            active_dev = None
+        if (ckpt.due(pairs) or (abort and ckpt.active)) \
+                and (not shrink_live or active_dev is None):
             # Round-boundary checkpoint, gated BEFORE any np.asarray
             # materialization (the smo.py discipline). The v2 payload
             # carries the RAW f plus the f_err lanes — not the
             # effective f - f_err the in-core v1 writers save —
             # because the compensated resume must continue the exact
             # Kahan accumulation bits, not restart the residual.
+            # While SHRINKING, saves land only at cycle boundaries
+            # (mid-cycle f has stale lanes, and the view itself is
+            # not persisted): a resume then re-opens the next cycle
+            # from exactly the state — f, alpha, demotion latch,
+            # previous cycle gap — the uninterrupted run would have,
+            # which is what keeps the shrinking resume BITWISE.
             ckpt.save(pairs, np.asarray(alpha)[:n], np.asarray(f)[:n],
                       b_hi, b_lo, force=True,
                       f_err=(np.asarray(f_err)[:n]
                              if f_err is not None else None),
-                      rounds=rounds)
+                      rounds=rounds,
+                      shrink_demoted=(shrink_demoted if use_shrink
+                                      else None),
+                      shrink_gap=last_cycle_gap,
+                      shrink_stall=(stall_streak if use_shrink
+                                    else None))
         if config.verbose:
             print(f"[ooc] round={rounds} pairs={pairs} "
                   f"gap={b_lo - b_hi:.6f} tiles={round_tiles} "
-                  f"hits={round_hits}")
+                  f"skip={round_skipped} hits={round_hits}")
         phase_seconds["observe"] += time.perf_counter() - t_obs0
         if abort:
             break
@@ -670,7 +980,23 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
         "cache_hit_rate": hit_rate,
         "cache_evictions": cache_evictions,
         "phase_seconds": phase_seconds,
+        "ooc_shrink": use_shrink,
     }
+    if use_shrink:
+        stats.update(
+            shrink_m=shrink_m,
+            shrink_cycles=shrink_cycles,
+            shrink_reconstructions=reconstructions,
+            shrink_demoted=shrink_demoted,
+            tiles_skipped=tiles_skipped,
+            tile_bytes_skipped=bytes_skipped,
+            shrink_tiles_in_cycle=tiles_in_cycle,
+            shrink_active_fraction=round(min(1.0, shrink_m / max(n, 1)),
+                                         6),
+        )
+    _at = _autotune_embed()
+    if _at:
+        stats.update(_at)
     if resumed_from is not None:
         stats["resumed_from"] = resumed_from
         # The block cache is never checkpointed: a resumed cache-on
@@ -691,6 +1017,472 @@ def _solve_ooc_impl(x, y, config: SVMConfig, callback, device,
                cache_hits=cache_hits, cache_lookups=cache_lookups,
                cache_hit_rate=round(hit_rate, 6),
                cache_evictions=cache_evictions,
+               ooc_shrink=use_shrink,
+               shrink_cycles=shrink_cycles,
+               shrink_reconstructions=reconstructions,
+               shrink_demoted=shrink_demoted,
+               shrink_active_fraction=(
+                   round(min(1.0, shrink_m / max(n, 1)), 6)
+                   if use_shrink else 0.0),
+               tiles_skipped=tiles_skipped,
+               tile_bytes_skipped=bytes_skipped,
+               phase_seconds=phase_seconds)
+    return SolveResult(
+        alpha=alpha_np,
+        b=float((b_lo + b_hi) / 2.0),
+        b_hi=b_hi,
+        b_lo=b_lo,
+        iterations=pairs,
+        converged=converged,
+        train_seconds=train_seconds,
+        dispatches=dispatches,
+        stats=stats,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mesh out-of-core stream (ISSUE 19): solve_mesh + config.ooc routes here.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("kp", "c", "eps", "tau",
+                                   "inner_iters", "inner_impl",
+                                   "interpret", "selection",
+                                   "pair_batch"))
+def _ooc_mesh_subproblem(qx, slot_ok, scal, b_hi, b_lo, budget_left,
+                         kp: KernelParams, c, eps: float, tau: float,
+                         inner_iters: int, inner_impl: str,
+                         interpret: bool, selection: str,
+                         pair_batch: int):
+    """Gram block + subproblem for a MESH stream round, replicated.
+
+    The working set's per-row scalars arrive as the select program's
+    ONE-psum (q, 5) stack — columns [x_sq, k_diag, alpha, y, f_eff] —
+    instead of the single-chip driver's device-side takes, and the rows
+    themselves as the replicated host gather qx. Same algebra as
+    _ooc_subproblem from there on, so a_w/coef/t are bitwise the
+    single-chip round's (dead slots carry psum zeros rather than
+    whatever take() read — dispatch_subproblem masks them either way,
+    the dist_block bitwise precedent). Returns (a_w, coef, t, qsq)."""
+    qsq = scal[:, 0]
+    kd_w = scal[:, 1]
+    a_w0 = scal[:, 2]
+    y_w = scal[:, 3]
+    f_w0 = scal[:, 4]
+    gap_open = b_lo > b_hi + 2.0 * eps
+    dots_w = jnp.dot(qx, qx.T, preferred_element_type=jnp.float32)
+    kb_w = kernel_from_dots(dots_w, qsq, qsq, kp)
+    limit = jnp.minimum(jnp.int32(inner_iters), budget_left)
+    limit = jnp.where(gap_open, limit, 0)
+    a_w, coef, t = dispatch_subproblem(
+        kb_w, kd_w, slot_ok, a_w0, y_w, f_w0, c, eps, tau, limit,
+        inner_impl, interpret, selection, pair_batch)
+    return a_w, coef, t, qsq
+
+
+def _mesh_block_host(x, j: int, tile: int, n: int, d: int, n_loc: int,
+                     n_dev: int):
+    """Stream step j's (P*tile, d) host block: device k's slice is its
+    shard's tile j — global rows [k*n_loc + j*tile, +tile), clipped and
+    zero-padded past n (pad rows are inert: zero coef contributions and
+    masked out of selection). One assembly feeds ONE sharded device_put
+    that lands each device exactly its own tile."""
+    blk = np.zeros((n_dev * tile, d), np.float32)
+    for k in range(n_dev):
+        s = k * n_loc + j * tile
+        e = min(s + tile, n)
+        if s < e:
+            blk[k * tile:k * tile + (e - s)] = np.asarray(
+                x[s:e], np.float32)
+    return blk
+
+
+def _put_block(x, j: int, tile: int, n: int, d: int, n_loc: int,
+               n_dev: int, dtype, mesh):
+    """One mesh stream step's host->HBM upload — the SAME
+    ``ooc_tile_put`` fault seam as the single-chip stream sits in
+    front, so injected H2D faults exercise the mesh path's
+    checkpoint-resume recovery too (tools/faults_smoke.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS
+
+    faults.device_fault("ooc_tile_put",
+                        f"mesh stream step {j} (tile rows/device "
+                        f"[{j * tile}, {(j + 1) * tile}))")
+    blk = _mesh_block_host(x, j, tile, n, d, n_loc, n_dev)
+    return jax.device_put(jnp.asarray(blk, dtype),
+                          NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
+
+
+def solve_ooc_mesh(
+    x,
+    y,
+    config: SVMConfig,
+    num_devices: Optional[int] = None,
+    mesh=None,
+    callback=None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
+    alpha_init=None,
+    f_init=None,
+    warm_start=None,
+) -> SolveResult:
+    """Out-of-core training sharded over the mesh's `data` axis
+    (backend='mesh' + config.ooc; solve_mesh routes here).
+
+    Each device owns a padded row shard's tiles: the host drives the
+    SAME double-buffered stream as solve_ooc, but every step's
+    device_put carries one (P*tile, d) block row-sharded over the mesh
+    — each device receives exactly its shard's tile j — and every
+    device folds its own rows locally (ZERO collectives in the fold;
+    the ``ooc_mesh_fold`` tpulint budget pins it). The round joins on
+    ONE (q, 5) psum of the working-set scalars inside selection
+    (parallel/dist_block.py make_ooc_mesh_programs), the (q, q)
+    subproblem runs replicated, and alpha scatters back owner-local.
+
+    The trajectory is BITWISE equal to the single-chip ooc stream
+    (tests/test_ooc.py pins it at 2 devices): each lane's fold is the
+    same fold_tile_body op sequence at the same (tile,) shapes, each
+    lane updates exactly once per round (cross-tile order is
+    irrelevant), and the scalar psum gathers exactly one nonzero f32
+    term per slot — exact, not just close.
+
+    Not composed here (loud errors, not silent drops): the block
+    kernel-row cache (single-chip HBM structure) and the shrunken
+    stream (host bookkeeping over one stream). Checkpoints are the
+    same v2 files as the single-chip stream's — gathered to host,
+    backend-portable, bitwise on resume — and the driver is
+    host-driven single-controller, same as every ooc stream."""
+    from dpsvm_tpu.solver.smo import _precision_ctx
+
+    if config.ooc_cache_lines:
+        raise ValueError(
+            "ooc_cache_lines with backend='mesh' is not implemented: "
+            "the (L, n) kernel-row cache is a single-chip HBM "
+            "structure — drop ooc_cache_lines, or use backend='single'")
+    if config.active_set_size or config.ooc_shrink:
+        raise ValueError(
+            "the shrunken tile stream (active_set_size / ooc_shrink) "
+            "is single-chip: the live-tile skip is host bookkeeping "
+            "over one stream — drop it, or use backend='single'")
+    if warm_start is not None:
+        if alpha_init is not None or f_init is not None:
+            raise ValueError(
+                "pass either warm_start or alpha_init/f_init, not both")
+        from dpsvm_tpu.solver.warmstart import prepare_warm_start
+
+        n_dev = (int(mesh.size) if mesh is not None
+                 else int(num_devices or len(jax.devices())))
+        a0, f0, wstats = prepare_warm_start(x, y, config, warm_start,
+                                            mesh_devices=n_dev)
+        res = solve_ooc_mesh(x, y, config, num_devices=num_devices,
+                             mesh=mesh, callback=callback,
+                             checkpoint_path=checkpoint_path,
+                             resume=resume, alpha_init=a0, f_init=f0)
+        res.stats["warm_start"] = wstats
+        return res
+
+    def attempt(cfg_k, res_k, _k):
+        return _solve_ooc_mesh_impl(x, y, cfg_k, num_devices, mesh,
+                                    callback, checkpoint_path, res_k,
+                                    alpha_init, f_init)
+
+    with _precision_ctx(config):
+        return run_with_fault_retry(config, checkpoint_path, resume,
+                                    attempt)
+
+
+def _solve_ooc_mesh_impl(x, y, config: SVMConfig, num_devices, mesh,
+                         callback, checkpoint_path, resume, alpha_init,
+                         f_init) -> SolveResult:
+    from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+    from dpsvm_tpu.parallel.dist_block import make_ooc_mesh_programs
+    from dpsvm_tpu.parallel.mesh import DATA_AXIS, make_data_mesh
+
+    t_entry = time.perf_counter()
+    y_np = np.asarray(y, np.int32)
+    n, d = x.shape
+    gamma = config.resolve_gamma(d)
+    kp = KernelParams(config.kernel, gamma, config.degree, config.coef0)
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    if config.dtype == "bfloat16":
+        from dpsvm_tpu.ops.kernels import warn_if_bf16_degrades
+        warn_if_bf16_degrades(np.asarray(x[:min(n, 4096)]), config)
+    if mesh is None:
+        mesh = make_data_mesh(num_devices)
+    n_dev = int(mesh.size)
+    interpret = mesh.devices.flat[0].platform != "tpu"
+    inner_impl = "xla" if interpret else "pallas"
+
+    # ---- shard-and-tile geometry: every shard is a whole number of
+    # stream tiles (n_loc = tile * ceil(n / (P*tile))), so stream step
+    # j moves each device's tile j as one row-sharded block. At P*tile
+    # dividing the single-chip n_pad the global pad is IDENTICAL to the
+    # single-chip driver's — the bitwise-equality test shape.
+    tile = min(int(config.ooc_tile_rows), n)
+    n_loc = -(-n // (n_dev * tile)) * tile
+    n_pad = n_dev * n_loc
+    tiles_loc = n_loc // tile
+    tile_bytes = tile * d * (2 if config.dtype == "bfloat16" else 4)
+
+    gran = 2  # mvp / second_order only (config validates)
+    # h = q/2 per-side candidates must fit a shard's rows.
+    q = max(gran, min(config.working_set_size, 2 * n_loc))
+    q -= q % gran
+    inner = config.inner_iters or 2 * q
+
+    c = config.c_bounds()
+    programs = make_ooc_mesh_programs(mesh, kp, c, q, n_loc, tile,
+                                      selection=config.selection,
+                                      compensated=config.compensated)
+
+    shard_s = NamedSharding(mesh, PSpec(DATA_AXIS))
+    rep_s = NamedSharding(mesh, PSpec())
+
+    y_p = np.ones((n_pad,), np.float32)
+    y_p[:n] = y_np
+    valid_np = np.zeros((n_pad,), bool)
+    valid_np[:n] = True
+    y_g = jax.device_put(jnp.asarray(y_p), shard_s)
+    valid_g = jax.device_put(jnp.asarray(valid_np), shard_s)
+
+    from dpsvm_tpu.obs import run_obs
+
+    obs = run_obs("solve", config,
+                  meta={"n": n, "d": d, "n_pad": n_pad,
+                        "engine": config.engine,
+                        "kernel": config.kernel,
+                        "selection": config.selection, "ooc": True,
+                        "ooc_mesh": True, "devices": n_dev,
+                        "ooc_tile_rows": tile,
+                        "ooc_tiles": tiles_loc * n_dev,
+                        "ooc_cache_lines": 0, "ooc_shrink": False,
+                        "shrink_m": 0})
+    drain_pending_obs_events(obs)
+
+    # ---- setup stream: squared norms computed ON DEVICE per (tile, d)
+    # block — the identical jitted reduction shape as the single-chip
+    # setup pass, which is what makes x_sq (and everything downstream
+    # of it) bit-identical.
+    with obs.span("solver/ooc_setup_stream"):
+        x_sq = jax.device_put(jnp.zeros((n_pad,), jnp.float32), shard_s)
+        nxt = _put_block(x, 0, tile, n, d, n_loc, n_dev, dtype, mesh)
+        for j in range(tiles_loc):
+            cur, nxt = nxt, (
+                _put_block(x, j + 1, tile, n, d, n_loc, n_dev, dtype,
+                           mesh)
+                if j + 1 < tiles_loc else None)
+            x_sq = programs["norms"](cur, x_sq, jnp.int32(j))
+        k_diag = jax.jit(kernel_diag,
+                         static_argnames="params")(x_sq, params=kp)
+
+    f_np0 = (-y_p).astype(np.float32)
+    a_np0 = np.zeros((n_pad,), np.float32)
+    if alpha_init is not None:
+        a_np0[:n] = np.asarray(alpha_init, np.float32)
+    if f_init is not None:
+        f_np0[:n] = np.asarray(f_init, np.float32)
+    e_np0 = (np.zeros((n_pad,), np.float32)
+             if config.compensated else None)
+
+    start_pairs = 0
+    start_rounds = 0
+    resumed_from = None
+    if resume:
+        from dpsvm_tpu.utils.checkpoint import resume_state
+
+        st = resume_state(checkpoint_path, config, n)
+        if st is not None:
+            a_np0 = np.zeros((n_pad,), np.float32)
+            a_np0[:n] = st.alpha
+            f_np0 = (-y_p).astype(np.float32)
+            f_np0[:n] = st.f
+            if e_np0 is not None and st.f_err is not None:
+                # v2 carries the raw Kahan residual — restoring it is
+                # what keeps the compensated mesh resume BITWISE.
+                e_np0[:n] = st.f_err
+            start_pairs = st.iteration
+            start_rounds = st.rounds
+            resumed_from = st.iteration
+            obs.event("resume", iteration=start_pairs,
+                      rounds=start_rounds,
+                      format_version=st.format_version,
+                      ooc_mesh=True)
+
+    f_g = jax.device_put(jnp.asarray(f_np0), shard_s)
+    alpha_g = jax.device_put(jnp.asarray(a_np0), shard_s)
+    err_g = (jax.device_put(jnp.asarray(e_np0), shard_s)
+             if e_np0 is not None else None)
+
+    eps_run = _BUDGET_EPS if config.budget_mode else float(config.epsilon)
+    max_iter = int(config.max_iter)
+    sub_kw = dict(kp=kp, c=c, eps=eps_run, tau=float(config.tau),
+                  inner_iters=inner, inner_impl=inner_impl,
+                  interpret=interpret, selection=config.selection,
+                  pair_batch=int(config.pair_batch))
+
+    jax.block_until_ready((x_sq, k_diag, f_g, alpha_g))
+    phase_seconds = {"setup": time.perf_counter() - t_entry,
+                     "solve": 0.0, "observe": 0.0, "finalize": 0.0}
+
+    from dpsvm_tpu.utils.checkpoint import PeriodicCheckpointer
+
+    ckpt = PeriodicCheckpointer(checkpoint_path, config, start_pairs)
+    pairs = start_pairs
+    rounds = start_rounds
+    dispatches = 0
+    tiles_streamed = 0
+    bytes_h2d = 0
+    b_hi = float("-inf")
+    b_lo = float("inf")
+    converged = False
+    train_seconds = 0.0
+
+    if obs.live:
+        c_tiles = obs.registry.counter("solve.ooc_tiles_total")
+        c_bytes = obs.registry.counter("solve.ooc_tile_bytes_total")
+
+    while True:
+        _sp = obs.span("solver/ooc_round")
+        _sp.__enter__()
+        try:
+            t0 = time.perf_counter()
+            round_tiles = 0
+            dispatches += 1
+            faults.device_fault("dispatch",
+                                f"ooc mesh round {rounds + 1}")
+            if err_g is not None:
+                w_d, ok_d, bh_d, bl_d, scal_d = programs["select"](
+                    f_g, err_g, alpha_g, y_g, x_sq, k_diag, valid_g)
+            else:
+                w_d, ok_d, bh_d, bl_d, scal_d = programs["select"](
+                    f_g, alpha_g, y_g, x_sq, k_diag, valid_g)
+            b_hi = float(np.asarray(bh_d))
+            b_lo = float(np.asarray(bl_d))
+            b_hi, b_lo = faults.poison_obs(b_hi, b_lo)
+            check_obs_finite(b_hi, b_lo, pairs, "ooc")
+            converged = not (b_lo > b_hi + 2.0 * eps_run)
+            if converged or pairs >= max_iter:
+                round_dt = time.perf_counter() - t0
+                train_seconds += round_dt
+                break
+            # Host-gather the working-set rows by GLOBAL id (exactly q
+            # rows read from host X) and replicate them — the fold and
+            # subproblem read them whole on every device.
+            w_np = np.clip(np.asarray(w_d), 0, n - 1)
+            qx = jax.device_put(
+                jnp.asarray(np.ascontiguousarray(
+                    np.asarray(x[w_np], np.float32)), dtype), rep_s)
+            dispatches += 1
+            a_w, coef, t_d, qsq = _ooc_mesh_subproblem(
+                qx, ok_d, scal_d, bh_d, bl_d,
+                jnp.int32(max_iter - pairs), **sub_kw)
+            # Double-buffered mesh stream: step j+1's sharded put is
+            # issued before step j's fold dispatch — one H2D DMA per
+            # step feeding all P devices, each folding only its own
+            # rows (zero collectives; the budget pins it).
+            nxt = _put_block(x, 0, tile, n, d, n_loc, n_dev, dtype,
+                             mesh)
+            for j in range(tiles_loc):
+                cur, nxt = nxt, (
+                    _put_block(x, j + 1, tile, n, d, n_loc, n_dev,
+                               dtype, mesh)
+                    if j + 1 < tiles_loc else None)
+                dispatches += 1
+                if err_g is not None:
+                    f_g, err_g = programs["fold"](
+                        cur, x_sq, f_g, err_g, qx, qsq, coef,
+                        jnp.int32(j))
+                else:
+                    f_g = programs["fold"](cur, x_sq, f_g, qx, qsq,
+                                           coef, jnp.int32(j))
+            dispatches += 1
+            alpha_g = programs["scatter"](alpha_g, w_d, ok_d, a_w)
+            pairs += int(np.asarray(t_d))
+            rounds += 1
+            round_tiles = tiles_loc * n_dev
+            tiles_streamed += round_tiles
+            bytes_h2d += round_tiles * tile_bytes
+            round_dt = time.perf_counter() - t0
+            train_seconds += round_dt
+        finally:
+            _sp.__exit__(None, None, None)
+
+        t_obs0 = time.perf_counter()
+        obs.chunk(pairs=pairs, b_hi=b_hi, b_lo=b_lo,
+                  device_seconds=round_dt, dispatch=dispatches,
+                  tiles=round_tiles)
+        if obs.live:
+            c_tiles.add(round_tiles)
+            c_bytes.add(tile_bytes * round_tiles)
+        abort = False
+        if callback is not None:
+            state = OocState(alpha_g, f_g, b_hi, b_lo, pairs, rounds, 0)
+            abort = bool(callback(pairs, b_hi, b_lo, state))
+        if config.check_numerics:
+            from dpsvm_tpu.solver.smo import assert_finite_state
+            assert_finite_state(OocState(alpha_g, f_g, b_hi, b_lo,
+                                         pairs, rounds, 0),
+                                pairs, "ooc")
+        if ckpt.due(pairs) or (abort and ckpt.active):
+            # Same v2 files as the single-chip stream: the sharded
+            # carry gathers to host here, so checkpoints stay
+            # backend-portable and the mesh resume is bitwise.
+            ckpt.save(pairs, np.asarray(alpha_g)[:n],
+                      np.asarray(f_g)[:n], b_hi, b_lo, force=True,
+                      f_err=(np.asarray(err_g)[:n]
+                             if err_g is not None else None),
+                      rounds=rounds)
+        if config.verbose:
+            print(f"[ooc-mesh] round={rounds} pairs={pairs} "
+                  f"gap={b_lo - b_hi:.6f} tiles={round_tiles} "
+                  f"devices={n_dev}")
+        phase_seconds["observe"] += time.perf_counter() - t_obs0
+        if abort:
+            break
+
+    t_fin0 = time.perf_counter()
+    alpha_np = np.asarray(alpha_g)[:n]
+    f_eff = f_g if err_g is None else f_g - err_g
+    f_final = np.asarray(f_eff)[:n]
+    if not converged:
+        b_hi, b_lo, converged = refresh_extrema_host(
+            f_final, alpha_np, y_np, c, config.epsilon,
+            rule=config.selection)
+    phase_seconds["solve"] = train_seconds
+    phase_seconds["finalize"] = time.perf_counter() - t_fin0
+    phase_seconds = {k: round(v, 6) for k, v in phase_seconds.items()}
+    stats = {
+        "f": f_final,
+        "outer_rounds": rounds,
+        "ooc": True,
+        "ooc_mesh": True,
+        "ooc_devices": n_dev,
+        "ooc_tile_rows": tile,
+        "tiles_streamed": tiles_streamed,
+        "tile_bytes_h2d": bytes_h2d,
+        "cached_rounds": 0,
+        "cache_hits": 0,
+        "cache_lookups": 0,
+        "cache_hit_rate": 0.0,
+        "cache_evictions": 0,
+        "phase_seconds": phase_seconds,
+        "ooc_shrink": False,
+    }
+    if resumed_from is not None:
+        stats["resumed_from"] = resumed_from
+    if obs.live:
+        stats["obs_run_id"] = obs.run_id
+        stats["obs_runlog"] = obs.path
+    obs.finish(iterations=pairs, converged=bool(converged),
+               train_seconds=round(train_seconds, 6),
+               dispatches=dispatches, b_hi=b_hi, b_lo=b_lo,
+               n_sv=int(np.count_nonzero(alpha_np > 0)),
+               tiles_streamed=tiles_streamed,
+               tile_bytes_h2d=bytes_h2d,
+               ooc_mesh=True, devices=n_dev,
                phase_seconds=phase_seconds)
     return SolveResult(
         alpha=alpha_np,

@@ -62,8 +62,15 @@ def train(
         multi = (num_devices or len(jax.devices())) > 1
         # The fused-pallas engine only exists in the single-chip solver;
         # auto must not silently swap it for a different mesh engine.
+        # Likewise the ooc block cache and the shrunken tile stream are
+        # single-chip: auto keeps those requests on the single backend
+        # (explicit backend="mesh" still rejects the combination).
+        single_only_ooc = config.ooc and (
+            config.ooc_cache_lines > 0 or config.ooc_shrink
+            or config.active_set_size > 0)
         backend = ("mesh" if (multi and mesh_available
-                              and config.engine in ("xla", "block"))
+                              and config.engine in ("xla", "block")
+                              and not single_only_ooc)
                    else "single")
 
     if config.kernel == "precomputed":

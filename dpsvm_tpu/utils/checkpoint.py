@@ -18,7 +18,18 @@ FORMAT_VERSION history:
   resume reproduces the uninterrupted trajectory BITWISE from the
   restore point (tests/test_ooc.py pins it). v1 files still load
   (``f_err`` -> None, ``rounds`` -> 0) for in-core resumes; v2 files
-  without ``f_err`` behave exactly like v1.
+  without ``f_err`` behave exactly like v1. ISSUE 19 rides two more
+  OPTIONAL keys on the same version — ``shrink_demoted`` (the ooc
+  shrunken stream's endgame demotion is permanent, so a resume must
+  not re-enter shrinking the uninterrupted run left) and
+  ``shrink_gap`` (the last shrink-cycle-start KKT gap: the stall
+  demotion compares successive cycle gaps, so a resume that forgot
+  the previous one would skip a demotion the uninterrupted run takes
+  and diverge from the bitwise pin) and ``shrink_stall`` (the
+  consecutive-stalled-cycle count: demotion needs two stalls in a
+  row, so a resume that reset the streak would demote later than the
+  uninterrupted run). Absent keys mean "not shrinking" — older files
+  resume exactly as before.
 
 Injected-fault coverage (dpsvm_tpu/testing/faults.py): the
 ``ckpt_truncate`` seam kills a save between the tmp write and the
@@ -73,6 +84,9 @@ class CheckpointState(NamedTuple):
     f_err: Optional[np.ndarray]
     rounds: int
     format_version: int
+    shrink_demoted: bool = False
+    shrink_gap: Optional[float] = None
+    shrink_stall: int = 0
 
 
 def fsync_dir(path: str) -> None:
@@ -95,13 +109,18 @@ def fsync_dir(path: str) -> None:
 
 def save_checkpoint(path: str, alpha, f, iteration: int, b_hi: float,
                     b_lo: float, config: SVMConfig, *, f_err=None,
-                    rounds: Optional[int] = None) -> None:
+                    rounds: Optional[int] = None,
+                    shrink_demoted: Optional[bool] = None,
+                    shrink_gap: Optional[float] = None,
+                    shrink_stall: Optional[int] = None) -> None:
     """Atomic DURABLE write (tmp + fsync + rename + dir fsync) so
     neither a preemption mid-save nor a power loss right after the
     rename can leave a truncated or garbage checkpoint (fsync-before-
     rename is what makes the rename mean something). ``f_err``/
     ``rounds`` are the v2 extras (the ooc driver's full carry);
-    omitted fields are simply absent from the file."""
+    ``shrink_demoted``/``shrink_gap`` the ooc shrunken stream's
+    cycle-boundary carry (ISSUE 19); omitted fields are simply absent
+    from the file."""
     from dpsvm_tpu.testing import faults
 
     d = os.path.dirname(os.path.abspath(path))
@@ -121,6 +140,12 @@ def save_checkpoint(path: str, alpha, f, iteration: int, b_hi: float,
             payload["f_err"] = np.asarray(f_err, np.float32)
         if rounds is not None:
             payload["rounds"] = np.int64(rounds)
+        if shrink_demoted is not None:
+            payload["shrink_demoted"] = np.bool_(shrink_demoted)
+        if shrink_gap is not None:
+            payload["shrink_gap"] = np.float64(shrink_gap)
+        if shrink_stall is not None:
+            payload["shrink_stall"] = np.int64(shrink_stall)
         with os.fdopen(fd, "wb") as fh:
             np.savez_compressed(fh, **payload)
             # Durability ordering: the tmp file's bytes must be ON
@@ -160,6 +185,12 @@ def load_checkpoint_state(path: str) -> CheckpointState:
                else None),
         rounds=int(z["rounds"]) if "rounds" in z.files else 0,
         format_version=version,
+        shrink_demoted=(bool(z["shrink_demoted"])
+                        if "shrink_demoted" in z.files else False),
+        shrink_gap=(float(z["shrink_gap"])
+                    if "shrink_gap" in z.files else None),
+        shrink_stall=(int(z["shrink_stall"])
+                      if "shrink_stall" in z.files else 0),
     )
 
 
@@ -313,7 +344,10 @@ class PeriodicCheckpointer:
 
     def save(self, iteration: int, alpha, f, b_hi: float, b_lo: float,
              force: bool = False, f_err=None,
-             rounds: Optional[int] = None) -> bool:
+             rounds: Optional[int] = None,
+             shrink_demoted: Optional[bool] = None,
+             shrink_gap: Optional[float] = None,
+             shrink_stall: Optional[int] = None) -> bool:
         """Save when the cadence is due, or unconditionally with
         ``force`` (abort exits: the state being stopped at must not
         exist only in memory). ``f_err``/``rounds`` ride through to
@@ -343,7 +377,10 @@ class PeriodicCheckpointer:
             return False
         self._rotate()
         save_checkpoint(self.path, alpha, f, iteration, b_hi, b_lo,
-                        self.config, f_err=f_err, rounds=rounds)
+                        self.config, f_err=f_err, rounds=rounds,
+                        shrink_demoted=shrink_demoted,
+                        shrink_gap=shrink_gap,
+                        shrink_stall=shrink_stall)
         self.last = iteration
         return True
 

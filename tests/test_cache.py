@@ -214,3 +214,80 @@ def test_lookup_pair_fuzz_against_model(x):
                                       np.asarray(model.keys))
         np.testing.assert_array_equal(np.asarray(cache.ticks),
                                       np.asarray(model.ticks))
+
+
+def test_refresh_rows_fuzz_across_reshrink_boundaries(x):
+    """The shrunken-stream usage pattern (ISSUE 19) replayed against
+    the reference model: the solver keeps cache keys GLOBAL row ids
+    and, while a shrink cycle is open, PROBES the cache every round
+    but never refreshes it (an in-cycle stream round computes partial
+    dot rows, which must not poison the full-width LRU). The fuzz
+    alternates full-stream phases (refresh vs model) with view phases
+    (probe-only, working sets drawn from a re-drawn active view), and
+    pins across every re-shrink boundary that (a) probe membership
+    matches the model exactly, (b) probe-only rounds leave key/tick
+    state and cached contents bit-unchanged, and (c) the first
+    refresh after a cycle carries the model forward as if the cycle
+    never touched the cache."""
+    rng = np.random.default_rng(7)
+    lines, q, n = 8, 4, 20
+    xs = np.asarray(x)
+    cache = init_cache(lines, n)
+    model = _ModelLRU(lines)
+    step = 0
+    for phase in range(6):
+        in_cycle = phase % 2 == 1
+        # Re-shrink boundary: each view phase draws a fresh active
+        # view (global ids — the cache never re-indexes).
+        view = rng.choice(n, size=10, replace=False)
+        for _ in range(8):
+            step += 1
+            pool = view if in_cycle else np.arange(n)
+            w = rng.choice(pool, size=q, replace=False).astype(np.int32)
+            ok = rng.random(q) > 0.2
+            hit, slot = jax.jit(probe_rows)(cache.keys,
+                                            jnp.asarray(w),
+                                            jnp.asarray(ok))
+            m_hits = [bool(o) and model.slot_of(int(k)) is not None
+                      for k, o in zip(w, ok)]
+            np.testing.assert_array_equal(np.asarray(hit), m_hits)
+            for s, (k, h) in enumerate(zip(w, m_hits)):
+                if h:
+                    assert int(np.asarray(cache.keys)[int(slot[s])]) \
+                        == int(k)
+            if in_cycle:
+                continue  # probe-only: the cycle never writes
+            rows = xs[w] @ xs.T
+            cache, n_hits, n_evict = jax.jit(refresh_rows)(
+                cache, jnp.asarray(w), jnp.asarray(ok),
+                jnp.asarray(rows, jnp.float32), jnp.int32(step))
+            # -- model step (same semantics as the plain fuzz)
+            hit_slots = {model.slot_of(int(k))
+                         for k, h in zip(w, m_hits) if h}
+            victims = model.lru_order(exclude=hit_slots)
+            m_evict, vi = 0, 0
+            for k, o, h in zip(w, ok, m_hits):
+                if not o:
+                    continue
+                if h:
+                    s = model.slot_of(int(k))
+                else:
+                    s = victims[vi]
+                    vi += 1
+                    if model.keys[s] != -1:
+                        m_evict += 1
+                    model.keys[s] = int(k)
+                model.ticks[s] = step
+            assert int(n_hits) == sum(m_hits)
+            assert int(n_evict) == m_evict
+            np.testing.assert_array_equal(np.asarray(cache.keys),
+                                          np.asarray(model.keys))
+            np.testing.assert_array_equal(np.asarray(cache.ticks),
+                                          np.asarray(model.ticks))
+        # Boundary invariant: contents are the true full-width rows
+        # for every live line, cycle or not.
+        for s, k in enumerate(model.keys):
+            if k >= 0:
+                np.testing.assert_allclose(
+                    np.asarray(cache.data)[s], xs[k] @ xs.T,
+                    rtol=1e-5, atol=1e-6)

@@ -90,6 +90,33 @@ def test_report_renders_text_and_md(tmp_path):
     assert md.splitlines()[1].startswith("|---")
 
 
+def test_report_renders_shrink_column(tmp_path):
+    """A run whose final record carries the shrunken-stream fields
+    (ISSUE 19) gets a populated shrink column — view fraction, recon
+    count, skipped tiles/bytes, and the demotion tag; runs without
+    shrinking render '-'."""
+    log = RunLog(str(tmp_path / "solve-1.jsonl"), "solve",
+                 meta={"n": 4096, "d": 54, "engine": "block"})
+    log.record("chunk", pairs=100, pairs_delta=100, gap=0.5,
+               device_seconds=0.1, dispatch=1, tiles=4,
+               tiles_skipped=12, shrink_active=True)
+    log.finish(iterations=100, converged=True, ooc_shrink=True,
+               shrink_active_fraction=0.125, shrink_reconstructions=3,
+               shrink_demoted=True, tiles_skipped=12,
+               tile_bytes_skipped=64 * 2**20)
+    _write_solve_run(tmp_path / "solve-2.jsonl")  # no shrinking
+    summaries = [analyze.summarize_run(r)
+                 for r in analyze.load_runs([str(tmp_path)])]
+    txt = analyze.render_report(summaries)
+    assert "act=0.12" in txt and "rec=3" in txt
+    assert "skip=12t" in txt and "0.06GiB" in txt and "dem" in txt
+    shrunk = next(s for s in summaries if s["ooc_shrink"])
+    plain = next(s for s in summaries if not s["ooc_shrink"])
+    assert analyze._report_row(plain)[
+        [h for h, _ in analyze._REPORT_COLS].index("shrink")] == "-"
+    assert shrunk["tiles_skipped"] == 12
+
+
 # ------------------------------------------------------------- diff
 
 def _summary_for(tmp_path, name, **kw):

@@ -178,8 +178,16 @@ def test_ooc_config_validation():
         SVMConfig(ooc=True, engine="block", kernel="precomputed")
     with pytest.raises(ValueError, match="gram_resident"):
         SVMConfig(ooc=True, engine="block", gram_resident=True)
-    with pytest.raises(ValueError, match="active_set_size"):
-        SVMConfig(ooc=True, engine="block", active_set_size=256)
+    # active_set_size with ooc is a ROUTE now (it sizes the shrunken
+    # tile stream's active view, ISSUE 19) — only the contradiction
+    # with a forced-off gate rejects.
+    assert SVMConfig(ooc=True, engine="block",
+                     active_set_size=256).active_set_size == 256
+    with pytest.raises(ValueError, match="ooc_shrink=False"):
+        SVMConfig(ooc=True, engine="block", active_set_size=256,
+                  ooc_shrink=False)
+    with pytest.raises(ValueError, match="ooc_shrink"):
+        SVMConfig(engine="block", ooc_shrink=True)  # needs ooc=True
     with pytest.raises(ValueError, match="pipeline_rounds"):
         SVMConfig(ooc=True, engine="block", pipeline_rounds=True)
     with pytest.raises(ValueError, match="ooc_cache_lines"):
@@ -187,17 +195,116 @@ def test_ooc_config_validation():
                   ooc_cache_lines=64)
     with pytest.raises(ValueError, match="ooc=True"):
         SVMConfig(engine="block", ooc_cache_lines=256)
-    with pytest.raises(ValueError, match="single-chip"):
+    with pytest.raises(ValueError, match="global working set"):
         SVMConfig(ooc=True, engine="block", local_working_sets=2)
 
 
-def test_ooc_mesh_backend_rejected(data):
+def test_train_auto_backend_keeps_shrink_single_chip(data):
+    """train(backend='auto') with >1 visible device normally picks the
+    mesh — but the shrunken stream and the ooc block cache are
+    single-chip features, so requesting them must route to the single
+    backend instead of the mesh rejecting the combination (the README
+    --ooc-shrink quickstart line on a multi-device host)."""
+    from dpsvm_tpu.train import train
+
+    x, y = data
+    cfg = CFG.replace(ooc=True, ooc_tile_rows=256, ooc_shrink=True,
+                      active_set_size=256)
+    model, res = train(x, y, cfg, backend="auto")
+    assert res.stats["ooc_shrink"] is True
+    assert "ooc_mesh" not in res.stats
+    # Explicit mesh still rejects — auto rescues, it doesn't mask.
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+    with pytest.raises(ValueError, match="single-chip"):
+        solve_mesh(x, y, cfg, num_devices=2)
+
+
+def test_ooc_mesh_bitwise_two_devices(data):
+    """solve_mesh + config.ooc routes to the sharded tile stream
+    (ISSUE 19 — it used to reject): each device folds its own row
+    shard's tiles, the round joins on ONE (q, 5) psum, and the
+    trajectory lands BITWISE on the single-chip ooc stream's."""
     from dpsvm_tpu.parallel.dist_smo import solve_mesh
 
     x, y = data
-    with pytest.raises(ValueError, match="single-chip"):
-        solve_mesh(x, y, SVMConfig(engine="block", ooc=True),
+    cfg = CFG.replace(ooc=True, ooc_tile_rows=256)
+    single = solve(x, y, cfg)
+    mesh = solve_mesh(x, y, cfg, num_devices=2)
+    _assert_bitwise(single, mesh)
+    assert mesh.stats["ooc_mesh"] is True
+    assert mesh.stats["ooc"] is True
+
+
+def test_ooc_mesh_rejects_cache_and_shrink(data):
+    """The mesh stream's non-compositions stay LOUD errors, not
+    silent drops: the kernel-row cache is a single-chip HBM structure
+    and the shrunken stream is host bookkeeping over one stream."""
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+    x, y = data
+    with pytest.raises(ValueError, match="ooc_cache_lines"):
+        solve_mesh(x, y, CFG.replace(ooc=True, ooc_tile_rows=256,
+                                     ooc_cache_lines=256),
                    num_devices=2)
+    with pytest.raises(ValueError, match="shrunken"):
+        solve_mesh(x, y, CFG.replace(ooc=True, ooc_tile_rows=256,
+                                     ooc_shrink=True),
+                   num_devices=2)
+
+
+def test_ooc_shrink_converges_same_criterion(data, incore):
+    """Shrunken stream (ISSUE 19): per-round tile fold walks only the
+    active view's tiles, yet the FINAL model meets the same
+    convergence criterion — cycle-start full selects are the only
+    stopping decisions and the endgame demotes to the exact full
+    stream. The trajectory legitimately differs from the full
+    stream's (work is reordered), so the pin is the criterion plus
+    model-level agreement, not bitwise equality."""
+    x, y = data
+    res = solve(x, y, CFG.replace(ooc=True, ooc_tile_rows=128,
+                                  active_set_size=256))
+    assert res.converged
+    assert res.b_lo <= res.b_hi + 2.0 * CFG.epsilon + 1e-6
+    st = res.stats
+    assert st["ooc_shrink"] is True
+    assert st["shrink_m"] == 256
+    assert st["shrink_cycles"] >= 1
+    assert st["shrink_reconstructions"] >= 1
+    assert st["tiles_skipped"] > 0
+    assert st["tile_bytes_skipped"] > 0
+    assert st["shrink_tiles_in_cycle"] > 0
+    # Model-level agreement with the in-core exact solve.
+    assert abs(res.b - incore.b) < 0.05
+    assert abs(res.n_sv - incore.n_sv) <= max(8, incore.n_sv // 10)
+
+
+def test_ooc_shrink_resume_bitwise(data, tmp_path, monkeypatch):
+    """Die mid-SHRINKING-solve (injected tile-put fault), resume from
+    the periodic checkpoint: bitwise equal to the uninterrupted
+    shrinking run. While shrinking, periodic saves land only at cycle
+    boundaries (exact f, no live view) and carry the shrink latches —
+    demotion, last cycle gap, stall streak — so the resumed run
+    re-opens the next cycle from exactly the state the uninterrupted
+    run had there. (A graceful callback abort instead CLOSES the open
+    cycle early to leave an exact checkpoint — a correct state, but a
+    reordered trajectory — so the bitwise pin is the kill path's.)"""
+    import dpsvm_tpu.solver.smo as smo_mod
+    from dpsvm_tpu.testing import faults
+
+    monkeypatch.setattr(smo_mod, "_RETRY_BACKOFF_S", ())
+    x, y = data
+    cfg = CFG.replace(ooc=True, ooc_tile_rows=128, active_set_size=256,
+                      checkpoint_every=256)
+    full = solve(x, y, cfg)
+    assert full.stats["shrink_cycles"] >= 1
+    assert full.stats["tiles_skipped"] > 0
+    p = str(tmp_path / "ooc.shrink.ck.npz")
+    with faults.install(
+            faults.FaultPlan.parse("ooc_tile_put@200")) as plan:
+        res = solve(x, y, cfg, checkpoint_path=p)
+    assert plan.fired["ooc_tile_put"] == 1
+    assert res.stats["resumed_from"] > 0
+    _assert_bitwise(full, res)
 
 
 # ------------------------------ checkpoint/resume (ISSUE 13 tentpole)

@@ -357,6 +357,110 @@ def test_fusedround_extra_hbm_pass_drifts():
         json.dumps(drifted_paths)
 
 
+def test_ooc_shrink_fold_budget_and_masked_variant_drifts():
+    """The shrunken-stream skip contract, mutation-verified (ISSUE 19):
+    a skipped tile is a dispatch that never happens, so the in-cycle
+    fold program (ooc_fold_tile at want_dots=False) stays a pure
+    function of (T_TILE, D, Q) — n-doubling must be byte-identical,
+    and the clean entry must PASS its committed budget. The REJECTED
+    masked-kernel alternative — one program folding every tile of a
+    device-resident (n, D) X under a live mask — must DRIFT, because
+    its argument bytes are n-sized: exactly the out-of-core violation
+    the budget exists to catch."""
+    import json
+
+    import pytest
+
+    from dpsvm_tpu.analysis import budget
+    from dpsvm_tpu.analysis.manifest import (D, N, Q, T_TILE,
+                                             ooc_fold_tile_shrink,
+                                             require_devices)
+
+    require_devices()
+    clean = entry_facts(ooc_fold_tile_shrink(N))
+    assert clean == entry_facts(ooc_fold_tile_shrink(2 * N))
+    u = clean["units"]["fold_tile"]
+    # Tile-pool-scale arguments only (the ooc_fold_tile formula):
+    # the (T, d) tile + its norms + the gradient slice + the q-sized
+    # working-set operands.
+    assert u["memory"]["argument_bytes"] == (
+        T_TILE * D * 4 + T_TILE * 4 + T_TILE * 4
+        + Q * D * 4 + Q * 4 + Q * 4)
+    assert all(v["count"] == 0 for v in u["collectives"].values())
+    assert all(v == 0 for v in u["transfers"].values())
+    assert u["donation"]["missed"] == 0
+    assert u["donation"]["declared_donated"] == 1
+
+    gen = budget.budget_jax_version()
+    if gen is not None and gen != jax.__version__:
+        pytest.skip(
+            f"budgets generated under jax {gen}, running {jax.__version__}")
+    assert budget.check_entry("ooc_fold_tile_shrink",
+                              clean)["verdict"] == budget.PASS
+
+    masked = entry_facts(ooc_fold_tile_shrink(N, masked=True))
+    res = budget.check_entry("ooc_fold_tile_shrink", masked)
+    assert res["verdict"] == budget.DRIFT
+    drifted_paths = [p for p, _, _ in res["diffs"]]
+    assert any("argument_bytes" in p for p in drifted_paths), \
+        json.dumps(drifted_paths)
+    # And the masked form is NOT n-independent: doubling n doubles its
+    # resident operands — the property the budget's n-doubling pin
+    # would silently lose if the stream ever became a masked kernel.
+    masked2 = entry_facts(ooc_fold_tile_shrink(2 * N, masked=True))
+    assert (masked2["units"]["fold_tile"]["memory"]["argument_bytes"]
+            > masked["units"]["fold_tile"]["memory"]["argument_bytes"])
+
+
+def test_ooc_mesh_fold_budget_and_extra_psum_drifts():
+    """The mesh-stream collective budget, mutation-verified (ISSUE 19):
+    the per-step local fold is ZERO-collective (each device folds only
+    its own shard's tile) and the round's ONLY collectives live in the
+    select unit — the candidate all_gather pair plus ONE (Q, 5)
+    all-reduce replicating the working-set scalars. The extra_psum
+    mutation — the same fold body plus one per-step psum — must DRIFT
+    against the committed budget, naming the fold unit's collective
+    facts."""
+    import json
+
+    import pytest
+
+    from dpsvm_tpu.analysis import budget
+    from dpsvm_tpu.analysis.manifest import (Q, ooc_mesh_fold,
+                                             require_devices)
+
+    gen = budget.budget_jax_version()
+    if gen is not None and gen != jax.__version__:
+        pytest.skip(
+            f"budgets generated under jax {gen}, running {jax.__version__}")
+    require_devices()
+
+    clean = entry_facts(ooc_mesh_fold())
+    assert budget.check_entry("ooc_mesh_fold",
+                              clean)["verdict"] == budget.PASS
+    fold = clean["units"]["fold"]
+    assert all(v["count"] == 0 for v in fold["collectives"].values())
+    assert all(v == 0 for v in fold["transfers"].values())
+    assert fold["donation"]["missed"] == 0
+    sel = clean["units"]["select"]
+    # ONE psum of the (Q, 5) [x_sq|k_diag|alpha|y|f] scalar stack...
+    assert sel["collectives"]["all-reduce"]["count"] == 1
+    assert sel["collectives"]["all-reduce"]["payload_bytes"] == [Q * 5 * 4]
+    # ...plus the exact top-k merge's (value, id) all_gather pair, and
+    # nothing else crosses devices in the whole round.
+    assert sel["collectives"]["all-gather"]["count"] == 2
+    for k in ("all-to-all", "collective-permute", "reduce-scatter"):
+        assert sel["collectives"][k]["count"] == 0
+    assert all(v == 0 for v in sel["transfers"].values())
+
+    mutated = entry_facts(ooc_mesh_fold(extra_psum=True))
+    res = budget.check_entry("ooc_mesh_fold", mutated)
+    assert res["verdict"] == budget.DRIFT
+    drifted_paths = [p for p, _, _ in res["diffs"]]
+    assert any(p.startswith("units.fold.collectives") for p in
+               drifted_paths), json.dumps(drifted_paths)
+
+
 # ------------------------------------- the committed budgets (tier-1)
 
 def test_manifest_budgets_pass_against_committed(monkeypatch):

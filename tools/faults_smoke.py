@@ -1,7 +1,7 @@
 """Fault-tolerance smoke (ISSUE 13) — `make faults_smoke`, wired into
 tier1.yml.
 
-Three checks, each proving an acceptance behavior with a REAL injected
+Four checks, each proving an acceptance behavior with a REAL injected
 fault (dpsvm_tpu/testing/faults.py), end to end:
 
 1. **Harness self-test** — spec parsing, deterministic arrival firing,
@@ -11,7 +11,11 @@ fault (dpsvm_tpu/testing/faults.py), end to end:
    flushed); a relaunch with resume lands BITWISE on the uninterrupted
    run's alpha/f/extrema. This is the acceptance criterion verbatim,
    as a process-level kill rather than an in-process abort.
-3. **Watchdog trip** — a stalled dispatch (serve_stall seam) must be
+3. **mesh-ooc kill -9 / --resume** (ISSUE 19) — the same kill, against
+   the MESH out-of-core stream at 2 virtual devices; the v2
+   checkpoint's gathered carry must put the resumed sharded stream
+   BITWISE on the uninterrupted trajectory.
+4. **Watchdog trip** — a stalled dispatch (serve_stall seam) must be
    bounded by ServeConfig.dispatch_timeout_ms, fail with an explicit
    'failed' verdict + counters, and leave the engine serving the next
    batch.
@@ -95,6 +99,94 @@ np.savez({out!r}, alpha=res.alpha, f=res.stats["f"],
          iterations=res.iterations, converged=res.converged)
 print("DONE", res.iterations, flush=True)
 """
+
+
+_CHILD_MESH = r"""
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, {repo!r})
+from dpsvm_tpu.config import SVMConfig
+from dpsvm_tpu.data.synth import make_blobs_binary
+from dpsvm_tpu.parallel.dist_smo import solve_mesh
+
+x, y = make_blobs_binary(n=1024, d=24, seed=11, sep=1.0)
+cfg = SVMConfig(c=2.0, epsilon=1e-3, engine="block", working_set_size=64,
+                max_iter=50_000, ooc=True, ooc_tile_rows=256,
+                checkpoint_every=128, retry_faults=0)
+slow = "--slow" in sys.argv
+def cb(it, bh, bl, st):
+    if slow:
+        time.sleep(0.02)  # widen the kill window
+res = solve_mesh(x, y, cfg, num_devices=2, callback=cb,
+                 checkpoint_path={ck!r}, resume=True)
+np.savez({out!r}, alpha=res.alpha, f=res.stats["f"],
+         b_hi=np.float64(res.b_hi), b_lo=np.float64(res.b_lo),
+         iterations=res.iterations, converged=res.converged)
+print("DONE", res.iterations, flush=True)
+"""
+
+
+def check_ooc_mesh_kill_resume() -> None:
+    """kill -9 mid-MESH-ooc-solve (2 virtual devices), then --resume:
+    bitwise-equal final state (ISSUE 19 — the v2 checkpoint carries
+    the full gathered carry, so the sharded stream resumes on the
+    uninterrupted trajectory exactly like the single-chip one)."""
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="dpsvm_faults_smoke_")
+    ck = os.path.join(tmp, "mesh.ck.npz")
+    out = os.path.join(tmp, "mesh.result.npz")
+    ref = os.path.join(tmp, "mesh.ref.npz")
+    code = _CHILD_MESH.format(repo=REPO, ck=ck, out=out)
+    from dpsvm_tpu.utils.hostenv import cleaned_cpu_env
+
+    env = cleaned_cpu_env(2)
+
+    proc = subprocess.Popen([sys.executable, "-c", code, "--slow"],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    deadline = time.time() + 180
+    try:
+        while time.time() < deadline and not os.path.exists(ck):
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "mesh child finished before a checkpoint appeared: "
+                    + proc.stderr.read().decode()[-500:])
+            time.sleep(0.05)
+        assert os.path.exists(ck), "no mesh ooc checkpoint within 180s"
+        time.sleep(0.3)  # advance past the first checkpoint
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert not os.path.exists(out), "mesh child should have died mid-run"
+    print("[faults_smoke] SIGKILLed mesh-ooc child mid-solve "
+          f"(checkpoint at {ck})")
+
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, timeout=600)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    z = np.load(out)
+    assert bool(z["converged"])
+
+    # Uninterrupted reference in a FRESH 2-device child (this parent
+    # process is a 1-device platform).
+    code_ref = _CHILD_MESH.format(repo=REPO, ck=os.path.join(
+        tmp, "mesh.ref.ck.npz"), out=ref)
+    r = subprocess.run([sys.executable, "-c", code_ref], env=env,
+                       capture_output=True, timeout=600)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    full = np.load(ref)
+    assert int(z["iterations"]) == int(full["iterations"])
+    np.testing.assert_array_equal(z["alpha"], full["alpha"])
+    np.testing.assert_array_equal(z["f"], full["f"])
+    assert float(z["b_hi"]) == float(full["b_hi"])
+    assert float(z["b_lo"]) == float(full["b_lo"])
+    print("[faults_smoke] mesh-ooc kill -9 -> resume BITWISE-equal "
+          f"({int(full['iterations'])} pairs, 2 devices) OK")
 
 
 def check_ooc_kill_resume() -> None:
@@ -197,6 +289,7 @@ def check_watchdog() -> None:
 def main() -> int:
     check_harness()
     check_ooc_kill_resume()
+    check_ooc_mesh_kill_resume()
     check_watchdog()
     print("[faults_smoke] ALL OK")
     return 0

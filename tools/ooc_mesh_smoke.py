@@ -1,0 +1,94 @@
+"""Mesh out-of-core smoke (ISSUE 19) — `make ooc_mesh_smoke`, wired
+into tier1.yml.
+
+Two checks on the 2-virtual-device CPU harness, end to end:
+
+1. **Bitwise parity** — solve_mesh + config.ooc at num_devices=2 must
+   land BITWISE on the single-chip ooc stream's final state (alpha, f,
+   b_hi/b_lo, iteration count). This is the acceptance criterion
+   verbatim: each lane's fold is the same fold_tile_body op sequence
+   at the same (tile,) shapes and the round joins on exactly one
+   (q, 5) scalar psum, so equality is exact, not approximate.
+2. **Stream fault seam** — the `ooc_tile_put` seam fires on the mesh
+   stream's per-step device_put too (ISSUE 13 composition): a planned
+   transient fault mid-stream with retry_faults=1 must be absorbed by
+   the shared retry machinery and land on the SAME bitwise state.
+
+Needs 2 visible devices; run through the Makefile target, which forces
+JAX_PLATFORMS=cpu with --xla_force_host_platform_device_count=2. No
+artifacts written; exit 0 = both behaviors held.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N, D, SEED, SEP = 1024, 24, 11, 1.0
+
+
+def _cfg(**kw):
+    from dpsvm_tpu.config import SVMConfig
+
+    base = dict(c=2.0, epsilon=1e-3, engine="block",
+                working_set_size=64, max_iter=50_000,
+                ooc=True, ooc_tile_rows=256)
+    base.update(kw)
+    return SVMConfig(**base)
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    have = len(jax.devices())
+    if have < 2:
+        print(f"[ooc_mesh_smoke] FAIL: needs 2 devices, found {have} "
+              "(run via `make ooc_mesh_smoke`)")
+        return 1
+
+    from dpsvm_tpu.data.synth import make_blobs_binary
+    from dpsvm_tpu.parallel.dist_smo import solve_mesh
+    from dpsvm_tpu.solver.smo import solve
+    from dpsvm_tpu.testing import faults
+
+    x, y = make_blobs_binary(n=N, d=D, seed=SEED, sep=SEP)
+
+    single = solve(x, y, _cfg())
+    assert single.converged, "single-chip ooc reference did not converge"
+    mesh = solve_mesh(x, y, _cfg(), num_devices=2)
+    assert mesh.converged, "mesh ooc did not converge"
+    assert mesh.stats.get("ooc_mesh") is True, mesh.stats.get("ooc_mesh")
+
+    assert mesh.iterations == single.iterations, (
+        f"iteration divergence: mesh={mesh.iterations} "
+        f"single={single.iterations}")
+    np.testing.assert_array_equal(mesh.alpha, single.alpha)
+    np.testing.assert_array_equal(mesh.stats["f"], single.stats["f"])
+    assert mesh.b_hi == single.b_hi and mesh.b_lo == single.b_lo
+    print(f"[ooc_mesh_smoke] mesh(2) BITWISE == single-chip ooc "
+          f"({single.iterations} pairs, n={N}) OK")
+
+    # The ooc_tile_put seam must cover the mesh stream's H2D path:
+    # one planned transient fault mid-stream, absorbed by the shared
+    # retry machinery, landing on the same bitwise state.
+    with faults.install(faults.FaultPlan.parse("ooc_tile_put@3")) as plan:
+        retried = solve_mesh(x, y, _cfg(retry_faults=1), num_devices=2)
+    assert plan.fired.get("ooc_tile_put", 0) >= 1, (
+        "ooc_tile_put seam never fired on the mesh stream")
+    assert retried.converged
+    assert retried.iterations == single.iterations
+    np.testing.assert_array_equal(retried.alpha, single.alpha)
+    np.testing.assert_array_equal(retried.stats["f"], single.stats["f"])
+    print("[ooc_mesh_smoke] ooc_tile_put fault on the mesh stream "
+          "retried to the same bitwise state OK")
+
+    print("[ooc_mesh_smoke] ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

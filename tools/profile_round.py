@@ -430,6 +430,61 @@ def ablate_bf16_gram(x, y, cfg, q: int, reps: int, obs_cfg=None):
     return 0
 
 
+def ablate_ooc_shrink(n: int, d: int, budget: int = 20_000,
+                      tile_rows: int = 512, m: int = 0) -> int:
+    """End-to-end A/B of the shrunken ooc tile stream (ISSUE 19 — the
+    measurement the solver/block.py ooc_shrink_pays auto gate is
+    waiting on): one budget-mode ooc solve with shrinking forced ON vs
+    the identical solve with the full stream, same covtype-shaped data
+    and pair budget. Reports wall, pairs/s, tiles streamed/skipped,
+    stream bytes, and the late-phase (in-cycle) byte cut. On the CPU
+    harness the H2D put is a memcpy, so the BYTE columns are the
+    decisive ones — flip the gate from a device run."""
+    from dpsvm_tpu.config import SVMConfig
+    from dpsvm_tpu.data import make_covtype_like
+    from dpsvm_tpu.solver.smo import solve
+
+    x, y = make_covtype_like(n, d, seed=0)
+    base = SVMConfig(c=32.0, gamma=0.03125, epsilon=1e-3,
+                     engine="block", working_set_size=256,
+                     budget_mode=True, max_iter=budget, ooc=True,
+                     ooc_tile_rows=tile_rows)
+    arms = [("shrink", base.replace(
+        ooc_shrink=True, **({"active_set_size": m} if m else {}))),
+        ("full  ", base)]
+    print(f"ooc shrink A/B: covtype-shaped n={n} d={d} "
+          f"tile_rows={tile_rows} budget={budget}"
+          + (f" m={m}" if m else " (auto m)"))
+    rows = {}
+    for label, cfg in arms:
+        solve(x, y, cfg.replace(max_iter=64))  # warm the executors
+        res = min((solve(x, y, cfg) for _ in range(2)),
+                  key=lambda r: r.train_seconds)
+        st = res.stats
+        pps = res.iterations / max(res.train_seconds, 1e-9)
+        rows[label.strip()] = st
+        in_cyc = st.get("shrink_tiles_in_cycle", 0)
+        skip = st.get("tiles_skipped", 0)
+        cut = ((in_cyc + skip) / in_cyc) if in_cyc else float("nan")
+        print(f"  {label}: {res.iterations} pairs "
+              f"{res.train_seconds:.3f}s ({pps:.0f}/s) "
+              f"tiles={st['tiles_streamed']} "
+              f"bytes={st['tile_bytes_h2d']}"
+              + (f" skipped={skip} cycles={st.get('shrink_cycles')} "
+                 f"recon={st.get('shrink_reconstructions')} "
+                 f"late-cut={cut:.2f}x "
+                 f"demoted={st.get('shrink_demoted')}"
+                 if st.get("ooc_shrink") else ""))
+    s, f = rows["shrink"], rows["full"]
+    if f["tile_bytes_h2d"]:
+        print(f"  => stream bytes {s['tile_bytes_h2d']} vs "
+              f"{f['tile_bytes_h2d']} "
+              f"({f['tile_bytes_h2d'] / max(s['tile_bytes_h2d'], 1):.2f}x"
+              f" cut overall; flip solver/block.py ooc_shrink_pays "
+              f"from THIS number, measured on a real device)")
+    return 0
+
+
 # v5e per-chip ceilings (Google's published spec): the MXU runs bf16
 # (and default-precision f32, which lowers to one bf16 pass) matmuls at
 # 197 TFLOP/s; 'highest' f32 is ~6 bf16 passes. HBM streams 819 GB/s.
@@ -540,6 +595,12 @@ def main() -> int:
                          "device (ISSUE 11; the probe the ring_pays "
                          "auto gate is waiting on — interpret-mode "
                          "structure check on CPU)")
+    ap.add_argument("--ooc-shrink", action="store_true",
+                    help="A/B the shrunken ooc tile stream against the "
+                         "full stream at the same pair budget on "
+                         "covtype-shaped data (ISSUE 19; the probe the "
+                         "ooc_shrink_pays auto gate is waiting on — "
+                         "tiles/bytes skipped and the late-phase cut)")
     ap.add_argument("--bf16-gram", action="store_true",
                     help="A/B the single-chip block chunk with X stored "
                          "float32 vs bfloat16 (the config.bf16_gram "
@@ -596,6 +657,9 @@ def main() -> int:
                           device_seconds=round(t, 6))
             rl.finish(fixed_ms=round(fixed_ms, 4),
                       marginal_us_per_pair=round(marg_us, 3))
+
+    if args.ooc_shrink:
+        return ablate_ooc_shrink(args.n or 16384, 54)
 
     import jax
     import jax.numpy as jnp
