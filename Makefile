@@ -32,12 +32,15 @@ test_all:
 
 # The local mirror of CI's lint gates (tier1.yml): compileall, the
 # tpulint static HLO/jaxpr contract check against committed budgets
-# (per-entrypoint PASS/DRIFT table), and ruff when installed (CI pins
-# and enforces it; locally it is best-effort so the target works on
-# the bare image).
+# (per-entrypoint PASS/DRIFT table), the threadlint concurrency
+# contracts (guarded-by / lock-order / thread-lifecycle / seam
+# coverage against dpsvm_tpu/analysis/contracts), and ruff when
+# installed (CI pins and enforces it; locally it is best-effort so
+# the target works on the bare image).
 lint:
 	$(PY) -m compileall -q dpsvm_tpu tools tests bench.py
 	$(PY) -m tools.tpulint --check
+	$(PY) -m tools.tpulint --threads --check
 	@if command -v ruff >/dev/null 2>&1; then \
 	  ruff check dpsvm_tpu tools tests bench.py; \
 	else \
@@ -48,6 +51,13 @@ lint:
 # structural change; commit the JSON diff (it is the review artifact).
 lint_budgets:
 	$(PY) -m tools.tpulint --write-budgets
+
+# Regenerate dpsvm_tpu/analysis/contracts/*.json (threadlint) after an
+# INTENTIONAL concurrency change; allow lists and the handoff->seam
+# map survive regeneration. Commit the JSON diff. Deterministic: two
+# consecutive runs produce byte-identical files.
+lint_contracts:
+	$(PY) -m tools.tpulint --threads --write-contracts
 
 # Measured autotuner (ISSUE 14; ROADMAP item 5): run the probe
 # registry on THIS device kind and persist the DeviceProfile JSON

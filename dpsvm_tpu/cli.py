@@ -447,7 +447,8 @@ def _build_lint_parser(sub) -> argparse.ArgumentParser:
         help="tpulint: static HLO/jaxpr contract check of the hot-"
              "entrypoint manifest against committed budgets "
              "(dpsvm_tpu/analysis; no TPU needed; flags as in "
-             "`python -m tools.tpulint --help`)")
+             "`python -m tools.tpulint --help`; add --threads for "
+             "the threadlint concurrency contracts)")
 
 
 def _build_obs_parser(sub) -> argparse.ArgumentParser:
@@ -509,10 +510,18 @@ def main(argv=None) -> int:
     if argv[:1] == ["lint"]:
         # Forward verbatim so `cli lint` and `python -m tools.tpulint`
         # share one flag surface (budget.run_lint's parser) — no
-        # re-declared flags to drift out of sync.
+        # re-declared flags to drift out of sync. `--threads` flips to
+        # the threadlint surface (concurrency contracts), same as the
+        # tools entrypoint.
+        rest = argv[1:]
+        if "--threads" in rest:
+            from dpsvm_tpu.analysis.threadlint import run_threadlint
+
+            rest.remove("--threads")
+            return run_threadlint(rest)
         from dpsvm_tpu.analysis.budget import run_lint
 
-        return run_lint(argv[1:])
+        return run_lint(rest)
     if argv[:1] == ["obs"]:
         # Same forwarding discipline for the runlog-analytics surface
         # (dpsvm_tpu/obs/analyze.run_cli owns the flags). Pure JSONL

@@ -144,23 +144,35 @@ class MetricsExporter:
         self._httpd.render_fn = render_fn
         self.host = host
         self.port = int(self._httpd.server_address[1])
+        # State the close path reads is fully initialized BEFORE the
+        # serving thread starts — nothing observes a half-built
+        # exporter.
+        self._closed = False
+        self._close_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name=f"dpsvm-metrics-{self.port}", daemon=True)
         self._thread.start()
-        self._closed = False
 
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/metrics"
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        self._thread.join(timeout=5.0)
+        # Serialized teardown: concurrent close() callers all BLOCK
+        # until the socket is unbound and the thread joined. The old
+        # flag-first idempotence let a second caller return while the
+        # first was still mid-shutdown — engine teardown would proceed
+        # believing the port and thread were gone (the last member of
+        # the scrape-during-close race family; regression-pinned in
+        # tests/test_obs.py).
+        with self._close_lock:
+            if self._closed:
+                return
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5.0)
+            self._closed = True
 
     def __enter__(self):
         return self

@@ -410,7 +410,13 @@ class ModelRegistry:
         return self.register(name, source)
 
     def get(self, name: Optional[str] = None) -> LoadedModel:
+        from dpsvm_tpu.testing import faults
+
         with self._lock:
+            # Seeded lock-contention probe: an armed lock_stall holds
+            # THIS lock for a bounded interval (tools/faults_smoke.py
+            # proves the serving path survives it).
+            faults.lock_stall()
             if name is None:
                 if len(self._live) != 1:
                     raise KeyError(

@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import math
+import threading
 from typing import Optional
 
 import numpy as np
@@ -59,9 +60,19 @@ class Scheduler:
     order, shedding expired requests, until the batch would exceed
     ``max_rows`` (a single oversized request forms alone — the
     dispatcher loops it over the top bucket, the v1 discipline).
+
+    All mutation (submit and form) runs under one internal lock, so
+    CONCURRENT SUBMIT from several threads is well-defined: seq
+    numbers stay dense and FIFO-ordered per admission, queue_rows and
+    the per-entry refcounts stay exact, and a scrape iterating the
+    queues never races a heappush (threadlint guarded-by contract:
+    Scheduler._q/_seq/queue_rows/_entry_refs are _lock's). form() is
+    still driven by one pump at a time — the lock makes the
+    ACCOUNTING safe, not two dispatchers per group sensible.
     """
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._q: dict = {}  # group key -> [(deadline, seq, Request)]
         self._seq = 0
         self.queue_rows = 0
@@ -76,56 +87,60 @@ class Scheduler:
     def submit(self, entry: LoadedModel, rows: np.ndarray, now: float,
                deadline_s: Optional[float], ticket: int,
                dtype: str) -> Request:
-        self._seq += 1
-        req = Request(
-            ticket=ticket, entry=entry, rows=rows, t_submit=now,
-            deadline=(now + deadline_s if deadline_s is not None
-                      else math.inf),
-            seq=self._seq)
-        key = entry.group_key(dtype)
-        heapq.heappush(self._q.setdefault(key, []),
-                       (req.deadline, req.seq, req))
-        self.queue_rows += req.n
-        self._entry_refs[entry] = self._entry_refs.get(entry, 0) + 1
-        return req
+        with self._lock:
+            self._seq += 1
+            req = Request(
+                ticket=ticket, entry=entry, rows=rows, t_submit=now,
+                deadline=(now + deadline_s if deadline_s is not None
+                          else math.inf),
+                seq=self._seq)
+            key = entry.group_key(dtype)
+            heapq.heappush(self._q.setdefault(key, []),
+                           (req.deadline, req.seq, req))
+            self.queue_rows += req.n
+            self._entry_refs[entry] = \
+                self._entry_refs.get(entry, 0) + 1
+            return req
 
     # ------------------------------------------------------------ state
     @property
     def queue_depth(self) -> int:
-        return sum(len(q) for q in self._q.values())
+        with self._lock:
+            return sum(len(q) for q in self._q.values())
 
     def depth_by_model(self) -> dict:
         """{model name: queued requests} — the exported queue-depth
-        gauge's label set. Iterates over SNAPSHOTS (list() copies are
-        atomic under the GIL): a /metrics scrape thread or an admin
-        thread preparing a hot swap reads this while the serving
-        thread mutates the queues."""
+        gauge's label set. Under the scheduler lock: a /metrics scrape
+        thread or an admin thread preparing a hot swap reads this
+        while serving threads submit."""
         out: dict = {}
-        for q in list(self._q.values()):
-            for item in list(q):
-                name = item[2].entry.name
-                out[name] = out.get(name, 0) + 1
+        with self._lock:
+            for q in self._q.values():
+                for item in q:
+                    name = item[2].entry.name
+                    out[name] = out.get(name, 0) + 1
         return out
 
     def pending_entries(self) -> set:
         """Every LoadedModel with queued work — what keeps an old
         version's union group staged across a swap until it drains.
         O(distinct entries) via the maintained refcounts (this is on
-        the per-dispatch path); list() snapshot so an admin thread can
-        call it mid-traffic."""
-        return {e for e, c in list(self._entry_refs.items()) if c > 0}
+        the per-dispatch path)."""
+        with self._lock:
+            return {e for e, c in self._entry_refs.items() if c > 0}
 
     def next_key(self):
         """The group whose head request has the earliest deadline (FIFO
         among equals) — the group the next dispatch should serve. None
         when idle."""
         best_key, best = None, None
-        for key, q in self._q.items():
-            if not q:
-                continue
-            head = q[0][:2]
-            if best is None or head < best:
-                best, best_key = head, key
+        with self._lock:
+            for key, q in self._q.items():
+                if not q:
+                    continue
+                head = q[0][:2]
+                if best is None or head < best:
+                    best, best_key = head, key
         return best_key
 
     # ------------------------------------------------------------- form
@@ -134,6 +149,10 @@ class Scheduler:
         of at most `max_rows` total rows; requests already past their
         deadline are shed into `expired` (they never occupy bucket
         rows). The queue may drain entirely into one call."""
+        with self._lock:
+            return self._form_locked(key, now, max_rows)
+
+    def _form_locked(self, key, now: float, max_rows: int):
         q = self._q.get(key, ())
         batch: list = []
         expired: list = []
@@ -158,6 +177,7 @@ class Scheduler:
         return batch, expired
 
     def _drop_ref(self, req: Request) -> None:
+        # caller holds self._lock (form's pop path)
         self.queue_rows -= req.n
         left = self._entry_refs.get(req.entry, 0) - 1
         if left > 0:

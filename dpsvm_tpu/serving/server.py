@@ -522,7 +522,11 @@ class ServeServer:
                     and not self._tickets[rep]
                     and not eng.scheduler.queue_depth
                     and not eng._dispatcher.busy):
-                self._rep_parked[rep] = True  # drain_replica's signal
+                # drain_replica's signal; under _rep_lock so the park
+                # flag never races resume_replica's reset (threadlint
+                # guarded-by contract: _rep_parked is _rep_lock's).
+                with self._rep_lock:
+                    self._rep_parked[rep] = True
             if not handled and not busy:
                 time.sleep(0.002)  # parked/ineligible: don't spin
         # Final sweep: a frame parsed between the drain's quiescence
@@ -717,10 +721,11 @@ class ServeServer:
                 raise RuntimeError(
                     f"refusing to drain replica {rep}: it is the last "
                     f"live replica (use drain() to stop serving)")
-            already = self._rep_draining[rep]
-            self._rep_draining[rep] = True
-            if not already:
-                self._rep_parked[rep] = False
+            with self._rep_lock:
+                already = self._rep_draining[rep]
+                self._rep_draining[rep] = True
+                if not already:
+                    self._rep_parked[rep] = False
             self._obs.event("drain_replica", phase="begin", replica=rep,
                             queued=self._eng(rep).scheduler.queue_depth)
         deadline = time.monotonic() + timeout_s
@@ -738,8 +743,9 @@ class ServeServer:
             raise ValueError(f"replica {rep} out of range "
                              f"(0..{self._n_rep - 1})")
         with self._life:
-            self._rep_draining[rep] = False
-            self._rep_parked[rep] = False
+            with self._rep_lock:
+                self._rep_draining[rep] = False
+                self._rep_parked[rep] = False
         self._obs.event("resume_replica", replica=rep)
 
     def __enter__(self):

@@ -104,7 +104,7 @@ SEAMS = frozenset({
     "dispatch", "ooc_tile_put", "ckpt_truncate", "swap_corrupt",
     "serve_dispatch", "serve_stall", "nonfinite_obs",
     "net_accept", "net_conn_drop", "net_read_stall",
-    "net_partial_write",
+    "net_partial_write", "lock_stall",
 })
 
 #: how long a fired ``serve_stall`` sleeps (long enough to trip any
@@ -118,6 +118,13 @@ STALL_SECONDS = 5.0
 #: must be provably unaffected, so nothing waits on it). Tests and the
 #: loadgen chaos leg may monkeypatch.
 NET_STALL_SECONDS = 0.5
+
+#: how long a fired ``lock_stall`` holds its caller's lock. The seam
+#: is CALLED INSIDE a critical section (ModelRegistry.get), so this
+#: bounds seeded lock contention: long enough that every contending
+#: thread provably blocks on the lock, short enough that a smoke leg's
+#: wall clock stays sane. Tests may monkeypatch.
+LOCK_STALL_SECONDS = 0.25
 
 _SPEC_RE = re.compile(r"^(?P<seam>[a-z_]+)(@(?P<at>\d+))?(x(?P<times>\d+))?$")
 
@@ -203,6 +210,12 @@ class FaultPlan:
 # string compare.
 _PLAN: Optional[FaultPlan] = None
 _ENV_CACHE: tuple = ("", None)  # (env string, FaultPlan | None)
+# Guards writes to the two module globals above. arrive() runs on pump
+# and watchdog threads; the lock keeps a racing first-touch env parse
+# single-flight (threadlint guarded-by contract: faults._PLAN and
+# faults._ENV_CACHE are protected by faults._plan_lock). The disarmed
+# hot path stays lock-free — a plain tuple read.
+_plan_lock = threading.Lock()
 
 
 def active_plan() -> Optional[FaultPlan]:
@@ -215,7 +228,9 @@ def active_plan() -> Optional[FaultPlan]:
         return None
     if env != _ENV_CACHE[0]:
         seed = int(os.environ.get("DPSVM_FAULTS_SEED", "0"))
-        _ENV_CACHE = (env, FaultPlan.parse(env, seed=seed))
+        with _plan_lock:
+            if env != _ENV_CACHE[0]:  # single-flight parse
+                _ENV_CACHE = (env, FaultPlan.parse(env, seed=seed))
     return _ENV_CACHE[1]
 
 
@@ -224,12 +239,14 @@ def install(plan: Optional[FaultPlan]):
     """Install `plan` as the process-wide active plan for the scope
     (tests). Nesting replaces; exit restores the previous plan."""
     global _PLAN
-    prev = _PLAN
-    _PLAN = plan
+    with _plan_lock:
+        prev = _PLAN
+        _PLAN = plan
     try:
         yield plan
     finally:
-        _PLAN = prev
+        with _plan_lock:
+            _PLAN = prev
 
 
 def arrive(seam: str) -> bool:
@@ -341,6 +358,20 @@ def serve_stall() -> None:
     device dispatch the watchdog must bound."""
     if arrive("serve_stall"):
         time.sleep(STALL_SECONDS)
+
+
+def lock_stall() -> None:
+    """The ``lock_stall`` seam: seeded lock CONTENTION. It is called
+    inside ModelRegistry.get's critical section, so a fired stall
+    holds ModelRegistry._lock for ``LOCK_STALL_SECONDS`` while every
+    other registry caller (submits routing a model, an admin thread
+    preparing a swap, a scrape labelling queue depth) blocks on the
+    lock. The dynamic companion of threadlint's static ORDER contract:
+    with the committed acquired-while-holding graph acyclic, a held
+    lock can delay the fabric but never wedge it — the faults_smoke
+    leg pins exactly that (bounded wall clock, no failed verdicts)."""
+    if arrive("lock_stall"):
+        time.sleep(LOCK_STALL_SECONDS)
 
 
 # The network seams (ISSUE 15). net_accept fires in the SERVER's accept

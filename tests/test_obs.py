@@ -811,3 +811,80 @@ def test_bench_runlog_reconciliation(blobs_small, tmp_path):
     # ... and the field set is empty without obs (no crash, no noise).
     r2 = solve(x, y, SVMConfig(c=2.0, epsilon=1e-3))
     assert bench._runlog_reconciliation(r2, 1.0) == {}
+
+
+# ---------------------------------------------------------------------------
+# MetricsExporter teardown ordering (ISSUE 20 satellite): close() is
+# SERIALIZED — any caller that returns from close() may rely on the
+# socket being unbound and the serving thread joined. The old
+# flag-first idempotence let a second closer return mid-shutdown,
+# so engine teardown proceeded believing the port and thread were
+# gone (the last member of the scrape-during-close race family).
+# ---------------------------------------------------------------------------
+def test_exporter_concurrent_close_serialized():
+    import threading
+    import urllib.request
+
+    from dpsvm_tpu.obs.export import MetricsExporter
+
+    exp = MetricsExporter(lambda: "# EOF\n", port=0)
+    # Prove it is live before the teardown race starts.
+    assert b"# EOF" in urllib.request.urlopen(exp.url,
+                                              timeout=5).read()
+    alive_after_return = []
+    start = threading.Barrier(3)
+
+    def closer():
+        start.wait()
+        exp.close()
+        # THE contract under test: once close() returns to ANY
+        # caller, the serving thread is joined — no caller can
+        # observe a half-torn-down exporter.
+        alive_after_return.append(exp._thread.is_alive())
+
+    ts = [threading.Thread(target=closer, name=f"dpsvm-test-close-{i}")
+          for i in range(3)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert alive_after_return == [False, False, False]
+    exp.close()  # still idempotent after the storm
+
+
+def test_exporter_scrape_during_close_never_wedges():
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from dpsvm_tpu.obs.export import MetricsExporter
+
+    exp = MetricsExporter(lambda: "x 1\n# EOF\n", port=0)
+    stop = threading.Event()
+    outcomes = []
+
+    def scrape_loop():
+        while not stop.is_set():
+            try:
+                urllib.request.urlopen(exp.url, timeout=2).read()
+                outcomes.append("ok")
+            except (urllib.error.URLError, ConnectionError, OSError):
+                outcomes.append("refused")  # post-close is fine
+
+    th = threading.Thread(target=scrape_loop,
+                          name="dpsvm-test-scrape")
+    th.start()
+    try:
+        # Let scrapes land, then tear down mid-traffic.
+        for _ in range(50):
+            if "ok" in outcomes:
+                break
+            import time
+            time.sleep(0.01)
+        exp.close()
+        assert not exp._thread.is_alive()
+    finally:
+        stop.set()
+        th.join(timeout=5)
+    assert not th.is_alive()
+    assert "ok" in outcomes  # at least one scrape answered pre-close

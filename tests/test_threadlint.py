@@ -1,0 +1,242 @@
+"""threadlint (ISSUE 20): the static concurrency-contract analyzer.
+
+Three layers, mirroring tests/test_tpulint.py's discipline for the HLO
+budgets:
+
+* extractor sanity — the fact families over the LIVE tree contain the
+  load-bearing inventory (the serving locks, the five dpsvm- threads,
+  the cross-thread handoffs, the fault seams);
+* contract mechanics — deny-by-default diffing, allow-prefix
+  semantics, byte-deterministic regeneration that preserves allow
+  lists and the handoff->seam map;
+* mutation verification — the analyzer is only evidence if deliberate
+  regressions trip it: a deleted ``with self._lock:`` must surface as
+  GUARDED_BY drift, a reversed nested acquire as an ORDER cycle, an
+  unnamed thread as a LIFECYCLE violation. Mutations are injected via
+  the ``sources`` override; the tree is never touched.
+
+Everything here is host-only (pure AST) — no jax, no devices.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+from pathlib import Path
+
+from dpsvm_tpu.analysis import concurrency_facts as cf
+from dpsvm_tpu.analysis import threadlint as tl
+
+REPO = Path(__file__).resolve().parent.parent
+REGISTRY = "dpsvm_tpu/serving/registry.py"
+EXPORT = "dpsvm_tpu/obs/export.py"
+
+
+def _facts(overrides=None):
+    return cf.extract_concurrency_facts(
+        sources=cf.load_sources(root=REPO, overrides=overrides))
+
+
+def _check(overrides=None):
+    return tl.run_check(
+        sources=cf.load_sources(root=REPO, overrides=overrides))
+
+
+def _verdicts(results):
+    return {r["family"]: r["verdict"] for r in results}
+
+
+# ------------------------------------------------------------------
+# extractor sanity over the live tree
+# ------------------------------------------------------------------
+def test_extractor_finds_the_serving_locks():
+    facts = _facts()
+    locks = facts["guarded_by"]["locks"]
+    for lock in ("Scheduler._lock", "ServeServer._life",
+                 "ServeServer._rep_lock", "ModelRegistry._lock",
+                 "MetricsExporter._close_lock", "faults._plan_lock",
+                 "_NetStats.lock"):
+        assert lock in locks, f"extractor lost {lock}"
+    # The RLock is recorded as such (its self-edges are legal).
+    assert locks["ServeServer._life"]["kind"] == "RLock"
+
+
+def test_extractor_guards_the_fixed_seed_findings():
+    """The seed-run true positives fixed in this PR must now read as
+    guarded: regressing any of them is contract drift, but the facts
+    themselves are the first line of evidence."""
+    attrs = _facts()["guarded_by"]["attrs"]
+    for attr, lock in (
+            ("Scheduler._seq", "Scheduler._lock"),
+            ("Scheduler.queue_rows", "Scheduler._lock"),
+            ("Scheduler._entry_refs", "Scheduler._lock"),
+            ("ServeServer._rep_parked", "ServeServer._rep_lock"),
+            ("ServeServer._rep_draining", "ServeServer._rep_lock"),
+            ("faults._PLAN", "faults._plan_lock"),
+            ("MetricsExporter._closed",
+             "MetricsExporter._close_lock")):
+        f = attrs[attr]
+        assert f["writes_unguarded"] == 0, (attr, f)
+        assert lock in f["locks"], (attr, f)
+
+
+def test_extractor_thread_inventory():
+    threads = _facts()["thread_lifecycle"]["threads"]
+    names = sorted(t["name"] for t in threads.values())
+    assert names == ["dpsvm-dispatch-watchdog", "dpsvm-metrics-*",
+                     "dpsvm-net-accept", "dpsvm-net-pump*",
+                     "dpsvm-net-writer-*"]
+    for site, t in threads.items():
+        assert t["named_ok"], site
+        assert t["daemon"] or t["joined"], site
+
+
+def test_extractor_handoffs_and_seams():
+    sc = _facts()["seam_coverage"]
+    assert "lock_stall" in sc["seams"]  # this PR's fault seam
+    assert ("dpsvm_tpu/serving/server.py::ServeServer._read_loop::"
+            "_inbox.put") in sc["handoffs"]
+
+
+def test_no_lock_order_cycles_in_tree():
+    lo = _facts()["lock_order"]
+    assert lo["cycles"] == []
+    # The committed canonical order covers every lock in the graph.
+    in_edges = {x for e in lo["edges"] for x in e.split(" -> ")}
+    assert in_edges <= set(lo["order"])
+
+
+# ------------------------------------------------------------------
+# contract mechanics
+# ------------------------------------------------------------------
+def test_clean_tree_passes_committed_contracts():
+    code, lines, results = _check()
+    assert code == 0, "\n".join(lines)
+    assert set(_verdicts(results).values()) == {tl.PASS}
+
+
+def test_regeneration_is_deterministic_and_drift_free(tmp_path):
+    """Two regenerations are byte-identical, and both match the
+    committed contracts exactly — the CI drift gate's property."""
+    work = tmp_path / "contracts"
+    shutil.copytree(tl.CONTRACT_DIR, work)
+    srcs = cf.load_sources(root=REPO)
+    tl.write_contracts(sources=srcs, contracts_dir=work)
+    first = {p.name: p.read_bytes() for p in sorted(work.iterdir())}
+    tl.write_contracts(sources=srcs, contracts_dir=work)
+    second = {p.name: p.read_bytes() for p in sorted(work.iterdir())}
+    assert first == second
+    for fam in tl.FAMILIES:
+        committed = (tl.CONTRACT_DIR / f"{fam}.json").read_bytes()
+        assert first[f"{fam}.json"] == committed, fam
+
+
+def test_diff_facts_leaf_semantics():
+    exp = {"a": {"b": 1, "c": [1, 2]}, "d": 4}
+    act = {"a": {"b": 2, "c": [1, 2]}, "e": 5}
+    got = tl.diff_facts(exp, act)
+    assert got == [("a.b", 1, 2), ("d", 4, tl.ABSENT),
+                   ("e", tl.ABSENT, 5)]
+
+
+def test_allow_is_prefix_matched_and_deny_by_default():
+    facts = {"guarded_by": {"locks": {}, "attrs": {}}}
+    contract = {"facts": {"locks": {}, "attrs": {"X.y": 1}},
+                "allow": []}
+    r = tl.check_family("guarded_by", facts, contract)
+    assert r["verdict"] == tl.DRIFT and len(r["denied"]) == 1
+    contract["allow"] = [{"path": "guarded_by.attrs.X.",
+                          "reason": "test"}]
+    r = tl.check_family("guarded_by", facts, contract)
+    assert r["verdict"] == tl.PASS and len(r["allowed"]) == 1
+
+
+def test_missing_contract_fails_closed(tmp_path):
+    code, lines, results = tl.run_check(
+        sources=cf.load_sources(root=REPO),
+        contracts_dir=tmp_path / "nowhere")
+    assert code == 1
+    assert set(_verdicts(results).values()) == {tl.MISSING}
+
+
+def test_unmapped_handoff_is_denied(tmp_path):
+    """Seam coverage is deny-by-default: drop one committed map entry
+    and the corresponding handoff must FAIL the check."""
+    work = tmp_path / "contracts"
+    shutil.copytree(tl.CONTRACT_DIR, work)
+    p = work / "seam_coverage.json"
+    c = json.loads(p.read_text())
+    victim = ("dpsvm_tpu/serving/server.py::ServeServer._read_loop::"
+              "_inbox.put")
+    del c["map"][victim]
+    p.write_text(json.dumps(c, indent=2, sort_keys=True) + "\n")
+    code, lines, results = tl.run_check(
+        sources=cf.load_sources(root=REPO), contracts_dir=work)
+    assert code == 1
+    seam = next(r for r in results if r["family"] == "seam_coverage")
+    assert seam["verdict"] == tl.VIOLATION
+    assert any(victim in rec[0] for rec in seam["denied"])
+
+
+# ------------------------------------------------------------------
+# mutation verification — the analyzer must catch what it claims to
+# ------------------------------------------------------------------
+def test_mutation_deleted_lock_is_guarded_by_drift():
+    """Remove registry.attach_journal's ``with self._lock:`` (the
+    indentation-preserving ``if True:`` swap): the journal-attach
+    writes flip to unguarded and the guarded_by family must fail."""
+    src = (REPO / REGISTRY).read_text()
+    assert src.count("with self._lock:") >= 5
+    mutated = src.replace("with self._lock:", "if True:", 1)
+    code, lines, results = _check({REGISTRY: mutated})
+    assert code == 1
+    v = _verdicts(results)
+    assert v["guarded_by"] != tl.PASS
+    gb = next(r for r in results if r["family"] == "guarded_by")
+    assert any("ModelRegistry._journal" in rec[0]
+               for rec in gb["denied"]), gb["denied"]
+
+
+def test_mutation_reversed_nesting_is_order_cycle():
+    """Inject a pair of methods acquiring _lock/_journal_lock in
+    OPPOSING nested order: the acquired-while-holding graph gains a
+    cycle and the lock_order family must fail with a cycle finding."""
+    src = (REPO / REGISTRY).read_text()
+    anchor = "def __len__(self) -> int:"
+    assert src.count(anchor) == 1
+    mutant = (
+        "def _tl_forward(self):\n"
+        "        with self._lock:\n"
+        "            with self._journal_lock:\n"
+        "                pass\n\n"
+        "    def _tl_backward(self):\n"
+        "        with self._journal_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n\n"
+        "    " + anchor)
+    code, lines, results = _check(
+        {REGISTRY: src.replace(anchor, mutant, 1)})
+    assert code == 1
+    lo = next(r for r in results if r["family"] == "lock_order")
+    assert lo["verdict"] != tl.PASS
+    assert any("cycles" in rec[0] for rec in lo["denied"])
+    # The facts themselves carry the cycle (both locks named in it).
+    facts = _facts({REGISTRY: src.replace(anchor, mutant, 1)})
+    assert any("ModelRegistry._lock" in c
+               and "ModelRegistry._journal_lock" in c
+               for c in facts["lock_order"]["cycles"])
+
+
+def test_mutation_unnamed_thread_is_lifecycle_failure():
+    """Strip the exporter thread's dpsvm- name: the lifecycle family
+    must fail on the naming rule (watchdog-readability contract)."""
+    src = (REPO / EXPORT).read_text()
+    victim = 'name=f"dpsvm-metrics-{self.port}", daemon=True'
+    assert victim in src
+    mutated = src.replace(victim, "daemon=True", 1)
+    code, lines, results = _check({EXPORT: mutated})
+    assert code == 1
+    lf = next(r for r in results if r["family"] == "thread_lifecycle")
+    assert lf["verdict"] != tl.PASS
+    assert any("MetricsExporter.__init__" in rec[0] and
+               rec[0].endswith(".name") for rec in lf["denied"])

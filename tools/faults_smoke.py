@@ -1,7 +1,7 @@
 """Fault-tolerance smoke (ISSUE 13) — `make faults_smoke`, wired into
 tier1.yml.
 
-Four checks, each proving an acceptance behavior with a REAL injected
+Five checks, each proving an acceptance behavior with a REAL injected
 fault (dpsvm_tpu/testing/faults.py), end to end:
 
 1. **Harness self-test** — spec parsing, deterministic arrival firing,
@@ -19,6 +19,11 @@ fault (dpsvm_tpu/testing/faults.py), end to end:
    bounded by ServeConfig.dispatch_timeout_ms, fail with an explicit
    'failed' verdict + counters, and leave the engine serving the next
    batch.
+5. **Lock stall** (ISSUE 20) — DPSVM_FAULTS="lock_stall@N" holds
+   ModelRegistry._lock inside get()'s critical section while other
+   threads contend for it; with the threadlint ORDER contract acyclic
+   the fabric is delayed, never wedged: bounded wall clock, zero
+   watchdog trips, every verdict 'ok'.
 
 Runs on the CPU harness (JAX_PLATFORMS=cpu), no artifacts written;
 exit 0 = all behaviors held.
@@ -286,11 +291,89 @@ def check_watchdog() -> None:
           "kept serving OK")
 
 
+def check_lock_stall() -> None:
+    """The lock_stall seam (ISSUE 20): DPSVM_FAULTS-armed contention
+    on ModelRegistry._lock — the stall holds the registry's critical
+    section while other threads hammer the same lock. With the
+    committed acquired-while-holding graph acyclic (threadlint's ORDER
+    contract), a held lock delays the fabric but can never wedge it:
+    wall clock stays bounded, the watchdog never trips, verdicts stay
+    'ok' and the answers stay right."""
+    import threading
+
+    from dpsvm_tpu.config import ServeConfig, SVMConfig
+    from dpsvm_tpu.models.multiclass import train_multiclass
+    from dpsvm_tpu.serving import ServingEngine
+    from dpsvm_tpu.testing import faults
+
+    rng = np.random.default_rng(9)
+    x = np.concatenate([
+        rng.normal(size=(60, 4)).astype(np.float32) + off
+        for off in (0.0, 2.5)])
+    y = np.repeat([0, 1], 60)
+    model, _ = train_multiclass(
+        x, y, SVMConfig(c=2.0, gamma=0.5, epsilon=1e-3), strategy="ovr")
+
+    eng = ServingEngine(ServeConfig(buckets=(16, 64),
+                                    dispatch_timeout_ms=2000.0))
+    eng.register("m", model)
+    q = np.asarray(x[:12], np.float32)
+    ref = eng.decision(q)  # healthy baseline, seam disarmed
+
+    stalls = 3
+    os.environ["DPSVM_FAULTS"] = f"lock_stall@1x{stalls}"
+    try:
+        stop = threading.Event()
+
+        def contend():
+            # Read-only registry/scheduler callers — exactly who the
+            # fired stall makes wait on ModelRegistry._lock.
+            while not stop.is_set():
+                eng.registry.get("m")
+                eng.scheduler.depth_by_model()
+
+        readers = [threading.Thread(target=contend,
+                                    name=f"dpsvm-test-contend-{i}")
+                   for i in range(2)]
+        for th in readers:
+            th.start()
+        t0 = time.perf_counter()
+        tickets = [eng.submit(q, model="m") for _ in range(4)]
+        done = eng.drain()
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        for th in readers:
+            th.join(timeout=10)
+            assert not th.is_alive(), "reader wedged on the stall"
+        plan = faults.active_plan()
+        assert plan is not None and plan.fired["lock_stall"] >= 1, \
+            "lock_stall never fired"
+        fired = plan.fired["lock_stall"]
+        # Bounded: the stalls serialize, they do not deadlock. Budget
+        # = every fired stall back-to-back + generous slack.
+        bound = fired * faults.LOCK_STALL_SECONDS + 5.0
+        assert elapsed < bound, f"not bounded: {elapsed:.2f}s"
+        assert eng.watchdog_trips.value == 0, \
+            "lock contention must not read as a wedged dispatch"
+        for t in tickets:
+            res = done[t]
+            assert res.verdict == "ok", res
+            np.testing.assert_array_equal(res.decision, ref)
+    finally:
+        del os.environ["DPSVM_FAULTS"]
+    eng.close()
+    print(f"[faults_smoke] lock_stall fired {fired}x "
+          f"({faults.LOCK_STALL_SECONDS:.2f}s each holding "
+          f"ModelRegistry._lock), fabric bounded in {elapsed:.2f}s, "
+          "0 watchdog trips, all verdicts ok")
+
+
 def main() -> int:
     check_harness()
     check_ooc_kill_resume()
     check_ooc_mesh_kill_resume()
     check_watchdog()
+    check_lock_stall()
     print("[faults_smoke] ALL OK")
     return 0
 

@@ -82,12 +82,12 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
             # The one-HBM-pass round (ISSUE 12): same padding contract
             # as the fused engine; the --fused-round A/B differences
             # this against the stock fused ablation.
-            run = lambda st, n: run_chunk_block_fusedround(
+            run = lambda st, n, inner=inner: run_chunk_block_fusedround(
                 xd, yd, x_sq, k_diag, valid, st, jnp.int32(10 ** 9), kp,
                 cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
                 n, inner_impl=impl, interpret=not on_tpu)
         elif fused:
-            run = lambda st, n: run_chunk_block_fused(
+            run = lambda st, n, inner=inner: run_chunk_block_fused(
                 xd, yd, x_sq, k_diag, valid, st, jnp.int32(10 ** 9), kp,
                 cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
                 n, inner_impl=impl, interpret=not on_tpu)
@@ -97,13 +97,13 @@ def ablate(xd, yd, x_sq, k_diag, kp, cfg, q: int, reps: int,
             # pallas_select rides the fused padding contract when the
             # caller padded (valid is not None); TPU only — in interpret
             # mode the per-round kernel would dominate everything.
-            run = lambda st, n: run_chunk_block_pipelined(
+            run = lambda st, n, inner=inner: run_chunk_block_pipelined(
                 xd, yd, x_sq, k_diag, valid, st, jnp.int32(10 ** 9), kp,
                 cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
                 n, inner_impl=impl, interpret=not on_tpu,
                 pallas_select=valid is not None and on_tpu)
         else:
-            run = lambda st, n: run_chunk_block(
+            run = lambda st, n, inner=inner: run_chunk_block(
                 xd, yd, x_sq, k_diag, None, st, jnp.int32(10 ** 9), kp,
                 cfg.c_bounds(), _BUDGET_EPS, float(cfg.tau), q, inner,
                 n, inner_impl=impl)

@@ -7,12 +7,22 @@ dtype-promotion leaks, rank-3 kernel products, donation misses,
 recompile hazards), and diffs them against the checked-in budgets in
 dpsvm_tpu/analysis/budgets/*.json.
 
+`--threads` switches to the threadlint surface (ISSUE 20): the same
+deny-by-default contract discipline pointed at the serving layer's
+CONCURRENCY instead of its HLO — guarded-by, lock-order, thread-
+lifecycle, and seam-coverage facts diffed against
+dpsvm_tpu/analysis/contracts/*.json. Pure AST, no jax import: the
+threads check runs on a bare Python, which is why the routing below
+happens BEFORE the budget module (and therefore jax) is imported.
+
 Usage:
     python -m tools.tpulint --check           # CI / pre-merge gate
     python -m tools.tpulint --write-budgets   # after an INTENTIONAL
                                               # structural change;
                                               # commit the JSON diff
     python -m tools.tpulint --check --entries mesh_chunk,serve_bucket
+    python -m tools.tpulint --threads --check # concurrency contracts
+    python -m tools.tpulint --threads --write-contracts
 
 Exit status: 0 iff every checked entrypoint PASSes its budget.
 
@@ -22,9 +32,36 @@ GEMV kernel rows, no host round-trips) is checkable on every CI run.
 """
 
 import sys
+from pathlib import Path
+
+
+def _threadlint_module():
+    """The threadlint module, importable even without jax: the
+    dpsvm_tpu package __init__ pulls jax, so fall back to loading the
+    analyzer file directly (it is stdlib-only by design)."""
+    try:
+        from dpsvm_tpu.analysis import threadlint
+        return threadlint
+    except Exception:
+        import importlib.util
+
+        path = (Path(__file__).resolve().parent.parent
+                / "dpsvm_tpu" / "analysis" / "threadlint.py")
+        spec = importlib.util.spec_from_file_location(
+            "dpsvm_threadlint", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--threads" in argv:
+        # Route BEFORE any dpsvm_tpu/jax import — the concurrency
+        # contracts are host-source facts and must stay checkable on
+        # an interpreter with no accelerator stack at all.
+        argv.remove("--threads")
+        return _threadlint_module().run_threadlint(argv)
     # Backend forcing (CPU platform, the manifest's virtual device
     # count) lives in ONE place — budget._force_cpu_backend, which
     # run_lint applies before any jax backend initialization.
